@@ -1,0 +1,24 @@
+"""fantoch_tpu — a TPU-native framework for evaluating planet-scale
+consensus protocols.
+
+Built from scratch with the capability set of the reference ``fantoch``
+(see SURVEY.md): five consensus protocols (Tempo, Atlas, EPaxos, FPaxos,
+Caesar) behind one Protocol/Executor boundary, a protocol-agnostic
+discrete-event simulator over real inter-region latency data, workload
+generation, metrics, and plotting — with the simulation core re-designed as
+a batched, fixed-shape JAX step function that advances thousands of
+configurations in lockstep on TPU (``fantoch_tpu.engine``).
+
+Layers:
+- ``core``     — L0 foundation (ids, commands, kvs, config, planet, time,
+                 metrics)
+- ``client``   — workload generation and closed-loop clients
+- ``protocol`` — protocol abstraction + oracle implementations
+- ``executor`` — execution abstraction + per-protocol executors
+- ``sim``      — host discrete-event runner (the differential-test oracle)
+- ``engine``   — the batched TPU engine (vmap/pjit over config sweeps)
+- ``bote``     — closed-form latency modeling and config search
+- ``plot``     — result plotting
+"""
+
+__version__ = "0.1.0"

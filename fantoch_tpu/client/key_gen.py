@@ -1,0 +1,96 @@
+"""Workload key generators.
+
+Capability parity with ``fantoch/src/client/key_gen.rs``: two generators —
+``ConflictPool`` (with probability ``conflict_rate``% pick a random key from
+a shared pool of ``CONFLICT<i>`` keys, otherwise use the client's private
+key; key_gen.rs:96-110) and ``Zipf`` over a fixed key universe
+(key_gen.rs:113-119).
+
+Unlike the reference (which draws from a global ``thread_rng``), generators
+here draw from an explicit ``random.Random`` so simulations are
+reproducible; the device engine uses counter-based ``jax.random`` with the
+same distributions.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Optional, Union
+
+import numpy as np
+
+from ..core.ids import ClientId
+from ..core.kvs import Key
+
+CONFLICT_COLOR = "CONFLICT"
+
+
+@dataclass(frozen=True)
+class ConflictPool:
+    conflict_rate: int  # percentage 0..=100
+    pool_size: int = 1
+
+    def __str__(self) -> str:
+        return f"conflict_{self.conflict_rate}_{self.pool_size}"
+
+
+@dataclass(frozen=True)
+class Zipf:
+    coefficient: float
+    total_keys_per_shard: int
+
+    def __str__(self) -> str:
+        return f"zipf_{self.coefficient:.2f}_{self.total_keys_per_shard}".replace(
+            ".", "-"
+        )
+
+
+KeyGen = Union[ConflictPool, Zipf]
+
+
+def zipf_weights(key_count: int, coefficient: float) -> np.ndarray:
+    """P(k) ∝ 1 / k^coefficient for k in 1..=key_count, matching the zipf
+    crate used by the reference (client/key_gen.rs:62-77)."""
+    ranks = np.arange(1, key_count + 1, dtype=np.float64)
+    weights = 1.0 / np.power(ranks, coefficient)
+    return weights / weights.sum()
+
+
+class KeyGenState:
+    """Per-client generator state (key_gen.rs:54-120)."""
+
+    def __init__(self, key_gen: KeyGen, shard_count: int, client_id: ClientId,
+                 rng: Optional[random.Random] = None):
+        self.key_gen = key_gen
+        self.client_id = client_id
+        self.rng = rng if rng is not None else random.Random()
+        if isinstance(key_gen, Zipf):
+            key_count = key_gen.total_keys_per_shard * shard_count
+            self._zipf_cum = np.cumsum(
+                zipf_weights(key_count, key_gen.coefficient)
+            )
+        else:
+            self._zipf_cum = None
+
+    def gen_cmd_key(self) -> Key:
+        kg = self.key_gen
+        if isinstance(kg, ConflictPool):
+            if true_if_random_is_less_than(kg.conflict_rate, self.rng):
+                return f"{CONFLICT_COLOR}{self.rng.randrange(kg.pool_size)}"
+            return str(self.client_id)
+        # zipf: sample rank in 1..=key_count
+        u = self.rng.random()
+        rank = int(np.searchsorted(self._zipf_cum, u, side="right")) + 1
+        return str(rank)
+
+
+def true_if_random_is_less_than(
+    percentage: int, rng: random.Random
+) -> bool:
+    """key_gen.rs:122-128."""
+    if percentage == 0:
+        return False
+    if percentage == 100:
+        return True
+    return rng.randrange(100) < percentage

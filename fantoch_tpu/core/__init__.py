@@ -1,0 +1,29 @@
+"""L0 foundation: ids, commands, KV store, config, time, planet, metrics.
+
+Mirrors the capability set of the reference's ``fantoch`` core modules
+(fantoch/src/lib.rs:1-91) in host Python; array-world exports (latency
+matrices, bucketed histograms) feed the device engine in
+``fantoch_tpu.engine``.
+"""
+
+from .command import Command, CommandResult, CommandResultBuilder, DEFAULT_SHARD_ID
+from .config import Config
+from .ids import (
+    ClientId,
+    Dot,
+    DotGen,
+    Id,
+    IdGen,
+    ProcessId,
+    Rifl,
+    RiflGen,
+    ShardId,
+    all_process_ids,
+    dots,
+    process_ids,
+)
+from .kvs import DELETE, GET, PUT, ExecutionOrderMonitor, Key, KVStore, Value
+from .metrics import Histogram, Metrics
+from .planet import Planet, Region
+from .timing import RunTime, SimTime, SysTime
+from .util import closest_process_per_shard, key_hash, sort_processes_by_distance

@@ -1,0 +1,12 @@
+"""Execution layer (reference: ``fantoch/src/executor/`` and
+``fantoch_ps/src/executor/``)."""
+
+from .base import (
+    AggregatePending,
+    BasicExecutionInfo,
+    BasicExecutor,
+    Executor,
+    ExecutorMetrics,
+    ExecutorMetricsKind,
+    ExecutorResult,
+)

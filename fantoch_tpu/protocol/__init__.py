@@ -1,0 +1,19 @@
+"""Protocol layer: abstraction + oracle implementations.
+
+Reference: ``fantoch/src/protocol/`` (abstraction) and
+``fantoch_ps/src/protocol/`` (Tempo, Atlas, EPaxos, FPaxos, Caesar).
+"""
+
+from .base import (
+    Action,
+    BaseProcess,
+    CommandsInfo,
+    GCTrack,
+    Message,
+    Protocol,
+    ProtocolMetrics,
+    ProtocolMetricsKind,
+    ToForward,
+    ToSend,
+)
+from .basic import Basic

@@ -1,0 +1,5 @@
+"""Discrete-event simulation driver (reference: ``fantoch/src/sim/``)."""
+
+from .runner import Runner
+from .schedule import Schedule
+from .simulation import Simulation

@@ -1,0 +1,335 @@
+"""Deterministic discrete-event simulation runner (the oracle).
+
+Capability parity with ``fantoch/src/sim/runner.rs``: wires planet + config
++ workload into processes/executors/clients (runner.rs:64-190), runs the
+event loop over submit/send/periodic actions (runner.rs:233-313), models
+message delay as half the ping latency (runner.rs:575-595) with optional
+symmetric distances and random reordering (×U(0,10), runner.rs:520-524),
+and reports per-process protocol/executor metrics, execution-order
+monitors, and per-region latency histograms (runner.rs:597-681).
+
+This host runner advances ONE configuration at a time and is the
+differential-test oracle for the batched device engine in
+``fantoch_tpu.engine``, which advances thousands of configurations in
+lockstep under ``vmap``.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Tuple, Type
+
+from ..client.client import Client
+from ..client.workload import Workload
+from ..core.command import Command, CommandResult
+from ..core.config import Config
+from ..core.ids import ClientId, ProcessId, ShardId
+from ..core.metrics import Histogram
+from ..core.planet import Planet
+from ..core.util import closest_process_per_shard, sort_processes_by_distance
+from ..executor.base import Executor
+from ..protocol.base import Protocol, ToForward, ToSend
+from .schedule import Schedule
+from .simulation import Simulation
+
+# schedule action kinds
+_SUBMIT = 0
+_SEND = 1
+_TO_CLIENT = 2
+_PERIODIC = 3
+_EXECUTED_NOTIFICATION = 4
+
+
+class Runner:
+    def __init__(
+        self,
+        protocol_cls: Type[Protocol],
+        planet: Planet,
+        config: Config,
+        workload: Workload,
+        clients_per_process: int,
+        process_regions: List[str],
+        client_regions: List[str],
+        seed: int = 0,
+    ):
+        assert len(process_regions) == config.n
+        assert config.gc_interval_ms is not None
+
+        self.planet = planet
+        self.simulation = Simulation()
+        self.schedule: Schedule = Schedule()
+        self.process_to_region: Dict[ProcessId, str] = {}
+        self.client_to_region: Dict[ClientId, str] = {}
+        self.make_distances_symmetric = False
+        self.reorder_messages = False
+        self.rng = random.Random(seed)
+
+        # single shard in the simulator (runner.rs:84-85)
+        shard_id: ShardId = 0
+        from ..core.ids import process_ids
+
+        to_discover = [
+            (process_id, shard_id, region)
+            for region, process_id in zip(
+                process_regions, process_ids(shard_id, config.n)
+            )
+        ]
+        self.process_to_region = {
+            pid: region for pid, _, region in to_discover
+        }
+
+        periodic: List[Tuple[ProcessId, object, int]] = []
+        executed_notifications: List[Tuple[ProcessId, int]] = []
+
+        executor_cls = protocol_cls.EXECUTOR  # type: ignore[attr-defined]
+        for process_id, shard, region in to_discover:
+            process = protocol_cls(process_id, shard, config)
+            for event, delay in process.periodic_events():
+                periodic.append((process_id, event, delay))
+            executed_notifications.append(
+                (process_id, config.executor_executed_notification_interval_ms)
+            )
+            sorted_ = sort_processes_by_distance(
+                region, planet, to_discover
+            )
+            connect_ok, _ = process.discover(sorted_)
+            assert connect_ok
+            executor = executor_cls(process_id, shard, config)
+            self.simulation.register_process(process, executor)
+
+        client_id = 0
+        for region in client_regions:
+            for _ in range(clients_per_process):
+                client_id += 1
+                client = Client(
+                    client_id,
+                    workload,
+                    rng=random.Random(self.rng.randrange(2**63)),
+                )
+                closest = closest_process_per_shard(
+                    region, planet, to_discover
+                )
+                client.connect(closest)
+                self.simulation.register_client(client)
+                self.client_to_region[client_id] = region
+        self.client_count = client_id
+
+        for process_id, event, delay in periodic:
+            self._schedule_periodic(process_id, event, delay)
+        for process_id, delay in executed_notifications:
+            self._schedule_executed_notification(process_id, delay)
+
+    # ------------------------------------------------------------------
+
+    def run(
+        self, extra_sim_time_ms: Optional[int] = None
+    ) -> Tuple[dict, dict, Dict[str, Tuple[int, Histogram]]]:
+        for client_id, process_id, cmd in self.simulation.start_clients():
+            self._schedule_submit(("client", client_id), process_id, cmd)
+
+        self._simulation_loop(extra_sim_time_ms)
+
+        return (
+            self._metrics(),
+            self._executors_monitors(),
+            self._clients_latencies(),
+        )
+
+    def _simulation_loop(self, extra_sim_time_ms: Optional[int]) -> None:
+        clients_done = 0
+        final_time: Optional[int] = None
+        time = self.simulation.time
+        while True:
+            action = self.schedule.next_action(time)
+            assert action is not None, (
+                "there should be a new action since stability is always"
+                " running"
+            )
+            kind = action[0]
+            if kind == _PERIODIC:
+                _, process_id, event, delay = action
+                self._handle_periodic(process_id, event, delay)
+            elif kind == _EXECUTED_NOTIFICATION:
+                _, process_id, delay = action
+                self._handle_executed_notification(process_id, delay)
+            elif kind == _SUBMIT:
+                _, process_id, cmd = action
+                self._handle_submit(process_id, cmd)
+            elif kind == _SEND:
+                _, from_, from_shard_id, process_id, msg = action
+                self._handle_send(from_, from_shard_id, process_id, msg)
+            elif kind == _TO_CLIENT:
+                _, client_id, cmd_result = action
+                submit = self.simulation.forward_to_client(cmd_result)
+                if submit is not None:
+                    process_id, cmd = submit
+                    self._schedule_submit(
+                        ("client", client_id), process_id, cmd
+                    )
+                else:
+                    clients_done += 1
+                    if clients_done == self.client_count:
+                        if extra_sim_time_ms is None:
+                            return
+                        final_time = time.millis() + extra_sim_time_ms
+            if final_time is not None and time.millis() > final_time:
+                return
+
+    # -- action handlers (runner.rs:315-377) ----------------------------
+
+    def _handle_periodic(self, process_id, event, delay) -> None:
+        process, _, _, time = self.simulation.get_process(process_id)
+        process.handle_event(event, time)
+        self._send_to_processes_and_executors(process_id)
+        self._schedule_periodic(process_id, event, delay)
+
+    def _handle_executed_notification(self, process_id, delay) -> None:
+        process, executor, _, time = self.simulation.get_process(process_id)
+        executed = executor.executed(time)
+        if executed is not None:
+            process.handle_executed(executed, time)
+            self._send_to_processes_and_executors(process_id)
+        self._schedule_executed_notification(process_id, delay)
+
+    def _handle_submit(self, process_id: ProcessId, cmd: Command) -> None:
+        process, _executor, pending, time = self.simulation.get_process(
+            process_id
+        )
+        pending.wait_for(cmd)
+        process.submit(None, cmd, time)
+        self._send_to_processes_and_executors(process_id)
+
+    def _handle_send(self, from_, from_shard_id, process_id, msg) -> None:
+        process, _, _, time = self.simulation.get_process(process_id)
+        process.handle(from_, from_shard_id, msg, time)
+        self._send_to_processes_and_executors(process_id)
+
+    def _send_to_processes_and_executors(self, process_id: ProcessId) -> None:
+        """runner.rs:395-441."""
+        process, executor, pending, time = self.simulation.get_process(
+            process_id
+        )
+        shard_id = process.shard_id()
+
+        protocol_actions = process.to_processes()
+
+        ready: List[CommandResult] = []
+        for info in process.to_executors():
+            executor.handle(info, time)
+            # executor messages to self (single shard in sim)
+            for to_shard, self_info in executor.to_executors():
+                assert to_shard == shard_id
+                executor.handle(self_info, time)
+            for executor_result in executor.to_clients():
+                cmd_result = pending.add_executor_result(executor_result)
+                if cmd_result is not None:
+                    ready.append(cmd_result)
+
+        self._schedule_protocol_actions(
+            process_id, shard_id, ("process", process_id), protocol_actions
+        )
+        for cmd_result in ready:
+            self._schedule_to_client(("process", process_id), cmd_result)
+
+    def _schedule_protocol_actions(
+        self, process_id, shard_id, from_region, actions
+    ) -> None:
+        """runner.rs:444-488; self-messages and ToForward are delivered
+        immediately (recursively)."""
+        for action in actions:
+            if isinstance(action, ToSend):
+                for to in action.target:
+                    if to == process_id:
+                        self._handle_send(
+                            process_id, shard_id, process_id, action.msg
+                        )
+                    else:
+                        self._schedule_message(
+                            from_region,
+                            ("process", to),
+                            (_SEND, process_id, shard_id, to, action.msg),
+                        )
+            elif isinstance(action, ToForward):
+                self._handle_send(process_id, shard_id, process_id, action.msg)
+            else:
+                raise TypeError(f"unsupported action {action!r}")
+
+    # -- scheduling (runner.rs:379-557) ---------------------------------
+
+    def _schedule_submit(self, from_region, process_id, cmd) -> None:
+        self._schedule_message(
+            from_region, ("process", process_id), (_SUBMIT, process_id, cmd)
+        )
+
+    def _schedule_to_client(self, from_region, cmd_result) -> None:
+        client_id = cmd_result.rifl.source
+        self._schedule_message(
+            from_region,
+            ("client", client_id),
+            (_TO_CLIENT, client_id, cmd_result),
+        )
+
+    def _schedule_message(self, from_region, to_region, action) -> None:
+        from_ = self._compute_region(from_region)
+        to = self._compute_region(to_region)
+        distance = self._distance(from_, to)
+        if self.reorder_messages:
+            distance = int(distance * self.rng.uniform(0.0, 10.0))
+        self.schedule.schedule(self.simulation.time, distance, action)
+
+    def _schedule_periodic(self, process_id, event, delay) -> None:
+        self.schedule.schedule(
+            self.simulation.time, delay, (_PERIODIC, process_id, event, delay)
+        )
+
+    def _schedule_executed_notification(self, process_id, delay) -> None:
+        self.schedule.schedule(
+            self.simulation.time,
+            delay,
+            (_EXECUTED_NOTIFICATION, process_id, delay),
+        )
+
+    def _compute_region(self, message_region) -> str:
+        kind, id_ = message_region
+        if kind == "process":
+            return self.process_to_region[id_]
+        return self.client_to_region[id_]
+
+    def _distance(self, from_: str, to: str) -> int:
+        """Half the ping latency (runner.rs:575-595)."""
+        from_to = self.planet.ping_latency(from_, to)
+        assert from_to is not None
+        if self.make_distances_symmetric:
+            to_from = self.planet.ping_latency(to, from_)
+            assert to_from is not None
+            ping = (from_to + to_from) // 2
+        else:
+            ping = from_to
+        return ping // 2
+
+    # -- outputs (runner.rs:597-681) ------------------------------------
+
+    def _metrics(self) -> dict:
+        out = {}
+        for process_id in self.process_to_region:
+            process, executor, _, _ = self.simulation.get_process(process_id)
+            out[process_id] = (process.metrics(), executor.metrics())
+        return out
+
+    def _executors_monitors(self) -> dict:
+        out = {}
+        for process_id in self.process_to_region:
+            _, executor, _, _ = self.simulation.get_process(process_id)
+            out[process_id] = executor.monitor()
+        return out
+
+    def _clients_latencies(self) -> Dict[str, Tuple[int, Histogram]]:
+        out: Dict[str, Tuple[int, Histogram]] = {}
+        for client_id, region in self.client_to_region.items():
+            client, _ = self.simulation.get_client(client_id)
+            issued, histogram = out.get(region, (0, Histogram()))
+            issued += client.issued_commands()
+            for latency_us in client.data.latency_data():
+                histogram.increment(latency_us // 1000)
+            out[region] = (issued, histogram)
+        return out

@@ -1,0 +1,40 @@
+"""Discrete-event schedule (min-heap on milliseconds).
+
+Capability parity with ``fantoch/src/sim/schedule.rs``: schedule actions at
+``now + delay`` and pop them in time order, advancing the simulated clock.
+Unlike the reference's BinaryHeap (which breaks same-time ties arbitrarily,
+schedule.rs:109-119), ties here break by insertion order, making runs
+bit-reproducible — a property the device engine's differential tests rely
+on.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Generic, List, Optional, Tuple, TypeVar
+
+from ..core.timing import SimTime
+
+A = TypeVar("A")
+
+
+class Schedule(Generic[A]):
+    def __init__(self) -> None:
+        self._heap: List[Tuple[int, int, A]] = []
+        self._seq = 0
+
+    def schedule(self, time: SimTime, delay_ms: int, action: A) -> None:
+        self._seq += 1
+        heapq.heappush(
+            self._heap, (time.millis() + delay_ms, self._seq, action)
+        )
+
+    def next_action(self, time: SimTime) -> Optional[A]:
+        if not self._heap:
+            return None
+        schedule_time, _, action = heapq.heappop(self._heap)
+        time.set_millis(schedule_time)
+        return action
+
+    def __len__(self) -> int:
+        return len(self._heap)

@@ -1,0 +1,15 @@
+"""Test configuration.
+
+Device-engine tests run on a virtual 8-device CPU mesh so multi-chip
+sharding is exercised without TPU hardware; this must be set before jax is
+imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,34 @@
+"""Quorum-size formula tests (mirrors fantoch/src/config.rs:461-549)."""
+
+from fantoch_tpu.core import Config
+
+
+def test_basic_parameters():
+    assert Config(7, 1).basic_quorum_size() == 2
+    assert Config(7, 2).basic_quorum_size() == 3
+    assert Config(7, 3).basic_quorum_size() == 4
+
+
+def test_atlas_parameters():
+    assert Config(7, 1).atlas_quorum_sizes() == (4, 2)
+    assert Config(7, 2).atlas_quorum_sizes() == (5, 3)
+    assert Config(7, 3).atlas_quorum_sizes() == (6, 4)
+
+
+def test_epaxos_parameters():
+    ns = [3, 5, 7, 9, 11, 13, 15, 17]
+    expected = [(2, 2), (3, 3), (5, 4), (6, 5), (8, 6), (9, 7), (11, 8), (12, 9)]
+    assert [Config(n, 0).epaxos_quorum_sizes() for n in ns] == expected
+
+
+def test_caesar_parameters():
+    ns = [3, 5, 7, 9, 11]
+    expected = [(3, 2), (4, 3), (6, 4), (7, 5), (9, 6)]
+    assert [Config(n, 0).caesar_quorum_sizes() for n in ns] == expected
+
+
+def test_tempo_parameters():
+    assert Config(7, 1).tempo_quorum_sizes() == (4, 2, 4)
+    assert Config(7, 2).tempo_quorum_sizes() == (5, 3, 4)
+    assert Config(7, 1, tempo_tiny_quorums=True).tempo_quorum_sizes() == (2, 2, 6)
+    assert Config(7, 2, tempo_tiny_quorums=True).tempo_quorum_sizes() == (4, 3, 5)

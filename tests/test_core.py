@@ -1,0 +1,168 @@
+"""L0 tests: ids, commands, kvs, histogram, workload/key-gen statistics.
+
+Mirrors the co-located unit tests in fantoch/src/{id,command,kvs}.rs,
+metrics/histogram.rs and client/workload.rs.
+"""
+
+import random
+
+from fantoch_tpu.client import Client, ConflictPool, Workload, Zipf
+from fantoch_tpu.core import (
+    Command,
+    DotGen,
+    Histogram,
+    KVStore,
+    Rifl,
+    RiflGen,
+    SimTime,
+    process_ids,
+)
+from fantoch_tpu.core.kvs import GET, PUT
+
+
+def test_ids():
+    gen = DotGen(3)
+    assert (gen.next_id().source, gen.next_id().sequence) == (3, 2)
+    assert process_ids(0, 3) == [1, 2, 3]
+    assert process_ids(1, 3) == [4, 5, 6]
+    assert process_ids(3, 3) == [10, 11, 12]
+    assert process_ids(2, 5) == [11, 12, 13, 14, 15]
+
+
+def test_dot_target_shard():
+    from fantoch_tpu.core import Dot
+
+    n = 3
+    assert Dot(1, 1).target_shard(n) == 0
+    assert Dot(3, 1).target_shard(n) == 0
+    assert Dot(4, 1).target_shard(n) == 1
+    assert Dot(6, 7).target_shard(n) == 1
+
+
+def test_command_conflicts():
+    # mirrors command.rs:294-338
+    rifl = Rifl(1, 1)
+    cmd_a = Command(rifl, {0: {"A": [(GET,)]}})
+    cmd_b = Command(rifl, {0: {"B": [(GET,)]}})
+    cmd_ab = Command(rifl, {0: {"A": [(GET,)], "B": [(GET,)]}})
+    assert not cmd_a.conflicts(cmd_b)
+    assert cmd_a.conflicts(cmd_ab)
+    assert cmd_b.conflicts(cmd_ab)
+    assert cmd_a.conflicts(cmd_a)
+
+
+def test_kvs_flow():
+    # mirrors kvs.rs:86-158
+    store = KVStore()
+    rifl = Rifl(1, 1)
+    assert store.execute("x", [(GET,)], rifl) == [None]
+    assert store.execute("x", [(PUT, "a")], rifl) == [None]
+    assert store.execute("x", [(GET,)], rifl) == ["a"]
+    assert store.execute("x", [(PUT, "b")], rifl) == ["a"]
+    assert store.execute("x", [(GET,)], rifl) == ["b"]
+
+
+def test_command_execute():
+    store = KVStore()
+    rifl = Rifl(1, 1)
+    cmd = Command(rifl, {0: {"x": [(PUT, "v")], "y": [(GET,)]}})
+    result = cmd.execute(0, store)
+    assert result.rifl == rifl
+    assert result.results == {"x": [None], "y": [None]}
+
+
+def test_histogram():
+    h = Histogram.from_values([10, 20, 30])
+    assert h.mean() == 20.0
+    assert h.count() == 3
+    assert h.percentile(0.5) == 20.0
+    assert h.percentile(0.99) == 30.0
+    h2 = Histogram.from_values([10] * 100)
+    assert h2.cov() == 0.0
+
+
+def test_histogram_from_buckets():
+    import numpy as np
+
+    buckets = np.zeros(100, dtype=np.int64)
+    buckets[10] = 2
+    buckets[50] = 2
+    h = Histogram.from_buckets(buckets)
+    assert h.mean() == 30.0
+    assert h.count() == 4
+
+
+def test_conflict_rate_statistics():
+    # mirrors workload.rs:351-398 (reduced sample size)
+    for conflict_rate in (1, 2, 10, 50):
+        total = 200_000
+        workload = Workload(
+            shard_count=1,
+            key_gen=ConflictPool(conflict_rate=conflict_rate, pool_size=1),
+            keys_per_command=1,
+            commands_per_client=total,
+            payload_size=0,
+        )
+        rifl_gen = RiflGen(1)
+        state = workload.initial_state(1, random.Random(7))
+        conflicts = 0
+        while True:
+            nxt = workload.next_cmd(rifl_gen, state)
+            if nxt is None:
+                break
+            _, cmd = nxt
+            if cmd.keys(0) == ["CONFLICT0"]:
+                conflicts += 1
+        percentage = conflicts * 100 / total
+        assert round(percentage) == conflict_rate
+
+
+def test_zipf_keygen():
+    workload = Workload(
+        shard_count=1,
+        key_gen=Zipf(coefficient=1.0, total_keys_per_shard=100),
+        keys_per_command=2,
+        commands_per_client=1000,
+        payload_size=0,
+    )
+    rifl_gen = RiflGen(1)
+    state = workload.initial_state(1, random.Random(7))
+    seen = set()
+    while True:
+        nxt = workload.next_cmd(rifl_gen, state)
+        if nxt is None:
+            break
+        _, cmd = nxt
+        keys = cmd.keys(0)
+        assert len(keys) == 2 and len(set(keys)) == 2
+        seen.update(int(k) for k in keys)
+    assert min(seen) >= 1 and max(seen) <= 100
+    # zipf(1.0) concentrates on low ranks
+    assert 1 in seen
+
+
+def test_client_flow():
+    # mirrors client/mod.rs:234-302
+    workload = Workload(
+        shard_count=1,
+        key_gen=ConflictPool(conflict_rate=100, pool_size=1),
+        keys_per_command=1,
+        commands_per_client=2,
+        payload_size=100,
+    )
+    client = Client(1, workload, rng=random.Random(0))
+    client.connect({0: 2})
+    time = SimTime()
+    shard, cmd = client.cmd_send(time)
+    assert client.shard_process(shard) == 2
+    time.add_millis(10)
+    client.cmd_recv(cmd.rifl, time)
+    nxt = client.cmd_send(time)
+    assert nxt is not None
+    _, cmd = nxt
+    time.add_millis(5)
+    client.cmd_recv(cmd.rifl, time)
+    assert client.cmd_send(time) is None
+    assert client.finished()
+    assert sorted(client.data.latency_data()) == [5000, 10000]
+    assert client.data.throughput_data() == [(10, 1), (15, 1)]

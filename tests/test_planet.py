@@ -1,0 +1,84 @@
+"""Planet/latency-data tests (mirrors fantoch/src/planet/mod.rs:180-301 and
+planet/dat.rs:111-155)."""
+
+import numpy as np
+
+from fantoch_tpu.core import Planet
+from fantoch_tpu.core.util import sort_processes_by_distance
+
+
+def symmetric(a, b, planet):
+    return planet.ping_latency(a, b) == planet.ping_latency(b, a)
+
+
+def test_latency():
+    planet = Planet.new()
+    assert symmetric("europe-west3", "us-central1", planet)
+    # sometimes it's not symmetric
+    assert not symmetric("us-east1", "europe-west3", planet)
+    assert not symmetric("us-east4", "us-west1", planet)
+    assert not symmetric("us-west1", "europe-west3", planet)
+
+
+def test_gcp_latency_values():
+    # values from planet/dat.rs:125-154 (europe-west3.dat)
+    planet = Planet.new()
+    expected = {
+        "europe-west3": 0, "europe-west4": 7, "europe-west6": 7,
+        "europe-west1": 8, "europe-west2": 13, "europe-north1": 31,
+        "us-east4": 86, "northamerica-northeast1": 87, "us-east1": 98,
+        "us-central1": 105, "us-west1": 136, "us-west2": 139,
+        "southamerica-east1": 214, "asia-northeast1": 224,
+        "asia-northeast2": 233, "asia-east1": 258, "asia-east2": 268,
+        "australia-southeast1": 276, "asia-southeast1": 289,
+        "asia-south1": 352,
+    }
+    for to, lat in expected.items():
+        assert planet.ping_latency("europe-west3", to) == lat
+
+
+def test_sorted():
+    planet = Planet.new()
+    expected = [
+        "europe-west3", "europe-west4", "europe-west6", "europe-west1",
+        "europe-west2", "europe-north1", "us-east4",
+        "northamerica-northeast1", "us-east1", "us-central1", "us-west1",
+        "us-west2", "southamerica-east1", "asia-northeast1",
+        "asia-northeast2", "asia-east1", "asia-east2",
+        "australia-southeast1", "asia-southeast1", "asia-south1",
+    ]
+    got = [r for _, r in planet.sorted("europe-west3")]
+    assert got == expected
+
+
+def test_equidistant():
+    regions, planet = Planet.equidistant(10, 3)
+    assert len(regions) == 3
+    for a in regions:
+        for b in regions:
+            assert planet.ping_latency(a, b) == (0 if a == b else 10)
+
+
+def test_latency_matrix():
+    planet = Planet.new()
+    regions = ["europe-west3", "us-west1"]
+    mat = planet.latency_matrix(regions)
+    assert mat.dtype == np.int32
+    assert mat[0, 0] == 0 and mat[1, 1] == 0
+    assert mat[0, 1] == 136
+
+
+def test_sort_processes_by_distance():
+    # mirrors util.rs:223-266
+    regions = [
+        "asia-east1", "asia-northeast1", "asia-south1", "asia-southeast1",
+        "australia-southeast1", "europe-north1", "europe-west1",
+        "europe-west2", "europe-west3", "europe-west4",
+        "northamerica-northeast1", "southamerica-east1", "us-central1",
+        "us-east1", "us-east4", "us-west1", "us-west2",
+    ]
+    processes = [(i, 0, r) for i, r in enumerate(regions)]
+    planet = Planet.new()
+    got = sort_processes_by_distance("europe-west3", planet, processes)
+    expected = [8, 9, 6, 7, 5, 14, 10, 13, 12, 15, 16, 11, 1, 0, 4, 3, 2]
+    assert [pid for pid, _ in got] == expected

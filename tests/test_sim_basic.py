@@ -1,0 +1,69 @@
+"""Whole-protocol simulation tests for the Basic protocol.
+
+Mirrors fantoch/src/sim/runner.rs:723-871: the deterministic latency means
+for Basic n=3 over the GCP planet are exact regression targets, including
+the GC completeness assertion (all commands stable at every process).
+"""
+
+import pytest
+
+from fantoch_tpu.client import ConflictPool, Workload
+from fantoch_tpu.core import Config, Planet
+from fantoch_tpu.protocol import Basic
+from fantoch_tpu.protocol.base import ProtocolMetricsKind
+from fantoch_tpu.sim import Runner
+
+COMMANDS_PER_CLIENT = 1000
+
+
+def run(f, clients_per_process, commands_per_client=COMMANDS_PER_CLIENT):
+    planet = Planet.new()
+    config = Config(n=3, f=f, gc_interval_ms=100)
+    workload = Workload(
+        shard_count=1,
+        key_gen=ConflictPool(conflict_rate=100, pool_size=1),
+        keys_per_command=1,
+        commands_per_client=commands_per_client,
+        payload_size=100,
+    )
+    runner = Runner(
+        Basic,
+        planet,
+        config,
+        workload,
+        clients_per_process,
+        ["asia-east1", "us-central1", "us-west1"],
+        ["us-west1", "us-west2"],
+    )
+    metrics, _monitors, latencies = runner.run(extra_sim_time_ms=1000)
+
+    expected = commands_per_client * clients_per_process
+    issued1, us_west1 = latencies["us-west1"]
+    issued2, us_west2 = latencies["us-west2"]
+    assert issued1 == expected
+    assert issued2 == expected
+
+    # all commands must have been garbage collected at every process
+    for _pid, (process_metrics, _executor_metrics) in metrics.items():
+        stable = process_metrics.get_aggregated(ProtocolMetricsKind.STABLE)
+        assert stable == expected * 2
+    return us_west1, us_west2
+
+
+@pytest.mark.parametrize(
+    "f,mean1,mean2", [(0, 0.0, 24.0), (1, 34.0, 58.0), (2, 118.0, 142.0)]
+)
+def test_runner_single_client_per_process(f, mean1, mean2):
+    us_west1, us_west2 = run(f, clients_per_process=1)
+    assert us_west1.mean() == mean1
+    assert us_west2.mean() == mean2
+
+
+def test_runner_multiple_clients_per_process():
+    one = run(1, clients_per_process=1, commands_per_client=200)
+    ten = run(1, clients_per_process=10, commands_per_client=200)
+    # latency stats are independent of the client count (runner.rs:851-870)
+    assert one[0].mean() == ten[0].mean()
+    assert one[0].cov() == ten[0].cov()
+    assert one[1].mean() == ten[1].mean()
+    assert one[1].cov() == ten[1].cov()

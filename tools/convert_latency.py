@@ -1,0 +1,45 @@
+"""Convert ping-format ``.dat`` latency files into the JSON matrices shipped
+in ``fantoch_tpu/data/``.
+
+The reference stores inter-region latency as one ``.dat`` file per region
+with lines ``min/avg/max/mdev:region`` (parsed in
+fantoch/src/planet/dat.rs:33-66): the *avg* field is truncated to an integer
+millisecond and intra-region latency is forced to 0.  We run this once at
+build time and ship a single JSON document per dataset instead of a
+directory of ping files; ``fantoch_tpu.core.planet`` loads the JSON.
+
+Usage: python tools/convert_latency.py <dat_dir> <out_json>
+"""
+
+import json
+import pathlib
+import sys
+
+
+def parse_dat_dir(dat_dir: pathlib.Path) -> dict:
+    latencies = {}
+    for dat in sorted(dat_dir.glob("*.dat")):
+        region = dat.stem
+        entries = {}
+        for line in dat.read_text().splitlines():
+            if not line.strip():
+                continue
+            # line format: min/avg/max/mdev:region
+            stats, _, to_region = line.partition(":")
+            avg = stats.split("/")[1]
+            # truncate like the reference (f64 as u64 rounds down)
+            entries[to_region] = 0 if to_region == region else int(float(avg))
+        latencies[region] = entries
+    return latencies
+
+
+def main() -> None:
+    dat_dir = pathlib.Path(sys.argv[1])
+    out = pathlib.Path(sys.argv[2])
+    latencies = parse_dat_dir(dat_dir)
+    out.write_text(json.dumps(latencies, indent=1, sort_keys=True))
+    print(f"wrote {out}: {len(latencies)} regions")
+
+
+if __name__ == "__main__":
+    main()
