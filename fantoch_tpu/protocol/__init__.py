@@ -17,3 +17,5 @@ from .base import (
     ToSend,
 )
 from .basic import Basic
+from .fpaxos import FPaxos
+from .tempo import Tempo

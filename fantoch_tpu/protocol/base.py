@@ -207,24 +207,9 @@ class CommandsInfo(Generic[I]):
         return len(self._infos)
 
 
-class AEClockSet:
-    """Above-exact event set per source: contiguous frontier + sparse
-    extras (the `threshold` crate's AboveExSet used by gc/clock.rs)."""
-
-    def __init__(self) -> None:
-        self.frontier = 0
-        self.extra: Set[int] = set()
-
-    def add(self, seq: int) -> None:
-        if seq <= self.frontier:
-            return
-        if seq == self.frontier + 1:
-            self.frontier = seq
-            while self.frontier + 1 in self.extra:
-                self.frontier += 1
-                self.extra.remove(self.frontier)
-        else:
-            self.extra.add(seq)
+# above-exact event set (the `threshold` crate's AboveExSet used by
+# gc/clock.rs); interval-backed so huge vote ranges stay cheap
+from ..core.intervals import IntervalSet as AEClockSet  # noqa: E402
 
 
 class GCTrack:

@@ -16,6 +16,7 @@ lockstep under ``vmap``.
 
 from __future__ import annotations
 
+import copy
 import random
 from typing import Dict, List, Optional, Tuple, Type
 
@@ -238,16 +239,21 @@ class Runner:
         immediately (recursively)."""
         for action in actions:
             if isinstance(action, ToSend):
+                # each target gets its own copy of the message — the
+                # reference clones per target (runner.rs:455-471), and
+                # protocol handlers mutate message contents (e.g. Tempo
+                # consumes votes out of MCommit)
                 for to in action.target:
+                    msg = copy.deepcopy(action.msg)
                     if to == process_id:
                         self._handle_send(
-                            process_id, shard_id, process_id, action.msg
+                            process_id, shard_id, process_id, msg
                         )
                     else:
                         self._schedule_message(
                             from_region,
                             ("process", to),
-                            (_SEND, process_id, shard_id, to, action.msg),
+                            (_SEND, process_id, shard_id, to, msg),
                         )
             elif isinstance(action, ToForward):
                 self._handle_send(process_id, shard_id, process_id, action.msg)

@@ -1,0 +1,140 @@
+"""Generic whole-protocol simulation test harness.
+
+Mirrors the reference's ``sim_test`` (fantoch_ps/src/protocol/mod.rs:639-705)
+and its checks:
+- ``check_monitors`` (mod.rs:724-813): every process must record the exact
+  same per-key execution order (linearizability-ish cross-replica check);
+- ``check_metrics`` (mod.rs:815-879): all commands commit (leaderless), and
+  all commands are GC'd at every process (n×commits for leaderless, (f+1)×
+  for FPaxos).
+
+Message reordering is enabled (delay ×U(0,10)) like the reference.
+"""
+
+from fantoch_tpu.client import ConflictPool, Workload
+from fantoch_tpu.core import Config, Planet
+from fantoch_tpu.protocol.base import ProtocolMetricsKind
+from fantoch_tpu.sim import Runner
+
+COMMANDS_PER_CLIENT = 20
+CLIENTS_PER_PROCESS = 3
+KEY_GEN = ConflictPool(conflict_rate=50, pool_size=1)
+
+
+def extract_process_metrics(metrics):
+    def get(kind):
+        return metrics.get_aggregated(kind) or 0
+
+    return (
+        get(ProtocolMetricsKind.FAST_PATH),
+        get(ProtocolMetricsKind.SLOW_PATH),
+        get(ProtocolMetricsKind.STABLE),
+    )
+
+
+def sim_test(
+    protocol_cls,
+    config: Config,
+    commands_per_client: int = COMMANDS_PER_CLIENT,
+    clients_per_process: int = CLIENTS_PER_PROCESS,
+    seed: int = 0,
+    extra_sim_time_ms: int = 10_000,
+    reorder: bool = True,
+) -> int:
+    """Runs the protocol in the DES with reordering; returns the total slow
+    path count after asserting the reference's invariants."""
+    shard_count = 1
+    config = config.with_(
+        executor_monitor_execution_order=True,
+        gc_interval_ms=100,
+        executor_executed_notification_interval_ms=100,
+        shard_count=shard_count,
+    )
+
+    planet = Planet.new()
+    workload = Workload(
+        shard_count=shard_count,
+        key_gen=KEY_GEN,
+        keys_per_command=2,
+        commands_per_client=commands_per_client,
+        payload_size=1,
+    )
+    regions = planet.regions()[: config.n]
+    runner = Runner(
+        protocol_cls,
+        planet,
+        config,
+        workload,
+        clients_per_process,
+        regions,
+        regions,
+        seed=seed,
+    )
+    if reorder:
+        runner.reorder_messages = True
+    metrics, monitors, _latencies = runner.run(extra_sim_time_ms)
+
+    per_process = {
+        pid: extract_process_metrics(pm) for pid, (pm, _em) in metrics.items()
+    }
+    check_monitors(monitors)
+    return check_metrics(
+        config, commands_per_client, clients_per_process, per_process
+    )
+
+
+def check_monitors(monitors: dict) -> None:
+    items = list(monitors.items())
+    pid_a, monitor_a = items[0]
+    assert monitor_a is not None, "execution order should be monitored"
+    for pid_b, monitor_b in items[1:]:
+        assert monitor_b is not None
+        assert set(monitor_a.keys()) == set(monitor_b.keys()), (
+            f"monitors of {pid_a} and {pid_b} should have the same keys"
+        )
+        for key in monitor_a.keys():
+            order_a = monitor_a.get_order(key)
+            order_b = monitor_b.get_order(key)
+            assert len(order_a) == len(order_b), (
+                f"key {key}: different execution counts on "
+                f"{pid_a} ({len(order_a)}) vs {pid_b} ({len(order_b)})"
+            )
+            if order_a != order_b:
+                first = next(
+                    i for i in range(len(order_a)) if order_a[i] != order_b[i]
+                )
+                raise AssertionError(
+                    f"different execution orders on key {key!r} at index"
+                    f" {first}:\n  process {pid_a}: {order_a[first:first+5]}"
+                    f"\n  process {pid_b}: {order_b[first:first+5]}"
+                )
+
+
+def check_metrics(
+    config: Config,
+    commands_per_client: int,
+    clients_per_process: int,
+    metrics: dict,
+) -> int:
+    total_fast = sum(m[0] for m in metrics.values())
+    total_slow = sum(m[1] for m in metrics.values())
+    total_stable = sum(m[2] for m in metrics.values())
+
+    total_processes = config.n * config.shard_count
+    total_clients = clients_per_process * total_processes
+    min_total_commits = commands_per_client * total_clients
+    max_total_commits = min_total_commits * config.shard_count
+
+    if config.leader is None:
+        total_commits = total_fast + total_slow
+        assert min_total_commits <= total_commits <= max_total_commits, (
+            f"number of committed commands out of bounds: {total_commits} not"
+            f" in [{min_total_commits}, {max_total_commits}]"
+        )
+
+    gc_at = (config.f + 1) if config.leader is not None else config.n
+    assert gc_at * min_total_commits == total_stable, (
+        f"not all processes gced: expected {gc_at * min_total_commits},"
+        f" got {total_stable}"
+    )
+    return total_slow

@@ -1,0 +1,22 @@
+"""FPaxos whole-protocol simulation tests (mirrors
+fantoch_ps/src/protocol/mod.rs sim_fpaxos_* tests)."""
+
+import pytest
+
+from fantoch_tpu.core import Config
+from fantoch_tpu.protocol.fpaxos import FPaxos
+
+from harness import sim_test
+
+
+@pytest.mark.parametrize("n,f,leader", [(3, 1, 1), (5, 1, 1), (5, 2, 1)])
+def test_sim_fpaxos(n, f, leader):
+    slow_paths = sim_test(FPaxos, Config(n=n, f=f, leader=leader))
+    # fpaxos has no fast/slow path distinction; metric stays zero
+    assert slow_paths == 0
+
+
+def test_sim_fpaxos_non_leader_region():
+    # leader in a different region than most clients
+    slow_paths = sim_test(FPaxos, Config(n=3, f=1, leader=3), seed=7)
+    assert slow_paths == 0

@@ -1,0 +1,42 @@
+"""Tempo whole-protocol simulation tests.
+
+Mirrors fantoch_ps/src/protocol/mod.rs sim_tempo_* tests: with conflict-pool
+workloads at 50% conflict, Tempo must be 100% fast path for (n, f) in
+{(3,1), (5,1)} and take some slow paths for (5,2); real-time clock-bump mode
+(tiny quorums) must also be 100% fast path for f=1.
+"""
+
+import pytest
+
+from fantoch_tpu.core import Config
+from fantoch_tpu.protocol.tempo import Tempo
+
+from harness import sim_test
+
+
+def tempo_config(n, f, clock_bump_interval_ms=None):
+    config = Config(n=n, f=f, tempo_detached_send_interval_ms=100)
+    if clock_bump_interval_ms is not None:
+        config.tempo_tiny_quorums = True
+        config.tempo_clock_bump_interval_ms = clock_bump_interval_ms
+    return config
+
+
+def test_sim_tempo_3_1():
+    assert sim_test(Tempo, tempo_config(3, 1)) == 0
+
+
+def test_sim_tempo_5_1():
+    assert sim_test(Tempo, tempo_config(5, 1)) == 0
+
+
+def test_sim_tempo_5_2():
+    assert sim_test(Tempo, tempo_config(5, 2), seed=3) > 0
+
+
+def test_sim_real_time_tempo_3_1():
+    assert sim_test(Tempo, tempo_config(3, 1, clock_bump_interval_ms=50)) == 0
+
+
+def test_sim_real_time_tempo_5_1():
+    assert sim_test(Tempo, tempo_config(5, 1, clock_bump_interval_ms=50)) == 0
