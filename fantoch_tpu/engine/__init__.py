@@ -1,0 +1,38 @@
+"""Batched on-device discrete-event simulation engine.
+
+This is the TPU-native replacement for the reference's per-config
+single-threaded simulator (fantoch/src/sim/) *and* its rayon sweep driver
+(fantoch_ps/src/bin/simulation.rs:165-217): thousands of independent
+(protocol, latency-matrix, conflict-rate) configurations advance in
+lockstep as one `jax.vmap`'d step function driven by `lax.while_loop`,
+sharded over a TPU device mesh by the sweep driver.
+
+Design (see SURVEY.md §7):
+- each *lane* (= one simulated deployment) holds a fixed-capacity message
+  pool and fixed-shape per-process protocol state;
+- each engine step advances simulated time to the earliest pending event
+  and delivers at most ONE message per destination process — messages to
+  different processes commute, so this preserves per-process timestamp
+  order, which is all a DES needs;
+- protocol handlers are pure per-process functions dispatched with
+  `lax.switch` over the message type and `jax.vmap`'d over the process
+  axis; the whole step is then vmapped over the lane (config) axis.
+"""
+
+from .dims import EngineDims
+from .core import build_runner, init_lane_state
+from .spec import LaneSpec, make_lane, stack_lanes
+from .results import LaneResults, collect_results
+from .driver import run_lanes
+
+__all__ = [
+    "EngineDims",
+    "LaneSpec",
+    "LaneResults",
+    "build_runner",
+    "init_lane_state",
+    "make_lane",
+    "stack_lanes",
+    "collect_results",
+    "run_lanes",
+]

@@ -1,0 +1,358 @@
+"""The batched device event loop.
+
+Replaces the reference's heap-driven single-config loop
+(fantoch/src/sim/runner.rs:233-313, schedule.rs:6-61) with a fixed-shape,
+vmappable step:
+
+  1. T := min arrival time over the lane's message pool and periodic
+     timers (masked min-reduction — the "heap pop");
+  2. every process with a pending message at time T handles its earliest
+     one (tie-break by global sequence number, which makes runs exactly
+     reproducible — the reference leaves heap ties unspecified,
+     schedule.rs:109-119);
+  3. handlers run as one `lax.switch` over message type, `vmap`'d over
+     the process axis; periodic timers fire on steps where their process
+     has no message at T;
+  4. emitted messages are scattered into free pool slots; messages bound
+     for clients are *rewritten in place* into the client's next SUBMIT
+     (closed-loop clients are deterministic: record latency, then either
+     issue the next command or finish — client/mod.rs:91-137), so clients
+     never occupy pool destinations.
+
+The whole lane step sits in a `lax.while_loop` whose condition is the
+lane's termination predicate; `vmap` over lanes gives the config batch,
+`jit` compiles the sweep once per (protocol, dims).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+
+from .dims import INF, EngineDims
+
+I32 = jnp.int32
+
+
+# ----------------------------------------------------------------------
+# outbox helpers (used by protocol handler modules)
+# ----------------------------------------------------------------------
+
+def empty_outbox(dims: EngineDims, slots: int | None = None) -> Dict[str, Any]:
+    f = dims.F if slots is None else slots
+    return {
+        "valid": jnp.zeros((f,), bool),
+        "dst": jnp.zeros((f,), I32),
+        "mtype": jnp.zeros((f,), I32),
+        "payload": jnp.zeros((f, dims.P), I32),
+    }
+
+
+def emit(outbox, i, dst, mtype, payload, valid=True):
+    """Write one message into outbox slot ``i`` (functional)."""
+    pay = jnp.zeros((outbox["payload"].shape[1],), I32)
+    payload = jnp.asarray(payload, I32)
+    pay = jax.lax.dynamic_update_slice(pay, payload.reshape(-1), (0,))
+    return {
+        "valid": outbox["valid"].at[i].set(jnp.asarray(valid, bool)),
+        "dst": outbox["dst"].at[i].set(jnp.asarray(dst, I32)),
+        "mtype": outbox["mtype"].at[i].set(jnp.asarray(mtype, I32)),
+        "payload": outbox["payload"].at[i].set(pay),
+    }
+
+
+def emit_broadcast(outbox, mtype, payload, n, me=None, exclude_me=False):
+    """Fill slots 0..N-1 with a broadcast to processes < n (the
+    reference's ``ToSend{target: all()}``; ``all_but_me()`` with
+    ``exclude_me``). Occupies the first N outbox slots."""
+    nmax = outbox["dst"].shape[0]
+    procs = jnp.arange(nmax, dtype=I32)
+    valid = procs < n
+    if exclude_me:
+        valid = valid & (procs != me)
+    pay = jnp.zeros((nmax, outbox["payload"].shape[1]), I32)
+    payload = jnp.asarray(payload, I32).reshape(-1)
+    pay = jax.lax.dynamic_update_slice(
+        pay, jnp.broadcast_to(payload, (nmax, payload.shape[0])), (0, 0)
+    )
+    return {
+        "valid": valid,
+        "dst": procs,
+        "mtype": jnp.full((nmax,), mtype, I32),
+        "payload": pay,
+    }
+
+
+# ----------------------------------------------------------------------
+# client workload (key generation; mirrors client/key_gen.rs semantics)
+# ----------------------------------------------------------------------
+
+def gen_key(ctx, client, cmd_seq):
+    """One key for (client, command) — counter-based so the device needs
+    no generator state. ConflictPool (key_gen.rs:96-110): with
+    probability conflict_rate% a key from the shared pool, otherwise the
+    client's private key (encoded as pool_size + client)."""
+    k = jr.fold_in(jr.fold_in(ctx["rng_key"], client), cmd_seq)
+    conflict = jr.randint(k, (), 0, 100) < ctx["conflict_rate"]
+    pool_key = jr.randint(jr.fold_in(k, 1), (), 0, jnp.maximum(ctx["pool_size"], 1))
+    return jnp.where(conflict, pool_key, ctx["pool_size"] + client).astype(I32)
+
+
+# ----------------------------------------------------------------------
+# lane state
+# ----------------------------------------------------------------------
+
+def init_lane_state(protocol, dims: EngineDims, ctx_np: Dict[str, np.ndarray]):
+    """Build one lane's initial state (numpy, host side).
+
+    Prepopulates the pool with every live client's first SUBMIT — the
+    reference's ``Simulation::start_clients`` (runner.rs:211-220) — and
+    arms the periodic timers at t = interval.
+    """
+    N, C, M, P, R = dims.N, dims.C, dims.M, dims.P, dims.R
+    pool = {
+        "arrival": np.full((M,), INF, np.int32),
+        "seq": np.zeros((M,), np.int32),
+        "src": np.zeros((M,), np.int32),
+        "dst": np.zeros((M,), np.int32),
+        "mtype": np.zeros((M,), np.int32),
+        "payload": np.zeros((M, P), np.int32),
+    }
+    budget = ctx_np["cmd_budget"]          # [C]
+    attach = ctx_np["client_attach"]       # [C]
+    live = budget > 0
+    assert live.sum() <= M, "pool must hold the initial submit wave"
+    # first keys for every client, with the same counter scheme the
+    # device uses for subsequent commands
+    keyctx = {
+        k: jnp.asarray(ctx_np[k])
+        for k in ("rng_key", "conflict_rate", "pool_size")
+    }
+    first_keys = np.asarray(
+        jax.vmap(lambda c: gen_key(keyctx, c, 1))(jnp.arange(C, dtype=I32))
+    )
+    slot = 0
+    for c in range(C):
+        if not live[c]:
+            continue
+        pool["arrival"][slot] = ctx_np["client_delay"][c, attach[c]]
+        pool["seq"][slot] = slot
+        pool["src"][slot] = N + c
+        pool["dst"][slot] = attach[c]
+        pool["mtype"][slot] = protocol.SUBMIT
+        pool["payload"][slot, 0] = c
+        pool["payload"][slot, 1] = 1
+        pool["payload"][slot, 2] = first_keys[c]
+        slot += 1
+
+    intervals = ctx_np["periodic_intervals"]  # [R]
+    next_periodic = np.broadcast_to(
+        np.where(intervals >= INF, INF, intervals), (N, R)
+    ).astype(np.int32).copy()
+    # timers only run on live processes
+    next_periodic[ctx_np["n"]:, :] = INF
+
+    return {
+        "pool": pool,
+        "ps": protocol.init_state(dims, ctx_np),
+        "next_periodic": next_periodic,
+        "clients": {
+            "issued": live.astype(np.int32),
+            "completed": np.zeros((C,), np.int32),
+            "start_time": np.zeros((C,), np.int32),
+        },
+        "metrics": {
+            "hist": np.zeros((dims.RR, dims.H), np.int32),
+            "lat_sum": np.zeros((dims.RR,), np.int32),
+            "lat_count": np.zeros((dims.RR,), np.int32),
+        },
+        "now": np.int32(0),
+        "msg_seq": np.int32(slot),
+        "steps": np.int32(0),
+        "done_time": np.int32(INF),
+        "err": np.zeros((), bool),
+    }
+
+
+# ----------------------------------------------------------------------
+# the step function
+# ----------------------------------------------------------------------
+
+def _lane_step(protocol, dims: EngineDims, st, ctx):
+    N, C, M, F, R, P = dims.N, dims.C, dims.M, dims.F, dims.R, dims.P
+    pool = st["pool"]
+    arrival, seq = pool["arrival"], pool["seq"]
+
+    # 1. advance time to the earliest pending event ---------------------
+    T = jnp.minimum(jnp.min(arrival), jnp.min(st["next_periodic"]))
+
+    # 2. pop at most one message per process at time T ------------------
+    # (T == INF means the lane is idle: consumed slots also hold INF, so
+    # without the guard they would be replayed as stale messages)
+    at_t = (arrival == T) & (T < INF)
+    procs = jnp.arange(N, dtype=I32)
+    cand = at_t[None, :] & (pool["dst"][None, :] == procs[:, None])  # [N, M]
+    order = jnp.where(cand, seq[None, :], INF)
+    slot = jnp.argmin(order, axis=1)                                  # [N]
+    has = jnp.min(order, axis=1) < INF
+    msg = {
+        "valid": has,
+        "src": pool["src"][slot],
+        "mtype": jnp.where(has, pool["mtype"][slot], protocol.NUM_TYPES),
+        "payload": pool["payload"][slot],
+    }
+    arrival = arrival.at[jnp.where(has, slot, M)].set(INF, mode="drop")
+
+    # 3. handlers -------------------------------------------------------
+    def handle_one(ps_slice, m, me):
+        return protocol.handle(ps_slice, m, me, T, ctx, dims)
+
+    ps, outbox = jax.vmap(handle_one)(st["ps"], msg, procs)  # outbox [N,F]
+
+    fire = (st["next_periodic"] == T) & ~has[:, None] & (T < INF)  # [N, R]
+
+    def periodic_one(ps_slice, f, me):
+        return protocol.periodic(ps_slice, f, me, T, ctx, dims)
+
+    ps, pout = jax.vmap(periodic_one)(ps, fire, procs)       # pout [N,F]
+    next_periodic = jnp.where(
+        fire, T + ctx["periodic_intervals"][None, :], st["next_periodic"]
+    )
+
+    # 4. flatten emissions ---------------------------------------------
+    def flat(ob):
+        return jax.tree_util.tree_map(
+            lambda a: a.reshape((-1,) + a.shape[2:]), ob
+        )
+
+    out = jax.tree_util.tree_map(
+        lambda a, b: jnp.concatenate([a, b], axis=0), flat(outbox), flat(pout)
+    )
+    emitter = jnp.concatenate([jnp.repeat(procs, F), jnp.repeat(procs, F)])
+    E = 2 * N * F
+    valid, dst = out["valid"], out["dst"]
+
+    # 5. client rewrite: TO_CLIENT → latency record + next SUBMIT -------
+    is_client = valid & (dst >= N)
+    c = jnp.where(is_client, dst - N, 0)
+    d_back = ctx["client_delay"][c, emitter]
+    t_arr = T + d_back
+    latency = t_arr - st["clients"]["start_time"][c]
+
+    cl = st["clients"]
+    completed = cl["completed"].at[jnp.where(is_client, c, C)].add(
+        1, mode="drop"
+    )
+    more = cl["issued"][c] < ctx["cmd_budget"][c]
+    issue = is_client & more
+    issued = cl["issued"].at[jnp.where(issue, c, C)].add(1, mode="drop")
+    start_time = cl["start_time"].at[jnp.where(issue, c, C)].set(
+        t_arr, mode="drop"
+    )
+    next_seq = cl["issued"][c] + 1
+    key = jax.vmap(lambda cc, ss: gen_key(ctx, cc, ss))(c, next_seq)
+    sub_payload = jnp.zeros((E, P), I32)
+    sub_payload = sub_payload.at[:, 0].set(c)
+    sub_payload = sub_payload.at[:, 1].set(next_seq)
+    sub_payload = sub_payload.at[:, 2].set(key)
+
+    # metrics
+    row = jnp.where(is_client, ctx["client_region_row"][c], dims.RR)
+    bucket = jnp.clip(latency, 0, dims.H - 1)
+    metrics = st["metrics"]
+    hist = metrics["hist"].at[row, bucket].add(1, mode="drop")
+    lat_sum = metrics["lat_sum"].at[row].add(latency, mode="drop")
+    lat_count = metrics["lat_count"].at[row].add(1, mode="drop")
+
+    # rewrite entries in place
+    dst = jnp.where(issue, ctx["client_attach"][c], dst)
+    mtype = jnp.where(issue, protocol.SUBMIT, out["mtype"])
+    payload = jnp.where(issue[:, None], sub_payload, out["payload"])
+    src = jnp.where(is_client, N + c, emitter)
+    base = jnp.where(issue, t_arr, T)
+    delay = jnp.where(
+        issue,
+        ctx["client_delay"][c, ctx["client_attach"][c]],
+        ctx["delay_pp"][emitter, jnp.clip(dst, 0, N - 1)],
+    )
+    valid = valid & (~is_client | issue)
+    msg_arrival = base + delay
+
+    # 6. scatter into free pool slots ----------------------------------
+    free = arrival == INF
+    rank = jnp.cumsum(valid.astype(I32))                      # [E], 1-based
+    free_cum = jnp.cumsum(free.astype(I32))                   # [M]
+    target = jnp.searchsorted(free_cum, rank, side="left")
+    target = jnp.where(valid, target, M)
+    pool_overflow = jnp.sum(valid) > jnp.sum(free)
+    new_pool = {
+        "arrival": arrival.at[target].set(msg_arrival, mode="drop"),
+        "seq": seq.at[target].set(st["msg_seq"] + rank - 1, mode="drop"),
+        "src": pool["src"].at[target].set(src, mode="drop"),
+        "dst": pool["dst"].at[target].set(dst, mode="drop"),
+        "mtype": pool["mtype"].at[target].set(mtype, mode="drop"),
+        "payload": pool["payload"].at[target].set(payload, mode="drop"),
+    }
+
+    # 7. termination bookkeeping ---------------------------------------
+    live = ctx["cmd_budget"] > 0
+    all_done = jnp.all(~live | (completed >= ctx["cmd_budget"]))
+    last_completion = jnp.max(jnp.where(is_client, t_arr, 0))
+    done_time = jnp.where(
+        (st["done_time"] == INF) & all_done,
+        jnp.maximum(st["now"], last_completion),
+        st["done_time"],
+    )
+    err = st["err"] | pool_overflow | jnp.any(protocol.error(ps))
+
+    return {
+        "pool": new_pool,
+        "ps": ps,
+        "next_periodic": next_periodic,
+        "clients": {
+            "issued": issued,
+            "completed": completed,
+            "start_time": start_time,
+        },
+        "metrics": {
+            "hist": hist,
+            "lat_sum": lat_sum,
+            "lat_count": lat_count,
+        },
+        "now": T,
+        "msg_seq": st["msg_seq"] + rank[-1],
+        "steps": st["steps"] + 1,
+        "done_time": done_time,
+        "err": err,
+    }
+
+
+def _lane_running(dims, st, ctx, max_steps):
+    end = jnp.where(
+        st["done_time"] >= INF, INF, st["done_time"] + ctx["extra_time"]
+    )
+    finished = (st["done_time"] < INF) & (st["now"] >= end)
+    idle = st["now"] >= INF  # nothing scheduled at all
+    return ~(finished | idle | st["err"]) & (st["steps"] < max_steps)
+
+
+def build_runner(protocol, dims: EngineDims, max_steps: int = 1 << 22):
+    """Compile the batched sweep runner: (batched state, batched ctx) →
+    final batched state. vmap supplies the config-batch axis; the sweep
+    driver shards that axis over the TPU mesh."""
+
+    def run_lane(st, ctx):
+        out = jax.lax.while_loop(
+            lambda s: _lane_running(dims, s, ctx, max_steps),
+            lambda s: _lane_step(protocol, dims, s, ctx),
+            st,
+        )
+        # a lane truncated by max_steps must never look like a clean run
+        truncated = (out["steps"] >= max_steps) & (out["done_time"] >= INF)
+        return dict(out, err=out["err"] | truncated)
+
+    return jax.jit(jax.vmap(run_lane))

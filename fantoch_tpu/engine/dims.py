@@ -1,0 +1,77 @@
+"""Static shape bounds for the device engine.
+
+Everything under `jit` needs static shapes; these bounds are the knobs.
+Per-lane *values* (n, f, delays, conflict rate, ...) vary freely inside a
+batch; the *bounds* below are shared by every lane of a compiled sweep.
+Overflow of any bound is detected at runtime and surfaced to the host as a
+per-lane error flag (SURVEY.md §7.3) — results of flagged lanes are
+discarded, never silently wrong.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+# simulated time / sequence sentinel: far enough from i32 overflow that
+# `INF + delay` cannot wrap
+INF = 1 << 30
+
+
+@dataclass(frozen=True)
+class EngineDims:
+    """Static bounds shared by all lanes of one compiled engine.
+
+    N: max processes per lane (lanes with n < N mask the tail)
+    C: max clients per lane (padded clients have a 0-command budget)
+    M: message-pool capacity (in-flight messages per lane)
+    D: per-source dot-slot capacity (in-flight + not-yet-GC'd commands
+       issued by one process; slots recycle modulo D after GC)
+    F: max messages a single handler invocation may emit
+    R: periodic-event rows per process (protocol-specific timers)
+    P: payload words per message
+    H: latency-histogram buckets (1 ms each; last bucket catches the tail)
+    RR: client-region rows for latency aggregation
+    """
+
+    N: int
+    C: int
+    M: int
+    D: int
+    F: int
+    R: int
+    P: int
+    H: int = 512
+    RR: int = 8
+
+    @staticmethod
+    def for_protocol(protocol, n: int, clients: int, payload: int,
+                     dot_slots: int = 64, pool: int | None = None,
+                     total_commands: int | None = None,
+                     regions: int = 8) -> "EngineDims":
+        """Reasonable bounds for a (protocol, n, client-count) sweep.
+
+        When a client sits at 0 latency from its whole quorum the closed
+        loop degenerates: the entire command budget is issued in one
+        simulated instant and every remote delivery queues up, so the
+        safe pool bound is ``total_commands × 2(n-1)``. Pass
+        ``total_commands`` to get that bound, or ``pool`` to override;
+        otherwise the steady-state bound (clients pace themselves at WAN
+        RTT) is used. Overflow is always detected, never silent.
+        """
+        fanout = getattr(protocol, "MAX_FANOUT", n + 1)
+        if pool is None:
+            # closed-loop clients keep ≤ ~n messages in flight per command
+            # plus periodic GC traffic
+            pool = clients * (n + 2) + 4 * n * n + 64
+            if total_commands is not None:
+                pool = max(pool, total_commands * 2 * (n - 1) + clients + 64)
+        return EngineDims(
+            N=n,
+            C=clients,
+            M=pool,
+            D=dot_slots,
+            F=max(fanout, n + 1),
+            R=getattr(protocol, "PERIODIC_ROWS", 1),
+            P=max(payload, 3),
+            RR=regions,
+        )

@@ -1,0 +1,33 @@
+"""Convenience driver: build, run, and collect a batch of lanes on the
+default device. The mesh-sharded sweep driver (pjit over a config batch
+across chips) lives in ``fantoch_tpu.parallel``."""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import numpy as np
+
+from .core import build_runner, init_lane_state
+from .dims import EngineDims
+from .results import LaneResults, collect_results
+from .spec import LaneSpec, stack_lanes
+
+
+def stack_states(protocol, dims: EngineDims, specs: Sequence[LaneSpec]):
+    states = [init_lane_state(protocol, dims, s.ctx) for s in specs]
+    return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *states)
+
+
+def run_lanes(
+    protocol,
+    dims: EngineDims,
+    specs: Sequence[LaneSpec],
+    max_steps: int = 1 << 22,
+) -> List[LaneResults]:
+    ctx = stack_lanes(specs)
+    state = stack_states(protocol, dims, specs)
+    runner = build_runner(protocol, dims, max_steps)
+    final = runner(state, ctx)
+    return collect_results(protocol, dims, final, specs)

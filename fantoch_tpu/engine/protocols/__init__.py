@@ -1,0 +1,12 @@
+"""Array-state protocol implementations for the device engine.
+
+Each module is the fixed-shape twin of a host oracle protocol in
+``fantoch_tpu/protocol/``: per-process state becomes a dict of i32/bool
+arrays, ``handle`` becomes a ``lax.switch`` over message types, and
+quorum membership / discovery orders arrive as precomputed lane-context
+matrices.
+"""
+
+from .basic import BasicDev
+
+__all__ = ["BasicDev"]

@@ -1,0 +1,280 @@
+"""Device twin of the ``Basic`` protocol (fantoch/src/protocol/basic.rs,
+host oracle: fantoch_tpu/protocol/basic.py).
+
+Semantics: coordinator broadcasts MStore; the f+1 fast-quorum members
+ack; on the f+1'th ack the coordinator broadcasts MCommit; commits feed
+the committed-clock GC flow (periodic MGarbageCollection frontier
+exchange → stable dots; gc/clock.rs:10-171). 100% fast path.
+
+State encoding (per process, fixed shapes):
+- ``seq_in_slot[N, D]``  — which command sequence currently occupies each
+  dot slot per source (0 = free); slots recycle modulo D after GC, with
+  a dirty-slot check surfacing overflow instead of corrupting state;
+- ``committed_cnt[N]``   — per-source committed frontier (commits arrive
+  in order per source because delays are constant per process pair; an
+  out-of-order commit raises the lane error flag);
+- ``acks[D]``/``client_of[D]``/``own_seq`` — coordinator bookkeeping;
+- ``others_frontier[N, N]``/``seen[N]``/``prev_stable[N]`` — the GC
+  tracker (VClockGCTrack): stable = meet of all advertised frontiers.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import I32, emit, emit_broadcast, empty_outbox
+from ..dims import INF, EngineDims
+
+
+class BasicDev:
+    SUBMIT = 0
+    MSTORE = 1
+    MSTOREACK = 2
+    MCOMMIT = 3
+    MGC = 4
+    NUM_TYPES = 5
+    TO_CLIENT = 6  # any id ≥ NUM_TYPES; routing is by dst ≥ N
+
+    PERIODIC_ROWS = 1  # garbage collection
+
+    # -- host-side builders -------------------------------------------
+
+    @staticmethod
+    def payload_width(n: int) -> int:
+        return max(n, 3)  # MGC carries an n-wide frontier
+
+    @staticmethod
+    def periodic_intervals(config, dims: EngineDims):
+        gc = config.gc_interval_ms
+        return [gc if gc is not None else INF]
+
+    @staticmethod
+    def lane_ctx(config, dims: EngineDims, sorted_idx: np.ndarray):
+        """Fast quorum = first f+1 processes in each process's discovery
+        order (base.rs:107-131 with basic_quorum_size, config.rs:265)."""
+        N = dims.N
+        q = config.basic_quorum_size()
+        quorum = np.zeros((N, N), bool)
+        n = config.n
+        for p in range(n):
+            for member in sorted_idx[p][:q]:
+                quorum[p, member] = True
+        return {"quorum": quorum, "q_size": np.int32(q)}
+
+    @staticmethod
+    def init_state(dims: EngineDims, ctx_np) -> Dict[str, np.ndarray]:
+        N, D = dims.N, dims.D
+        return {
+            "seq_in_slot": np.zeros((N, N, D), np.int32),
+            "buffered_commit": np.zeros((N, N, D), bool),
+            "committed_cnt": np.zeros((N, N), np.int32),
+            "acks": np.zeros((N, D), np.int32),
+            "client_of": np.zeros((N, D), np.int32),
+            "own_seq": np.zeros((N,), np.int32),
+            "others_frontier": np.zeros((N, N, N), np.int32),
+            "seen": np.zeros((N, N), bool),
+            "prev_stable": np.zeros((N, N), np.int32),
+            "m_fast_path": np.zeros((N,), np.int32),
+            "m_stable": np.zeros((N,), np.int32),
+            "err": np.zeros((N,), bool),
+        }
+
+    @staticmethod
+    def error(ps):
+        return ps["err"]
+
+    @staticmethod
+    def metrics(ps_np) -> Dict[str, np.ndarray]:
+        return {
+            "fast_path": ps_np["m_fast_path"],
+            "stable": ps_np["m_stable"],
+        }
+
+    # -- device handlers ----------------------------------------------
+
+    @staticmethod
+    def handle(ps, msg, me, now, ctx, dims: EngineDims):
+        def _noop(ps, msg):
+            return ps, empty_outbox(dims)
+
+        branches = [
+            lambda ps, msg: _submit(ps, msg, me, ctx, dims),
+            lambda ps, msg: _mstore(ps, msg, me, ctx, dims),
+            lambda ps, msg: _mstoreack(ps, msg, me, ctx, dims),
+            lambda ps, msg: _mcommit(ps, msg, me, ctx, dims),
+            lambda ps, msg: _mgc(ps, msg, me, ctx, dims),
+            _noop,
+        ]
+        idx = jnp.clip(msg["mtype"], 0, BasicDev.NUM_TYPES)
+        return jax.lax.switch(idx, branches, ps, msg)
+
+    @staticmethod
+    def periodic(ps, fire, me, now, ctx, dims: EngineDims):
+        """GARBAGE_COLLECTION: broadcast my committed frontier to
+        all-but-me (basic.rs handle_event)."""
+        ob = emit_broadcast(
+            empty_outbox(dims),
+            BasicDev.MGC,
+            ps["committed_cnt"],
+            ctx["n"],
+            me,
+            exclude_me=True,
+        )
+        ob = dict(ob, valid=ob["valid"] & fire[0])
+        return ps, ob
+
+
+# ----------------------------------------------------------------------
+# handlers (module-level so the switch branches stay small closures)
+# ----------------------------------------------------------------------
+
+
+def _slot(seq, dims):
+    return (seq - 1) % dims.D
+
+
+def _apply_commit(ps, src, seq, me, do, ob, ob_slot, dims):
+    """Commit (src, seq): advance the per-source frontier, and if I am
+    the coordinator, report back to the waiting client. ``do`` masks the
+    whole operation (commit may be buffered awaiting the payload)."""
+    expected = ps["committed_cnt"][src] + 1
+    ps = dict(
+        ps,
+        err=ps["err"] | (do & (seq != expected)),
+        committed_cnt=ps["committed_cnt"].at[src].add(do.astype(I32)),
+    )
+    slot = _slot(seq, dims)
+    client = ps["client_of"][slot]
+    ob = emit(
+        ob,
+        ob_slot,
+        dims.N + client,
+        BasicDev.TO_CLIENT,
+        [seq],
+        valid=do & (me == src),
+    )
+    return ps, ob
+
+
+def _submit(ps, msg, me, ctx, dims):
+    """Client SUBMIT → next dot, MStore to all (basic.rs:113-129)."""
+    client = msg["payload"][0]
+    key = msg["payload"][2]
+    seq = ps["own_seq"] + 1
+    slot = _slot(seq, dims)
+    ps = dict(
+        ps,
+        own_seq=seq,
+        client_of=ps["client_of"].at[slot].set(client),
+        acks=ps["acks"].at[slot].set(0),
+    )
+    ob = emit_broadcast(
+        empty_outbox(dims), BasicDev.MSTORE, [seq, key], ctx["n"]
+    )
+    ob = dict(ob, valid=ob["valid"] & msg["valid"])
+    return ps, ob
+
+
+def _mstore(ps, msg, me, ctx, dims):
+    """Store payload; quorum members ack; a buffered commit (commit seen
+    before payload) is applied now (basic.rs:152-162)."""
+    s, seq = msg["src"], msg["payload"][0]
+    slot = _slot(seq, dims)
+    dirty = ps["seq_in_slot"][s, slot] != 0
+    ps = dict(
+        ps,
+        err=ps["err"] | dirty,  # dot-slot capacity D overflow
+        seq_in_slot=ps["seq_in_slot"].at[s, slot].set(seq),
+    )
+    ob = emit(
+        empty_outbox(dims),
+        0,
+        s,
+        BasicDev.MSTOREACK,
+        [seq],
+        valid=ctx["quorum"][s, me],
+    )
+    buffered = ps["buffered_commit"][s, slot]
+    ps, ob = _apply_commit(ps, s, seq, me, buffered, ob, 1, dims)
+    ps = dict(
+        ps, buffered_commit=ps["buffered_commit"].at[s, slot].set(False)
+    )
+    return ps, ob
+
+
+def _mstoreack(ps, msg, me, ctx, dims):
+    """Count acks; on exactly f+1, commit everywhere
+    (basic.rs:163-169)."""
+    seq = msg["payload"][0]
+    slot = _slot(seq, dims)
+    cnt = ps["acks"][slot] + 1
+    reached = cnt == ctx["q_size"]
+    ps = dict(
+        ps,
+        acks=ps["acks"].at[slot].set(cnt),
+        m_fast_path=ps["m_fast_path"] + reached.astype(I32),
+    )
+    ob = emit_broadcast(
+        empty_outbox(dims), BasicDev.MCOMMIT, [me, seq], ctx["n"]
+    )
+    ob = dict(ob, valid=ob["valid"] & reached)
+    return ps, ob
+
+
+def _mcommit(ps, msg, me, ctx, dims):
+    """Apply the commit if the payload has arrived, else buffer it
+    (basic.rs:171-186)."""
+    dsrc, seq = msg["payload"][0], msg["payload"][1]
+    slot = _slot(seq, dims)
+    have = ps["seq_in_slot"][dsrc, slot] == seq
+    ps, ob = _apply_commit(
+        ps, dsrc, seq, me, have, empty_outbox(dims), 0, dims
+    )
+    ps = dict(
+        ps,
+        buffered_commit=ps["buffered_commit"]
+        .at[dsrc, slot]
+        .set(ps["buffered_commit"][dsrc, slot] | ~have),
+    )
+    return ps, ob
+
+
+def _mgc(ps, msg, me, ctx, dims):
+    """Join the sender's committed frontier; recompute the stable clock
+    (meet over everyone) and free newly stable dot slots
+    (gc/clock.rs:51-120)."""
+    N = dims.N
+    s = msg["src"]
+    frontier = msg["payload"][:N]
+    of = ps["others_frontier"]
+    of = of.at[s].set(jnp.maximum(of[s], frontier))
+    seen = ps["seen"].at[s].set(True)
+
+    procs = jnp.arange(N, dtype=I32)
+    nmask = procs < ctx["n"]
+    others = nmask & (procs != me)
+    ready = jnp.all(seen | ~others)
+
+    min_others = jnp.min(jnp.where(others[:, None], of, INF), axis=0)
+    stable = jnp.minimum(ps["committed_cnt"], min_others)
+    stable = jnp.where(ready & nmask, stable, 0)
+    delta = jnp.maximum(stable - ps["prev_stable"], 0)
+    prev_stable = jnp.maximum(ps["prev_stable"], stable)
+
+    freed = (ps["seq_in_slot"] > 0) & (
+        ps["seq_in_slot"] <= prev_stable[:, None]
+    )
+    ps = dict(
+        ps,
+        others_frontier=of,
+        seen=seen,
+        prev_stable=prev_stable,
+        m_stable=ps["m_stable"] + jnp.sum(delta),
+        seq_in_slot=jnp.where(freed, 0, ps["seq_in_slot"]),
+        buffered_commit=jnp.where(freed, False, ps["buffered_commit"]),
+    )
+    return ps, empty_outbox(dims)

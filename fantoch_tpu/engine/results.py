@@ -1,0 +1,71 @@
+"""Host-side result collection: device arrays → the same shapes the
+oracle runner reports (per-region latency histograms, per-process
+protocol metrics; fantoch/src/sim/runner.rs:597-681)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Sequence
+
+import jax
+import numpy as np
+
+from ..core.metrics import Histogram
+from .dims import INF, EngineDims
+from .spec import LaneSpec
+
+
+@dataclass
+class LaneResults:
+    """One lane's outputs in oracle-comparable form."""
+
+    region_rows: List[str]
+    hist: np.ndarray        # [RR, H] 1 ms buckets
+    lat_sum: np.ndarray     # [RR]
+    lat_count: np.ndarray   # [RR]
+    protocol_metrics: Dict[str, np.ndarray]  # name → per-process [N]
+    steps: int
+    err: bool
+    completed: int
+
+    def latency_mean(self, region: str) -> float:
+        row = self.region_rows.index(region)
+        assert self.lat_count[row] > 0
+        return float(self.lat_sum[row]) / float(self.lat_count[row])
+
+    def histogram(self, region: str) -> Histogram:
+        row = self.region_rows.index(region)
+        h = Histogram()
+        for ms, count in enumerate(self.hist[row]):
+            if count:
+                h.increment(ms, int(count))
+        return h
+
+    def issued(self, region: str) -> int:
+        row = self.region_rows.index(region)
+        return int(self.lat_count[row])
+
+
+def collect_results(
+    protocol,
+    dims: EngineDims,
+    final_state,
+    specs: Sequence[LaneSpec],
+) -> List[LaneResults]:
+    st = jax.device_get(final_state)
+    out: List[LaneResults] = []
+    for lane, spec in enumerate(specs):
+        ps = jax.tree_util.tree_map(lambda a: a[lane], st["ps"])
+        out.append(
+            LaneResults(
+                region_rows=spec.region_rows,
+                hist=st["metrics"]["hist"][lane],
+                lat_sum=st["metrics"]["lat_sum"][lane],
+                lat_count=st["metrics"]["lat_count"][lane],
+                protocol_metrics=protocol.metrics(ps),
+                steps=int(st["steps"][lane]),
+                err=bool(st["err"][lane]),
+                completed=int(st["clients"]["completed"][lane].sum()),
+            )
+        )
+    return out

@@ -1,0 +1,127 @@
+"""Host-side lane construction: planet + config + workload → ctx arrays.
+
+Mirrors the oracle runner's wiring (fantoch/src/sim/runner.rs:64-190):
+processes are placed one per region, discovery sorts processes by distance
+with id tie-breaks (util.rs:153-186), clients connect to the closest
+process (util.rs:188-230), and message delay is half the ping latency
+(runner.rs:575-595). The output is a dict of fixed-shape numpy arrays — a
+*lane context* — ready to be stacked into a batch and shipped to device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Sequence
+
+import jax.random as jr
+import numpy as np
+
+from ..core.config import Config
+from ..core.planet import Planet
+from .dims import INF, EngineDims
+
+
+@dataclass
+class LaneSpec:
+    """One configuration of the sweep: device ctx + host-side metadata."""
+
+    ctx: Dict[str, np.ndarray]
+    config: Config
+    region_rows: List[str]  # row index → client region name
+    process_regions: List[str] = field(default_factory=list)
+
+
+def _sorted_indices(planet: Planet, process_regions: Sequence[str]) -> np.ndarray:
+    """For each process, all processes ordered by (distance, id) from its
+    region — the discovery order (util.rs:153-186). 0-based indices."""
+    n = len(process_regions)
+    out = np.zeros((n, n), np.int32)
+    for p, region in enumerate(process_regions):
+        order = {r: i for i, (_lat, r) in enumerate(planet.sorted(region))}
+        ranked = sorted(range(n), key=lambda q: (order[process_regions[q]], q))
+        out[p] = ranked
+    return out
+
+
+def make_lane(
+    protocol,
+    planet: Planet,
+    config: Config,
+    *,
+    conflict_rate: int,
+    pool_size: int = 1,
+    commands_per_client: int,
+    clients_per_region: int,
+    process_regions: Sequence[str],
+    client_regions: Sequence[str],
+    dims: EngineDims,
+    extra_time_ms: int = 1000,
+    seed: int = 0,
+) -> LaneSpec:
+    n = config.n
+    assert len(process_regions) == n <= dims.N
+    N, C = dims.N, dims.C
+
+    # process↔process delays: half the ping latency (runner.rs:575-595)
+    delay_pp = np.zeros((N, N), np.int32)
+    for i, a in enumerate(process_regions):
+        for j, b in enumerate(process_regions):
+            delay_pp[i, j] = planet.ping_latency(a, b) // 2
+
+    sorted_idx = _sorted_indices(planet, process_regions)
+
+    # clients: clients_per_region per region, attached to the closest
+    # process (closest_process_per_shard; single shard in the simulator)
+    region_rows = list(dict.fromkeys(client_regions))
+    assert len(region_rows) <= dims.RR
+    client_attach = np.zeros((C,), np.int32)
+    client_region_row = np.full((C,), dims.RR, np.int32)
+    client_delay = np.zeros((C, N), np.int32)
+    cmd_budget = np.zeros((C,), np.int32)
+    c = 0
+    for region in client_regions:
+        order = {r: i for i, (_lat, r) in enumerate(planet.sorted(region))}
+        closest = min(range(n), key=lambda q: (order[process_regions[q]], q))
+        for _ in range(clients_per_region):
+            assert c < C, "raise EngineDims.C"
+            client_attach[c] = closest
+            client_region_row[c] = region_rows.index(region)
+            for p in range(n):
+                client_delay[c, p] = (
+                    planet.ping_latency(region, process_regions[p]) // 2
+                )
+            cmd_budget[c] = commands_per_client
+            c += 1
+
+    intervals = np.asarray(
+        protocol.periodic_intervals(config, dims), np.int32
+    )
+    assert intervals.shape == (dims.R,)
+
+    ctx: Dict[str, np.ndarray] = {
+        "n": np.int32(n),
+        "f": np.int32(config.f),
+        "delay_pp": delay_pp,
+        "client_delay": client_delay,
+        "client_attach": client_attach,
+        "client_region_row": client_region_row,
+        "cmd_budget": cmd_budget,
+        "conflict_rate": np.int32(conflict_rate),
+        "pool_size": np.int32(pool_size),
+        "rng_key": np.asarray(jr.PRNGKey(seed)),
+        "periodic_intervals": intervals,
+        "extra_time": np.int32(extra_time_ms),
+    }
+    ctx.update(protocol.lane_ctx(config, dims, sorted_idx))
+    return LaneSpec(
+        ctx=ctx,
+        config=config,
+        region_rows=region_rows,
+        process_regions=list(process_regions),
+    )
+
+
+def stack_lanes(specs: Sequence[LaneSpec]) -> Dict[str, np.ndarray]:
+    """Stack per-lane ctx dicts into one batched ctx (leading lane axis)."""
+    keys = specs[0].ctx.keys()
+    return {k: np.stack([s.ctx[k] for s in specs]) for k in keys}

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Deque, Dict, List, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Tuple, Union
 
 from ..core.config import Config
 from ..core.ids import Dot, ProcessId, Rifl, ShardId
@@ -49,7 +49,7 @@ class StableAtShard:
     rifl: Rifl
 
 
-TableExecutionInfo = AttachedVotes  # union alias for docs
+TableExecutionInfo = Union[AttachedVotes, DetachedVotes, StableAtShard]
 
 
 @dataclass

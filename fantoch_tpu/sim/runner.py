@@ -239,12 +239,18 @@ class Runner:
         immediately (recursively)."""
         for action in actions:
             if isinstance(action, ToSend):
-                # each target gets its own copy of the message — the
-                # reference clones per target (runner.rs:455-471), and
-                # protocol handlers mutate message contents (e.g. Tempo
-                # consumes votes out of MCommit)
-                for to in action.target:
-                    msg = copy.deepcopy(action.msg)
+                # targets before the last get their own copy of the
+                # message, the last gets the original — the reference
+                # clones n-1 times and moves (runner.rs:455-471); copies
+                # matter because handlers mutate message contents (e.g.
+                # Tempo consumes votes out of MCommit)
+                targets = list(action.target)
+                for i, to in enumerate(targets):
+                    msg = (
+                        action.msg
+                        if i == len(targets) - 1
+                        else copy.deepcopy(action.msg)
+                    )
                     if to == process_id:
                         self._handle_send(
                             process_id, shard_id, process_id, msg

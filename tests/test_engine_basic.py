@@ -1,0 +1,133 @@
+"""Device-engine differential tests: Basic protocol on the vmapped JAX
+engine must reproduce the reference's deterministic sim expectations
+(fantoch/src/sim/runner.rs:818-870) — the same numbers the host oracle
+reproduces in test_sim_basic.py — with several configs advancing in one
+batch.
+"""
+
+import numpy as np
+import pytest
+
+from fantoch_tpu.core import Config, Planet
+from fantoch_tpu.engine import EngineDims, make_lane, run_lanes
+from fantoch_tpu.engine.protocols import BasicDev
+
+COMMANDS_PER_CLIENT = 100
+PROCESS_REGIONS = ["asia-east1", "us-central1", "us-west1"]
+CLIENT_REGIONS = ["us-west1", "us-west2"]
+
+
+def make_specs(fs, clients_per_region=1, commands=COMMANDS_PER_CLIENT):
+    planet = Planet.new()
+    clients = clients_per_region * len(CLIENT_REGIONS)
+    dims = EngineDims.for_protocol(
+        BasicDev,
+        n=3,
+        clients=clients,
+        payload=BasicDev.payload_width(3),
+        total_commands=commands * clients,
+        dot_slots=commands * clients + 1,
+        regions=len(CLIENT_REGIONS),
+    )
+    specs = [
+        make_lane(
+            BasicDev,
+            planet,
+            Config(n=3, f=f, gc_interval_ms=100),
+            conflict_rate=100,
+            pool_size=1,
+            commands_per_client=commands,
+            clients_per_region=clients_per_region,
+            process_regions=PROCESS_REGIONS,
+            client_regions=CLIENT_REGIONS,
+            dims=dims,
+            extra_time_ms=1000,
+        )
+        for f in fs
+    ]
+    return dims, specs
+
+
+def test_engine_matches_reference_latency_means():
+    """One batch sweeping f ∈ {0,1,2}; exact reference means
+    (runner.rs:832-848)."""
+    dims, specs = make_specs([0, 1, 2])
+    results = run_lanes(BasicDev, dims, specs)
+    expected = {0: (0.0, 24.0), 1: (34.0, 58.0), 2: (118.0, 142.0)}
+    for f, res in zip([0, 1, 2], results):
+        assert not res.err
+        mean1, mean2 = expected[f]
+        assert res.issued("us-west1") == COMMANDS_PER_CLIENT
+        assert res.issued("us-west2") == COMMANDS_PER_CLIENT
+        assert res.latency_mean("us-west1") == mean1
+        assert res.latency_mean("us-west2") == mean2
+        # all commands garbage-collected at every process
+        # (check_metrics, fantoch_ps/src/protocol/mod.rs:858-875)
+        total = COMMANDS_PER_CLIENT * len(CLIENT_REGIONS)
+        stable = res.protocol_metrics["stable"]
+        assert list(stable) == [total] * 3
+
+
+def test_engine_latency_independent_of_client_count():
+    """runner.rs:851-870: stats don't change with more clients."""
+    dims1, specs1 = make_specs([1], clients_per_region=1, commands=50)
+    one = run_lanes(BasicDev, dims1, specs1)[0]
+    dims10, specs10 = make_specs([1], clients_per_region=10, commands=50)
+    ten = run_lanes(BasicDev, dims10, specs10)[0]
+    assert not one.err and not ten.err
+    for region in CLIENT_REGIONS:
+        assert one.latency_mean(region) == ten.latency_mean(region)
+        h1, h10 = one.histogram(region), ten.histogram(region)
+        assert h1.cov() == h10.cov()
+
+
+def test_engine_matches_host_oracle():
+    """Differential check against the host oracle runner on an AWS
+    planet (different latencies than the hand-checked GCP numbers)."""
+    from fantoch_tpu.client import ConflictPool, Workload
+    from fantoch_tpu.protocol import Basic
+    from fantoch_tpu.sim import Runner
+
+    planet = Planet.from_dataset("latency_aws_2021_02_13")
+    regions = planet.regions()[:3]
+    client_regions = regions[:2]
+    config = Config(n=3, f=1, gc_interval_ms=100)
+
+    workload = Workload(
+        shard_count=1,
+        key_gen=ConflictPool(conflict_rate=100, pool_size=1),
+        keys_per_command=1,
+        commands_per_client=50,
+        payload_size=0,
+    )
+    runner = Runner(
+        Basic, planet, config, workload, 1, list(regions), list(client_regions)
+    )
+    _, _, oracle_latencies = runner.run(extra_sim_time_ms=1000)
+
+    dims = EngineDims.for_protocol(
+        BasicDev,
+        n=3,
+        clients=2,
+        payload=BasicDev.payload_width(3),
+        total_commands=100,
+        dot_slots=101,
+        regions=2,
+    )
+    spec = make_lane(
+        BasicDev,
+        planet,
+        config,
+        conflict_rate=100,
+        pool_size=1,
+        commands_per_client=50,
+        clients_per_region=1,
+        process_regions=regions,
+        client_regions=client_regions,
+        dims=dims,
+    )
+    res = run_lanes(BasicDev, dims, [spec])[0]
+    assert not res.err
+    for region in client_regions:
+        _issued, hist = oracle_latencies[region]
+        assert res.latency_mean(region) == hist.mean()
