@@ -16,6 +16,8 @@ from .base import (
     ToForward,
     ToSend,
 )
+from .atlas import Atlas
 from .basic import Basic
+from .epaxos import EPaxos
 from .fpaxos import FPaxos
 from .tempo import Tempo

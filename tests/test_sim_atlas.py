@@ -1,0 +1,20 @@
+"""Atlas whole-protocol simulation tests.
+
+Mirrors fantoch_ps/src/protocol/mod.rs sim_atlas_* tests: 50%-conflict
+workloads must be 100% fast path for (n,f) ∈ {(3,1)} (threshold union ==
+union with f=1 always holds for n=3 quorums) and take some slow paths
+for (5,2).
+"""
+
+from fantoch_tpu.core import Config
+from fantoch_tpu.protocol import Atlas
+
+from harness import sim_test
+
+
+def test_sim_atlas_3_1():
+    assert sim_test(Atlas, Config(n=3, f=1)) == 0
+
+
+def test_sim_atlas_5_2():
+    assert sim_test(Atlas, Config(n=5, f=2)) > 0

@@ -1,0 +1,20 @@
+"""EPaxos whole-protocol simulation tests.
+
+Mirrors fantoch_ps/src/protocol/mod.rs sim_epaxos_* tests: fast path
+requires all fast-quorum deps equal, which holds trivially for n=3
+(fast quorum = 2, only the coordinator's deps are echoed back) and fails
+sometimes under conflicts for n=5.
+"""
+
+from fantoch_tpu.core import Config
+from fantoch_tpu.protocol import EPaxos
+
+from harness import sim_test
+
+
+def test_sim_epaxos_3_1():
+    assert sim_test(EPaxos, Config(n=3, f=1)) == 0
+
+
+def test_sim_epaxos_5_2():
+    assert sim_test(EPaxos, Config(n=5, f=2)) > 0
