@@ -370,28 +370,27 @@ class GraphExecutor(Executor):
     # -- partial replication (mod.rs:279-408) --------------------------
 
     def _process_requests(self, from_shard: ShardId, dots) -> None:
+        # batch all replies to the requesting shard into one message
+        # (mod.rs out_request_replies is keyed by shard and flushed as
+        # one RequestReply per shard, executor.rs:169-182)
+        replies: List = []
         for dot in dots:
             vertex = self.vertex_index.get(dot)
             if vertex is not None:
-                self.to_executors_buf.append(
-                    (
-                        from_shard,
-                        GraphRequestReply(
-                            [ReplyInfo(dot, vertex.cmd, list(vertex.deps))]
-                        ),
-                    )
-                )
+                replies.append(ReplyInfo(dot, vertex.cmd, list(vertex.deps)))
             elif (
                 dot.source in self.executed_clock
                 and self.executed_clock[dot.source].contains(dot.sequence)
             ):
-                self.to_executors_buf.append(
-                    (from_shard, GraphRequestReply([ReplyExecuted(dot)]))
-                )
+                replies.append(ReplyExecuted(dot))
             else:
                 self.buffered_in_requests.setdefault(from_shard, set()).add(
                     dot
                 )
+        if replies:
+            self.to_executors_buf.append(
+                (from_shard, GraphRequestReply(replies))
+            )
 
     def _handle_request_reply(self, infos, time) -> None:
         for info in infos:

@@ -18,6 +18,7 @@ from .base import (
 )
 from .atlas import Atlas
 from .basic import Basic
+from .caesar import Caesar
 from .epaxos import EPaxos
 from .fpaxos import FPaxos
 from .tempo import Tempo

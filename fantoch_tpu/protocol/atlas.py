@@ -261,9 +261,10 @@ class Atlas(Protocol):
         info.status = COLLECT
         info.quorum = set(msg.quorum)
         info.cmd = msg.cmd
-        assert info.synod.set_if_not_accepted(
+        was_set = info.synod.set_if_not_accepted(
             lambda: ConsensusValue(deps=set(deps))
         )
+        assert was_set
         self.to_processes_buf.append(
             ToSend(target={from_}, msg=MCollectAck(dot, deps))
         )
@@ -306,7 +307,8 @@ class Atlas(Protocol):
         assert cmd is not None
         self.to_executors_buf.append(GraphAdd(dot, cmd, set(value.deps)))
         info.status = COMMIT
-        assert info.synod.handle(from_, (S_CHOSEN, value)) is None
+        chosen_out = info.synod.handle(from_, (S_CHOSEN, value))
+        assert chosen_out is None
         my_shard = dot.source in self.shard_processes
         if self._gc_running() and my_shard:
             self.to_processes_buf.append(ToForward(MCommitDot(dot)))

@@ -203,6 +203,11 @@ class CommandsInfo(Generic[I]):
     def gc_single(self, dot: Dot) -> None:
         self._infos.pop(dot, None)
 
+    def pop(self, dot: Dot) -> Optional[I]:
+        """Remove and return the info of ``dot`` (LockedCommandsInfo::
+        gc_single returns the removed record for cleanup)."""
+        return self._infos.pop(dot, None)
+
     def __len__(self) -> int:
         return len(self._infos)
 

@@ -183,9 +183,10 @@ class EPaxos(Protocol):
         info.status = COLLECT
         info.quorum = set(msg.quorum)
         info.cmd = msg.cmd
-        assert info.synod.set_if_not_accepted(
+        was_set = info.synod.set_if_not_accepted(
             lambda: ConsensusValue(deps=set(deps))
         )
+        assert was_set
         # the coordinator does not ack itself (epaxos.rs:285-295)
         if not message_from_self:
             self.to_processes_buf.append(
@@ -230,7 +231,8 @@ class EPaxos(Protocol):
         assert cmd is not None
         self.to_executors_buf.append(GraphAdd(dot, cmd, set(value.deps)))
         info.status = COMMIT
-        assert info.synod.handle(from_, (S_CHOSEN, value)) is None
+        chosen_out = info.synod.handle(from_, (S_CHOSEN, value))
+        assert chosen_out is None
         if self._gc_running():
             self.to_processes_buf.append(ToForward(MCommitDot(dot)))
         else:

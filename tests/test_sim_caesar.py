@@ -1,0 +1,32 @@
+"""Caesar whole-protocol simulation tests.
+
+Mirrors fantoch_ps/src/protocol/mod.rs sim_caesar_* tests: the reference
+asserts no particular fast/slow-path split (the wait condition makes it
+timing-dependent) — the value is in the harness invariants: identical
+per-key execution order across processes and complete GC.
+"""
+
+from fantoch_tpu.core import Config
+from fantoch_tpu.protocol import Caesar
+
+from harness import sim_test
+
+
+def caesar_config(n, f, wait_condition):
+    return Config(n=n, f=f, caesar_wait_condition=wait_condition)
+
+
+def test_sim_caesar_wait_3_1():
+    sim_test(Caesar, caesar_config(3, 1, True))
+
+
+def test_sim_caesar_no_wait_3_1():
+    sim_test(Caesar, caesar_config(3, 1, False))
+
+
+def test_sim_caesar_wait_5_2():
+    sim_test(Caesar, caesar_config(5, 2, True))
+
+
+def test_sim_caesar_no_wait_5_2():
+    sim_test(Caesar, caesar_config(5, 2, False))
