@@ -37,6 +37,18 @@ from .dims import INF, EngineDims
 
 I32 = jnp.int32
 
+# per-client latency-log depth (debugging aid for differential tests)
+LAT_LOG = 64
+
+# optional per-process handled-message log depth (0 disables); set via
+# enable_debug_log() before building states/runners
+DEBUG_LOG = 0
+
+
+def enable_debug_log(depth: int) -> None:
+    global DEBUG_LOG
+    DEBUG_LOG = depth
+
 
 # ----------------------------------------------------------------------
 # outbox helpers (used by protocol handler modules)
@@ -121,6 +133,10 @@ def init_lane_state(protocol, dims: EngineDims, ctx_np: Dict[str, np.ndarray]):
         "dst": np.zeros((M,), np.int32),
         "mtype": np.zeros((M,), np.int32),
         "payload": np.zeros((M, P), np.int32),
+        # self-messages are delivered inline by the oracle (recursive
+        # ToForward/self-target handling, runner.rs:455-471): they beat
+        # any other message pending at the same instant
+        "prio": np.zeros((M,), bool),
     }
     budget = ctx_np["cmd_budget"]          # [C]
     attach = ctx_np["client_attach"]       # [C]
@@ -169,12 +185,17 @@ def init_lane_state(protocol, dims: EngineDims, ctx_np: Dict[str, np.ndarray]):
             "hist": np.zeros((dims.RR, dims.H), np.int32),
             "lat_sum": np.zeros((dims.RR,), np.int32),
             "lat_count": np.zeros((dims.RR,), np.int32),
+            # per-client in-order latency log (first LAT_LOG commands) —
+            # differential-debugging aid, negligible memory
+            "lat_log": np.full((C, LAT_LOG), -1, np.int32),
         },
         "now": np.int32(0),
         "msg_seq": np.int32(slot),
         "steps": np.int32(0),
         "done_time": np.int32(INF),
         "err": np.zeros((), bool),
+        "hlog": np.full((N, max(DEBUG_LOG, 1), 6), -1, np.int32),
+        "hlog_n": np.zeros((N,), np.int32),
     }
 
 
@@ -193,12 +214,27 @@ def _lane_step(protocol, dims: EngineDims, st, ctx):
     # 2. pop at most one message per process at time T ------------------
     # (T == INF means the lane is idle: consumed slots also hold INF, so
     # without the guard they would be replayed as stale messages)
+    # periodic timers take the whole step for their process: the oracle
+    # pops them first (enqueued an interval ago, lowest seq) and delivers
+    # their self-targeted emissions inline before any same-instant
+    # message — so pending messages wait for the next step
+    fire = (st["next_periodic"] == T) & (T < INF)  # [N, R]
+    fired_any = jnp.any(fire, axis=1)              # [N]
+
     at_t = (arrival == T) & (T < INF)
     procs = jnp.arange(N, dtype=I32)
-    cand = at_t[None, :] & (pool["dst"][None, :] == procs[:, None])  # [N, M]
-    order = jnp.where(cand, seq[None, :], INF)
+    cand = (
+        at_t[None, :]
+        & (pool["dst"][None, :] == procs[:, None])
+        & ~fired_any[:, None]
+    )  # [N, M]
+    # inline self-messages first (oracle recursion), then seq order
+    cand_prio = cand & pool["prio"][None, :]
+    use = jnp.where(jnp.any(cand_prio, axis=1)[:, None], cand_prio, cand)
+    order = jnp.where(use, seq[None, :], INF)
     slot = jnp.argmin(order, axis=1)                                  # [N]
-    has = jnp.min(order, axis=1) < INF
+    seq_handled = jnp.min(order, axis=1)                              # [N]
+    has = seq_handled < INF
     msg = {
         "valid": has,
         "src": pool["src"][slot],
@@ -208,33 +244,61 @@ def _lane_step(protocol, dims: EngineDims, st, ctx):
     arrival = arrival.at[jnp.where(has, slot, M)].set(INF, mode="drop")
 
     # 3. handlers -------------------------------------------------------
-    def handle_one(ps_slice, m, me):
-        return protocol.handle(ps_slice, m, me, T, ctx, dims)
-
-    ps, outbox = jax.vmap(handle_one)(st["ps"], msg, procs)  # outbox [N,F]
-
-    fire = (st["next_periodic"] == T) & ~has[:, None] & (T < INF)  # [N, R]
-
     def periodic_one(ps_slice, f, me):
         return protocol.periodic(ps_slice, f, me, T, ctx, dims)
 
-    ps, pout = jax.vmap(periodic_one)(ps, fire, procs)       # pout [N,F]
+    ps, pout = jax.vmap(periodic_one)(st["ps"], fire, procs)  # pout [N,F]
     next_periodic = jnp.where(
         fire, T + ctx["periodic_intervals"][None, :], st["next_periodic"]
     )
 
-    # 4. flatten emissions ---------------------------------------------
+    def handle_one(ps_slice, m, me):
+        return protocol.handle(ps_slice, m, me, T, ctx, dims)
+
+    ps, outbox = jax.vmap(handle_one)(ps, msg, procs)  # outbox [N,F]
+
+    # optional debug timeline of handled messages
+    hlog, hlog_n = st["hlog"], st["hlog_n"]
+    if DEBUG_LOG:
+        entry = jnp.stack(
+            [
+                jnp.broadcast_to(T, (N,)),
+                msg["mtype"],
+                msg["src"],
+                msg["payload"][:, 0],
+                msg["payload"][:, 1],
+                msg["payload"][:, 2],
+            ],
+            axis=1,
+        )
+        widx = jnp.where(has, jnp.minimum(hlog_n, DEBUG_LOG - 1), DEBUG_LOG)
+        hlog = hlog.at[procs, widx].set(entry, mode="drop")
+        hlog_n = hlog_n + has.astype(I32)
+
+    # 4. flatten emissions (periodic first, mirroring handler order) ----
     def flat(ob):
         return jax.tree_util.tree_map(
             lambda a: a.reshape((-1,) + a.shape[2:]), ob
         )
 
     out = jax.tree_util.tree_map(
-        lambda a, b: jnp.concatenate([a, b], axis=0), flat(outbox), flat(pout)
+        lambda a, b: jnp.concatenate([a, b], axis=0), flat(pout), flat(outbox)
     )
     emitter = jnp.concatenate([jnp.repeat(procs, F), jnp.repeat(procs, F)])
     E = 2 * N * F
     valid, dst = out["valid"], out["dst"]
+
+    # sequence-number ordering for emissions: the oracle assigns schedule
+    # seqs in pop order — periodic events first (group 0, by process),
+    # then messages in the order they were handled (their own seq), each
+    # handler's emissions in outbox-slot order
+    grp = jnp.concatenate(
+        [jnp.zeros((N * F,), I32), jnp.ones((N * F,), I32)]
+    )
+    trig = jnp.concatenate(
+        [jnp.repeat(procs, F), jnp.repeat(seq_handled, F)]
+    )
+    slotk = jnp.tile(jnp.arange(F, dtype=I32), 2 * N)
 
     # 5. client rewrite: TO_CLIENT → latency record + next SUBMIT -------
     is_client = valid & (dst >= N)
@@ -267,6 +331,10 @@ def _lane_step(protocol, dims: EngineDims, st, ctx):
     hist = metrics["hist"].at[row, bucket].add(1, mode="drop")
     lat_sum = metrics["lat_sum"].at[row].add(latency, mode="drop")
     lat_count = metrics["lat_count"].at[row].add(1, mode="drop")
+    log_idx = jnp.where(is_client, cl["completed"][c], LAT_LOG)
+    lat_log = metrics["lat_log"].at[
+        jnp.where(is_client, c, C), log_idx
+    ].set(latency, mode="drop")
 
     # rewrite entries in place
     dst = jnp.where(issue, ctx["client_attach"][c], dst)
@@ -281,10 +349,15 @@ def _lane_step(protocol, dims: EngineDims, st, ctx):
     )
     valid = valid & (~is_client | issue)
     msg_arrival = base + delay
+    prio = ~is_client & (dst == emitter)
 
     # 6. scatter into free pool slots ----------------------------------
+    # rank entries in oracle schedule order (grp, trig, slotk) so that
+    # same-instant ties break identically to the host oracle
+    perm = jnp.lexsort((slotk, trig, grp))
+    pos_sorted = jnp.cumsum(valid[perm].astype(I32))          # [E], 1-based
+    rank = jnp.zeros((E,), I32).at[perm].set(pos_sorted)
     free = arrival == INF
-    rank = jnp.cumsum(valid.astype(I32))                      # [E], 1-based
     free_cum = jnp.cumsum(free.astype(I32))                   # [M]
     target = jnp.searchsorted(free_cum, rank, side="left")
     target = jnp.where(valid, target, M)
@@ -296,6 +369,7 @@ def _lane_step(protocol, dims: EngineDims, st, ctx):
         "dst": pool["dst"].at[target].set(dst, mode="drop"),
         "mtype": pool["mtype"].at[target].set(mtype, mode="drop"),
         "payload": pool["payload"].at[target].set(payload, mode="drop"),
+        "prio": pool["prio"].at[target].set(prio, mode="drop"),
     }
 
     # 7. termination bookkeeping ---------------------------------------
@@ -322,10 +396,13 @@ def _lane_step(protocol, dims: EngineDims, st, ctx):
             "hist": hist,
             "lat_sum": lat_sum,
             "lat_count": lat_count,
+            "lat_log": lat_log,
         },
         "now": T,
-        "msg_seq": st["msg_seq"] + rank[-1],
+        "msg_seq": st["msg_seq"] + jnp.sum(valid, dtype=I32),
         "steps": st["steps"] + 1,
+        "hlog": hlog,
+        "hlog_n": hlog_n,
         "done_time": done_time,
         "err": err,
     }

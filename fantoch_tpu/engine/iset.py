@@ -1,0 +1,67 @@
+"""Fixed-shape interval sets for the device engine.
+
+Array twin of ``fantoch_tpu/core/intervals.IntervalSet`` (itself the host
+mirror of the threshold crate's AboveExSet/ARClock): a *frontier* scalar
+(all of 1..=frontier present) plus up to G buffered gap ranges above it.
+Used for per-(key, voter) vote clocks in the Tempo table executor (votes
+can arrive out of order because attached votes ride through the
+coordinator while detached votes fly direct) and per-source committed-dot
+clocks in GC (slow-path commits can finish after later fast-path ones).
+
+All functions are pure and shaped for ``vmap``/scatter composition:
+state is a pair of arrays ``frontier`` (i32 scalar) and ``gaps`` [G, 2]
+(start, end; start == 0 marks a free slot). Overflowing G is reported via
+the returned flag — callers surface it as a lane error, never silently
+drop votes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+I32 = jnp.int32
+
+
+def iset_empty(g: int):
+    return jnp.zeros((), I32), jnp.zeros((g, 2), I32)
+
+
+def iset_add_range(frontier, gaps, start, end, enable=True):
+    """Union ``start..=end`` into the set. Returns (frontier, gaps,
+    overflow). Tolerates overlap with already-present events (union
+    semantics — the host IntervalSet's add returns False there; device
+    callers don't need that signal)."""
+    g = gaps.shape[0]
+    start = jnp.maximum(start, frontier + 1)
+    do = jnp.asarray(enable, bool) & (end >= start)
+
+    # extend the frontier directly when adjacent, else buffer as a gap
+    direct = do & (start == frontier + 1)
+    frontier = jnp.where(direct, jnp.maximum(frontier, end), frontier)
+
+    store = do & ~direct
+    free = gaps[:, 0] == 0
+    slot = jnp.argmax(free)
+    overflow = store & ~jnp.any(free)
+    slot = jnp.where(store & ~overflow, slot, g)
+    gaps = gaps.at[slot, 0].set(start, mode="drop")
+    gaps = gaps.at[slot, 1].set(end, mode="drop")
+
+    # absorb gaps that touch the (possibly advanced) frontier; one pass
+    # per buffered gap bounds the chain
+    def absorb(_, carry):
+        frontier, gaps = carry
+        hit = (gaps[:, 0] > 0) & (gaps[:, 0] <= frontier + 1)
+        new_frontier = jnp.maximum(
+            frontier, jnp.max(jnp.where(hit, gaps[:, 1], 0))
+        )
+        gaps = jnp.where(hit[:, None], 0, gaps)
+        return new_frontier, gaps
+
+    frontier, gaps = jax.lax.fori_loop(0, g, absorb, (frontier, gaps))
+    return frontier, gaps, overflow
+
+
+def iset_add(frontier, gaps, event, enable=True):
+    return iset_add_range(frontier, gaps, event, event, enable)

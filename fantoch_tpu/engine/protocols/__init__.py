@@ -8,5 +8,7 @@ matrices.
 """
 
 from .basic import BasicDev
+from .fpaxos import FPaxosDev
+from .tempo import TempoDev
 
-__all__ = ["BasicDev"]
+__all__ = ["BasicDev", "FPaxosDev", "TempoDev"]

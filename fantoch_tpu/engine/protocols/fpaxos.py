@@ -1,0 +1,281 @@
+"""Device twin of FPaxos (fantoch_ps/src/protocol/fpaxos.rs, host
+oracle: fantoch_tpu/protocol/fpaxos.py).
+
+Semantics: submits at non-leaders forward to the leader; the leader
+assigns the next slot and sends ``MAccept`` to the f+1 write quorum;
+on f+1 ``MAccepted`` the slot is chosen and broadcast; every process
+executes slots in order (SlotExecutor) and the process a client is
+attached to reports the result back. Stable slots are GC'd via
+committed-frontier exchange (synod/gc.rs).
+
+Device encoding notes:
+- the reference's ``MSpawnCommander`` self-forward is worker routing
+  (fpaxos.rs:198-238); on device the leader's submit handler spawns the
+  commander directly — same messages on the wire;
+- ballots never change (recovery is out of scope in the reference too),
+  so the acceptor's ``b >= ballot`` check always passes and ballots are
+  omitted from payloads;
+- with constant per-pair delays the engine delivers the leader's
+  ``MChosen`` stream in slot order, so the SlotExecutor's buffer
+  degenerates to a frontier counter; an out-of-order arrival trips the
+  lane error flag rather than silently reordering execution;
+- slots live in a window of D recycled entries, freed by GC, with
+  dirty-slot checks surfacing window overflow.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import I32, emit, emit_broadcast, empty_outbox
+from ..dims import INF, EngineDims
+
+
+class FPaxosDev:
+    SUBMIT = 0
+    MFORWARD = 1
+    MACCEPT = 2
+    MACCEPTED = 3
+    MCHOSEN = 4
+    MGC = 5
+    NUM_TYPES = 6
+    TO_CLIENT = 7
+
+    PERIODIC_ROWS = 1  # garbage collection
+
+    # -- host-side builders -------------------------------------------
+
+    @staticmethod
+    def payload_width(n: int) -> int:
+        return 3  # [slot, client, key]
+
+    @staticmethod
+    def periodic_intervals(config, dims: EngineDims):
+        gc = config.gc_interval_ms
+        return [gc if gc is not None else INF]
+
+    @staticmethod
+    def lane_ctx(config, dims: EngineDims, sorted_idx: np.ndarray):
+        """Write quorum = first f+1 processes in the leader's discovery
+        order (fpaxos_quorum_size, config.rs:270-272)."""
+        assert config.leader is not None, "FPaxos needs an initial leader"
+        N = dims.N
+        leader = config.leader - 1  # ids are 1-based, device is 0-based
+        q = config.fpaxos_quorum_size()
+        wq = np.zeros((N,), bool)
+        for member in sorted_idx[leader][:q]:
+            wq[member] = True
+        return {
+            "leader": np.int32(leader),
+            "write_quorum": wq,
+            "q_size": np.int32(q),
+        }
+
+    @staticmethod
+    def init_state(dims: EngineDims, ctx_np) -> Dict[str, np.ndarray]:
+        N, D = dims.N, dims.D
+        return {
+            # leader role: commander window (slot number, accept count)
+            "last_slot": np.zeros((N,), np.int32),
+            "acc_count": np.zeros((N, D), np.int32),
+            # acceptor role: window entry → accepted slot (0 = free)
+            "acc_slot": np.zeros((N, D), np.int32),
+            # executor frontier: next slot to execute is exec_frontier+1
+            "exec_frontier": np.zeros((N,), np.int32),
+            # GC (SynodGCTrack): committed frontier per other process
+            "others_committed": np.zeros((N, N), np.int32),
+            "seen": np.zeros((N, N), bool),
+            "m_stable": np.zeros((N,), np.int32),
+            "err": np.zeros((N,), bool),
+        }
+
+    @staticmethod
+    def error(ps):
+        return ps["err"]
+
+    @staticmethod
+    def metrics(ps_np) -> Dict[str, np.ndarray]:
+        return {"stable": ps_np["m_stable"]}
+
+    # -- device handlers ----------------------------------------------
+
+    @staticmethod
+    def handle(ps, msg, me, now, ctx, dims: EngineDims):
+        def _noop(ps, msg):
+            return ps, empty_outbox(dims)
+
+        branches = [
+            lambda ps, msg: _submit(ps, msg, me, ctx, dims),
+            lambda ps, msg: _submit(ps, msg, me, ctx, dims),  # MFORWARD
+            lambda ps, msg: _maccept(ps, msg, me, ctx, dims),
+            lambda ps, msg: _maccepted(ps, msg, me, ctx, dims),
+            lambda ps, msg: _mchosen(ps, msg, me, ctx, dims),
+            lambda ps, msg: _mgc(ps, msg, me, ctx, dims),
+            _noop,
+        ]
+        idx = jnp.clip(msg["mtype"], 0, FPaxosDev.NUM_TYPES)
+        return jax.lax.switch(idx, branches, ps, msg)
+
+    @staticmethod
+    def periodic(ps, fire, me, now, ctx, dims: EngineDims):
+        """Broadcast my committed frontier (== executed frontier, since
+        slots are chosen in order) to all-but-me (fpaxos.rs:343-357)."""
+        ob = emit_broadcast(
+            empty_outbox(dims),
+            FPaxosDev.MGC,
+            [ps["exec_frontier"], 0, 0],
+            ctx["n"],
+            me,
+            exclude_me=True,
+        )
+        ob = dict(ob, valid=ob["valid"] & fire[0])
+        return ps, ob
+
+
+def _slot_idx(slot, dims):
+    return (slot - 1) % dims.D
+
+
+def _submit(ps, msg, me, ctx, dims):
+    """SUBMIT/MFORWARD: non-leader forwards to the leader; the leader
+    assigns the next slot, spawns the commander, and sends MAccept to
+    the write quorum (fpaxos.rs:165-238)."""
+    client = msg["payload"][0]
+    key = msg["payload"][2]
+    is_leader = me == ctx["leader"]
+    do = msg["valid"] & is_leader
+
+    slot = ps["last_slot"] + 1
+    idx = _slot_idx(slot, dims)
+    dirty = ps["acc_count"][idx] != 0
+    ps = dict(
+        ps,
+        err=ps["err"] | (do & dirty),
+        last_slot=jnp.where(do, slot, ps["last_slot"]),
+    )
+
+    # outbox: slot 0 = forward-to-leader, slots 1..N = MAccept broadcast
+    # masked to the write quorum (F >= N + 1)
+    F, N, P = dims.F, dims.N, dims.P
+    procs = jnp.arange(N, dtype=I32)
+    valid = jnp.zeros((F,), bool)
+    dst = jnp.zeros((F,), I32)
+    mtype = jnp.zeros((F,), I32)
+    payload = jnp.zeros((F, P), I32)
+
+    valid = valid.at[0].set(msg["valid"] & ~is_leader)
+    dst = dst.at[0].set(ctx["leader"])
+    mtype = mtype.at[0].set(FPaxosDev.MFORWARD)
+    # MFORWARD is re-handled by _submit, which reads the SUBMIT payload
+    # layout [client, cmd_seq, key]
+    payload = payload.at[0, 0].set(client)
+    payload = payload.at[0, 2].set(key)
+
+    valid = valid.at[1 : N + 1].set(
+        do & ctx["write_quorum"] & (procs < ctx["n"])
+    )
+    dst = dst.at[1 : N + 1].set(procs)
+    mtype = mtype.at[1 : N + 1].set(FPaxosDev.MACCEPT)
+    payload = payload.at[1 : N + 1, 0].set(slot)
+    payload = payload.at[1 : N + 1, 1].set(client)
+    payload = payload.at[1 : N + 1, 2].set(key)
+
+    return ps, {"valid": valid, "dst": dst, "mtype": mtype, "payload": payload}
+
+
+def _maccept(ps, msg, me, ctx, dims):
+    """Acceptor stores the slot and replies MAccepted to the leader
+    (fpaxos.rs:240-262)."""
+    slot, client = msg["payload"][0], msg["payload"][1]
+    idx = _slot_idx(slot, dims)
+    dirty = ps["acc_slot"][idx] != 0
+    ps = dict(
+        ps,
+        err=ps["err"] | dirty,
+        acc_slot=ps["acc_slot"].at[idx].set(slot),
+    )
+    ob = emit(
+        empty_outbox(dims),
+        0,
+        msg["src"],
+        FPaxosDev.MACCEPTED,
+        [slot, client, 0],
+    )
+    return ps, ob
+
+
+def _maccepted(ps, msg, me, ctx, dims):
+    """Commander counts accepts; on exactly f+1 the slot is chosen and
+    broadcast to all (fpaxos.rs:264-315)."""
+    slot, client = msg["payload"][0], msg["payload"][1]
+    idx = _slot_idx(slot, dims)
+    cnt = ps["acc_count"][idx] + 1
+    chosen = cnt == ctx["q_size"]
+    # the commander is retired once the slot is chosen (commanders.pop),
+    # freeing the window entry for reuse
+    ps = dict(
+        ps,
+        acc_count=ps["acc_count"].at[idx].set(jnp.where(chosen, 0, cnt)),
+    )
+    ob = emit_broadcast(
+        empty_outbox(dims),
+        FPaxosDev.MCHOSEN,
+        [slot, client, 0],
+        ctx["n"],
+    )
+    ob = dict(ob, valid=ob["valid"] & chosen)
+    return ps, ob
+
+
+def _mchosen(ps, msg, me, ctx, dims):
+    """SlotExecutor: with FIFO delivery the chosen stream arrives in
+    slot order, so execution is a frontier bump; the client's attached
+    process reports the result (executor/slot.rs:17-69)."""
+    slot, client = msg["payload"][0], msg["payload"][1]
+    in_order = slot == ps["exec_frontier"] + 1
+    ps = dict(
+        ps,
+        err=ps["err"] | ~in_order,
+        exec_frontier=ps["exec_frontier"] + in_order.astype(I32),
+    )
+    mine = ctx["client_attach"][client] == me
+    ob = emit(
+        empty_outbox(dims),
+        0,
+        dims.N + client,
+        FPaxosDev.TO_CLIENT,
+        [slot],
+        valid=in_order & mine,
+    )
+    return ps, ob
+
+
+def _mgc(ps, msg, me, ctx, dims):
+    """SynodGCTrack: stable slot = min committed frontier across all
+    processes; free acceptor window entries up to it, counting only the
+    slots this process actually accepted (synod/gc.rs, acceptor.gc)."""
+    s = msg["src"]
+    committed = msg["payload"][0]
+    oc = ps["others_committed"].at[s].set(
+        jnp.maximum(ps["others_committed"][s], committed)
+    )
+    seen = ps["seen"].at[s].set(True)
+    procs = jnp.arange(dims.N, dtype=I32)
+    others = (procs < ctx["n"]) & (procs != me)
+    ready = jnp.all(seen | ~others)
+    min_others = jnp.min(jnp.where(others, oc, INF))
+    stable = jnp.minimum(ps["exec_frontier"], min_others)
+    stable = jnp.where(ready, stable, 0)
+    freed = (ps["acc_slot"] > 0) & (ps["acc_slot"] <= stable)
+    ps = dict(
+        ps,
+        others_committed=oc,
+        seen=seen,
+        m_stable=ps["m_stable"] + jnp.sum(freed),
+        acc_slot=jnp.where(freed, 0, ps["acc_slot"]),
+    )
+    return ps, empty_outbox(dims)

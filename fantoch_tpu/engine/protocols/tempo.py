@@ -1,0 +1,763 @@
+"""Device twin of Tempo (fantoch_ps/src/protocol/tempo.rs, host oracle:
+fantoch_tpu/protocol/tempo.py) — the flagship protocol.
+
+Flow: submit bumps the coordinator's per-key clock into a timestamp
+proposal; fast-quorum members bump their own clocks to at least the
+proposal and report (clock, vote range); the fast path commits at the
+max reported clock iff it was reported by >= f members, else a
+single-decree consensus round fixes the timestamp. Commits carry the
+attached votes to the table executor, which executes a command once a
+stability threshold's worth of voters have voted past its timestamp.
+Detached votes (clock bumps without commands) are batched and broadcast
+periodically to keep the stability frontier moving; the optional
+real-time mode bumps all clocks to the wall clock.
+
+Array encoding:
+- per-key clocks ``[K]``; the detached-vote accumulator is per-key range
+  slots ``[K, R, 2]`` (exact ranges, like the reference's ``Votes`` —
+  attached votes interleave with detached ones, so prefixes won't do);
+- the executor's per-(key, voter) vote clock is a frontier + gap-buffer
+  interval set (votes arrive out of order: attached votes ride through
+  the coordinator's MCommit while detached fly direct);
+- the votes table is ``[K, PK]`` pending slots drained in (clock, dot)
+  order; a drain executes ONE command and re-schedules itself via a
+  zero-delay self-message, so outbox shapes stay fixed while multiple
+  commands stabilize at the same instant;
+- commits may complete out of source order (slow vs fast path), so the
+  GC committed clock is an interval set per source, not a counter.
+
+Like the oracle, recovery and ``skip_fast_ack`` are not modeled; partial
+replication (MBump/MShardCommit) is host-oracle-only for now.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import I32, emit, emit_broadcast, empty_outbox
+from ..dims import INF, EngineDims
+from ..iset import iset_add, iset_add_range
+
+# dot sequences must fit below this when packed with their source for
+# lexicographic argmin
+_SEQ_BOUND = 1 << 20
+
+
+class TempoDev:
+    SUBMIT = 0
+    MCOLLECT = 1
+    MCOLLECTACK = 2
+    MCOMMIT = 3
+    MDETACHED = 4
+    MCONSENSUS = 5
+    MCONSENSUSACK = 6
+    MGC = 7
+    MDRAIN = 8
+    DETACH_DRAIN = 9
+    NUM_TYPES = 10
+    TO_CLIENT = 11
+
+    PERIODIC_ROWS = 3  # [garbage collection, clock bump, send detached]
+
+    def __init__(
+        self,
+        keys: int,
+        pending_per_key: int = 32,
+        detached_slots: int = 16,
+        gap_slots: int = 8,
+    ):
+        self.K = keys
+        self.PK = pending_per_key
+        self.R = detached_slots
+        self.G = gap_slots
+
+    # -- host-side builders -------------------------------------------
+
+    def payload_width(self, n: int) -> int:
+        # MCOMMIT: [src, seq, clock, key, client, nv] + (by, start, end)*n
+        return max(6 + 3 * n, n, 2 + 2 * 4)
+
+    def detached_per_msg(self, dims: EngineDims) -> int:
+        return (dims.P - 2) // 2
+
+    def periodic_intervals(self, config, dims: EngineDims):
+        def ms(v):
+            return v if v is not None else INF
+
+        return [
+            ms(config.gc_interval_ms),
+            ms(config.tempo_clock_bump_interval_ms),
+            ms(config.tempo_detached_send_interval_ms),
+        ]
+
+    def lane_ctx(self, config, dims: EngineDims, sorted_idx: np.ndarray):
+        N = dims.N
+        fq_size, wq_size, threshold = config.tempo_quorum_sizes()
+        fq = np.zeros((N, N), bool)
+        wq = np.zeros((N, N), bool)
+        for p in range(config.n):
+            for member in sorted_idx[p][:fq_size]:
+                fq[p, member] = True
+            for member in sorted_idx[p][:wq_size]:
+                wq[p, member] = True
+        return {
+            "fast_quorum": fq,
+            "write_quorum": wq,
+            "fq_size": np.int32(fq_size),
+            "wq_size": np.int32(wq_size),
+            "threshold": np.int32(threshold),
+            "clock_bump_mode": np.bool_(
+                config.tempo_clock_bump_interval_ms is not None
+            ),
+        }
+
+    def init_state(self, dims: EngineDims, ctx_np) -> Dict[str, np.ndarray]:
+        N, D = dims.N, dims.D
+        K, PK, R, G = self.K, self.PK, self.R, self.G
+        return {
+            # key clocks + detached accumulator (protocol)
+            "clocks": np.zeros((N, K), np.int32),
+            "det": np.zeros((N, K, R, 2), np.int32),
+            "max_commit_clock": np.zeros((N,), np.int32),
+            # per-dot payload (every process)
+            "seq_in_slot": np.zeros((N, N, D), np.int32),
+            "key_of": np.zeros((N, N, D), np.int32),
+            "client_of": np.zeros((N, N, D), np.int32),
+            # coordinator per own dot
+            "own_seq": np.zeros((N,), np.int32),
+            "ack_cnt": np.zeros((N, D), np.int32),
+            "max_clock": np.zeros((N, D), np.int32),
+            "max_cnt": np.zeros((N, D), np.int32),
+            "slow_acks": np.zeros((N, D), np.int32),
+            "votes_n": np.zeros((N, D), np.int32),
+            "votes_by": np.zeros((N, D, N), np.int32),
+            "votes_s": np.zeros((N, D, N), np.int32),
+            "votes_e": np.zeros((N, D, N), np.int32),
+            # table executor
+            "vote_front": np.zeros((N, K, N), np.int32),
+            "vote_gaps": np.zeros((N, K, N, G, 2), np.int32),
+            "pend_clock": np.zeros((N, K, PK), np.int32),
+            "pend_src": np.zeros((N, K, PK), np.int32),
+            "pend_seq": np.zeros((N, K, PK), np.int32),
+            "pend_client": np.zeros((N, K, PK), np.int32),
+            # committed-clock GC
+            "comm_front": np.zeros((N, N), np.int32),
+            "comm_gaps": np.zeros((N, N, G, 2), np.int32),
+            "others_frontier": np.zeros((N, N, N), np.int32),
+            "seen": np.zeros((N, N), bool),
+            "prev_stable": np.zeros((N, N), np.int32),
+            "m_fast": np.zeros((N,), np.int32),
+            "m_slow": np.zeros((N,), np.int32),
+            "m_stable": np.zeros((N,), np.int32),
+            "err": np.zeros((N,), bool),
+        }
+
+    @staticmethod
+    def error(ps):
+        return ps["err"]
+
+    @staticmethod
+    def metrics(ps_np) -> Dict[str, np.ndarray]:
+        return {
+            "fast_path": ps_np["m_fast"],
+            "slow_path": ps_np["m_slow"],
+            "stable": ps_np["m_stable"],
+        }
+
+    # -- device handlers ----------------------------------------------
+
+    def handle(self, ps, msg, me, now, ctx, dims: EngineDims):
+        def _noop(ps, msg):
+            return ps, empty_outbox(dims)
+
+        branches = [
+            lambda ps, msg: _submit(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _mcollect(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _mcollectack(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _mcommit(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _mdetached(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _mconsensus(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _mconsensusack(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _mgc(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _mdrain(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _detach_drain(self, ps, msg, me, ctx, dims),
+            _noop,
+        ]
+        idx = jnp.clip(msg["mtype"], 0, TempoDev.NUM_TYPES)
+        return jax.lax.switch(idx, branches, ps, msg)
+
+    def periodic(self, ps, fire, me, now, ctx, dims: EngineDims):
+        """Rows: GC frontier broadcast; real-time clock bump
+        (tempo.rs:972-992); detached-send kick-off."""
+        ob = emit_broadcast(
+            empty_outbox(dims),
+            TempoDev.MGC,
+            ps["comm_front"],
+            ctx["n"],
+            me,
+            exclude_me=True,
+        )
+        ob = dict(ob, valid=ob["valid"] & fire[0])
+
+        # clock bump: lift every key to max(max commit clock, micros)
+        min_clock = jnp.maximum(ps["max_commit_clock"], now * 1000)
+        ps = _detached_all(self, ps, min_clock, fire[1])
+
+        # send-detached: start the per-key drain chain (the oracle sends
+        # one message with all keys; the chain emits the same ranges at
+        # the same instant)
+        has = jnp.any(ps["det"][:, :, 0] > 0)
+        ob = emit(
+            ob,
+            dims.N,  # slot N is free: broadcast used 0..N-1
+            me,
+            TempoDev.DETACH_DRAIN,
+            [0],
+            valid=fire[2] & has,
+        )
+        return ps, ob
+
+
+# ----------------------------------------------------------------------
+# clock/vote helpers
+# ----------------------------------------------------------------------
+
+
+def _det_add(tempo, ps, key, start, end, enable):
+    """Append a detached vote range for ``key`` (Votes::add; ranges stay
+    exact because attached votes interleave)."""
+    det = ps["det"]
+    row = det[key]  # [R, 2]
+    # compress with an existing contiguous range (votes.rs:131-147)
+    touch = (row[:, 0] > 0) & (row[:, 1] + 1 == start)
+    can_compress = jnp.any(touch)
+    cslot = jnp.argmax(touch)
+    do = jnp.asarray(enable, bool) & (end >= start)
+    comp = do & can_compress
+    det = det.at[key, jnp.where(comp, cslot, tempo.R), 1].set(
+        end, mode="drop"
+    )
+    # otherwise take a free slot
+    free = row[:, 0] == 0
+    slot = jnp.argmax(free)
+    store = do & ~can_compress
+    overflow = store & ~jnp.any(free)
+    slot = jnp.where(store & ~overflow, slot, tempo.R)
+    det = det.at[key, slot, 0].set(start, mode="drop")
+    det = det.at[key, slot, 1].set(end, mode="drop")
+    return dict(ps, det=det, err=ps["err"] | overflow)
+
+
+def _bump(tempo, ps, key, up_to, enable):
+    """key_clocks.detached: vote (clock+1..up_to) and lift the clock
+    (clocks/keys/sequential.rs:96-104)."""
+    cur = ps["clocks"][key]
+    do = jnp.asarray(enable, bool) & (cur < up_to)
+    ps = _det_add(tempo, ps, key, cur + 1, up_to, do)
+    return dict(
+        ps,
+        clocks=ps["clocks"].at[key].set(jnp.where(do, up_to, cur)),
+    )
+
+
+def _detached_all(tempo, ps, min_clock, enable):
+    """Bump every key below ``min_clock`` (detached_all): vectorized over
+    keys, each claiming a free detached slot."""
+    clocks = ps["clocks"]  # [K]
+    det = ps["det"]  # [K, R, 2]
+    do = jnp.asarray(enable, bool) & (clocks < min_clock)
+    free = det[:, :, 0] == 0  # [K, R]
+    slot = jnp.argmax(free, axis=1)  # [K]
+    overflow = do & ~jnp.any(free, axis=1)
+    kidx = jnp.arange(tempo.K)
+    slot_w = jnp.where(do & ~overflow, slot, tempo.R)
+    det = det.at[kidx, slot_w, 0].set(clocks + 1, mode="drop")
+    det = det.at[kidx, slot_w, 1].set(min_clock, mode="drop")
+    return dict(
+        ps,
+        det=det,
+        clocks=jnp.where(do, min_clock, clocks),
+        err=ps["err"] | jnp.any(overflow),
+    )
+
+
+def _vote_add(tempo, ps, key, voter, start, end, enable):
+    """Union a vote range into the (key, voter) interval clock."""
+    front = ps["vote_front"][key, voter]
+    gaps = ps["vote_gaps"][key, voter]
+    front, gaps, overflow = iset_add_range(front, gaps, start, end, enable)
+    return dict(
+        ps,
+        vote_front=ps["vote_front"].at[key, voter].set(front),
+        vote_gaps=ps["vote_gaps"].at[key, voter].set(gaps),
+        err=ps["err"] | overflow,
+    )
+
+
+def _slot(seq, dims):
+    return (seq - 1) % dims.D
+
+
+# ----------------------------------------------------------------------
+# table-executor drain
+# ----------------------------------------------------------------------
+
+
+def _stable_clock(tempo, ps, key, ctx, dims):
+    """Threshold-ranked frontier over voters (table/mod.rs:243-263)."""
+    fronts = ps["vote_front"][key]  # [N]
+    procs = jnp.arange(dims.N, dtype=I32)
+    masked = jnp.where(procs < ctx["n"], fronts, INF)
+    ordered = jnp.sort(masked)
+    return jnp.take(ordered, ctx["n"] - ctx["threshold"])
+
+
+def _drain(tempo, ps, key, me, ctx, dims, ob, exec_slot, drain_slot,
+           enable=True):
+    """Execute the lowest stable pending command on ``key`` (if any) and
+    re-schedule when more are ready (the VotesTable stable_ops loop,
+    spread across zero-delay self-messages)."""
+    stable = _stable_clock(tempo, ps, key, ctx, dims)
+    clocks = ps["pend_clock"][key]  # [PK]
+    ready = (clocks > 0) & (clocks <= stable)
+    num_ready = jnp.sum(ready)
+    cmin = jnp.min(jnp.where(ready, clocks, INF))
+    tie = ready & (clocks == cmin)
+    packed = ps["pend_src"][key] * _SEQ_BOUND + ps["pend_seq"][key]
+    idx = jnp.argmin(jnp.where(tie, packed, INF))
+
+    do = jnp.asarray(enable, bool) & (num_ready > 0)
+    client = ps["pend_client"][key, idx]
+    ps = dict(
+        ps,
+        pend_clock=ps["pend_clock"]
+        .at[key, jnp.where(do, idx, tempo.PK)]
+        .set(0, mode="drop"),
+    )
+    ob = emit(
+        ob,
+        exec_slot,
+        dims.N + client,
+        TempoDev.TO_CLIENT,
+        [0],
+        valid=do & (ctx["client_attach"][client] == me),
+    )
+    ob = emit(
+        ob,
+        drain_slot,
+        me,
+        TempoDev.MDRAIN,
+        [key],
+        valid=do & (num_ready > 1),
+    )
+    return ps, ob
+
+
+def _pend_insert(tempo, ps, key, clock, src, seq, client):
+    slots = ps["pend_clock"][key]
+    free = slots == 0
+    idx = jnp.argmax(free)
+    overflow = ~jnp.any(free)
+    widx = jnp.where(overflow, tempo.PK, idx)
+    return dict(
+        ps,
+        pend_clock=ps["pend_clock"].at[key, widx].set(clock, mode="drop"),
+        pend_src=ps["pend_src"].at[key, widx].set(src, mode="drop"),
+        pend_seq=ps["pend_seq"].at[key, widx].set(seq, mode="drop"),
+        pend_client=ps["pend_client"].at[key, widx].set(client, mode="drop"),
+        err=ps["err"] | overflow,
+    )
+
+
+# ----------------------------------------------------------------------
+# handlers
+# ----------------------------------------------------------------------
+
+
+def _submit(tempo, ps, msg, me, ctx, dims):
+    """tempo.rs:267-339: next dot; clock proposal with the coordinator's
+    own attached vote kept locally (sent later inside MCommit)."""
+    client = msg["payload"][0]
+    key = msg["payload"][2]
+    seq = ps["own_seq"] + 1
+    slot = _slot(seq, dims)
+
+    cur = ps["clocks"][key]
+    clock = cur + 1  # max(0, highest key clock + 1), single key
+    ps = dict(
+        ps,
+        own_seq=seq,
+        clocks=ps["clocks"].at[key].set(clock),
+        ack_cnt=ps["ack_cnt"].at[slot].set(0),
+        max_clock=ps["max_clock"].at[slot].set(0),
+        max_cnt=ps["max_cnt"].at[slot].set(0),
+        slow_acks=ps["slow_acks"].at[slot].set(0),
+        votes_n=ps["votes_n"].at[slot].set(1),
+        votes_by=ps["votes_by"].at[slot, 0].set(me),
+        votes_s=ps["votes_s"].at[slot, 0].set(cur + 1),
+        votes_e=ps["votes_e"].at[slot, 0].set(clock),
+    )
+    ob = emit_broadcast(
+        empty_outbox(dims),
+        TempoDev.MCOLLECT,
+        [seq, key, clock, client],
+        ctx["n"],
+    )
+    return ps, ob
+
+
+def _mcollect(tempo, ps, msg, me, ctx, dims):
+    """tempo.rs:341-459: store payload; quorum members re-propose with
+    the remote clock as a floor and report their vote range."""
+    s = msg["src"]
+    seq, key, rclock, client = (
+        msg["payload"][0],
+        msg["payload"][1],
+        msg["payload"][2],
+        msg["payload"][3],
+    )
+    slot = _slot(seq, dims)
+    dirty = ps["seq_in_slot"][s, slot] != 0
+    ps = dict(
+        ps,
+        err=ps["err"] | dirty,
+        seq_in_slot=ps["seq_in_slot"].at[s, slot].set(seq),
+        key_of=ps["key_of"].at[s, slot].set(key),
+        client_of=ps["client_of"].at[s, slot].set(client),
+    )
+    in_q = ctx["fast_quorum"][s, me]
+    from_self = s == me
+
+    # non-self quorum member: proposal(cmd, remote clock)
+    cur = ps["clocks"][key]
+    clock = jnp.maximum(rclock, cur + 1)
+    propose = in_q & ~from_self
+    ps = dict(
+        ps,
+        clocks=ps["clocks"].at[key].set(jnp.where(propose, clock, cur)),
+    )
+    ack_clock = jnp.where(from_self, rclock, clock)
+    vs = jnp.where(propose, cur + 1, 0)
+    ve = jnp.where(propose, clock, 0)
+    ob = emit(
+        empty_outbox(dims),
+        0,
+        s,
+        TempoDev.MCOLLECTACK,
+        [seq, ack_clock, vs, ve],
+        valid=in_q,
+    )
+    return ps, ob
+
+
+def _mcollectack(tempo, ps, msg, me, ctx, dims):
+    """tempo.rs:461-554: aggregate clocks + votes; fast path iff the max
+    clock was reported >= f times; bump own keys to the running max."""
+    src = msg["src"]
+    seq, clock, vs, ve = (
+        msg["payload"][0],
+        msg["payload"][1],
+        msg["payload"][2],
+        msg["payload"][3],
+    )
+    slot = _slot(seq, dims)
+
+    # merge the ack's vote range
+    nv = ps["votes_n"][slot]
+    has_vote = vs > 0
+    fits = has_vote & (nv < dims.N)
+    widx = jnp.where(fits, nv, dims.N)
+    ps = dict(
+        ps,
+        votes_by=ps["votes_by"].at[slot, widx].set(src, mode="drop"),
+        votes_s=ps["votes_s"].at[slot, widx].set(vs, mode="drop"),
+        votes_e=ps["votes_e"].at[slot, widx].set(ve, mode="drop"),
+        votes_n=ps["votes_n"].at[slot].add(fits.astype(I32)),
+        err=ps["err"] | (has_vote & ~fits),
+    )
+
+    # quorum clock aggregation
+    old_max = ps["max_clock"][slot]
+    new_max = jnp.maximum(old_max, clock)
+    new_cnt = jnp.where(
+        clock > old_max, 1, ps["max_cnt"][slot] + (clock == old_max)
+    )
+    cnt = ps["ack_cnt"][slot] + 1
+    ps = dict(
+        ps,
+        max_clock=ps["max_clock"].at[slot].set(new_max),
+        max_cnt=ps["max_cnt"].at[slot].set(new_cnt),
+        ack_cnt=ps["ack_cnt"].at[slot].set(cnt),
+    )
+
+    # bump own keys to the running max (tempo.rs:497-514)
+    key = ps["key_of"][me, slot]
+    ps = _bump(tempo, ps, key, new_max, src != me)
+
+    all_acks = cnt == ctx["fq_size"]
+    fast = all_acks & (new_cnt >= ctx["f"])
+    slow = all_acks & ~fast
+    ps = dict(
+        ps,
+        m_fast=ps["m_fast"] + fast.astype(I32),
+        m_slow=ps["m_slow"] + slow.astype(I32),
+    )
+
+    client = ps["client_of"][me, slot]
+    ob = _commit_broadcast(
+        tempo, ps, me, seq, new_max, key, client, ctx, dims, fast
+    )
+    obc = emit_broadcast(
+        empty_outbox(dims),
+        TempoDev.MCONSENSUS,
+        [me, seq, new_max],
+        ctx["n"],
+    )
+    wq = jnp.zeros((dims.F,), bool).at[: dims.N].set(
+        ctx["write_quorum"][me]
+    )
+    obc = dict(obc, valid=obc["valid"] & slow & wq)
+    ob = {
+        "valid": jnp.where(fast, ob["valid"], obc["valid"]),
+        "dst": jnp.where(fast, ob["dst"], obc["dst"]),
+        "mtype": jnp.where(fast, ob["mtype"], obc["mtype"]),
+        "payload": jnp.where(fast, ob["payload"], obc["payload"]),
+    }
+    return ps, ob
+
+
+def _commit_broadcast(tempo, ps, me, seq, clock, key, client, ctx, dims,
+                      valid):
+    """Build the MCommit broadcast carrying the aggregated votes."""
+    slot = _slot(seq, dims)
+    N, P = dims.N, dims.P
+    pay = jnp.zeros((P,), I32)
+    pay = pay.at[0].set(me)
+    pay = pay.at[1].set(seq)
+    pay = pay.at[2].set(clock)
+    pay = pay.at[3].set(key)
+    pay = pay.at[4].set(client)
+    pay = pay.at[5].set(ps["votes_n"][slot])
+    pay = jax.lax.dynamic_update_slice(
+        pay,
+        jnp.stack(
+            [
+                ps["votes_by"][slot],
+                ps["votes_s"][slot],
+                ps["votes_e"][slot],
+            ],
+            axis=1,
+        ).reshape(-1),
+        (6,),
+    )
+    procs = jnp.arange(N, dtype=I32)
+    F = dims.F
+    v = jnp.zeros((F,), bool).at[:N].set(
+        jnp.asarray(valid, bool) & (procs < ctx["n"])
+    )
+    d = jnp.zeros((F,), I32).at[:N].set(procs)
+    m = jnp.zeros((F,), I32).at[:N].set(
+        jnp.full((N,), TempoDev.MCOMMIT, I32)
+    )
+    p = jnp.zeros((F, P), I32).at[:N].set(jnp.broadcast_to(pay, (N, P)))
+    return {"valid": v, "dst": d, "mtype": m, "payload": p}
+
+
+def _mcommit(tempo, ps, msg, me, ctx, dims):
+    """tempo.rs:556-654: detached-bump the committed clock, feed the
+    votes table (attached votes + pending entry), record the commit for
+    GC, then drain."""
+    dsrc = msg["payload"][0]
+    seq = msg["payload"][1]
+    clock = msg["payload"][2]
+    key = msg["payload"][3]
+    client = msg["payload"][4]
+    nv = msg["payload"][5]
+    slot = _slot(seq, dims)
+    have = ps["seq_in_slot"][dsrc, slot] == seq
+    ps = dict(ps, err=ps["err"] | ~have)
+
+    # clock management (real-time mode defers to the periodic bump)
+    bump_mode = ctx["clock_bump_mode"]
+    ps = dict(
+        ps,
+        max_commit_clock=jnp.where(
+            bump_mode,
+            jnp.maximum(ps["max_commit_clock"], clock),
+            ps["max_commit_clock"],
+        ),
+    )
+    ps = _bump(tempo, ps, key, clock, ~bump_mode)
+
+    # executor: attached votes + pending entry
+    def add_vote(i, ps):
+        by = msg["payload"][6 + 3 * i]
+        s = msg["payload"][6 + 3 * i + 1]
+        e = msg["payload"][6 + 3 * i + 2]
+        return _vote_add(tempo, ps, key, by, s, e, i < nv)
+
+    ps = jax.lax.fori_loop(0, dims.N, add_vote, ps)
+    ps = _pend_insert(tempo, ps, key, clock, dsrc, seq, client)
+
+    # GC committed clock
+    cf, cg, overflow = iset_add(
+        ps["comm_front"][dsrc], ps["comm_gaps"][dsrc], seq
+    )
+    ps = dict(
+        ps,
+        comm_front=ps["comm_front"].at[dsrc].set(cf),
+        comm_gaps=ps["comm_gaps"].at[dsrc].set(cg),
+        err=ps["err"] | overflow,
+    )
+    return _drain(
+        tempo, ps, key, me, ctx, dims, empty_outbox(dims), 0, 1
+    )
+
+
+def _mdetached(tempo, ps, msg, me, ctx, dims):
+    """tempo.rs:703-716: union the sender's detached ranges into its
+    vote clock for the key, then drain."""
+    voter = msg["src"]
+    key = msg["payload"][0]
+    nr = msg["payload"][1]
+
+    def add(i, ps):
+        s = msg["payload"][2 + 2 * i]
+        e = msg["payload"][2 + 2 * i + 1]
+        return _vote_add(tempo, ps, key, voter, s, e, i < nr)
+
+    ps = jax.lax.fori_loop(0, tempo.detached_per_msg(dims), add, ps)
+    return _drain(tempo, ps, key, me, ctx, dims, empty_outbox(dims), 0, 1)
+
+
+def _mconsensus(tempo, ps, msg, me, ctx, dims):
+    """tempo.rs:718-773 (no recovery: the initial ballot always wins, so
+    the acceptor just bumps its keys and acks)."""
+    dsrc, seq, clock = (
+        msg["payload"][0],
+        msg["payload"][1],
+        msg["payload"][2],
+    )
+    slot = _slot(seq, dims)
+    key = ps["key_of"][dsrc, slot]
+    has_cmd = ps["seq_in_slot"][dsrc, slot] == seq
+    ps = _bump(tempo, ps, key, clock, has_cmd)
+    ob = emit(
+        empty_outbox(dims),
+        0,
+        msg["src"],
+        TempoDev.MCONSENSUSACK,
+        [dsrc, seq],
+    )
+    return ps, ob
+
+
+def _mconsensusack(tempo, ps, msg, me, ctx, dims):
+    """tempo.rs:775-812: f+1 accepts choose the slow-path clock; commit
+    with the votes gathered during collect."""
+    seq = msg["payload"][1]
+    slot = _slot(seq, dims)
+    cnt = ps["slow_acks"][slot] + 1
+    chosen = cnt == ctx["wq_size"]
+    ps = dict(ps, slow_acks=ps["slow_acks"].at[slot].set(cnt))
+    key = ps["key_of"][me, slot]
+    client = ps["client_of"][me, slot]
+    ob = _commit_broadcast(
+        tempo,
+        ps,
+        me,
+        seq,
+        ps["max_clock"][slot],
+        key,
+        client,
+        ctx,
+        dims,
+        chosen,
+    )
+    return ps, ob
+
+
+def _mgc(tempo, ps, msg, me, ctx, dims):
+    """Committed-clock GC, identical to Basic's flow but with interval-
+    set committed clocks (commits may arrive out of source order)."""
+    N = dims.N
+    s = msg["src"]
+    frontier = msg["payload"][:N]
+    of = ps["others_frontier"].at[s].set(
+        jnp.maximum(ps["others_frontier"][s], frontier)
+    )
+    seen = ps["seen"].at[s].set(True)
+    procs = jnp.arange(N, dtype=I32)
+    nmask = procs < ctx["n"]
+    others = nmask & (procs != me)
+    ready = jnp.all(seen | ~others)
+    min_others = jnp.min(jnp.where(others[:, None], of, INF), axis=0)
+    stable = jnp.minimum(ps["comm_front"], min_others)
+    stable = jnp.where(ready & nmask, stable, 0)
+    delta = jnp.maximum(stable - ps["prev_stable"], 0)
+    prev_stable = jnp.maximum(ps["prev_stable"], stable)
+    freed = (ps["seq_in_slot"] > 0) & (
+        ps["seq_in_slot"] <= prev_stable[:, None]
+    )
+    ps = dict(
+        ps,
+        others_frontier=of,
+        seen=seen,
+        prev_stable=prev_stable,
+        m_stable=ps["m_stable"] + jnp.sum(delta),
+        seq_in_slot=jnp.where(freed, 0, ps["seq_in_slot"]),
+    )
+    return ps, empty_outbox(dims)
+
+
+def _mdrain(tempo, ps, msg, me, ctx, dims):
+    key = msg["payload"][0]
+    return _drain(tempo, ps, key, me, ctx, dims, empty_outbox(dims), 0, 1)
+
+
+def _detach_drain(tempo, ps, msg, me, ctx, dims):
+    """Send one key's pending detached ranges to everyone, then continue
+    the chain while any key still has ranges (the oracle's single
+    MDetached with all keys, split at the same simulated instant)."""
+    det = ps["det"]  # [K, R, 2]
+    has = det[:, :, 0] > 0  # [K, R]
+    key_has = jnp.any(has, axis=1)  # [K]
+    key = jnp.argmax(key_has)
+    any_key = jnp.any(key_has)
+
+    row = det[key]  # [R, 2]
+    occ = row[:, 0] > 0
+    order = jnp.cumsum(occ.astype(I32))
+    per_msg = tempo.detached_per_msg(dims)
+    take = occ & (order <= per_msg)
+    nr = jnp.sum(take)
+
+    # pack taken ranges into the payload
+    pay = jnp.zeros((dims.P,), I32)
+    pay = pay.at[0].set(key)
+    pay = pay.at[1].set(nr)
+    lo = jnp.where(take, 2 + 2 * (order - 1), dims.P)
+    pay = pay.at[lo].set(row[:, 0], mode="drop")
+    pay = pay.at[lo + 1].set(row[:, 1], mode="drop")
+
+    det = det.at[key].set(jnp.where(take[:, None], 0, row))
+    ps = dict(ps, det=det)
+
+    ob = emit_broadcast(
+        empty_outbox(dims), TempoDev.MDETACHED, pay, ctx["n"]
+    )
+    ob = dict(ob, valid=ob["valid"] & any_key)
+    more = jnp.any(det[:, :, 0] > 0)
+    ob = emit(
+        ob,
+        dims.N,
+        me,
+        TempoDev.DETACH_DRAIN,
+        [0],
+        valid=any_key & more,
+    )
+    return ps, ob

@@ -200,12 +200,9 @@ class CommandsInfo(Generic[I]):
                 count += 1
         return count
 
-    def gc_single(self, dot: Dot) -> None:
-        self._infos.pop(dot, None)
-
-    def pop(self, dot: Dot) -> Optional[I]:
-        """Remove and return the info of ``dot`` (LockedCommandsInfo::
-        gc_single returns the removed record for cleanup)."""
+    def gc_single(self, dot: Dot) -> Optional[I]:
+        """Remove ``dot``'s info, returning it for cleanup if present
+        (LockedCommandsInfo::gc_single returns the removed record)."""
         return self._infos.pop(dot, None)
 
     def __len__(self) -> int:

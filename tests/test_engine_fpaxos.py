@@ -1,0 +1,84 @@
+"""Device-engine FPaxos differential tests: latency means and GC totals
+must match the host oracle runner on identical configurations (leader,
+write quorum, slot-ordered execution)."""
+
+from fantoch_tpu.client import ConflictPool, Workload
+from fantoch_tpu.core import Config, Planet
+from fantoch_tpu.engine import EngineDims, make_lane, run_lanes
+from fantoch_tpu.engine.protocols import FPaxosDev
+from fantoch_tpu.protocol import FPaxos
+from fantoch_tpu.protocol.base import ProtocolMetricsKind
+from fantoch_tpu.sim import Runner
+
+COMMANDS = 50
+PROCESS_REGIONS = ["asia-east1", "us-central1", "us-west1"]
+CLIENT_REGIONS = ["us-west1", "us-west2"]
+
+
+def oracle(config):
+    planet = Planet.new()
+    workload = Workload(
+        shard_count=1,
+        key_gen=ConflictPool(conflict_rate=100, pool_size=1),
+        keys_per_command=1,
+        commands_per_client=COMMANDS,
+        payload_size=0,
+    )
+    runner = Runner(
+        FPaxos,
+        planet,
+        config,
+        workload,
+        1,
+        PROCESS_REGIONS,
+        list(CLIENT_REGIONS),
+    )
+    metrics, _, latencies = runner.run(extra_sim_time_ms=1000)
+    stable = sum(
+        pm.get_aggregated(ProtocolMetricsKind.STABLE) or 0
+        for pm, _em in metrics.values()
+    )
+    return latencies, stable
+
+
+def engine(config):
+    planet = Planet.new()
+    total = COMMANDS * len(CLIENT_REGIONS)
+    dims = EngineDims.for_protocol(
+        FPaxosDev,
+        n=3,
+        clients=2,
+        payload=FPaxosDev.payload_width(3),
+        total_commands=total,
+        dot_slots=total + 1,
+        regions=2,
+    )
+    spec = make_lane(
+        FPaxosDev,
+        planet,
+        config,
+        conflict_rate=100,
+        pool_size=1,
+        commands_per_client=COMMANDS,
+        clients_per_region=1,
+        process_regions=PROCESS_REGIONS,
+        client_regions=CLIENT_REGIONS,
+        dims=dims,
+    )
+    return run_lanes(FPaxosDev, dims, [spec])[0]
+
+
+def test_engine_fpaxos_matches_oracle():
+    for f, leader in [(1, 1), (1, 3), (2, 2)]:
+        config = Config(n=3, f=f, leader=leader, gc_interval_ms=100)
+        oracle_lat, oracle_stable = oracle(config)
+        res = engine(config)
+        assert not res.err, (f, leader)
+        for region in CLIENT_REGIONS:
+            _issued, hist = oracle_lat[region]
+            assert res.latency_mean(region) == hist.mean(), (f, leader, region)
+        # GC totals: stable slots counted at the f+1 acceptors only
+        assert int(res.protocol_metrics["stable"].sum()) == oracle_stable, (
+            f,
+            leader,
+        )
