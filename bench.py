@@ -1,93 +1,77 @@
 #!/usr/bin/env python
-"""Headline benchmark: batched protocol-simulation sweep throughput.
+"""Headline benchmark: batched Tempo-sweep throughput on device.
 
-Runs a batch of independent (region-set × f × conflict-rate)
-configurations of the Basic protocol through the on-device engine — the
-TPU-native replacement for the reference's rayon sweep
-(fantoch_ps/src/bin/simulation.rs:165-217, one CPU thread per config) —
-and reports swept configs/second.
+Runs a (region-set × f × conflict-rate) sweep of the flagship Tempo
+protocol through the on-device engine — the TPU-native replacement for
+the reference's rayon sweep (fantoch_ps/src/bin/simulation.rs:165-217,
+one CPU thread per config) — and reports swept configs/second.
 
 Baseline: the north-star target from BASELINE.md is 10,000 sweep points
-in under 60 s on a v5e-8, i.e. ~166.7 points/s per 8 chips ≈ 20.8
-points/s per chip; ``vs_baseline`` is measured single-chip points/s
-divided by that per-chip rate (>1.0 beats the target rate pro-rata).
+in under 60 s on a v5e-8, i.e. ~20.8 points/s per chip; ``vs_baseline``
+is measured single-chip points/s over that per-chip rate (>1.0 beats
+the target rate pro-rata).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
 from __future__ import annotations
 
-import itertools
 import json
 import time
 
 import jax
 
 from fantoch_tpu.core import Config, Planet
-from fantoch_tpu.engine import EngineDims, make_lane, run_lanes
-from fantoch_tpu.engine.driver import stack_states
-from fantoch_tpu.engine.core import build_runner
-from fantoch_tpu.engine.spec import stack_lanes
-from fantoch_tpu.engine.protocols import BasicDev
+from fantoch_tpu.engine import EngineDims
+from fantoch_tpu.engine.protocols import TempoDev
+from fantoch_tpu.parallel import make_sweep_specs, run_sweep
 
-COMMANDS_PER_CLIENT = 50
 N = 3
+COMMANDS = 50
+CLIENTS_PER_REGION = 1
 CONFLICTS = [0, 10, 50, 100]
-FS = [1, 2]
-
-
-def build_specs(planet: Planet):
-    regions = planet.regions()
-    # 8 distinct 3-region subsets × f × conflict = 64 sweep points
-    subsets = [regions[i : i + N] for i in range(8)]
-    total_cmds = N * COMMANDS_PER_CLIENT
-    dims = EngineDims.for_protocol(
-        BasicDev,
-        n=N,
-        clients=N,
-        payload=BasicDev.payload_width(N),
-        total_commands=total_cmds,
-        dot_slots=total_cmds + 1,
-        regions=N,
-    )
-    specs = [
-        make_lane(
-            BasicDev,
-            planet,
-            Config(n=N, f=f, gc_interval_ms=100),
-            conflict_rate=conflict,
-            pool_size=1,
-            commands_per_client=COMMANDS_PER_CLIENT,
-            clients_per_region=1,
-            process_regions=subset,
-            client_regions=subset,
-            dims=dims,
-            extra_time_ms=500,
-            seed=i,
-        )
-        for i, (subset, f, conflict) in enumerate(
-            itertools.product(subsets, FS, CONFLICTS)
-        )
-    ]
-    return dims, specs
+FS = [1]
+SUBSETS = 16  # region sets → 16 × 1 × 4 = 64 sweep points
 
 
 def main() -> None:
     planet = Planet.new()
-    dims, specs = build_specs(planet)
-    ctx = stack_lanes(specs)
-    state = stack_states(BasicDev, dims, specs)
-    runner = build_runner(BasicDev, dims)
+    regions = planet.regions()
+    region_sets = [regions[i : i + N] for i in range(SUBSETS)]
+    clients = N * CLIENTS_PER_REGION
+    tempo = TempoDev(keys=1 + clients)
+    total = COMMANDS * clients
+    dims = EngineDims.for_protocol(
+        tempo,
+        n=N,
+        clients=clients,
+        payload=tempo.payload_width(N),
+        total_commands=total,
+        dot_slots=total + 1,
+        regions=N,
+    )
+    base = Config(
+        n=N, f=1, gc_interval_ms=100, tempo_detached_send_interval_ms=100
+    )
+    specs = make_sweep_specs(
+        tempo,
+        planet,
+        region_sets=region_sets,
+        fs=FS,
+        conflicts=CONFLICTS,
+        commands_per_client=COMMANDS,
+        clients_per_region=CLIENTS_PER_REGION,
+        dims=dims,
+        config_base=base,
+    )
 
     # compile + warm up, then time
-    jax.block_until_ready(runner(state, ctx))
+    results = run_sweep(tempo, dims, specs)
+    assert not any(r.err for r in results), "lanes overflowed"
     t0 = time.perf_counter()
-    final = runner(state, ctx)
-    jax.block_until_ready(final)
+    results = run_sweep(tempo, dims, specs)
     elapsed = time.perf_counter() - t0
 
-    errs = int(final["err"].sum())
-    assert errs == 0, f"{errs} lanes overflowed"
     points_per_sec = len(specs) / elapsed
     per_chip_target = 10_000 / 60.0 / 8.0  # north-star rate, per chip
     print(
@@ -95,7 +79,8 @@ def main() -> None:
             {
                 "metric": "sweep_points_per_sec",
                 "value": round(points_per_sec, 2),
-                "unit": "configs/s (Basic n=3, 150 cmds, 1 chip)",
+                "unit": f"Tempo configs/s (n={N}, {total} cmds each, "
+                f"{len(jax.devices())} device(s))",
                 "vs_baseline": round(points_per_sec / per_chip_target, 3),
             }
         )

@@ -1,0 +1,147 @@
+"""The closed-form latency model.
+
+Host API mirrors ``fantoch_bote/src/lib.rs``: ``leaderless`` = client →
+closest server → that server's closest quorum (lib.rs:38-58);
+``leader`` = client → leader → leader's closest quorum (lib.rs:60-89);
+``best_leader`` picks by a Histogram statistic (lib.rs:91-120). The
+``nth_closest`` helper counts the source itself when it is a server
+(distance 0), exactly like filtering the planet's sorted list
+(lib.rs:160-180).
+
+``batched_config_stats`` is the device twin: given the full latency
+matrix, evaluate a [B, n] batch of server subsets for all clients at
+once — the unit of work the search fans out over.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from ..core.metrics import Histogram
+from ..core.planet import Planet, Region
+
+
+class Bote:
+    def __init__(self, planet: Planet | None = None):
+        self.planet = planet if planet is not None else Planet.new()
+
+    def leaderless(
+        self,
+        servers: Sequence[Region],
+        clients: Sequence[Region],
+        quorum_size: int,
+    ) -> List[Tuple[Region, int]]:
+        """lib.rs:38-58."""
+        out = []
+        for client in clients:
+            client_to_closest, closest = self._nth_closest(1, client, servers)
+            closest_to_quorum, _ = self._nth_closest(
+                quorum_size, closest, servers
+            )
+            out.append((client, client_to_closest + closest_to_quorum))
+        return out
+
+    def leader(
+        self,
+        leader: Region,
+        servers: Sequence[Region],
+        clients: Sequence[Region],
+        quorum_size: int,
+    ) -> List[Tuple[Region, int]]:
+        """lib.rs:60-89."""
+        leader_to_quorum, _ = self._nth_closest(quorum_size, leader, servers)
+        return [
+            (
+                client,
+                self.planet.ping_latency(client, leader) + leader_to_quorum,
+            )
+            for client in clients
+        ]
+
+    def best_leader(
+        self,
+        servers: Sequence[Region],
+        clients: Sequence[Region],
+        quorum_size: int,
+        sort_by: str = "cov",
+    ) -> Tuple[Region, Histogram]:
+        """lib.rs:91-120; ``sort_by`` in {mean, cov, mdtm}."""
+        stats = []
+        for leader in servers:
+            latencies = self.leader(leader, servers, clients, quorum_size)
+            hist = Histogram.from_values(lat for _c, lat in latencies)
+            stats.append((leader, hist))
+        stats.sort(key=lambda pair: getattr(pair[1], sort_by)())
+        return stats[0]
+
+    def _nth_closest(
+        self, nth: int, from_: Region, servers: Sequence[Region]
+    ) -> Tuple[int, Region]:
+        ranked = [
+            (lat, to)
+            for lat, to in self.planet.sorted(from_)
+            if to in set(servers)
+        ]
+        lat, to = ranked[nth - 1]
+        return lat, to
+
+
+def batched_config_stats(
+    lat: np.ndarray,
+    subsets: np.ndarray,
+    client_idx: np.ndarray,
+    quorum_sizes: Sequence[int],
+    leader_quorum_size: int | None = None,
+    xp=np,
+):
+    """Evaluate many server subsets at once.
+
+    lat:          [R, R] ping matrix over alphabetically-ordered regions
+                  (index order == the host model's name tie-break)
+    subsets:      [B, n] region indices per configuration
+    client_idx:   [C] region indices of clients
+    quorum_sizes: leaderless quorum sizes to evaluate (one output each)
+    leader_quorum_size: when set, also compute the best-COV-leader stats
+                  (FPaxos model, compute_stats: search.rs:271-276)
+
+    Returns a dict with, per quorum size q: ``lat_q`` [B, C] leaderless
+    client latencies; and when requested: ``leader`` [B] best leader
+    subset position + ``leader_lat`` [B, C] its client latencies. Pass
+    ``xp=jax.numpy`` to run the whole batch on device.
+    """
+    B, n = subsets.shape
+
+    # pairwise distances inside each subset: [B, n, n]
+    within = lat[subsets[:, :, None], subsets[:, None, :]]
+    within_sorted = xp.sort(within, axis=2)
+
+    # client → servers: [B, C, n]
+    c2s = lat[client_idx[None, :, None], subsets[:, None, :]]
+    client_to_closest = xp.min(c2s, axis=2)              # [B, C]
+    closest = xp.argmin(c2s, axis=2)                     # [B, C]
+
+    out = {}
+    for q in quorum_sizes:
+        # closest server's latency to its q-th closest (self included)
+        quorum_lat = within_sorted[:, :, q - 1]          # [B, n]
+        out[f"lat_{q}"] = client_to_closest + xp.take_along_axis(
+            quorum_lat, closest, axis=1
+        )
+
+    if leader_quorum_size is not None:
+        q = leader_quorum_size
+        quorum_lat = within_sorted[:, :, q - 1]          # [B, n]
+        # per candidate leader l: client→leader + leader→quorum: [B, n, C]
+        c2l = xp.swapaxes(c2s, 1, 2)                     # [B, n, C]
+        per_leader = c2l + quorum_lat[:, :, None]
+        mean = xp.mean(per_leader, axis=2)
+        std = xp.std(per_leader, axis=2)
+        cov = std / xp.maximum(mean, 1e-9)
+        best = xp.argmin(cov, axis=1)                    # [B]
+        out["leader"] = best
+        out["leader_lat"] = xp.take_along_axis(
+            per_leader, best[:, None, None], axis=1
+        )[:, 0, :]
+    return out

@@ -1,0 +1,220 @@
+"""Exhaustive ranked region-set search.
+
+Capability parity with ``fantoch_bote/src/search.rs``: for every
+candidate server subset, model Atlas/FPaxos/EPaxos client latencies
+(compute_stats, search.rs:262-319; the FPaxos leader is the best-COV
+leader for f=1, reused for f=2), score each config by Atlas's mean
+improvement over FPaxos plus a 30x-weighted improvement over EPaxos,
+and filter by minimum mean/fairness improvements (compute_score,
+search.rs:421-472). ``FTMetric`` picks which f values count
+(search.rs:652-666).
+
+The reference evaluates configs with rayon (search.rs:321-327); here the
+whole subset batch is one array program (``batched_config_stats``) that
+runs on numpy or, for large searches, on the TPU via ``xp=jax.numpy``.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from ..core.metrics import Histogram
+from ..core.planet import Planet, Region
+from .model import Bote, batched_config_stats
+
+
+class ProtocolModel:
+    """Quorum-size formulas (fantoch_bote/src/protocol.rs:20-35)."""
+
+    @staticmethod
+    def minority(n: int) -> int:
+        return n // 2
+
+    @staticmethod
+    def fpaxos(n: int, f: int) -> int:
+        return f + 1
+
+    @staticmethod
+    def epaxos(n: int, _f: int = 0) -> int:
+        f = ProtocolModel.minority(n)
+        return f + (f + 1) // 2
+
+    @staticmethod
+    def atlas(n: int, f: int) -> int:
+        return ProtocolModel.minority(n) + f
+
+
+class FTMetric:
+    """Which f values count for scoring (search.rs:652-666)."""
+
+    F1 = "f1"
+    F1F2 = "f1f2"
+
+    @staticmethod
+    def fs(metric: str, n: int) -> List[int]:
+        max_f = 1 if metric == FTMetric.F1 else 2
+        return list(range(1, min(ProtocolModel.minority(n), max_f) + 1))
+
+
+@dataclass
+class RankingParams:
+    """search.rs RankingParams."""
+
+    min_mean_fpaxos_improv: float
+    min_fairness_fpaxos_improv: float
+    min_mean_epaxos_improv: float = float("-inf")
+    min_n: int = 3
+    max_n: int = 13
+    ft_metric: str = FTMetric.F1F2
+
+
+def _max_f(n: int) -> int:
+    return min(ProtocolModel.minority(n), 2)  # search.rs:474-477
+
+
+def compute_stats(
+    config: Sequence[Region], all_clients: Sequence[Region], bote: Bote
+) -> Dict[str, Histogram]:
+    """Host reference for one config (search.rs:262-319): keys like the
+    reference's ProtocolStats — ``af1``/``ff1``/``e`` (+``C`` when
+    clients are colocated with the servers)."""
+    n = len(config)
+    stats: Dict[str, Histogram] = {}
+    leader, _ = bote.best_leader(
+        config, all_clients, ProtocolModel.fpaxos(n, 1), sort_by="cov"
+    )
+    for placement, clients in (("", all_clients), ("C", config)):
+        for f in range(1, _max_f(n) + 1):
+            atlas = bote.leaderless(
+                config, clients, ProtocolModel.atlas(n, f)
+            )
+            stats[f"af{f}{placement}"] = Histogram.from_values(
+                lat for _c, lat in atlas
+            )
+            fpaxos = bote.leader(
+                leader, config, clients, ProtocolModel.fpaxos(n, f)
+            )
+            stats[f"ff{f}{placement}"] = Histogram.from_values(
+                lat for _c, lat in fpaxos
+            )
+        epaxos = bote.leaderless(config, clients, ProtocolModel.epaxos(n))
+        stats[f"e{placement}"] = Histogram.from_values(
+            lat for _c, lat in epaxos
+        )
+    return stats
+
+
+@dataclass
+class RankedConfig:
+    score: float
+    config: Tuple[Region, ...]
+    means: Dict[str, float]
+
+
+class Search:
+    """Exhaustive search over all C(len(servers), n) subsets for each n
+    in [min_n, max_n] (odd n only, like the reference's configs)."""
+
+    def __init__(
+        self,
+        planet: Planet | None = None,
+        servers: Sequence[Region] | None = None,
+        clients: Sequence[Region] | None = None,
+    ):
+        self.planet = planet if planet is not None else Planet.new()
+        regions = sorted(self.planet.regions())  # name order == index order
+        self.servers = list(servers) if servers is not None else regions
+        self.clients = list(clients) if clients is not None else regions
+        self.region_index = {r: i for i, r in enumerate(regions)}
+        self.lat = self.planet.latency_matrix(regions).astype(np.float32)
+
+    def rank(self, params: RankingParams, xp=np) -> Dict[int, List[RankedConfig]]:
+        """Rank all configs per n; pass ``xp=jax.numpy`` to evaluate the
+        subset batches on device."""
+        out: Dict[int, List[RankedConfig]] = {}
+        for n in range(params.min_n, params.max_n + 1, 2):
+            subsets = list(
+                itertools.combinations(
+                    sorted(self.region_index[r] for r in self.servers), n
+                )
+            )
+            if not subsets:
+                continue
+            out[n] = self._rank_n(n, np.asarray(subsets), params, xp)
+        return out
+
+    def _rank_n(self, n, subsets, params: RankingParams, xp):
+        client_idx = np.asarray(
+            [self.region_index[r] for r in self.clients]
+        )
+        fs = FTMetric.fs(params.ft_metric, n)
+        quorums = sorted(
+            {ProtocolModel.atlas(n, f) for f in fs}
+            | {ProtocolModel.epaxos(n)}
+        )
+        res = batched_config_stats(
+            xp.asarray(self.lat),
+            xp.asarray(subsets),
+            xp.asarray(client_idx),
+            quorums,
+            leader_quorum_size=ProtocolModel.fpaxos(n, 1),
+            xp=xp,
+        )
+        # FPaxos per-f latencies with the f=1-chosen leader
+        lat = xp.asarray(self.lat)
+        c2s = lat[xp.asarray(client_idx)[None, :, None],
+                  xp.asarray(subsets)[:, None, :]]      # [B, C, n]
+        within = lat[xp.asarray(subsets)[:, :, None],
+                     xp.asarray(subsets)[:, None, :]]
+        within_sorted = xp.sort(within, axis=2)
+        leader = res["leader"]                           # [B]
+        c2l = xp.take_along_axis(
+            c2s, leader[:, None, None], axis=2
+        )[:, :, 0]                                       # [B, C]
+
+        def stats(latencies):
+            mean = xp.mean(latencies, axis=1)
+            std = xp.std(latencies, axis=1)
+            return mean, std / xp.maximum(mean, 1e-9)
+
+        valid = np.ones((subsets.shape[0],), bool)
+        score = np.zeros((subsets.shape[0],), np.float64)
+        means: Dict[str, np.ndarray] = {}
+        e_mean, _ = stats(res[f"lat_{ProtocolModel.epaxos(n)}"])
+        means["e"] = np.asarray(e_mean)
+        for f in fs:
+            a_mean, a_cov = stats(res[f"lat_{ProtocolModel.atlas(n, f)}"])
+            lq = xp.take_along_axis(
+                within_sorted[:, :, ProtocolModel.fpaxos(n, f) - 1],
+                leader[:, None],
+                axis=1,
+            )                                            # [B, 1]
+            f_mean, f_cov = stats(c2l + lq)
+            a_mean, a_cov = np.asarray(a_mean), np.asarray(a_cov)
+            f_mean, f_cov = np.asarray(f_mean), np.asarray(f_cov)
+            means[f"af{f}"] = a_mean
+            means[f"ff{f}"] = f_mean
+            mean_improv = f_mean - a_mean
+            fairness_improv = f_cov - a_cov
+            valid &= mean_improv >= params.min_mean_fpaxos_improv
+            valid &= fairness_improv >= params.min_fairness_fpaxos_improv
+            e_improv = means["e"] - a_mean
+            if n in (11, 13):  # search.rs:460-464
+                valid &= e_improv >= params.min_mean_epaxos_improv
+            score += mean_improv + 30.0 * e_improv  # search.rs:467-468
+
+        region_names = sorted(self.region_index, key=self.region_index.get)
+        ranked = [
+            RankedConfig(
+                score=float(score[b]),
+                config=tuple(region_names[i] for i in subsets[b]),
+                means={k: float(v[b]) for k, v in means.items()},
+            )
+            for b in np.nonzero(valid)[0]
+        ]
+        ranked.sort(key=lambda rc: -rc.score)
+        return ranked

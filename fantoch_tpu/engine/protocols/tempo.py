@@ -593,14 +593,32 @@ def _mcommit(tempo, ps, msg, me, ctx, dims):
     )
     ps = _bump(tempo, ps, key, clock, ~bump_mode)
 
-    # executor: attached votes + pending entry
-    def add_vote(i, ps):
-        by = msg["payload"][6 + 3 * i]
-        s = msg["payload"][6 + 3 * i + 1]
-        e = msg["payload"][6 + 3 * i + 2]
-        return _vote_add(tempo, ps, key, by, s, e, i < nv)
-
-    ps = jax.lax.fori_loop(0, dims.N, add_vote, ps)
+    # executor: attached votes + pending entry. Voters in an MCommit are
+    # distinct (one range per quorum member), so scatter the ranges to
+    # per-voter lanes and union them with one vmapped interval-set add
+    # instead of a sequential loop.
+    idxs = 6 + 3 * jnp.arange(dims.N, dtype=I32)
+    bys = msg["payload"][idxs]
+    enable = jnp.arange(dims.N, dtype=I32) < nv
+    bys = jnp.where(enable, bys, dims.N)
+    per_s = jnp.zeros((dims.N,), I32).at[bys].set(
+        msg["payload"][idxs + 1], mode="drop"
+    )
+    per_e = jnp.zeros((dims.N,), I32).at[bys].set(
+        msg["payload"][idxs + 2], mode="drop"
+    )
+    per_enable = jnp.zeros((dims.N,), bool).at[bys].set(
+        enable, mode="drop"
+    )
+    fronts, gaps, ovf = jax.vmap(iset_add_range)(
+        ps["vote_front"][key], ps["vote_gaps"][key], per_s, per_e, per_enable
+    )
+    ps = dict(
+        ps,
+        vote_front=ps["vote_front"].at[key].set(fronts),
+        vote_gaps=ps["vote_gaps"].at[key].set(gaps),
+        err=ps["err"] | jnp.any(ovf),
+    )
     ps = _pend_insert(tempo, ps, key, clock, dsrc, seq, client)
 
     # GC committed clock

@@ -1,0 +1,11 @@
+"""Multi-chip sweep parallelism.
+
+The reference sweeps configurations with one rayon thread per config
+(fantoch_ps/src/bin/simulation.rs:165-217); here the batch axis of the
+vmapped engine shards across a ``jax.sharding.Mesh`` of TPU chips —
+each chip advances its shard of lanes, and results gather back to host.
+"""
+
+from .sweep import make_sweep_specs, run_sweep
+
+__all__ = ["make_sweep_specs", "run_sweep"]

@@ -1,0 +1,98 @@
+"""Mesh-sharded config sweeps.
+
+``make_sweep_specs`` enumerates (region subset × f × conflict-rate)
+points — the reference simulation binary's nested loops — into engine
+lanes; ``run_sweep`` stacks them, shards the lane axis over a device
+mesh with ``NamedSharding``, runs the batched engine, and collects
+per-lane results. Lanes are padded to a multiple of the mesh size with
+duplicate configs whose results are dropped.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..core.config import Config
+from ..core.planet import Planet
+from ..engine import (
+    EngineDims,
+    LaneResults,
+    LaneSpec,
+    collect_results,
+    make_lane,
+)
+from ..engine.core import build_runner, init_lane_state
+from ..engine.spec import stack_lanes
+
+
+def make_sweep_specs(
+    protocol,
+    planet: Planet,
+    *,
+    region_sets: Sequence[Sequence[str]],
+    fs: Sequence[int],
+    conflicts: Sequence[int],
+    commands_per_client: int,
+    clients_per_region: int,
+    dims: EngineDims,
+    config_base: Optional[Config] = None,
+    extra_time_ms: int = 500,
+) -> List[LaneSpec]:
+    """The sweep grid: one lane per (region set, f, conflict) point."""
+    base = config_base or Config(n=len(region_sets[0]), f=1,
+                                 gc_interval_ms=100)
+    specs = []
+    for i, (regions, f, conflict) in enumerate(
+        itertools.product(region_sets, fs, conflicts)
+    ):
+        config = base.with_(n=len(regions), f=f)
+        specs.append(
+            make_lane(
+                protocol,
+                planet,
+                config,
+                conflict_rate=conflict,
+                pool_size=1,
+                commands_per_client=commands_per_client,
+                clients_per_region=clients_per_region,
+                process_regions=list(regions),
+                client_regions=list(regions),
+                dims=dims,
+                extra_time_ms=extra_time_ms,
+                seed=i,
+            )
+        )
+    return specs
+
+
+def run_sweep(
+    protocol,
+    dims: EngineDims,
+    specs: Sequence[LaneSpec],
+    mesh: Optional[Mesh] = None,
+    max_steps: int = 1 << 22,
+) -> List[LaneResults]:
+    """Run a sweep batch, sharded over ``mesh`` (default: all local
+    devices on one axis)."""
+    if mesh is None:
+        mesh = Mesh(np.asarray(jax.devices()), ("sweep",))
+    shards = mesh.devices.size
+    pad = (-len(specs)) % shards
+    padded = list(specs) + [specs[-1]] * pad
+
+    ctx = stack_lanes(padded)
+    states = [init_lane_state(protocol, dims, s.ctx) for s in padded]
+    state = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *states)
+
+    sharding = NamedSharding(mesh, PartitionSpec("sweep"))
+    put = lambda tree: jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, sharding), tree
+    )
+    runner = build_runner(protocol, dims, max_steps)
+    final = runner(put(state), put(ctx))
+    return collect_results(protocol, dims, final, padded)[: len(specs)]

@@ -1,15 +1,24 @@
 """Test configuration.
 
-Device-engine tests run on a virtual 8-device CPU mesh so multi-chip
-sharding is exercised without TPU hardware; this must be set before jax is
-imported anywhere.
+Tests run on a virtual 8-device CPU mesh so multi-chip sharding is
+exercised without TPU hardware. The environment presets
+JAX_PLATFORMS=axon (a tunneled TPU) *and* pre-imports jax at interpreter
+startup, so plain env-var overrides are too late — but XLA backends
+initialize lazily, so flipping the config before the first computation
+still works. Benches target real hardware; tests target the
+deterministic CPU mesh.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

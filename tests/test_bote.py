@@ -1,0 +1,122 @@
+"""Bote latency model + search tests: the batched array path must agree
+with the straightforward host model (which mirrors fantoch_bote), and
+the ranked search must honor its own scoring rules."""
+
+import itertools
+
+import numpy as np
+
+from fantoch_tpu.bote import (
+    Bote,
+    FTMetric,
+    ProtocolModel,
+    RankingParams,
+    Search,
+    batched_config_stats,
+    compute_stats,
+)
+from fantoch_tpu.core import Planet
+
+
+def test_quorum_sizes():
+    """fantoch_bote/src/protocol.rs:118-135."""
+    assert ProtocolModel.fpaxos(3, 1) == 2
+    assert ProtocolModel.fpaxos(5, 2) == 3
+    assert ProtocolModel.epaxos(3) == 2
+    assert ProtocolModel.epaxos(5) == 3
+    assert ProtocolModel.epaxos(7) == 5
+    assert ProtocolModel.epaxos(11) == 8
+    assert ProtocolModel.epaxos(17) == 12
+    assert ProtocolModel.atlas(3, 1) == 2
+    assert ProtocolModel.atlas(5, 1) == 3
+    assert ProtocolModel.atlas(5, 2) == 4
+
+
+def test_batched_matches_host_model():
+    planet = Planet.new()
+    bote = Bote(planet)
+    regions = sorted(planet.regions())
+    index = {r: i for i, r in enumerate(regions)}
+    lat = planet.latency_matrix(regions).astype(np.float32)
+
+    servers_sets = [
+        ["asia-east1", "europe-west2", "us-central1"],
+        ["asia-south1", "europe-north1", "southamerica-east1"],
+        ["asia-east1", "asia-northeast1", "europe-west4", "us-east1",
+         "us-west1"],
+    ]
+    clients = sorted(planet.regions())[:10]
+    for servers in servers_sets:
+        servers = sorted(servers)
+        n = len(servers)
+        q = ProtocolModel.atlas(n, 1)
+        subsets = np.asarray([[index[r] for r in servers]])
+        res = batched_config_stats(
+            lat,
+            subsets,
+            np.asarray([index[c] for c in clients]),
+            [q],
+            leader_quorum_size=ProtocolModel.fpaxos(n, 1),
+        )
+        host = bote.leaderless(servers, clients, q)
+        np.testing.assert_array_equal(
+            res[f"lat_{q}"][0], [l for _c, l in host]
+        )
+        leader, hist = bote.best_leader(
+            servers, clients, ProtocolModel.fpaxos(n, 1), sort_by="cov"
+        )
+        assert servers[int(res["leader"][0])] == leader
+        np.testing.assert_allclose(
+            float(np.mean(res["leader_lat"][0])), hist.mean(), rtol=1e-6
+        )
+
+
+def test_search_ranks_and_scores():
+    planet = Planet.new()
+    servers = sorted(planet.regions())[:8]
+    search = Search(planet, servers=servers, clients=servers)
+    params = RankingParams(
+        min_mean_fpaxos_improv=-1000.0,
+        min_fairness_fpaxos_improv=-1000.0,
+        min_n=3,
+        max_n=5,
+        ft_metric=FTMetric.F1,
+    )
+    ranked = search.rank(params)
+    assert set(ranked) == {3, 5}
+    for n, configs in ranked.items():
+        assert len(configs) == len(
+            list(itertools.combinations(servers, n))
+        )
+        scores = [rc.score for rc in configs]
+        assert scores == sorted(scores, reverse=True)
+
+    # cross-check the top n=3 config's score against the host model
+    bote = Bote(planet)
+    top = ranked[3][0]
+    stats = compute_stats(list(top.config), servers, bote)
+    expected = (stats["ff1"].mean() - stats["af1"].mean()) + 30.0 * (
+        stats["e"].mean() - stats["af1"].mean()
+    )
+    assert abs(top.score - expected) < 1e-3
+
+
+def test_tighter_params_filter_configs():
+    planet = Planet.new()
+    servers = sorted(planet.regions())[:8]
+    search = Search(planet, servers=servers, clients=servers)
+    strict = RankingParams(
+        min_mean_fpaxos_improv=30.0,
+        min_fairness_fpaxos_improv=0.0,
+        min_n=3,
+        max_n=3,
+        ft_metric=FTMetric.F1,
+    )
+    lenient = RankingParams(
+        min_mean_fpaxos_improv=-1000.0,
+        min_fairness_fpaxos_improv=-1000.0,
+        min_n=3,
+        max_n=3,
+        ft_metric=FTMetric.F1,
+    )
+    assert len(search.rank(strict)[3]) < len(search.rank(lenient)[3])
