@@ -105,13 +105,21 @@ def emit_broadcast(outbox, mtype, payload, n, me=None, exclude_me=False):
 
 def gen_key(ctx, client, cmd_seq):
     """One key for (client, command) — counter-based so the device needs
-    no generator state. ConflictPool (key_gen.rs:96-110): with
-    probability conflict_rate% a key from the shared pool, otherwise the
-    client's private key (encoded as pool_size + client)."""
+    no generator state.
+
+    ConflictPool (key_gen.rs:96-110): with probability conflict_rate% a
+    key from the shared pool, otherwise the client's private key
+    (encoded as pool_size + client). Zipf (key_gen.rs:62-77,113-119):
+    inverse-CDF sampling over the precomputed weight table in
+    ``ctx["zipf_cum"]``. ``ctx["key_gen_kind"]`` selects (0 = pool,
+    1 = zipf)."""
     k = jr.fold_in(jr.fold_in(ctx["rng_key"], client), cmd_seq)
     conflict = jr.randint(k, (), 0, 100) < ctx["conflict_rate"]
     pool_key = jr.randint(jr.fold_in(k, 1), (), 0, jnp.maximum(ctx["pool_size"], 1))
-    return jnp.where(conflict, pool_key, ctx["pool_size"] + client).astype(I32)
+    pool = jnp.where(conflict, pool_key, ctx["pool_size"] + client)
+    u = jr.uniform(jr.fold_in(k, 2), ())
+    zipf = jnp.searchsorted(ctx["zipf_cum"], u, side="right")
+    return jnp.where(ctx["key_gen_kind"] == 0, pool, zipf).astype(I32)
 
 
 # ----------------------------------------------------------------------
@@ -146,7 +154,13 @@ def init_lane_state(protocol, dims: EngineDims, ctx_np: Dict[str, np.ndarray]):
     # device uses for subsequent commands
     keyctx = {
         k: jnp.asarray(ctx_np[k])
-        for k in ("rng_key", "conflict_rate", "pool_size")
+        for k in (
+            "rng_key",
+            "conflict_rate",
+            "pool_size",
+            "key_gen_kind",
+            "zipf_cum",
+        )
     }
     first_keys = np.asarray(
         jax.vmap(lambda c: gen_key(keyctx, c, 1))(jnp.arange(C, dtype=I32))

@@ -48,8 +48,9 @@ def make_lane(
     planet: Planet,
     config: Config,
     *,
-    conflict_rate: int,
+    conflict_rate: int = 100,
     pool_size: int = 1,
+    zipf: "tuple[float, int] | None" = None,
     commands_per_client: int,
     clients_per_region: int,
     process_regions: Sequence[str],
@@ -58,6 +59,10 @@ def make_lane(
     extra_time_ms: int = 1000,
     seed: int = 0,
 ) -> LaneSpec:
+    """``zipf=(coefficient, total_keys)`` switches the workload from the
+    ConflictPool generator to Zipf sampling over ``total_keys`` keys
+    (key_gen.rs:113-119); lanes batched together must share the same
+    zipf table size."""
     n = config.n
     assert len(process_regions) == n <= dims.N
     N, C = dims.N, dims.C
