@@ -16,6 +16,7 @@ from typing import Dict, List, Sequence
 import jax.random as jr
 import numpy as np
 
+from ..client.key_gen import zipf_weights
 from ..core.config import Config
 from ..core.planet import Planet
 from .dims import INF, EngineDims
@@ -103,6 +104,24 @@ def make_lane(
     )
     assert intervals.shape == (dims.R,)
 
+    # workload switch (key_gen.rs:113-119): kind 0 = ConflictPool, kind
+    # 1 = Zipf via inverse-CDF over the cumulative weight table; pool
+    # lanes carry a 1-element dummy table so shapes stay static
+    if zipf is None:
+        key_gen_kind = np.int32(0)
+        zipf_cum = np.ones((1,), np.float32)
+    else:
+        coefficient, total_keys = zipf
+        key_cap = getattr(protocol, "K", None)
+        assert key_cap is None or total_keys <= key_cap, (
+            f"zipf universe {total_keys} exceeds protocol key capacity "
+            f"{key_cap}; out-of-range keys would be silently dropped"
+        )
+        key_gen_kind = np.int32(1)
+        zipf_cum = np.cumsum(
+            zipf_weights(total_keys, coefficient)
+        ).astype(np.float32)
+
     ctx: Dict[str, np.ndarray] = {
         "n": np.int32(n),
         "f": np.int32(config.f),
@@ -113,6 +132,8 @@ def make_lane(
         "cmd_budget": cmd_budget,
         "conflict_rate": np.int32(conflict_rate),
         "pool_size": np.int32(pool_size),
+        "key_gen_kind": key_gen_kind,
+        "zipf_cum": zipf_cum,
         "rng_key": np.asarray(jr.PRNGKey(seed)),
         "periodic_intervals": intervals,
         "extra_time": np.int32(extra_time_ms),

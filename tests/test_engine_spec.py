@@ -1,0 +1,97 @@
+"""Lane-spec construction unit tests (no engine loop needed).
+
+Guards the ctx contract between `make_lane` (engine/spec.py) and
+`init_lane_state`/`gen_key` (engine/core.py) — the round-1 breakage —
+and the Zipf workload wiring (key_gen.rs:113-119 parity).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fantoch_tpu.client.key_gen import zipf_weights
+from fantoch_tpu.core import Config, Planet
+from fantoch_tpu.engine import EngineDims, make_lane, run_lanes
+from fantoch_tpu.engine.core import gen_key, init_lane_state
+from fantoch_tpu.engine.protocols import TempoDev
+
+
+def _spec(zipf=None, conflict=50, keys=8):
+    planet = Planet.new()
+    n = 3
+    regions = planet.regions()[:n]
+    tempo = TempoDev(keys=keys)
+    dims = EngineDims.for_protocol(
+        tempo,
+        n=n,
+        clients=n,
+        payload=tempo.payload_width(n),
+        total_commands=5 * n,
+        dot_slots=5 * n + 1,
+        regions=n,
+    )
+    config = Config(
+        n=n, f=1, gc_interval_ms=100, tempo_detached_send_interval_ms=100
+    )
+    spec = make_lane(
+        tempo,
+        planet,
+        config,
+        conflict_rate=conflict,
+        pool_size=1,
+        zipf=zipf,
+        commands_per_client=5,
+        clients_per_region=1,
+        process_regions=regions,
+        client_regions=regions,
+        dims=dims,
+    )
+    return tempo, dims, spec
+
+
+def test_make_lane_pool_ctx_feeds_init_lane_state():
+    tempo, dims, spec = _spec(zipf=None)
+    assert spec.ctx["key_gen_kind"] == 0
+    assert spec.ctx["zipf_cum"].shape == (1,)
+    st = init_lane_state(tempo, dims, spec.ctx)  # round-1 KeyError site
+    assert int(st["msg_seq"]) == dims.C  # one SUBMIT per live client
+
+
+def test_make_lane_zipf_ctx():
+    total_keys = 64
+    tempo, dims, spec = _spec(zipf=(1.0, total_keys), keys=total_keys)
+    assert spec.ctx["key_gen_kind"] == 1
+    assert spec.ctx["zipf_cum"].shape == (total_keys,)
+    assert spec.ctx["zipf_cum"][-1] == pytest.approx(1.0)
+    st = init_lane_state(tempo, dims, spec.ctx)
+    assert int(st["msg_seq"]) == dims.C
+
+
+def test_device_zipf_matches_weight_table():
+    """Empirical device key frequencies converge to the Zipf pmf the
+    host generator samples from (client/key_gen.py:52-57)."""
+    total_keys = 16
+    coefficient = 1.0
+    tempo, dims, spec = _spec(zipf=(coefficient, total_keys), keys=total_keys)
+    ctx = {k: jnp.asarray(v) for k, v in spec.ctx.items()}
+    draws = 4000
+    keys = jax.vmap(lambda s: gen_key(ctx, jnp.int32(0), s))(
+        jnp.arange(draws, dtype=jnp.int32)
+    )
+    keys = np.asarray(keys)
+    assert keys.min() >= 0 and keys.max() < total_keys
+    freq = np.bincount(keys, minlength=total_keys) / draws
+    want = zipf_weights(total_keys, coefficient)
+    assert np.abs(freq - want).max() < 0.03
+
+
+def test_engine_runs_zipf_lane_end_to_end():
+    total_keys = 8
+    tempo, dims, spec = _spec(zipf=(1.0, total_keys), keys=total_keys)
+    res = run_lanes(tempo, dims, [spec])[0]
+    assert not res.err
+    # every issued command takes exactly one path at its coordinator
+    assert int(res.protocol_metrics["fast_path"].sum()) + int(
+        res.protocol_metrics["slow_path"].sum()
+    ) == 5 * dims.C
