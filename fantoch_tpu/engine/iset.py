@@ -65,3 +65,18 @@ def iset_add_range(frontier, gaps, start, end, enable=True):
 
 def iset_add(frontier, gaps, event, enable=True):
     return iset_add_range(frontier, gaps, event, event, enable)
+
+
+def iset_contains(frontier, gaps, x):
+    """Membership test; broadcasts over leading axes of ``x`` when
+    ``frontier``/``gaps`` are gathered to matching shapes (gaps'
+    trailing axes must be [..., G, 2])."""
+    in_gap = jnp.any(
+        (gaps[..., 0] > 0)
+        & (gaps[..., 0] <= x[..., None])
+        & (x[..., None] <= gaps[..., 1]),
+        axis=-1,
+    )
+    # events are 1-based; 0 is the codebase's empty-slot marker and is
+    # never a member
+    return (x >= 1) & ((x <= frontier) | in_gap)

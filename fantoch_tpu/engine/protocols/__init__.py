@@ -9,6 +9,7 @@ matrices.
 
 from .basic import BasicDev
 from .fpaxos import FPaxosDev
+from .graphdep import AtlasDev, EPaxosDev
 from .tempo import TempoDev
 
-__all__ = ["BasicDev", "FPaxosDev", "TempoDev"]
+__all__ = ["AtlasDev", "BasicDev", "EPaxosDev", "FPaxosDev", "TempoDev"]

@@ -1,0 +1,658 @@
+"""Device twin of the dependency-based protocols — Atlas
+(fantoch_ps/src/protocol/atlas.rs, host oracle:
+fantoch_tpu/protocol/atlas.py) and EPaxos (epaxos.rs, host oracle:
+fantoch_tpu/protocol/epaxos.py) — sharing one array machinery.
+
+Flow: the coordinator takes its per-key latest-dot as the command's
+dependencies and broadcasts MCollect (atlas.rs:210-248); fast-quorum
+members merge the coordinator's deps with their own latest-dot and ack
+(250-323); the coordinator aggregates per-dependency report counts and
+takes the fast path iff
+
+- Atlas: every reported dep was reported by >= f members — the
+  threshold-union == union test (atlas.rs:353-390, quorum.rs:46-64);
+- EPaxos: all members reported identical dep sets (epaxos.rs:299-364,
+  quorum.rs:67-98);
+
+else a single-decree consensus round on the dep set runs through the
+write quorum (chosen at model-f+1 accepts, synod/single.rs). Commits
+carry (key, client, deps) into the graph executor.
+
+The two protocols differ only in quorum sizes and the fast-path
+predicate, so both compile to the same step function; per-lane ctx flags
+(``fp_mode``, ``ack_self``, quorum masks) select the behavior — one
+compiled sweep can mix Atlas and EPaxos lanes.
+
+Graph executor: the reference executes strongly-connected components of
+the dependency graph in topological order via Tarjan with
+executed-clock pruning (fantoch_ps/src/executor/graph/tarjan.rs:99-319).
+Tarjan's sequential DFS is hostile to SIMT, so the device computes the
+*greatest fixed point* of
+
+    ok(d) = committed(d) and for every dep e: executed(e) or ok(e)
+
+by masked relaxation (SURVEY.md §7.1): ok converges to exactly the dots
+whose transitive dependency closure is fully committed — the union of
+the SCCs Tarjan would pop — because SCC members keep each other in the
+set and any uncommitted transitive dep evicts the whole chain. One dot
+executes per drain step (DAG-ready dots first, then cycle members, in
+(source, sequence) order), chained through zero-delay self-messages so
+outbox shapes stay fixed; everything in one chain executes at the same
+simulated instant, matching the oracle's batched SCC execution.
+
+Array encoding (per process):
+- ``latest_{src,seq}[K]`` — latest-dep-per-key conflict index
+  (sequential.rs:8-60);
+- ``qd_{src,seq,cnt}[D, Q]`` — the coordinator's per-dot dependency
+  report counts (QuorumDeps; Q = N+1 bounds distinct deps because each
+  ack carries at most its reporter's latest plus the coordinator's);
+- ``vx_*[N, D]`` — the executor's vertex store (committed flag, key,
+  client, dep list per dot);
+- ``exec_front/exec_gaps`` — per-source executed interval set (execution
+  order need not follow sequence order);
+- committed-clock GC identical to the Tempo/Basic device flow.
+
+Like the oracle, recovery is not modeled (the reference's is ``todo!``,
+atlas.rs:427-430) and commits overtaking their MCollect payload raise
+the lane error flag instead of buffering (cannot happen on tie-free
+FIFO schedules).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import I32, emit, emit_broadcast, empty_outbox
+from ..dims import INF, EngineDims
+from ..iset import iset_add, iset_contains
+
+# dot sequences must fit below this when packed with their source
+_SEQ_BOUND = 1 << 20
+
+
+class _DepDev:
+    """Shared device machinery; subclasses pick quorum formulas and the
+    fast-path predicate via lane ctx."""
+
+    SUBMIT = 0
+    MCOLLECT = 1
+    MCOLLECTACK = 2
+    MCOMMIT = 3
+    MCONSENSUS = 4
+    MCONSENSUSACK = 5
+    MGC = 6
+    MDRAIN = 7
+    NUM_TYPES = 8
+    TO_CLIENT = 9
+
+    PERIODIC_ROWS = 1  # garbage collection
+
+    def __init__(self, keys: int, gap_slots: int = 8):
+        self.K = keys
+        self.G = gap_slots
+
+    # -- host-side builders -------------------------------------------
+
+    @staticmethod
+    def dep_slots(n: int) -> int:
+        """Q: each of the <= n ack reporters contributes at most its own
+        latest dep, plus the coordinator's dep rides in every ack."""
+        return n + 1
+
+    def payload_width(self, n: int) -> int:
+        # MCOMMIT: [dsrc, seq, key, client, nd] + (src, seq) * Q
+        return max(5 + 2 * self.dep_slots(n), n)
+
+    def periodic_intervals(self, config, dims: EngineDims):
+        gc = config.gc_interval_ms
+        return [gc if gc is not None else INF]
+
+    def _quorum_sizes(self, config):
+        raise NotImplementedError
+
+    def _fp_mode(self) -> int:
+        raise NotImplementedError
+
+    def _ack_self(self) -> bool:
+        raise NotImplementedError
+
+    def lane_ctx(self, config, dims: EngineDims, sorted_idx: np.ndarray):
+        N = dims.N
+        fq_size, wq_size = self._quorum_sizes(config)
+        fq = np.zeros((N, N), bool)
+        wq = np.zeros((N, N), bool)
+        for p in range(config.n):
+            for member in sorted_idx[p][:fq_size]:
+                fq[p, member] = True
+            for member in sorted_idx[p][:wq_size]:
+                wq[p, member] = True
+        ack_self = self._ack_self()
+        return {
+            "fast_quorum": fq,
+            "write_quorum": wq,
+            "expected_acks": np.int32(fq_size if ack_self else fq_size - 1),
+            "fp_mode": np.int32(self._fp_mode()),
+            "ack_self": np.bool_(ack_self),
+        }
+
+    def init_state(self, dims: EngineDims, ctx_np) -> Dict[str, np.ndarray]:
+        N, D, K, G = dims.N, dims.D, self.K, self.G
+        Q = self.dep_slots(N)
+        return {
+            # conflict index (protocol)
+            "latest_src": np.zeros((N, K), np.int32),
+            "latest_seq": np.zeros((N, K), np.int32),
+            # per-dot payload (every process)
+            "seq_in_slot": np.zeros((N, N, D), np.int32),
+            "key_of": np.zeros((N, N, D), np.int32),
+            "client_of": np.zeros((N, N, D), np.int32),
+            # coordinator per own dot
+            "own_seq": np.zeros((N,), np.int32),
+            "ack_cnt": np.zeros((N, D), np.int32),
+            "qd_src": np.zeros((N, D, Q), np.int32),
+            "qd_seq": np.zeros((N, D, Q), np.int32),
+            "qd_cnt": np.zeros((N, D, Q), np.int32),
+            "slow_acks": np.zeros((N, D), np.int32),
+            # graph-executor vertex store
+            "vx_committed": np.zeros((N, N, D), bool),
+            "vx_seq": np.zeros((N, N, D), np.int32),
+            "vx_key": np.zeros((N, N, D), np.int32),
+            "vx_client": np.zeros((N, N, D), np.int32),
+            "vx_nd": np.zeros((N, N, D), np.int32),
+            "vx_dep_src": np.zeros((N, N, D, Q), np.int32),
+            "vx_dep_seq": np.zeros((N, N, D, Q), np.int32),
+            # executed clock per source
+            "exec_front": np.zeros((N, N), np.int32),
+            "exec_gaps": np.zeros((N, N, G, 2), np.int32),
+            # committed-clock GC
+            "comm_front": np.zeros((N, N), np.int32),
+            "comm_gaps": np.zeros((N, N, G, 2), np.int32),
+            "others_frontier": np.zeros((N, N, N), np.int32),
+            "seen": np.zeros((N, N), bool),
+            "prev_stable": np.zeros((N, N), np.int32),
+            "m_fast": np.zeros((N,), np.int32),
+            "m_slow": np.zeros((N,), np.int32),
+            "m_stable": np.zeros((N,), np.int32),
+            "err": np.zeros((N,), bool),
+        }
+
+    @staticmethod
+    def error(ps):
+        return ps["err"]
+
+    @staticmethod
+    def metrics(ps_np) -> Dict[str, np.ndarray]:
+        return {
+            "fast_path": ps_np["m_fast"],
+            "slow_path": ps_np["m_slow"],
+            "stable": ps_np["m_stable"],
+        }
+
+    # -- device handlers ----------------------------------------------
+
+    def handle(self, ps, msg, me, now, ctx, dims: EngineDims):
+        def _noop(ps, msg):
+            return ps, empty_outbox(dims)
+
+        branches = [
+            lambda ps, msg: _submit(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _mcollect(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _mcollectack(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _mcommit(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _mconsensus(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _mconsensusack(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _mgc(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _mdrain(self, ps, msg, me, ctx, dims),
+            _noop,
+        ]
+        idx = jnp.clip(msg["mtype"], 0, _DepDev.NUM_TYPES)
+        return jax.lax.switch(idx, branches, ps, msg)
+
+    def periodic(self, ps, fire, me, now, ctx, dims: EngineDims):
+        """GARBAGE_COLLECTION: broadcast my committed frontier
+        (atlas.rs handle_event -> MGarbageCollection)."""
+        ob = emit_broadcast(
+            empty_outbox(dims),
+            _DepDev.MGC,
+            ps["comm_front"],
+            ctx["n"],
+            me,
+            exclude_me=True,
+        )
+        ob = dict(ob, valid=ob["valid"] & fire[0])
+        return ps, ob
+
+
+class AtlasDev(_DepDev):
+    """Atlas: fast quorum n/2+f, write quorum f+1 (config.rs:275-281);
+    coordinator acks itself (atlas.rs:306-323); threshold-union fast
+    path."""
+
+    def _quorum_sizes(self, config):
+        return config.atlas_quorum_sizes()
+
+    def _fp_mode(self) -> int:
+        return 0
+
+    def _ack_self(self) -> bool:
+        return True
+
+
+class EPaxosDev(_DepDev):
+    """EPaxos: minority-based quorums with f = n//2 (config.rs:284-292);
+    the coordinator does not ack itself (epaxos.rs:285-295); all-equal
+    fast path."""
+
+    def _quorum_sizes(self, config):
+        return config.epaxos_quorum_sizes()
+
+    def _fp_mode(self) -> int:
+        return 1
+
+    def _ack_self(self) -> bool:
+        return False
+
+
+# ----------------------------------------------------------------------
+# helpers
+# ----------------------------------------------------------------------
+
+
+def _slot(seq, dims):
+    return (seq - 1) % dims.D
+
+
+def _qd_add(ps, slot, dsrc, dseq, enable):
+    """Merge one reported dep into the coordinator's count table
+    (QuorumDeps.add, quorum.rs:24-34)."""
+    src_row = ps["qd_src"][slot]
+    seq_row = ps["qd_seq"][slot]
+    Q = src_row.shape[0]
+    do = jnp.asarray(enable, bool) & (dseq > 0)
+    match = (seq_row == dseq) & (src_row == dsrc)
+    found = jnp.any(match)
+    midx = jnp.argmax(match)
+    free = seq_row == 0
+    fidx = jnp.argmax(free)
+    overflow = do & ~found & ~jnp.any(free)
+    widx = jnp.where(do & ~overflow, jnp.where(found, midx, fidx), Q)
+    return dict(
+        ps,
+        qd_src=ps["qd_src"].at[slot, widx].set(dsrc, mode="drop"),
+        qd_seq=ps["qd_seq"].at[slot, widx].set(dseq, mode="drop"),
+        qd_cnt=ps["qd_cnt"]
+        .at[slot, widx]
+        .set(jnp.where(found, ps["qd_cnt"][slot, widx] + 1, 1), mode="drop"),
+        err=ps["err"] | overflow,
+    )
+
+
+def _commit_broadcast(dev, ps, me, seq, key, client, ctx, dims, valid):
+    """MCommit to all with the aggregated dep union (the single-shard arm
+    of mcommit_actions, atlas.rs:393-409)."""
+    slot = _slot(seq, dims)
+    Q = dev.dep_slots(dims.N)
+    N, P, F = dims.N, dims.P, dims.F
+    present = ps["qd_seq"][slot] > 0
+    nd = jnp.sum(present)
+    pay = jnp.zeros((P,), I32)
+    pay = pay.at[0].set(me)
+    pay = pay.at[1].set(seq)
+    pay = pay.at[2].set(key)
+    pay = pay.at[3].set(client)
+    pay = pay.at[4].set(nd)
+    # compact present deps to the front so nd prefixes are meaningful
+    order = jnp.where(present, jnp.cumsum(present.astype(I32)) - 1, Q)
+    packed = jnp.stack([ps["qd_src"][slot], ps["qd_seq"][slot]], axis=1)
+    lo = jnp.where(order < Q, 5 + 2 * order, P)
+    pay = pay.at[lo].set(packed[:, 0], mode="drop")
+    pay = pay.at[lo + 1].set(packed[:, 1], mode="drop")
+
+    procs = jnp.arange(N, dtype=I32)
+    v = jnp.zeros((F,), bool).at[:N].set(
+        jnp.asarray(valid, bool) & (procs < ctx["n"])
+    )
+    d = jnp.zeros((F,), I32).at[:N].set(procs)
+    m = jnp.zeros((F,), I32).at[:N].set(
+        jnp.full((N,), _DepDev.MCOMMIT, I32)
+    )
+    p = jnp.zeros((F, P), I32).at[:N].set(jnp.broadcast_to(pay, (N, P)))
+    return {"valid": v, "dst": d, "mtype": m, "payload": p}
+
+
+# ----------------------------------------------------------------------
+# graph-executor drain (relaxation replacing Tarjan)
+# ----------------------------------------------------------------------
+
+
+def _drain(dev, ps, me, ctx, dims, ob, exec_slot, drain_slot, enable=True):
+    """Execute one dot whose transitive dep closure is committed, and
+    re-schedule while more remain (tarjan.rs:99-319 as a greatest fixed
+    point; see module docstring for the equivalence argument)."""
+    N, D = dims.N, dims.D
+    dep_src = ps["vx_dep_src"]  # [N, D, Q]
+    dep_seq = ps["vx_dep_seq"]
+    dslot = _slot(dep_seq, dims)
+
+    # per-dep static facts: absent deps pass; executed deps pass
+    absent = dep_seq == 0
+    ex_front = ps["exec_front"][dep_src]           # [N, D, Q]
+    ex_gaps = ps["exec_gaps"][dep_src]             # [N, D, Q, G, 2]
+    dep_executed = iset_contains(ex_front, ex_gaps, dep_seq)
+    # the dep's vertex-store cell only counts if it still holds that seq
+    dep_cell_valid = ps["vx_seq"][dep_src, dslot] == dep_seq
+    dep_pass_static = absent | dep_executed
+
+    def body(carry):
+        ok, _changed = carry
+        dep_ok = ok[dep_src, dslot] & dep_cell_valid
+        new_ok = ok & jnp.all(dep_pass_static | dep_ok, axis=2)
+        return new_ok, jnp.any(new_ok != ok)
+
+    ok0 = ps["vx_committed"]
+    ok, _ = jax.lax.while_loop(
+        lambda c: c[1], body, (ok0, jnp.asarray(True))
+    )
+
+    num_ok = jnp.sum(ok)
+    # DAG-ready dots (all deps executed outright) execute before cycle
+    # members; ties in (source, sequence) order — the oracle's SCC pop
+    # order within one instant
+    ready = ok & jnp.all(dep_pass_static, axis=2)
+    sel = jnp.where(jnp.any(ready), ready, ok)
+    srcs = jnp.arange(N, dtype=I32)[:, None]
+    packed = srcs * _SEQ_BOUND + ps["vx_seq"]
+    flat_idx = jnp.argmin(jnp.where(sel, packed, INF))
+    esrc, eslot = flat_idx // D, flat_idx % D
+    eseq = ps["vx_seq"][esrc, eslot]
+    client = ps["vx_client"][esrc, eslot]
+
+    do = jnp.asarray(enable, bool) & (num_ok > 0)
+    front, gaps, overflow = iset_add(
+        ps["exec_front"][esrc], ps["exec_gaps"][esrc], eseq, do
+    )
+    ps = dict(
+        ps,
+        exec_front=ps["exec_front"].at[esrc].set(front),
+        exec_gaps=ps["exec_gaps"].at[esrc].set(gaps),
+        vx_committed=ps["vx_committed"]
+        .at[jnp.where(do, esrc, N), eslot]
+        .set(False, mode="drop"),
+        vx_seq=ps["vx_seq"]
+        .at[jnp.where(do, esrc, N), eslot]
+        .set(0, mode="drop"),
+        err=ps["err"] | overflow,
+    )
+    ob = emit(
+        ob,
+        exec_slot,
+        dims.N + client,
+        _DepDev.TO_CLIENT,
+        [0],
+        valid=do & (ctx["client_attach"][client] == me),
+    )
+    ob = emit(
+        ob,
+        drain_slot,
+        me,
+        _DepDev.MDRAIN,
+        [0],
+        valid=do & (num_ok > 1),
+    )
+    return ps, ob
+
+
+# ----------------------------------------------------------------------
+# handlers
+# ----------------------------------------------------------------------
+
+
+def _submit(dev, ps, msg, me, ctx, dims):
+    """atlas.rs:210-248 / epaxos.rs:199-220: next dot; deps = my latest
+    dot on the key; broadcast MCollect to all."""
+    client = msg["payload"][0]
+    key = msg["payload"][2]
+    seq = ps["own_seq"] + 1
+    slot = _slot(seq, dims)
+    Q = dev.dep_slots(dims.N)
+
+    prev_src = ps["latest_src"][key]
+    prev_seq = ps["latest_seq"][key]
+    ps = dict(
+        ps,
+        own_seq=seq,
+        latest_src=ps["latest_src"].at[key].set(me),
+        latest_seq=ps["latest_seq"].at[key].set(seq),
+        ack_cnt=ps["ack_cnt"].at[slot].set(0),
+        slow_acks=ps["slow_acks"].at[slot].set(0),
+        qd_src=ps["qd_src"].at[slot].set(jnp.zeros((Q,), I32)),
+        qd_seq=ps["qd_seq"].at[slot].set(jnp.zeros((Q,), I32)),
+        qd_cnt=ps["qd_cnt"].at[slot].set(jnp.zeros((Q,), I32)),
+    )
+    ob = emit_broadcast(
+        empty_outbox(dims),
+        _DepDev.MCOLLECT,
+        [seq, key, client, prev_src, prev_seq],
+        ctx["n"],
+    )
+    ob = dict(ob, valid=ob["valid"] & msg["valid"])
+    return ps, ob
+
+
+def _mcollect(dev, ps, msg, me, ctx, dims):
+    """atlas.rs:250-323: store payload; fast-quorum members merge the
+    coordinator's deps with their own latest and ack; the coordinator
+    acks its own deps iff ack_self (Atlas)."""
+    s = msg["src"]
+    seq, key, client, cdsrc, cdseq = (
+        msg["payload"][0],
+        msg["payload"][1],
+        msg["payload"][2],
+        msg["payload"][3],
+        msg["payload"][4],
+    )
+    slot = _slot(seq, dims)
+    dirty = (ps["seq_in_slot"][s, slot] != 0) | (ps["vx_seq"][s, slot] != 0)
+    ps = dict(
+        ps,
+        err=ps["err"] | dirty,
+        seq_in_slot=ps["seq_in_slot"].at[s, slot].set(seq),
+        key_of=ps["key_of"].at[s, slot].set(key),
+        client_of=ps["client_of"].at[s, slot].set(client),
+    )
+    in_q = ctx["fast_quorum"][s, me]
+    from_self = s == me
+
+    # quorum member (not the coordinator): add_cmd with the
+    # coordinator's deps as past (sequential.rs:62-86)
+    member = in_q & ~from_self
+    d1src = jnp.where(member, ps["latest_src"][key], cdsrc)
+    d1seq = jnp.where(member, ps["latest_seq"][key], cdseq)
+    # second dep = coordinator's, dropped when identical to mine
+    dup = (d1src == cdsrc) & (d1seq == cdseq)
+    d2src = jnp.where(member & ~dup, cdsrc, 0)
+    d2seq = jnp.where(member & ~dup, cdseq, 0)
+    ps = dict(
+        ps,
+        latest_src=ps["latest_src"]
+        .at[jnp.where(member, key, dev.K)]
+        .set(s, mode="drop"),
+        latest_seq=ps["latest_seq"]
+        .at[jnp.where(member, key, dev.K)]
+        .set(seq, mode="drop"),
+    )
+    ack = in_q & (ctx["ack_self"] | ~from_self)
+    ob = emit(
+        empty_outbox(dims),
+        0,
+        s,
+        _DepDev.MCOLLECTACK,
+        [seq, d1src, d1seq, d2src, d2seq],
+        valid=ack,
+    )
+    return ps, ob
+
+
+def _mcollectack(dev, ps, msg, me, ctx, dims):
+    """atlas.rs:325-391 / epaxos.rs:297-364: aggregate dep reports; on
+    the last expected ack run the fast-path predicate."""
+    seq = msg["payload"][0]
+    slot = _slot(seq, dims)
+    ps = _qd_add(ps, slot, msg["payload"][1], msg["payload"][2], True)
+    ps = _qd_add(ps, slot, msg["payload"][3], msg["payload"][4], True)
+    cnt = ps["ack_cnt"][slot] + 1
+    ps = dict(ps, ack_cnt=ps["ack_cnt"].at[slot].set(cnt))
+
+    all_acks = cnt == ctx["expected_acks"]
+    present = ps["qd_seq"][slot] > 0
+    counts = ps["qd_cnt"][slot]
+    # Atlas: every dep seen >= f times; EPaxos: every dep seen by all
+    threshold = jnp.where(
+        ctx["fp_mode"] == 0, ctx["f"], ctx["expected_acks"]
+    )
+    fp_ok = jnp.all(~present | (counts >= threshold))
+    fast = all_acks & fp_ok
+    slow = all_acks & ~fast
+    ps = dict(
+        ps,
+        m_fast=ps["m_fast"] + fast.astype(I32),
+        m_slow=ps["m_slow"] + slow.astype(I32),
+    )
+
+    key = ps["key_of"][me, slot]
+    client = ps["client_of"][me, slot]
+    ob = _commit_broadcast(dev, ps, me, seq, key, client, ctx, dims, fast)
+    obc = emit_broadcast(
+        empty_outbox(dims),
+        _DepDev.MCONSENSUS,
+        [me, seq],
+        ctx["n"],
+    )
+    wq = jnp.zeros((dims.F,), bool).at[: dims.N].set(
+        ctx["write_quorum"][me]
+    )
+    obc = dict(obc, valid=obc["valid"] & slow & wq)
+    ob = {
+        "valid": jnp.where(fast, ob["valid"], obc["valid"]),
+        "dst": jnp.where(fast, ob["dst"], obc["dst"]),
+        "mtype": jnp.where(fast, ob["mtype"], obc["mtype"]),
+        "payload": jnp.where(fast, ob["payload"], obc["payload"]),
+    }
+    return ps, ob
+
+
+def _mcommit(dev, ps, msg, me, ctx, dims):
+    """atlas.rs:393-464: feed the vertex store, record the committed dot
+    for GC, then drain the graph."""
+    dsrc = msg["payload"][0]
+    seq = msg["payload"][1]
+    key = msg["payload"][2]
+    client = msg["payload"][3]
+    nd = msg["payload"][4]
+    slot = _slot(seq, dims)
+    Q = dev.dep_slots(dims.N)
+
+    have = ps["seq_in_slot"][dsrc, slot] == seq
+    already = ps["vx_seq"][dsrc, slot] == seq
+    do = have & ~already
+    ps = dict(ps, err=ps["err"] | ~have)
+
+    idxs = 5 + 2 * jnp.arange(Q, dtype=I32)
+    dep_en = jnp.arange(Q, dtype=I32) < nd
+    dsrcs = jnp.where(dep_en, msg["payload"][idxs], 0)
+    dseqs = jnp.where(dep_en, msg["payload"][idxs + 1], 0)
+
+    wsrc = jnp.where(do, dsrc, dims.N)
+    ps = dict(
+        ps,
+        vx_committed=ps["vx_committed"].at[wsrc, slot].set(True, mode="drop"),
+        vx_seq=ps["vx_seq"].at[wsrc, slot].set(seq, mode="drop"),
+        vx_key=ps["vx_key"].at[wsrc, slot].set(key, mode="drop"),
+        vx_client=ps["vx_client"].at[wsrc, slot].set(client, mode="drop"),
+        vx_nd=ps["vx_nd"].at[wsrc, slot].set(nd, mode="drop"),
+        vx_dep_src=ps["vx_dep_src"].at[wsrc, slot].set(dsrcs, mode="drop"),
+        vx_dep_seq=ps["vx_dep_seq"].at[wsrc, slot].set(dseqs, mode="drop"),
+    )
+
+    cf, cg, overflow = iset_add(
+        ps["comm_front"][dsrc], ps["comm_gaps"][dsrc], seq, do
+    )
+    ps = dict(
+        ps,
+        comm_front=ps["comm_front"].at[dsrc].set(cf),
+        comm_gaps=ps["comm_gaps"].at[dsrc].set(cg),
+        err=ps["err"] | overflow,
+    )
+    return _drain(dev, ps, me, ctx, dims, empty_outbox(dims), 0, 1)
+
+
+def _mconsensus(dev, ps, msg, me, ctx, dims):
+    """Slow-path accept (synod/single.rs:107-131): with no recovery the
+    initial ballot always wins, so the acceptor just acks."""
+    dsrc, seq = msg["payload"][0], msg["payload"][1]
+    ob = emit(
+        empty_outbox(dims),
+        0,
+        msg["src"],
+        _DepDev.MCONSENSUSACK,
+        [dsrc, seq],
+    )
+    return ps, ob
+
+
+def _mconsensusack(dev, ps, msg, me, ctx, dims):
+    """Chosen at model-f+1 accepts (synod/single.rs:159; the synod is
+    built with the model f even for EPaxos, epaxos.rs:45-70), then
+    commit with the dep union gathered during collect."""
+    seq = msg["payload"][1]
+    slot = _slot(seq, dims)
+    cnt = ps["slow_acks"][slot] + 1
+    chosen = cnt == ctx["f"] + 1
+    ps = dict(ps, slow_acks=ps["slow_acks"].at[slot].set(cnt))
+    key = ps["key_of"][me, slot]
+    client = ps["client_of"][me, slot]
+    ob = _commit_broadcast(
+        dev, ps, me, seq, key, client, ctx, dims, chosen
+    )
+    return ps, ob
+
+
+def _mgc(dev, ps, msg, me, ctx, dims):
+    """Committed-clock GC (gc/clock.rs:10-171): meet of advertised
+    frontiers frees stable payload slots."""
+    N = dims.N
+    s = msg["src"]
+    frontier = msg["payload"][:N]
+    of = ps["others_frontier"].at[s].set(
+        jnp.maximum(ps["others_frontier"][s], frontier)
+    )
+    seen = ps["seen"].at[s].set(True)
+    procs = jnp.arange(N, dtype=I32)
+    nmask = procs < ctx["n"]
+    others = nmask & (procs != me)
+    ready = jnp.all(seen | ~others)
+    min_others = jnp.min(jnp.where(others[:, None], of, INF), axis=0)
+    stable = jnp.minimum(ps["comm_front"], min_others)
+    stable = jnp.where(ready & nmask, stable, 0)
+    delta = jnp.maximum(stable - ps["prev_stable"], 0)
+    prev_stable = jnp.maximum(ps["prev_stable"], stable)
+    freed = (ps["seq_in_slot"] > 0) & (
+        ps["seq_in_slot"] <= prev_stable[:, None]
+    )
+    ps = dict(
+        ps,
+        others_frontier=of,
+        seen=seen,
+        prev_stable=prev_stable,
+        m_stable=ps["m_stable"] + jnp.sum(delta),
+        seq_in_slot=jnp.where(freed, 0, ps["seq_in_slot"]),
+    )
+    return ps, empty_outbox(dims)
+
+
+def _mdrain(dev, ps, msg, me, ctx, dims):
+    return _drain(dev, ps, me, ctx, dims, empty_outbox(dims), 0, 1)
