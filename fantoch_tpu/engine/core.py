@@ -118,7 +118,12 @@ def gen_key(ctx, client, cmd_seq):
     pool_key = jr.randint(jr.fold_in(k, 1), (), 0, jnp.maximum(ctx["pool_size"], 1))
     pool = jnp.where(conflict, pool_key, ctx["pool_size"] + client)
     u = jr.uniform(jr.fold_in(k, 2), ())
-    zipf = jnp.searchsorted(ctx["zipf_cum"], u, side="right")
+    # clamp: float32 rounding can leave cum[-1] < 1.0, and a draw at or
+    # above it would index one past the table
+    zipf = jnp.minimum(
+        jnp.searchsorted(ctx["zipf_cum"], u, side="right"),
+        ctx["zipf_cum"].shape[0] - 1,
+    )
     return jnp.where(ctx["key_gen_kind"] == 0, pool, zipf).astype(I32)
 
 

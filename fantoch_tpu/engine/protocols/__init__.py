@@ -8,8 +8,16 @@ matrices.
 """
 
 from .basic import BasicDev
+from .caesar import CaesarDev
 from .fpaxos import FPaxosDev
 from .graphdep import AtlasDev, EPaxosDev
 from .tempo import TempoDev
 
-__all__ = ["AtlasDev", "BasicDev", "EPaxosDev", "FPaxosDev", "TempoDev"]
+__all__ = [
+    "AtlasDev",
+    "BasicDev",
+    "CaesarDev",
+    "EPaxosDev",
+    "FPaxosDev",
+    "TempoDev",
+]

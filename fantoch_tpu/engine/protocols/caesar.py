@@ -1,0 +1,1040 @@
+"""Device twin of Caesar (fantoch_ps/src/protocol/caesar.rs, host
+oracle: fantoch_tpu/protocol/caesar.py) — timestamp + dependency
+consensus with the wait condition.
+
+Flow: the coordinator proposes a logical clock and broadcasts MPropose
+to everyone — the fastest ⌊3n/4⌋+1 repliers form the (dynamic) fast
+quorum (caesar.rs:245-264). Every receiver computes the command's
+predecessors (lower-clock conflicts) and blockers (higher-clock
+conflicts); with blockers present the *wait condition* holds the reply
+until each blocker reaches a safe clock — accepting if this command
+appears in the blocker's deps, rejecting otherwise (caesar.rs:932-1096).
+All-ok replies commit on the fast path; any rejection once a majority
+replied triggers an MRetry round through the write quorum whose acks
+aggregate a final dep set (560-822). Execution is the two-phase
+predecessors executor: a command executes once every dep is committed
+and every lower-clock dep is executed — commands execute in clock order
+(executor/pred/mod.rs:104-339). GC frees a command once all n processes
+report it executed (BasicGCTrack + periodic MGarbageCollection).
+
+Device-design notes (equivalences relied on):
+- The oracle unblocks waiting commands incrementally via back-pointer
+  lists (info.blocking / try_to_unblock_again). The device instead
+  *re-evaluates* every waiting command's blockers after each
+  MCommit/MRetry, which is equivalent because ignore-ability is
+  monotone: once a blocker is safe with this command in its deps, its
+  committed deps can only be a superset of its retry deps (MCommit deps
+  aggregate every MRetryAck, each of which includes the MRetry's deps),
+  and a fully GC'd blocker was executed everywhere, so its accept/
+  reject decision already fired at its own commit instant.
+- Phase-two readiness ("every lower-clock dep executed") needs no fixed
+  point: the lower-clock relation is acyclic, so executing one ready
+  command per zero-delay drain step reaches the same set the oracle's
+  pending-index cascade does, in clock order, at the same instant.
+- Rejected proposals include the command's own old-clock entry in the
+  recomputed deps, exactly like the oracle (predecessors at the new
+  clock sees the old registration); the commit handler discards
+  self-deps (caesar.rs:665-668).
+
+Array encoding (per process): per-key clock tables ``kc_*[K, S]``
+((dot, clock) registrations; predecessors/blockers are masked compares
+over the row), per-dot lifecycle arrays (status, clock, deps, blockers),
+dynamic-quorum aggregation tables, committed/executed interval sets per
+source, and the executed→notify→broadcast GC buffers.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import I32, emit, emit_broadcast, empty_outbox
+from ..dims import INF, EngineDims
+from ..iset import iset_add, iset_contains
+
+_SEQ_BOUND = 1 << 20
+
+# statuses (caesar.rs Status; PROPOSE_BEGIN is transient host-side only)
+ST_START = 0
+ST_PROPOSE_END = 2
+ST_REJECT = 3
+ST_ACCEPT = 4
+ST_COMMIT = 5
+ST_EXECUTED = 6
+
+
+class CaesarDev:
+    SUBMIT = 0
+    MPROPOSE = 1
+    MPROPOSEACK = 2
+    MCOMMIT = 3
+    MRETRY = 4
+    MRETRYACK = 5
+    MGC = 6
+    WAIT_DRAIN = 7
+    EXEC_DRAIN = 8
+    GC_DRAIN = 9
+    NUM_TYPES = 10
+    TO_CLIENT = 11
+
+    PERIODIC_ROWS = 2  # [garbage collection, executed notification]
+
+    def __init__(
+        self,
+        keys: int,
+        key_slots: int = 32,
+        dep_slots: int = 32,
+        blocker_slots: int = 16,
+        gap_slots: int = 8,
+        exec_buffer: int = 128,
+    ):
+        self.K = keys
+        self.S = key_slots       # (dot, clock) registrations per key
+        self.DEP = dep_slots     # deps per dot / per message
+        self.BB = blocker_slots  # blockers per waiting dot
+        self.G = gap_slots
+        self.EB = exec_buffer    # executed-dot buffers (notify + GC)
+
+    # -- host-side builders -------------------------------------------
+
+    def payload_width(self, n: int) -> int:
+        # MCOMMIT/MRETRY: [dsrc, dseq, cseq, cpid, nd] + (src, seq)*DEP
+        return max(5 + 2 * self.DEP, n)
+
+    def gc_per_msg(self, dims: EngineDims) -> int:
+        return (dims.P - 1) // 2
+
+    def periodic_intervals(self, config, dims: EngineDims):
+        gc = config.gc_interval_ms
+        return [
+            gc if gc is not None else INF,
+            config.executor_executed_notification_interval_ms,
+        ]
+
+    def lane_ctx(self, config, dims: EngineDims, sorted_idx: np.ndarray):
+        fq_size, wq_size = config.caesar_quorum_sizes()
+        return {
+            "fq_size": np.int32(fq_size),
+            "wq_size": np.int32(wq_size),
+            "wait_condition": np.bool_(config.caesar_wait_condition),
+        }
+
+    def init_state(self, dims: EngineDims, ctx_np) -> Dict[str, np.ndarray]:
+        N, D = dims.N, dims.D
+        K, S, DEP, BB, G, EB = (
+            self.K, self.S, self.DEP, self.BB, self.G, self.EB,
+        )
+        return {
+            # per-key clock table (clocks/keys/locked.rs): registered
+            # (dot, clock) pairs; kc_cseq == 0 marks a free slot
+            "kc_src": np.zeros((N, K, S), np.int32),
+            "kc_seq": np.zeros((N, K, S), np.int32),
+            "kc_cseq": np.zeros((N, K, S), np.int32),
+            "kc_cpid": np.zeros((N, K, S), np.int32),
+            "clk_counter": np.zeros((N,), np.int32),
+            # per-dot lifecycle
+            "pseq": np.zeros((N, N, D), np.int32),
+            "status": np.zeros((N, N, D), np.int32),
+            "key_of": np.zeros((N, N, D), np.int32),
+            "client_of": np.zeros((N, N, D), np.int32),
+            "clk_seq": np.zeros((N, N, D), np.int32),
+            "clk_pid": np.zeros((N, N, D), np.int32),
+            "dep_src": np.zeros((N, N, D, DEP), np.int32),
+            "dep_seq": np.zeros((N, N, D, DEP), np.int32),
+            "bb_src": np.zeros((N, N, D, BB), np.int32),
+            "bb_seq": np.zeros((N, N, D, BB), np.int32),
+            # coordinator aggregation (QuorumClocks / QuorumRetries)
+            "own_seq": np.zeros((N,), np.int32),
+            "qa_cnt": np.zeros((N, D), np.int32),
+            "qa_ok": np.ones((N, D), bool),
+            "qa_done": np.zeros((N, D), bool),
+            "qa_cseq": np.zeros((N, D), np.int32),
+            "qa_cpid": np.zeros((N, D), np.int32),
+            "ag_src": np.zeros((N, D, DEP), np.int32),
+            "ag_seq": np.zeros((N, D, DEP), np.int32),
+            "qr_cnt": np.zeros((N, D), np.int32),
+            # executor clocks (committed / executed per source)
+            "cm_front": np.zeros((N, N), np.int32),
+            "cm_gaps": np.zeros((N, N, G, 2), np.int32),
+            "ex_front": np.zeros((N, N), np.int32),
+            "ex_gaps": np.zeros((N, N, G, 2), np.int32),
+            # executed→notification buffer (executor.rs:65-77) and the
+            # notification→MGC broadcast buffer (caesar.rs:194-213)
+            "eb_src": np.zeros((N, EB), np.int32),
+            "eb_seq": np.zeros((N, EB), np.int32),
+            "eb_n": np.zeros((N,), np.int32),
+            "gb_src": np.zeros((N, EB), np.int32),
+            "gb_seq": np.zeros((N, EB), np.int32),
+            "gb_n": np.zeros((N,), np.int32),
+            # BasicGCTrack: executed-at count per dot
+            "gc_cnt": np.zeros((N, N, D), np.int32),
+            "m_fast": np.zeros((N,), np.int32),
+            "m_slow": np.zeros((N,), np.int32),
+            "m_stable": np.zeros((N,), np.int32),
+            "err": np.zeros((N,), bool),
+        }
+
+    @staticmethod
+    def error(ps):
+        return ps["err"]
+
+    @staticmethod
+    def metrics(ps_np) -> Dict[str, np.ndarray]:
+        return {
+            "fast_path": ps_np["m_fast"],
+            "slow_path": ps_np["m_slow"],
+            "stable": ps_np["m_stable"],
+        }
+
+    # -- device handlers ----------------------------------------------
+
+    def handle(self, ps, msg, me, now, ctx, dims: EngineDims):
+        def _noop(ps, msg):
+            return ps, empty_outbox(dims)
+
+        branches = [
+            lambda ps, msg: _submit(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _mpropose(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _mproposeack(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _mcommit(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _mretry(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _mretryack(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _mgc(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _wait_drain(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _exec_drain(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _gc_drain(self, ps, msg, me, ctx, dims),
+            _noop,
+        ]
+        idx = jnp.clip(msg["mtype"], 0, CaesarDev.NUM_TYPES)
+        return jax.lax.switch(idx, branches, ps, msg)
+
+    def periodic(self, ps, fire, me, now, ctx, dims: EngineDims):
+        """Row 0: GC — kick the MGC broadcast chain for buffered
+        executed dots. Row 1: executed notification — drain the
+        executor's buffer into the GC flow (handle_executed)."""
+        # row 1 first: a GC tick at the same instant sees fresh dots
+        # only on the next tick, mirroring the oracle's separate events
+        ps = _drain_executed_notification(self, ps, me, ctx, dims, fire[1])
+        has = ps["gb_n"] > 0
+        ob = emit(
+            empty_outbox(dims),
+            0,
+            me,
+            CaesarDev.GC_DRAIN,
+            [0],
+            valid=fire[0] & has,
+        )
+        return ps, ob
+
+
+# ----------------------------------------------------------------------
+# key-clock helpers (common/pred/clocks)
+# ----------------------------------------------------------------------
+
+
+def _clk_lt(a_seq, a_pid, b_seq, b_pid):
+    """Lexicographic Clock order (clocks/mod.rs:27-60)."""
+    return (a_seq < b_seq) | ((a_seq == b_seq) & (a_pid < b_pid))
+
+
+def _kc_add(dev, ps, key, src, seq, cseq, cpid, enable):
+    """Register (dot, clock) on the key (locked.rs add); a duplicate
+    clock or a full row raises the lane error flag."""
+    row_cseq = ps["kc_cseq"][key]
+    row_cpid = ps["kc_cpid"][key]
+    do = jnp.asarray(enable, bool)
+    dup = jnp.any((row_cseq == cseq) & (row_cpid == cpid) & (row_cseq > 0))
+    free = row_cseq == 0
+    slot = jnp.argmax(free)
+    overflow = do & ~jnp.any(free)
+    widx = jnp.where(do & ~overflow & ~dup, slot, dev.S)
+    return dict(
+        ps,
+        kc_src=ps["kc_src"].at[key, widx].set(src, mode="drop"),
+        kc_seq=ps["kc_seq"].at[key, widx].set(seq, mode="drop"),
+        kc_cseq=ps["kc_cseq"].at[key, widx].set(cseq, mode="drop"),
+        kc_cpid=ps["kc_cpid"].at[key, widx].set(cpid, mode="drop"),
+        err=ps["err"] | overflow | (do & dup),
+    )
+
+
+def _kc_remove(dev, ps, key, cseq, cpid, enable):
+    """Unregister the clock from the key (locked.rs remove); missing
+    entries raise the lane error flag."""
+    row_cseq = ps["kc_cseq"][key]
+    row_cpid = ps["kc_cpid"][key]
+    match = (row_cseq == cseq) & (row_cpid == cpid) & (row_cseq > 0)
+    do = jnp.asarray(enable, bool)
+    found = jnp.any(match)
+    slot = jnp.argmax(match)
+    widx = jnp.where(do & found, slot, dev.S)
+    zero = jnp.zeros((), I32)
+    return dict(
+        ps,
+        kc_src=ps["kc_src"].at[key, widx].set(zero, mode="drop"),
+        kc_seq=ps["kc_seq"].at[key, widx].set(zero, mode="drop"),
+        kc_cseq=ps["kc_cseq"].at[key, widx].set(zero, mode="drop"),
+        kc_cpid=ps["kc_cpid"].at[key, widx].set(zero, mode="drop"),
+        err=ps["err"] | (do & ~found),
+    )
+
+
+def _predecessors(dev, ps, key, cseq, cpid):
+    """Masked row compare (locked.rs:85-131): returns (pred_mask [S],
+    blocker_mask [S]) over the key row relative to clock (cseq, cpid)."""
+    row_cseq = ps["kc_cseq"][key]
+    row_cpid = ps["kc_cpid"][key]
+    present = row_cseq > 0
+    lower = _clk_lt(row_cseq, row_cpid, cseq, cpid)
+    higher = _clk_lt(cseq, cpid, row_cseq, row_cpid)
+    return present & lower, present & higher
+
+
+def _pack_deps(dev, ps, key, pred_mask, base, pay):
+    """Compact the masked key-row dots into payload dep slots starting
+    at ``base`` ([nd, (src, seq)*]); returns (pay, nd, overflow)."""
+    order = jnp.where(pred_mask, jnp.cumsum(pred_mask.astype(I32)) - 1, dev.S)
+    nd = jnp.sum(pred_mask)
+    overflow = nd > dev.DEP
+    lo = jnp.where(order < dev.DEP, base + 1 + 2 * order, dims_P(pay))
+    pay = pay.at[base].set(nd)
+    pay = pay.at[lo].set(ps["kc_src"][key], mode="drop")
+    pay = pay.at[lo + 1].set(ps["kc_seq"][key], mode="drop")
+    return pay, nd, overflow
+
+
+def dims_P(pay):
+    return pay.shape[0]
+
+
+def _slot(seq, dims):
+    return (seq - 1) % dims.D
+
+
+# ----------------------------------------------------------------------
+# wait-condition scan
+# ----------------------------------------------------------------------
+
+
+def _blocker_verdicts(dev, ps, dims):
+    """For every dot's blocker entries: (resolved, reject) masks
+    [N, D, BB] (caesar.rs:932-1096 re-evaluated lazily; see module
+    docstring for the monotonicity argument)."""
+    bsrc = ps["bb_src"]                       # [N, D, BB]
+    bseq = ps["bb_seq"]
+    bslot = _slot(bseq, dims)
+    present = bseq > 0
+    valid = ps["pseq"][bsrc, bslot] == bseq
+    gcd = present & ~valid                    # freed ⇒ executed everywhere
+    b_st = ps["status"][bsrc, bslot]
+    safe = present & valid & (b_st >= ST_ACCEPT)
+    # my dot ∈ blocker.deps?
+    my_src = jnp.arange(dims.N, dtype=I32)[:, None, None]  # [N, 1, 1]
+    my_seq = ps["pseq"]                                    # [N, D]
+    b_dsrc = ps["dep_src"][bsrc, bslot]       # [N, D, BB, DEP]
+    b_dseq = ps["dep_seq"][bsrc, bslot]
+    member = jnp.any(
+        (b_dseq > 0)
+        & (b_dsrc == my_src[..., None])
+        & (b_dseq == my_seq[..., None, None]),
+        axis=3,
+    )
+    ign = safe & member
+    reject = safe & ~member
+    resolved = ~present | gcd | ign
+    return resolved, reject
+
+
+def _wait_scan(dev, ps, me, ctx, dims, ob, ack_slot, chain_slot,
+               enable=True):
+    """Find one waiting dot whose wait condition resolves, reply its
+    MProposeAck, and chain while more remain."""
+    resolved, reject = _blocker_verdicts(dev, ps, dims)
+    waiting = (ps["status"] == ST_PROPOSE_END) & jnp.any(
+        ps["bb_seq"] > 0, axis=2
+    )
+    w_rej = waiting & jnp.any(reject, axis=2)
+    w_acc = waiting & jnp.all(resolved, axis=2) & ~w_rej
+    actionable = w_rej | w_acc
+    num = jnp.sum(actionable)
+
+    srcs = jnp.arange(dims.N, dtype=I32)[:, None]
+    packed = srcs * _SEQ_BOUND + ps["pseq"]
+    flat = jnp.argmin(jnp.where(actionable, packed, INF))
+    wsrc, wslot = flat // dims.D, flat % dims.D
+    wseq = ps["pseq"][wsrc, wslot]
+    is_rej = w_rej[wsrc, wslot]
+
+    do = jnp.asarray(enable, bool) & (num > 0)
+    ps, ob = _propose_reply(
+        dev, ps, me, wsrc, wslot, wseq, ~is_rej, ctx, dims, ob, ack_slot, do
+    )
+    ob = emit(
+        ob, chain_slot, me, CaesarDev.WAIT_DRAIN, [0], valid=do & (num > 1)
+    )
+    return ps, ob
+
+
+def _propose_reply(dev, ps, me, wsrc, wslot, wseq, accept, ctx, dims, ob,
+                   ob_slot, enable):
+    """Send the MProposeAck for a decided proposal: accept echoes the
+    registered clock + deps; reject generates a fresh clock and
+    recomputes deps at it (_accept_command/_reject_command)."""
+    do = jnp.asarray(enable, bool)
+    rej = do & ~jnp.asarray(accept, bool)
+    key = ps["key_of"][wsrc, wslot]
+
+    # reject: new clock from my counter; deps = all lower-clock entries
+    # on the key (including this dot's own old registration)
+    new_cseq = ps["clk_counter"] + 1
+    ps = dict(
+        ps,
+        clk_counter=jnp.where(rej, new_cseq, ps["clk_counter"]),
+        status=ps["status"]
+        .at[jnp.where(rej, wsrc, dims.N), wslot]
+        .set(ST_REJECT, mode="drop"),
+        # accept: clear the blocker list so the scan never re-fires
+        bb_seq=ps["bb_seq"]
+        .at[jnp.where(do & ~rej, wsrc, dims.N), wslot]
+        .set(jnp.zeros((dev.BB,), I32), mode="drop"),
+    )
+
+    # reject payload: fresh clock + deps recomputed at it (this dot's
+    # own old-clock registration is included, like the oracle)
+    rpay = jnp.zeros((dims.P,), I32)
+    rpay = rpay.at[0].set(wseq)
+    rpay = rpay.at[1].set(new_cseq)
+    rpay = rpay.at[2].set(me)
+    pred_mask, _ = _predecessors(dev, ps, key, new_cseq, me)
+    rpay, _rnd, roverflow = _pack_deps(dev, ps, key, pred_mask, 4, rpay)
+
+    # accept payload: registered clock + propose-time deps (compact)
+    apay = jnp.zeros((dims.P,), I32)
+    apay = apay.at[0].set(wseq)
+    apay = apay.at[1].set(ps["clk_seq"][wsrc, wslot])
+    apay = apay.at[2].set(ps["clk_pid"][wsrc, wslot])
+    apay = apay.at[3].set(1)
+    apay = apay.at[4].set(jnp.sum(ps["dep_seq"][wsrc, wslot] > 0))
+    order = 5 + 2 * jnp.arange(dev.DEP, dtype=I32)
+    apay = apay.at[order].set(ps["dep_src"][wsrc, wslot], mode="drop")
+    apay = apay.at[order + 1].set(ps["dep_seq"][wsrc, wslot], mode="drop")
+
+    pay = jnp.where(rej, rpay, apay)
+    ps = dict(ps, err=ps["err"] | (rej & roverflow))
+    ob = emit(ob, ob_slot, wsrc, CaesarDev.MPROPOSEACK, pay, valid=do)
+    return ps, ob
+
+
+# ----------------------------------------------------------------------
+# predecessors-executor drain
+# ----------------------------------------------------------------------
+
+
+def _exec_scan(dev, ps, me, ctx, dims, ob, client_slot, chain_slot,
+               enable=True):
+    """Execute one command whose deps are committed and whose
+    lower-clock deps are executed (pred/mod.rs:104-275); chain while
+    more are ready. Lower-clock gating is acyclic, so one execution per
+    zero-delay step reaches the oracle's cascade at the same instant."""
+    dsrc = ps["dep_src"]                      # [N, D, DEP]
+    dseq = ps["dep_seq"]
+    dslot = _slot(dseq, dims)
+    absent = dseq == 0
+    committed = iset_contains(
+        ps["cm_front"][dsrc], ps["cm_gaps"][dsrc], dseq
+    )
+    executed = iset_contains(
+        ps["ex_front"][dsrc], ps["ex_gaps"][dsrc], dseq
+    )
+    d_cseq = ps["clk_seq"][dsrc, dslot]
+    d_cpid = ps["clk_pid"][dsrc, dslot]
+    my_cseq = ps["clk_seq"][..., None]
+    my_cpid = ps["clk_pid"][..., None]
+    lower = _clk_lt(d_cseq, d_cpid, my_cseq, my_cpid)
+    dep_ok = absent | (committed & (executed | ~lower))
+    ready = (ps["status"] == ST_COMMIT) & jnp.all(dep_ok, axis=2)
+    num = jnp.sum(ready)
+
+    # clock order (phase-two executes in clock order, mod.rs:208-275);
+    # clk_seq * (N+1) + pid stays well under 2^30 for feasible lane
+    # sizes (clk_seq grows by a few per command)
+    packed = ps["clk_seq"] * (dims.N + 1) + ps["clk_pid"]
+    flat = jnp.argmin(jnp.where(ready, packed, INF))
+    esrc, eslot = flat // dims.D, flat % dims.D
+    eseq = ps["pseq"][esrc, eslot]
+    client = ps["client_of"][esrc, eslot]
+
+    do = jnp.asarray(enable, bool) & (num > 0)
+    front, gaps, overflow = iset_add(
+        ps["ex_front"][esrc], ps["ex_gaps"][esrc], eseq, do
+    )
+    # buffer the executed dot for the notification tick
+    eb_n = ps["eb_n"]
+    eb_overflow = do & (eb_n >= dev.EB)
+    widx = jnp.where(do & ~eb_overflow, eb_n, dev.EB)
+    ps = dict(
+        ps,
+        ex_front=ps["ex_front"].at[esrc].set(front),
+        ex_gaps=ps["ex_gaps"].at[esrc].set(gaps),
+        status=ps["status"]
+        .at[jnp.where(do, esrc, dims.N), eslot]
+        .set(ST_EXECUTED, mode="drop"),
+        eb_src=ps["eb_src"].at[widx].set(esrc, mode="drop"),
+        eb_seq=ps["eb_seq"].at[widx].set(eseq, mode="drop"),
+        eb_n=eb_n + (do & ~eb_overflow).astype(I32),
+        err=ps["err"] | overflow | eb_overflow,
+    )
+    ob = emit(
+        ob,
+        client_slot,
+        dims.N + client,
+        CaesarDev.TO_CLIENT,
+        [0],
+        valid=do & (ctx["client_attach"][client] == me),
+    )
+    # always re-chain after an execution: executing this command may
+    # make lower-frontier commands ready (the oracle's pending-index
+    # cascade); the follow-up drain no-ops when nothing is left
+    ob = emit(
+        ob, chain_slot, me, CaesarDev.EXEC_DRAIN, [0], valid=do
+    )
+    return ps, ob
+
+
+# ----------------------------------------------------------------------
+# GC helpers
+# ----------------------------------------------------------------------
+
+
+def _gc_count(dev, ps, me, ctx, dims, src, seq, enable):
+    """BasicGCTrack.add for one dot: at n sightings, free it
+    (caesar.rs _gc_command + bp.stable)."""
+    slot = _slot(seq, dims)
+    do = jnp.asarray(enable, bool) & (seq > 0)
+    valid = ps["pseq"][src, slot] == seq
+    cnt = ps["gc_cnt"][src, slot] + 1
+    full = do & valid & (cnt == ctx["n"])
+    wsrc = jnp.where(do & valid, src, dims.N)
+    ps = dict(
+        ps,
+        err=ps["err"] | (do & ~valid),
+        gc_cnt=ps["gc_cnt"].at[wsrc, slot].set(cnt, mode="drop"),
+    )
+    # free: unregister the clock, clear the slot, count stability
+    key = ps["key_of"][src, slot]
+    ps = _kc_remove(
+        dev, ps, key, ps["clk_seq"][src, slot], ps["clk_pid"][src, slot],
+        full,
+    )
+    fsrc = jnp.where(full, src, dims.N)
+    zero = jnp.zeros((), I32)
+    ps = dict(
+        ps,
+        pseq=ps["pseq"].at[fsrc, slot].set(zero, mode="drop"),
+        status=ps["status"].at[fsrc, slot].set(zero, mode="drop"),
+        gc_cnt=ps["gc_cnt"].at[fsrc, slot].set(zero, mode="drop"),
+        dep_seq=ps["dep_seq"]
+        .at[fsrc, slot]
+        .set(jnp.zeros((dev.DEP,), I32), mode="drop"),
+        bb_seq=ps["bb_seq"]
+        .at[fsrc, slot]
+        .set(jnp.zeros((dev.BB,), I32), mode="drop"),
+        m_stable=ps["m_stable"] + full.astype(I32),
+    )
+    return ps
+
+
+def _drain_executed_notification(dev, ps, me, ctx, dims, enable):
+    """handle_executed (caesar.rs:194-213): move the executor's newly
+    executed dots into the MGC broadcast buffer and count my own
+    sighting of each."""
+    do = jnp.asarray(enable, bool)
+    n_dots = jnp.where(do, ps["eb_n"], 0)
+
+    def body(i, ps):
+        take = i < n_dots
+        src = ps["eb_src"][i]
+        seq = ps["eb_seq"][i]
+        gb_n = ps["gb_n"]
+        overflow = take & (gb_n >= dev.EB)
+        widx = jnp.where(take & ~overflow, gb_n, dev.EB)
+        ps = dict(
+            ps,
+            gb_src=ps["gb_src"].at[widx].set(src, mode="drop"),
+            gb_seq=ps["gb_seq"].at[widx].set(seq, mode="drop"),
+            gb_n=gb_n + (take & ~overflow).astype(I32),
+            err=ps["err"] | overflow,
+        )
+        return _gc_count(dev, ps, me, ctx, dims, src, seq, take)
+
+    ps = jax.lax.fori_loop(0, dev.EB, body, ps)
+    return dict(ps, eb_n=jnp.where(do, 0, ps["eb_n"]))
+
+
+# ----------------------------------------------------------------------
+# handlers
+# ----------------------------------------------------------------------
+
+
+def _submit(dev, ps, msg, me, ctx, dims):
+    """caesar.rs:245-264: next dot + fresh clock, MPropose to everyone
+    (the fastest repliers form the fast quorum)."""
+    client = msg["payload"][0]
+    key = msg["payload"][2]
+    seq = ps["own_seq"] + 1
+    slot = _slot(seq, dims)
+    cseq = ps["clk_counter"] + 1
+    DEP = dev.DEP
+    ps = dict(
+        ps,
+        # (source, sequence) packing in the scans requires seq < bound
+        err=ps["err"] | (seq >= _SEQ_BOUND),
+        own_seq=seq,
+        clk_counter=cseq,
+        qa_cnt=ps["qa_cnt"].at[slot].set(0),
+        qa_ok=ps["qa_ok"].at[slot].set(True),
+        qa_done=ps["qa_done"].at[slot].set(False),
+        qa_cseq=ps["qa_cseq"].at[slot].set(0),
+        qa_cpid=ps["qa_cpid"].at[slot].set(0),
+        qr_cnt=ps["qr_cnt"].at[slot].set(0),
+        ag_src=ps["ag_src"].at[slot].set(jnp.zeros((DEP,), I32)),
+        ag_seq=ps["ag_seq"].at[slot].set(jnp.zeros((DEP,), I32)),
+    )
+    ob = emit_broadcast(
+        empty_outbox(dims),
+        CaesarDev.MPROPOSE,
+        [seq, key, client, cseq],
+        ctx["n"],
+    )
+    ob = dict(ob, valid=ob["valid"] & msg["valid"])
+    return ps, ob
+
+
+def _mpropose(dev, ps, msg, me, ctx, dims):
+    """caesar.rs:266-510: join the clock, compute predecessors and
+    blockers, register the proposal, and decide accept/reject/wait."""
+    s = msg["src"]
+    seq, key, client, cseq = (
+        msg["payload"][0],
+        msg["payload"][1],
+        msg["payload"][2],
+        msg["payload"][3],
+    )
+    cpid = s
+    slot = _slot(seq, dims)
+    dirty = ps["pseq"][s, slot] != 0
+    ps = dict(
+        ps,
+        clk_counter=jnp.maximum(ps["clk_counter"], cseq),
+        err=ps["err"] | dirty,
+        pseq=ps["pseq"].at[s, slot].set(seq),
+        key_of=ps["key_of"].at[s, slot].set(key),
+        client_of=ps["client_of"].at[s, slot].set(client),
+        clk_seq=ps["clk_seq"].at[s, slot].set(cseq),
+        clk_pid=ps["clk_pid"].at[s, slot].set(cpid),
+        status=ps["status"].at[s, slot].set(ST_PROPOSE_END),
+    )
+
+    # predecessors + blockers over the key row, then register the dot
+    pred_mask, block_mask = _predecessors(dev, ps, key, cseq, cpid)
+    row_src = ps["kc_src"][key]
+    row_seq = ps["kc_seq"][key]
+    # store deps
+    order = jnp.where(pred_mask, jnp.cumsum(pred_mask.astype(I32)) - 1,
+                      dev.S)
+    nd = jnp.sum(pred_mask)
+    d_src = jnp.zeros((dev.DEP,), I32).at[order].set(row_src, mode="drop")
+    d_seq = jnp.zeros((dev.DEP,), I32).at[order].set(row_seq, mode="drop")
+    border = jnp.where(block_mask, jnp.cumsum(block_mask.astype(I32)) - 1,
+                       dev.S)
+    nb = jnp.sum(block_mask)
+    b_src = jnp.zeros((dev.BB,), I32).at[border].set(row_src, mode="drop")
+    b_seq = jnp.zeros((dev.BB,), I32).at[border].set(row_seq, mode="drop")
+    ps = dict(
+        ps,
+        dep_src=ps["dep_src"].at[s, slot].set(d_src),
+        dep_seq=ps["dep_seq"].at[s, slot].set(d_seq),
+        bb_src=ps["bb_src"].at[s, slot].set(b_src),
+        bb_seq=ps["bb_seq"].at[s, slot].set(b_seq),
+        err=ps["err"] | (nd > dev.DEP) | (nb > dev.BB),
+    )
+    ps = _kc_add(dev, ps, key, s, seq, cseq, cpid, True)
+
+    # decide: no blockers → accept; wait condition off → reject;
+    # otherwise evaluate each blocker now (safe ones ignore/reject,
+    # unsafe ones leave us waiting)
+    resolved, reject = _blocker_verdicts(dev, ps, dims)
+    has_block = nb > 0
+    any_rej = jnp.any(reject[s, slot])
+    all_res = jnp.all(resolved[s, slot])
+    accept_now = ~has_block | (ctx["wait_condition"] & all_res & ~any_rej)
+    reject_now = has_block & (~ctx["wait_condition"] | any_rej)
+    decided = accept_now | reject_now
+    ps, ob = _propose_reply(
+        dev, ps, me, s, slot, seq, accept_now, ctx, dims,
+        empty_outbox(dims), 0, decided,
+    )
+    return ps, ob
+
+
+def _agg_union(dev, ps, slot, pay_base, msg, enable):
+    """Union the message's dep list into the per-dot aggregate table
+    (QuorumClocks/QuorumRetries dep union)."""
+    nd = msg["payload"][pay_base]
+
+    def body(i, ps):
+        take = jnp.asarray(enable, bool) & (i < nd)
+        dsrc = msg["payload"][pay_base + 1 + 2 * i]
+        dseq = msg["payload"][pay_base + 2 + 2 * i]
+        row_src = ps["ag_src"][slot]
+        row_seq = ps["ag_seq"][slot]
+        exists = jnp.any(
+            (row_seq == dseq) & (row_src == dsrc) & (row_seq > 0)
+        )
+        free = row_seq == 0
+        fidx = jnp.argmax(free)
+        overflow = take & ~exists & ~jnp.any(free)
+        widx = jnp.where(take & ~exists & ~overflow, fidx, dev.DEP)
+        return dict(
+            ps,
+            ag_src=ps["ag_src"].at[slot, widx].set(dsrc, mode="drop"),
+            ag_seq=ps["ag_seq"].at[slot, widx].set(dseq, mode="drop"),
+            err=ps["err"] | overflow,
+        )
+
+    return jax.lax.fori_loop(0, dev.DEP, body, ps)
+
+
+def _agg_broadcast(dev, ps, me, seq, cseq, cpid, mtype, ctx, dims, valid):
+    """Broadcast MCommit/MRetry carrying the aggregated clock + deps."""
+    slot = _slot(seq, dims)
+    P = dims.P
+    present = ps["ag_seq"][slot] > 0
+    nd = jnp.sum(present)
+    pay = jnp.zeros((P,), I32)
+    pay = pay.at[0].set(me)
+    pay = pay.at[1].set(seq)
+    pay = pay.at[2].set(cseq)
+    pay = pay.at[3].set(cpid)
+    pay = pay.at[4].set(nd)
+    order = jnp.where(present, jnp.cumsum(present.astype(I32)) - 1, dev.DEP)
+    lo = jnp.where(order < dev.DEP, 5 + 2 * order, P)
+    pay = pay.at[lo].set(ps["ag_src"][slot], mode="drop")
+    pay = pay.at[lo + 1].set(ps["ag_seq"][slot], mode="drop")
+    ob = emit_broadcast(empty_outbox(dims), mtype, pay, ctx["n"])
+    return dict(ob, valid=ob["valid"] & jnp.asarray(valid, bool))
+
+
+def _mproposeack(dev, ps, msg, me, ctx, dims):
+    """caesar.rs:512-558 + QuorumClocks (clocks/quorum.rs:7-81): join
+    clocks, union deps, and fire fast path (all ok at fq_size) or the
+    retry round (some reject once a majority replied)."""
+    seq = msg["payload"][0]
+    cseq = msg["payload"][1]
+    cpid = msg["payload"][2]
+    ok = msg["payload"][3] > 0
+    slot = _slot(seq, dims)
+
+    st = ps["status"][me, slot]
+    live = ((st == ST_PROPOSE_END) | (st == ST_REJECT)) & ~ps["qa_done"][slot]
+
+    join_hi = _clk_lt(
+        ps["qa_cseq"][slot], ps["qa_cpid"][slot], cseq, cpid
+    )
+    cnt = ps["qa_cnt"][slot] + 1
+    all_ok = ps["qa_ok"][slot] & ok
+    ps = dict(
+        ps,
+        qa_cnt=ps["qa_cnt"].at[slot].set(jnp.where(live, cnt,
+                                                   ps["qa_cnt"][slot])),
+        qa_ok=ps["qa_ok"].at[slot].set(jnp.where(live, all_ok,
+                                                 ps["qa_ok"][slot])),
+        qa_cseq=ps["qa_cseq"]
+        .at[slot]
+        .set(jnp.where(live & join_hi, cseq, ps["qa_cseq"][slot])),
+        qa_cpid=ps["qa_cpid"]
+        .at[slot]
+        .set(jnp.where(live & join_hi, cpid, ps["qa_cpid"][slot])),
+    )
+    ps = _agg_union(dev, ps, slot, 4, msg, live)
+
+    done = live & (
+        (cnt == ctx["fq_size"])
+        | (~all_ok & (cnt >= ctx["wq_size"]))
+    )
+    fast = done & all_ok
+    slow = done & ~all_ok
+    ps = dict(
+        ps,
+        qa_done=ps["qa_done"].at[slot].set(ps["qa_done"][slot] | done),
+        m_fast=ps["m_fast"] + fast.astype(I32),
+        m_slow=ps["m_slow"] + slow.astype(I32),
+    )
+    cseq_f = ps["qa_cseq"][slot]
+    cpid_f = ps["qa_cpid"][slot]
+    obc = _agg_broadcast(
+        dev, ps, me, seq, cseq_f, cpid_f, CaesarDev.MCOMMIT, ctx, dims, fast
+    )
+    obr = _agg_broadcast(
+        dev, ps, me, seq, cseq_f, cpid_f, CaesarDev.MRETRY, ctx, dims, slow
+    )
+    ob = {
+        "valid": jnp.where(fast, obc["valid"], obr["valid"]),
+        "dst": jnp.where(fast, obc["dst"], obr["dst"]),
+        "mtype": jnp.where(fast, obc["mtype"], obr["mtype"]),
+        "payload": jnp.where(fast, obc["payload"], obr["payload"]),
+    }
+    return ps, ob
+
+
+def _store_deps_from_msg(dev, ps, src, slot, msg, base, skip_self, seq,
+                         enable):
+    """Replace the dot's dep list with the message's (minus a self-dep
+    when ``skip_self``; caesar.rs:665-668)."""
+    Q = dev.DEP
+    nd = msg["payload"][base]
+    idxs = base + 1 + 2 * jnp.arange(Q, dtype=I32)
+    en = jnp.arange(Q, dtype=I32) < nd
+    dsrcs = jnp.where(en, msg["payload"][idxs], 0)
+    dseqs = jnp.where(en, msg["payload"][idxs + 1], 0)
+    if skip_self:
+        selfdep = (dsrcs == src) & (dseqs == seq)
+        dsrcs = jnp.where(selfdep, 0, dsrcs)
+        dseqs = jnp.where(selfdep, 0, dseqs)
+    do = jnp.asarray(enable, bool)
+    wsrc = jnp.where(do, src, dims_N_of(ps))
+    return dict(
+        ps,
+        dep_src=ps["dep_src"].at[wsrc, slot].set(dsrcs, mode="drop"),
+        dep_seq=ps["dep_seq"].at[wsrc, slot].set(dseqs, mode="drop"),
+        err=ps["err"] | (do & (nd > Q)),
+    )
+
+
+def dims_N_of(ps):
+    return ps["pseq"].shape[0]
+
+
+def _update_clock(dev, ps, src, slot, key, new_cseq, new_cpid, enable):
+    """Swap the registered clock (caesar.rs:893-918)."""
+    do = jnp.asarray(enable, bool)
+    old_cseq = ps["clk_seq"][src, slot]
+    old_cpid = ps["clk_pid"][src, slot]
+    changed = do & ((old_cseq != new_cseq) | (old_cpid != new_cpid))
+    ps = _kc_remove(dev, ps, key, old_cseq, old_cpid, changed)
+    ps = _kc_add(
+        dev, ps, key, src, ps["pseq"][src, slot], new_cseq, new_cpid, changed
+    )
+    wsrc = jnp.where(do, src, dims_N_of(ps))
+    return dict(
+        ps,
+        clk_seq=ps["clk_seq"].at[wsrc, slot].set(new_cseq, mode="drop"),
+        clk_pid=ps["clk_pid"].at[wsrc, slot].set(new_cpid, mode="drop"),
+    )
+
+
+def _mcommit(dev, ps, msg, me, ctx, dims):
+    """caesar.rs:634-702: final clock + deps, feed the executor, and
+    re-evaluate waiting proposals."""
+    dsrc = msg["payload"][0]
+    seq = msg["payload"][1]
+    cseq = msg["payload"][2]
+    cpid = msg["payload"][3]
+    slot = _slot(seq, dims)
+    st = ps["status"][dsrc, slot]
+    have = ps["pseq"][dsrc, slot] == seq
+    do = have & (st != ST_COMMIT) & (st != ST_EXECUTED)
+    key = ps["key_of"][dsrc, slot]
+
+    ps = dict(
+        ps,
+        clk_counter=jnp.maximum(ps["clk_counter"], cseq),
+        err=ps["err"] | ~have,
+    )
+    ps = _store_deps_from_msg(dev, ps, dsrc, slot, msg, 4, True, seq, do)
+    ps = _update_clock(dev, ps, dsrc, slot, key, cseq, cpid, do)
+    wsrc = jnp.where(do, dsrc, dims.N)
+    ps = dict(
+        ps,
+        status=ps["status"].at[wsrc, slot].set(ST_COMMIT, mode="drop"),
+    )
+    cf, cg, overflow = iset_add(
+        ps["cm_front"][dsrc], ps["cm_gaps"][dsrc], seq, do
+    )
+    ps = dict(
+        ps,
+        cm_front=ps["cm_front"].at[dsrc].set(cf),
+        cm_gaps=ps["cm_gaps"].at[dsrc].set(cg),
+        err=ps["err"] | overflow,
+    )
+    # executor + wait re-evaluation, all at this instant
+    ob = empty_outbox(dims)
+    ps, ob = _exec_scan(dev, ps, me, ctx, dims, ob, 0, 1, do)
+    ps, ob = _wait_scan(dev, ps, me, ctx, dims, ob, 2, 3, do)
+    return ps, ob
+
+
+def _mretry(dev, ps, msg, me, ctx, dims):
+    """caesar.rs:704-760: adopt the retry clock + deps, reply with my
+    predecessors at the new clock, and re-evaluate waiting proposals."""
+    dsrc = msg["payload"][0]
+    seq = msg["payload"][1]
+    cseq = msg["payload"][2]
+    cpid = msg["payload"][3]
+    slot = _slot(seq, dims)
+    st = ps["status"][dsrc, slot]
+    have = ps["pseq"][dsrc, slot] == seq
+    do = have & (st != ST_COMMIT) & (st != ST_EXECUTED)
+    key = ps["key_of"][dsrc, slot]
+
+    ps = dict(
+        ps,
+        clk_counter=jnp.maximum(ps["clk_counter"], cseq),
+        err=ps["err"] | ~have,
+    )
+    ps = _store_deps_from_msg(dev, ps, dsrc, slot, msg, 4, False, seq, do)
+    ps = _update_clock(dev, ps, dsrc, slot, key, cseq, cpid, do)
+    wsrc = jnp.where(do, dsrc, dims.N)
+    ps = dict(
+        ps,
+        status=ps["status"].at[wsrc, slot].set(ST_ACCEPT, mode="drop"),
+        bb_seq=ps["bb_seq"]
+        .at[wsrc, slot]
+        .set(jnp.zeros((dev.BB,), I32), mode="drop"),
+    )
+
+    # reply: my predecessors at the new clock ∪ the message deps
+    pred_mask, _ = _predecessors(dev, ps, key, cseq, cpid)
+    pay = jnp.zeros((dims.P,), I32)
+    pay = pay.at[0].set(dsrc)
+    pay = pay.at[1].set(seq)
+    pay, nd, overflow = _pack_deps(dev, ps, key, pred_mask, 2, pay)
+
+    def add_msg_dep(i, carry):
+        pay, nd, err = carry
+        take = i < msg["payload"][4]
+        msrc = msg["payload"][5 + 2 * i]
+        mseq = msg["payload"][6 + 2 * i]
+        idxs = 3 + 2 * jnp.arange(dev.DEP, dtype=I32)
+        have_already = jnp.any(
+            (jnp.arange(dev.DEP) < nd)
+            & (pay[idxs] == msrc)
+            & (pay[idxs + 1] == mseq)
+        )
+        add = take & ~have_already
+        ovf = add & (nd >= dev.DEP)
+        lo = jnp.where(add & ~ovf, 3 + 2 * nd, dims.P)
+        pay = pay.at[lo].set(msrc, mode="drop")
+        pay = pay.at[lo + 1].set(mseq, mode="drop")
+        return pay, nd + (add & ~ovf).astype(I32), err | ovf
+
+    pay, nd, o2 = jax.lax.fori_loop(
+        0, dev.DEP, add_msg_dep, (pay, nd, jnp.asarray(False))
+    )
+    pay = pay.at[2].set(nd)
+    ps = dict(ps, err=ps["err"] | (do & (overflow | o2)))
+    ob = emit(
+        empty_outbox(dims), 0, msg["src"], CaesarDev.MRETRYACK, pay,
+        valid=do,
+    )
+    ps, ob = _wait_scan(dev, ps, me, ctx, dims, ob, 1, 2, do)
+    return ps, ob
+
+
+def _mretryack(dev, ps, msg, me, ctx, dims):
+    """caesar.rs:762-822 + QuorumRetries: union write-quorum dep
+    replies; on the last one, commit."""
+    seq = msg["payload"][1]
+    slot = _slot(seq, dims)
+    live = ps["status"][me, slot] == ST_ACCEPT
+    cnt = ps["qr_cnt"][slot] + 1
+    ps = dict(
+        ps,
+        qr_cnt=ps["qr_cnt"].at[slot].set(
+            jnp.where(live, cnt, ps["qr_cnt"][slot])
+        ),
+    )
+    ps = _agg_union(dev, ps, slot, 2, msg, live)
+    chosen = live & (cnt == ctx["wq_size"])
+    ob = _agg_broadcast(
+        dev,
+        ps,
+        me,
+        seq,
+        ps["clk_seq"][me, slot],
+        ps["clk_pid"][me, slot],
+        CaesarDev.MCOMMIT,
+        ctx,
+        dims,
+        chosen,
+    )
+    return ps, ob
+
+
+def _mgc(dev, ps, msg, me, ctx, dims):
+    """MGarbageCollection: count each advertised executed dot
+    (BasicGCTrack; frees at n sightings)."""
+    nd = msg["payload"][0]
+
+    def body(i, ps):
+        take = i < nd
+        src = msg["payload"][1 + 2 * i]
+        seq = msg["payload"][2 + 2 * i]
+        return _gc_count(dev, ps, me, ctx, dims, src, seq, take)
+
+    DPM = dev.gc_per_msg(dims)
+    ps = jax.lax.fori_loop(0, DPM, body, ps)
+    return ps, empty_outbox(dims)
+
+
+def _wait_drain(dev, ps, msg, me, ctx, dims):
+    return _wait_scan(
+        dev, ps, me, ctx, dims, empty_outbox(dims), 0, 1
+    )
+
+
+def _exec_drain(dev, ps, msg, me, ctx, dims):
+    return _exec_scan(
+        dev, ps, me, ctx, dims, empty_outbox(dims), 0, 1
+    )
+
+
+def _gc_drain(dev, ps, msg, me, ctx, dims):
+    """Broadcast up to one message's worth of buffered executed dots to
+    all-but-me; chain while the buffer is non-empty."""
+    DPM = dev.gc_per_msg(dims)
+    n_buf = ps["gb_n"]
+    take = jnp.minimum(n_buf, DPM)
+    pay = jnp.zeros((dims.P,), I32)
+    pay = pay.at[0].set(take)
+    idx = jnp.arange(DPM, dtype=I32)
+    en = idx < take
+    pay = pay.at[jnp.where(en, 1 + 2 * idx, dims.P)].set(
+        ps["gb_src"][idx], mode="drop"
+    )
+    pay = pay.at[jnp.where(en, 2 + 2 * idx, dims.P)].set(
+        ps["gb_seq"][idx], mode="drop"
+    )
+    # shift the buffer down
+    src_rolled = jnp.roll(ps["gb_src"], -DPM)
+    seq_rolled = jnp.roll(ps["gb_seq"], -DPM)
+    remaining = n_buf - take
+    keep = jnp.arange(dev.EB, dtype=I32) < remaining
+    ps = dict(
+        ps,
+        gb_src=jnp.where(keep, src_rolled, 0),
+        gb_seq=jnp.where(keep, seq_rolled, 0),
+        gb_n=remaining,
+    )
+    ob = emit_broadcast(
+        empty_outbox(dims), CaesarDev.MGC, pay, ctx["n"], me,
+        exclude_me=True,
+    )
+    ob = dict(ob, valid=ob["valid"] & (take > 0))
+    ob = emit(
+        ob, dims.N, me, CaesarDev.GC_DRAIN, [0], valid=remaining > 0
+    )
+    return ps, ob

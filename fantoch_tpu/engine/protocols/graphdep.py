@@ -296,7 +296,7 @@ def _commit_broadcast(dev, ps, me, seq, key, client, ctx, dims, valid):
     of mcommit_actions, atlas.rs:393-409)."""
     slot = _slot(seq, dims)
     Q = dev.dep_slots(dims.N)
-    N, P, F = dims.N, dims.P, dims.F
+    P = dims.P
     present = ps["qd_seq"][slot] > 0
     nd = jnp.sum(present)
     pay = jnp.zeros((P,), I32)
@@ -312,16 +312,10 @@ def _commit_broadcast(dev, ps, me, seq, key, client, ctx, dims, valid):
     pay = pay.at[lo].set(packed[:, 0], mode="drop")
     pay = pay.at[lo + 1].set(packed[:, 1], mode="drop")
 
-    procs = jnp.arange(N, dtype=I32)
-    v = jnp.zeros((F,), bool).at[:N].set(
-        jnp.asarray(valid, bool) & (procs < ctx["n"])
+    ob = emit_broadcast(
+        empty_outbox(dims), _DepDev.MCOMMIT, pay, ctx["n"]
     )
-    d = jnp.zeros((F,), I32).at[:N].set(procs)
-    m = jnp.zeros((F,), I32).at[:N].set(
-        jnp.full((N,), _DepDev.MCOMMIT, I32)
-    )
-    p = jnp.zeros((F, P), I32).at[:N].set(jnp.broadcast_to(pay, (N, P)))
-    return {"valid": v, "dst": d, "mtype": m, "payload": p}
+    return dict(ob, valid=ob["valid"] & jnp.asarray(valid, bool))
 
 
 # ----------------------------------------------------------------------
@@ -424,6 +418,8 @@ def _submit(dev, ps, msg, me, ctx, dims):
     prev_seq = ps["latest_seq"][key]
     ps = dict(
         ps,
+        # (source, sequence) packing in the drain requires seq < bound
+        err=ps["err"] | (seq >= _SEQ_BOUND),
         own_seq=seq,
         latest_src=ps["latest_src"].at[key].set(me),
         latest_seq=ps["latest_seq"].at[key].set(seq),

@@ -1,0 +1,124 @@
+"""Device-engine Caesar differential tests.
+
+Same bar as the other device protocols: on tie-free schedules the array
+engine reproduces the host oracle exactly — per-region latency means,
+fast/slow-path counts, GC stable totals. The reference asserts no
+particular fast/slow split for Caesar (the wait condition makes it
+timing-dependent, see test_sim_caesar.py), so the concurrent variants
+assert the harness invariants instead.
+"""
+
+import pytest
+
+from fantoch_tpu.client import ConflictPool, Workload
+from fantoch_tpu.core import Config, Planet
+from fantoch_tpu.engine import EngineDims, make_lane, run_lanes
+from fantoch_tpu.engine.protocols import CaesarDev
+from fantoch_tpu.protocol import Caesar
+from fantoch_tpu.protocol.base import ProtocolMetricsKind
+from fantoch_tpu.sim import Runner
+
+
+def run_oracle(config, regions, conflict, commands, cpr):
+    planet = Planet.new()
+    workload = Workload(
+        shard_count=1,
+        key_gen=ConflictPool(conflict_rate=conflict, pool_size=1),
+        keys_per_command=1,
+        commands_per_client=commands,
+        payload_size=0,
+    )
+    runner = Runner(
+        Caesar, planet, config, workload, cpr, regions, list(regions)
+    )
+    metrics, _, latencies = runner.run(extra_sim_time_ms=1000)
+    fast = slow = stable = 0
+    for pm, _em in metrics.values():
+        fast += pm.get_aggregated(ProtocolMetricsKind.FAST_PATH) or 0
+        slow += pm.get_aggregated(ProtocolMetricsKind.SLOW_PATH) or 0
+        stable += pm.get_aggregated(ProtocolMetricsKind.STABLE) or 0
+    return latencies, fast, slow, stable
+
+
+def run_engine(config, regions, conflict, commands, cpr):
+    planet = Planet.new()
+    clients = cpr * len(regions)
+    dev = CaesarDev(keys=1 + clients)
+    total = commands * clients
+    dims = EngineDims.for_protocol(
+        dev,
+        n=config.n,
+        clients=clients,
+        payload=dev.payload_width(config.n),
+        total_commands=total,
+        dot_slots=total + 1,
+        regions=len(regions),
+    )
+    spec = make_lane(
+        dev,
+        planet,
+        config,
+        conflict_rate=conflict,
+        pool_size=1,
+        commands_per_client=commands,
+        clients_per_region=cpr,
+        process_regions=regions,
+        client_regions=regions,
+        dims=dims,
+    )
+    return run_lanes(dev, dims, [spec])[0]
+
+
+@pytest.mark.parametrize(
+    "n,f,wait,conflict,commands,cpr",
+    [
+        (3, 1, True, 100, 30, 1),
+        (3, 1, False, 100, 30, 1),
+        (3, 1, True, 0, 30, 2),
+        (5, 2, True, 100, 10, 1),
+        (5, 2, False, 100, 10, 1),
+    ],
+)
+def test_engine_caesar_matches_oracle_exactly(
+    n, f, wait, conflict, commands, cpr
+):
+    """Tie-free schedules: every metric matches the oracle exactly."""
+    config = Config(
+        n=n, f=f, gc_interval_ms=100, caesar_wait_condition=wait
+    )
+    regions = Planet.new().regions()[:n]
+    oracle_lat, fast, slow, stable = run_oracle(
+        config, regions, conflict, commands, cpr
+    )
+    res = run_engine(config, regions, conflict, commands, cpr)
+    assert not res.err
+    assert int(res.protocol_metrics["fast_path"].sum()) == fast
+    assert int(res.protocol_metrics["slow_path"].sum()) == slow
+    assert int(res.protocol_metrics["stable"].sum()) == stable
+    for region in regions:
+        _issued, hist = oracle_lat[region]
+        assert res.latency_mean(region) == hist.mean(), region
+
+
+def test_engine_caesar_concurrent_invariants():
+    """Same-instant concurrency: tie orders may differ; assert protocol
+    invariants and closeness of latency means."""
+    n, f, conflict, commands, cpr = 5, 2, 100, 20, 2
+    config = Config(
+        n=n, f=f, gc_interval_ms=100, caesar_wait_condition=True
+    )
+    regions = Planet.new().regions()[:n]
+    oracle_lat, fast, slow, stable = run_oracle(
+        config, regions, conflict, commands, cpr
+    )
+    res = run_engine(config, regions, conflict, commands, cpr)
+    assert not res.err
+    total_commits = commands * cpr * n
+    dev_fast = int(res.protocol_metrics["fast_path"].sum())
+    dev_slow = int(res.protocol_metrics["slow_path"].sum())
+    assert dev_fast + dev_slow == total_commits == fast + slow
+    assert int(res.protocol_metrics["stable"].sum()) == n * total_commits
+    for region in regions:
+        _issued, hist = oracle_lat[region]
+        assert res.issued(region) == commands * cpr
+        assert abs(res.latency_mean(region) - hist.mean()) <= 0.1 * hist.mean()
