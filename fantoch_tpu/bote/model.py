@@ -110,6 +110,12 @@ def batched_config_stats(
     client latencies; and when requested: ``leader`` [B] best leader
     subset position + ``leader_lat`` [B, C] its client latencies. Pass
     ``xp=jax.numpy`` to run the whole batch on device.
+
+    The latencies themselves are integer-valued and exact in float32;
+    only the best-leader COV comparison happens in float32 here (TPUs
+    have no f64), so a near-exact COV tie between two candidate leaders
+    may break differently than the host model's f64 sort. Rankings
+    consume the latencies and re-reduce them in f64 (see search.py).
     """
     B, n = subsets.shape
 

@@ -177,9 +177,14 @@ class Search:
         )[:, :, 0]                                       # [B, C]
 
         def stats(latencies):
-            mean = xp.mean(latencies, axis=1)
-            std = xp.std(latencies, axis=1)
-            return mean, std / xp.maximum(mean, 1e-9)
+            # latencies are integer milliseconds (exactly representable in
+            # float32), so reducing them in float64 on the host reproduces
+            # the reference's Histogram-of-u64 mean/COV bit-for-bit — the
+            # device only does the heavy [B, C] latency evaluation
+            latencies = np.asarray(latencies, np.float64)
+            mean = latencies.mean(axis=1)
+            std = latencies.std(axis=1)
+            return mean, std / np.maximum(mean, 1e-9)
 
         valid = np.ones((subsets.shape[0],), bool)
         score = np.zeros((subsets.shape[0],), np.float64)

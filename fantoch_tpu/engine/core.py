@@ -77,6 +77,19 @@ def emit(outbox, i, dst, mtype, payload, valid=True):
     }
 
 
+def compact_order(mask, limit):
+    """Scatter order for compacting masked entries: each True entry of
+    ``mask`` gets its 0-based position in mask order; masked-out entries
+    and positions >= ``limit`` get INF, which can never alias a valid
+    index of a ``limit``-wide destination (pair with mode="drop").
+    Returns (order, true_count) — callers flag ``true_count > limit`` as
+    their overflow condition."""
+    mask = jnp.asarray(mask, bool)
+    order = jnp.cumsum(mask.astype(I32)) - 1
+    order = jnp.where(mask & (order < limit), order, INF)
+    return order, jnp.sum(mask)
+
+
 def emit_broadcast(outbox, mtype, payload, n, me=None, exclude_me=False):
     """Fill slots 0..N-1 with a broadcast to processes < n (the
     reference's ``ToSend{target: all()}``; ``all_but_me()`` with

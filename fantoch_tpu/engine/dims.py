@@ -16,6 +16,16 @@ from dataclasses import dataclass
 # `INF + delay` cannot wrap
 INF = 1 << 30
 
+# dot sequences must stay below this bound so (source, sequence) packs
+# into one i32 for lexicographic argmin scans; protocols flag `err` on a
+# sequence reaching it
+SEQ_BOUND = 1 << 20
+
+
+def dot_slot(seq, dims: "EngineDims"):
+    """Recycled per-source dot-slot index for a 1-based sequence."""
+    return (seq - 1) % dims.D
+
 
 @dataclass(frozen=True)
 class EngineDims:

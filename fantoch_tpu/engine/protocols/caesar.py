@@ -51,11 +51,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core import I32, emit, emit_broadcast, empty_outbox
-from ..dims import INF, EngineDims
+from ..core import I32, compact_order, emit, emit_broadcast, empty_outbox
+from ..dims import INF, SEQ_BOUND, EngineDims, dot_slot
 from ..iset import iset_add, iset_contains
 
-_SEQ_BOUND = 1 << 20
 
 # statuses (caesar.rs Status; PROPOSE_BEGIN is transient host-side only)
 ST_START = 0
@@ -86,7 +85,11 @@ class CaesarDev:
         self,
         keys: int,
         key_slots: int = 32,
-        dep_slots: int = 32,
+        # dep unions aggregate several acks' predecessor lists computed at
+        # different instants, so they can exceed the key-row population;
+        # GC rounds lag executions by up to one interval (oracle event
+        # order), keeping registrations visible longer
+        dep_slots: int = 64,
         blocker_slots: int = 16,
         gap_slots: int = 8,
         exec_buffer: int = 128,
@@ -169,6 +172,9 @@ class CaesarDev:
             "gb_src": np.zeros((N, EB), np.int32),
             "gb_seq": np.zeros((N, EB), np.int32),
             "gb_n": np.zeros((N,), np.int32),
+            # dots eligible for the in-flight GC round (snapshot of gb_n
+            # at the GC tick, before any same-instant notification drain)
+            "gb_gc": np.zeros((N,), np.int32),
             # BasicGCTrack: executed-at count per dot
             "gc_cnt": np.zeros((N, N, D), np.int32),
             "m_fast": np.zeros((N,), np.int32),
@@ -215,17 +221,21 @@ class CaesarDev:
         """Row 0: GC — kick the MGC broadcast chain for buffered
         executed dots. Row 1: executed notification — drain the
         executor's buffer into the GC flow (handle_executed)."""
-        # row 1 first: a GC tick at the same instant sees fresh dots
-        # only on the next tick, mirroring the oracle's separate events
+        # the oracle pops a coinciding GC event before the notification
+        # event, so dots drained by a same-instant notification must NOT
+        # ride this GC broadcast: snapshot the eligible count before the
+        # drain; the GC_DRAIN chain consumes only gb_gc entries (the
+        # buffer is FIFO, so those are exactly the pre-notification dots)
+        pre_n = ps["gb_n"]
         ps = _drain_executed_notification(self, ps, me, ctx, dims, fire[1])
-        has = ps["gb_n"] > 0
+        ps = dict(ps, gb_gc=jnp.where(fire[0], pre_n, ps["gb_gc"]))
         ob = emit(
             empty_outbox(dims),
             0,
             me,
             CaesarDev.GC_DRAIN,
             [0],
-            valid=fire[0] & has,
+            valid=fire[0] & (pre_n > 0),
         )
         return ps, ob
 
@@ -293,25 +303,20 @@ def _predecessors(dev, ps, key, cseq, cpid):
     return present & lower, present & higher
 
 
-def _pack_deps(dev, ps, key, pred_mask, base, pay):
+def _pack_deps(dev, ps, key, pred_mask, base, pay, dims):
     """Compact the masked key-row dots into payload dep slots starting
-    at ``base`` ([nd, (src, seq)*]); returns (pay, nd, overflow)."""
-    order = jnp.where(pred_mask, jnp.cumsum(pred_mask.astype(I32)) - 1, dev.S)
-    nd = jnp.sum(pred_mask)
+    at ``base`` ([nd, (src, seq)*]); returns (pay, nd, overflow).
+
+    Non-predecessor entries order at INF so they can never alias a
+    valid dep slot regardless of how S and DEP compare."""
+    order, nd = compact_order(pred_mask, dev.DEP)
     overflow = nd > dev.DEP
-    lo = jnp.where(order < dev.DEP, base + 1 + 2 * order, dims_P(pay))
+    lo = base + 1 + 2 * jnp.minimum(order, dims.P)  # > P when order==INF
     pay = pay.at[base].set(nd)
     pay = pay.at[lo].set(ps["kc_src"][key], mode="drop")
     pay = pay.at[lo + 1].set(ps["kc_seq"][key], mode="drop")
     return pay, nd, overflow
 
-
-def dims_P(pay):
-    return pay.shape[0]
-
-
-def _slot(seq, dims):
-    return (seq - 1) % dims.D
 
 
 # ----------------------------------------------------------------------
@@ -325,7 +330,7 @@ def _blocker_verdicts(dev, ps, dims):
     docstring for the monotonicity argument)."""
     bsrc = ps["bb_src"]                       # [N, D, BB]
     bseq = ps["bb_seq"]
-    bslot = _slot(bseq, dims)
+    bslot = dot_slot(bseq, dims)
     present = bseq > 0
     valid = ps["pseq"][bsrc, bslot] == bseq
     gcd = present & ~valid                    # freed ⇒ executed everywhere
@@ -348,6 +353,30 @@ def _blocker_verdicts(dev, ps, dims):
     return resolved, reject
 
 
+def _blocker_verdicts_one(dev, ps, src, slot, dims):
+    """Single-dot variant of :func:`_blocker_verdicts` for the dot at
+    (src, slot): returns (resolved [BB], reject [BB]) without gathering
+    the whole [N, D, BB, DEP] state."""
+    bsrc = ps["bb_src"][src, slot]            # [BB]
+    bseq = ps["bb_seq"][src, slot]
+    bslot = dot_slot(bseq, dims)
+    present = bseq > 0
+    valid = ps["pseq"][bsrc, bslot] == bseq
+    gcd = present & ~valid                    # freed ⇒ executed everywhere
+    b_st = ps["status"][bsrc, bslot]
+    safe = present & valid & (b_st >= ST_ACCEPT)
+    my_seq = ps["pseq"][src, slot]
+    b_dsrc = ps["dep_src"][bsrc, bslot]       # [BB, DEP]
+    b_dseq = ps["dep_seq"][bsrc, bslot]
+    member = jnp.any(
+        (b_dseq > 0) & (b_dsrc == src) & (b_dseq == my_seq), axis=1
+    )
+    ign = safe & member
+    reject = safe & ~member
+    resolved = ~present | gcd | ign
+    return resolved, reject
+
+
 def _wait_scan(dev, ps, me, ctx, dims, ob, ack_slot, chain_slot,
                enable=True):
     """Find one waiting dot whose wait condition resolves, reply its
@@ -362,7 +391,7 @@ def _wait_scan(dev, ps, me, ctx, dims, ob, ack_slot, chain_slot,
     num = jnp.sum(actionable)
 
     srcs = jnp.arange(dims.N, dtype=I32)[:, None]
-    packed = srcs * _SEQ_BOUND + ps["pseq"]
+    packed = srcs * SEQ_BOUND + ps["pseq"]
     flat = jnp.argmin(jnp.where(actionable, packed, INF))
     wsrc, wslot = flat // dims.D, flat % dims.D
     wseq = ps["pseq"][wsrc, wslot]
@@ -392,6 +421,8 @@ def _propose_reply(dev, ps, me, wsrc, wslot, wseq, accept, ctx, dims, ob,
     new_cseq = ps["clk_counter"] + 1
     ps = dict(
         ps,
+        # the executor's clock packing clk_seq*(N+1)+pid must stay < INF
+        err=ps["err"] | (rej & (new_cseq >= INF // (dims.N + 1))),
         clk_counter=jnp.where(rej, new_cseq, ps["clk_counter"]),
         status=ps["status"]
         .at[jnp.where(rej, wsrc, dims.N), wslot]
@@ -409,7 +440,7 @@ def _propose_reply(dev, ps, me, wsrc, wslot, wseq, accept, ctx, dims, ob,
     rpay = rpay.at[1].set(new_cseq)
     rpay = rpay.at[2].set(me)
     pred_mask, _ = _predecessors(dev, ps, key, new_cseq, me)
-    rpay, _rnd, roverflow = _pack_deps(dev, ps, key, pred_mask, 4, rpay)
+    rpay, _rnd, roverflow = _pack_deps(dev, ps, key, pred_mask, 4, rpay, dims)
 
     # accept payload: registered clock + propose-time deps (compact)
     apay = jnp.zeros((dims.P,), I32)
@@ -441,7 +472,7 @@ def _exec_scan(dev, ps, me, ctx, dims, ob, client_slot, chain_slot,
     zero-delay step reaches the oracle's cascade at the same instant."""
     dsrc = ps["dep_src"]                      # [N, D, DEP]
     dseq = ps["dep_seq"]
-    dslot = _slot(dseq, dims)
+    dslot = dot_slot(dseq, dims)
     absent = dseq == 0
     committed = iset_contains(
         ps["cm_front"][dsrc], ps["cm_gaps"][dsrc], dseq
@@ -512,7 +543,7 @@ def _exec_scan(dev, ps, me, ctx, dims, ob, client_slot, chain_slot,
 def _gc_count(dev, ps, me, ctx, dims, src, seq, enable):
     """BasicGCTrack.add for one dot: at n sightings, free it
     (caesar.rs _gc_command + bp.stable)."""
-    slot = _slot(seq, dims)
+    slot = dot_slot(seq, dims)
     do = jnp.asarray(enable, bool) & (seq > 0)
     valid = ps["pseq"][src, slot] == seq
     cnt = ps["gc_cnt"][src, slot] + 1
@@ -585,13 +616,16 @@ def _submit(dev, ps, msg, me, ctx, dims):
     client = msg["payload"][0]
     key = msg["payload"][2]
     seq = ps["own_seq"] + 1
-    slot = _slot(seq, dims)
+    slot = dot_slot(seq, dims)
     cseq = ps["clk_counter"] + 1
     DEP = dev.DEP
     ps = dict(
         ps,
-        # (source, sequence) packing in the scans requires seq < bound
-        err=ps["err"] | (seq >= _SEQ_BOUND),
+        # (source, sequence) packing in the scans requires seq < bound;
+        # the executor's clock packing clk_seq*(N+1)+pid must stay < INF
+        err=ps["err"]
+        | (seq >= SEQ_BOUND)
+        | (cseq >= INF // (dims.N + 1)),
         own_seq=seq,
         clk_counter=cseq,
         qa_cnt=ps["qa_cnt"].at[slot].set(0),
@@ -624,7 +658,7 @@ def _mpropose(dev, ps, msg, me, ctx, dims):
         msg["payload"][3],
     )
     cpid = s
-    slot = _slot(seq, dims)
+    slot = dot_slot(seq, dims)
     dirty = ps["pseq"][s, slot] != 0
     ps = dict(
         ps,
@@ -639,18 +673,16 @@ def _mpropose(dev, ps, msg, me, ctx, dims):
     )
 
     # predecessors + blockers over the key row, then register the dot
+    # (compact_order's INF sentinel can never alias a valid index of the
+    # DEP-/BB-wide arrays, whatever their size relative to S)
     pred_mask, block_mask = _predecessors(dev, ps, key, cseq, cpid)
     row_src = ps["kc_src"][key]
     row_seq = ps["kc_seq"][key]
     # store deps
-    order = jnp.where(pred_mask, jnp.cumsum(pred_mask.astype(I32)) - 1,
-                      dev.S)
-    nd = jnp.sum(pred_mask)
+    order, nd = compact_order(pred_mask, dev.DEP)
     d_src = jnp.zeros((dev.DEP,), I32).at[order].set(row_src, mode="drop")
     d_seq = jnp.zeros((dev.DEP,), I32).at[order].set(row_seq, mode="drop")
-    border = jnp.where(block_mask, jnp.cumsum(block_mask.astype(I32)) - 1,
-                       dev.S)
-    nb = jnp.sum(block_mask)
+    border, nb = compact_order(block_mask, dev.BB)
     b_src = jnp.zeros((dev.BB,), I32).at[border].set(row_src, mode="drop")
     b_seq = jnp.zeros((dev.BB,), I32).at[border].set(row_seq, mode="drop")
     ps = dict(
@@ -666,10 +698,10 @@ def _mpropose(dev, ps, msg, me, ctx, dims):
     # decide: no blockers → accept; wait condition off → reject;
     # otherwise evaluate each blocker now (safe ones ignore/reject,
     # unsafe ones leave us waiting)
-    resolved, reject = _blocker_verdicts(dev, ps, dims)
+    resolved, reject = _blocker_verdicts_one(dev, ps, s, slot, dims)
     has_block = nb > 0
-    any_rej = jnp.any(reject[s, slot])
-    all_res = jnp.all(resolved[s, slot])
+    any_rej = jnp.any(reject)
+    all_res = jnp.all(resolved)
     accept_now = ~has_block | (ctx["wait_condition"] & all_res & ~any_rej)
     reject_now = has_block & (~ctx["wait_condition"] | any_rej)
     decided = accept_now | reject_now
@@ -710,18 +742,17 @@ def _agg_union(dev, ps, slot, pay_base, msg, enable):
 
 def _agg_broadcast(dev, ps, me, seq, cseq, cpid, mtype, ctx, dims, valid):
     """Broadcast MCommit/MRetry carrying the aggregated clock + deps."""
-    slot = _slot(seq, dims)
+    slot = dot_slot(seq, dims)
     P = dims.P
     present = ps["ag_seq"][slot] > 0
-    nd = jnp.sum(present)
+    order, nd = compact_order(present, dev.DEP)
     pay = jnp.zeros((P,), I32)
     pay = pay.at[0].set(me)
     pay = pay.at[1].set(seq)
     pay = pay.at[2].set(cseq)
     pay = pay.at[3].set(cpid)
     pay = pay.at[4].set(nd)
-    order = jnp.where(present, jnp.cumsum(present.astype(I32)) - 1, dev.DEP)
-    lo = jnp.where(order < dev.DEP, 5 + 2 * order, P)
+    lo = 5 + 2 * jnp.minimum(order, P)  # > P when order==INF
     pay = pay.at[lo].set(ps["ag_src"][slot], mode="drop")
     pay = pay.at[lo + 1].set(ps["ag_seq"][slot], mode="drop")
     ob = emit_broadcast(empty_outbox(dims), mtype, pay, ctx["n"])
@@ -736,7 +767,7 @@ def _mproposeack(dev, ps, msg, me, ctx, dims):
     cseq = msg["payload"][1]
     cpid = msg["payload"][2]
     ok = msg["payload"][3] > 0
-    slot = _slot(seq, dims)
+    slot = dot_slot(seq, dims)
 
     st = ps["status"][me, slot]
     live = ((st == ST_PROPOSE_END) | (st == ST_REJECT)) & ~ps["qa_done"][slot]
@@ -775,23 +806,16 @@ def _mproposeack(dev, ps, msg, me, ctx, dims):
     )
     cseq_f = ps["qa_cseq"][slot]
     cpid_f = ps["qa_cpid"][slot]
-    obc = _agg_broadcast(
-        dev, ps, me, seq, cseq_f, cpid_f, CaesarDev.MCOMMIT, ctx, dims, fast
+    # one broadcast: identical payload either way, only the type differs
+    mtype = jnp.where(fast, CaesarDev.MCOMMIT, CaesarDev.MRETRY)
+    ob = _agg_broadcast(
+        dev, ps, me, seq, cseq_f, cpid_f, mtype, ctx, dims, done
     )
-    obr = _agg_broadcast(
-        dev, ps, me, seq, cseq_f, cpid_f, CaesarDev.MRETRY, ctx, dims, slow
-    )
-    ob = {
-        "valid": jnp.where(fast, obc["valid"], obr["valid"]),
-        "dst": jnp.where(fast, obc["dst"], obr["dst"]),
-        "mtype": jnp.where(fast, obc["mtype"], obr["mtype"]),
-        "payload": jnp.where(fast, obc["payload"], obr["payload"]),
-    }
     return ps, ob
 
 
 def _store_deps_from_msg(dev, ps, src, slot, msg, base, skip_self, seq,
-                         enable):
+                         enable, dims):
     """Replace the dot's dep list with the message's (minus a self-dep
     when ``skip_self``; caesar.rs:665-668)."""
     Q = dev.DEP
@@ -805,7 +829,7 @@ def _store_deps_from_msg(dev, ps, src, slot, msg, base, skip_self, seq,
         dsrcs = jnp.where(selfdep, 0, dsrcs)
         dseqs = jnp.where(selfdep, 0, dseqs)
     do = jnp.asarray(enable, bool)
-    wsrc = jnp.where(do, src, dims_N_of(ps))
+    wsrc = jnp.where(do, src, dims.N)
     return dict(
         ps,
         dep_src=ps["dep_src"].at[wsrc, slot].set(dsrcs, mode="drop"),
@@ -814,11 +838,7 @@ def _store_deps_from_msg(dev, ps, src, slot, msg, base, skip_self, seq,
     )
 
 
-def dims_N_of(ps):
-    return ps["pseq"].shape[0]
-
-
-def _update_clock(dev, ps, src, slot, key, new_cseq, new_cpid, enable):
+def _update_clock(dev, ps, src, slot, key, new_cseq, new_cpid, enable, dims):
     """Swap the registered clock (caesar.rs:893-918)."""
     do = jnp.asarray(enable, bool)
     old_cseq = ps["clk_seq"][src, slot]
@@ -828,7 +848,7 @@ def _update_clock(dev, ps, src, slot, key, new_cseq, new_cpid, enable):
     ps = _kc_add(
         dev, ps, key, src, ps["pseq"][src, slot], new_cseq, new_cpid, changed
     )
-    wsrc = jnp.where(do, src, dims_N_of(ps))
+    wsrc = jnp.where(do, src, dims.N)
     return dict(
         ps,
         clk_seq=ps["clk_seq"].at[wsrc, slot].set(new_cseq, mode="drop"),
@@ -843,7 +863,7 @@ def _mcommit(dev, ps, msg, me, ctx, dims):
     seq = msg["payload"][1]
     cseq = msg["payload"][2]
     cpid = msg["payload"][3]
-    slot = _slot(seq, dims)
+    slot = dot_slot(seq, dims)
     st = ps["status"][dsrc, slot]
     have = ps["pseq"][dsrc, slot] == seq
     do = have & (st != ST_COMMIT) & (st != ST_EXECUTED)
@@ -854,8 +874,9 @@ def _mcommit(dev, ps, msg, me, ctx, dims):
         clk_counter=jnp.maximum(ps["clk_counter"], cseq),
         err=ps["err"] | ~have,
     )
-    ps = _store_deps_from_msg(dev, ps, dsrc, slot, msg, 4, True, seq, do)
-    ps = _update_clock(dev, ps, dsrc, slot, key, cseq, cpid, do)
+    ps = _store_deps_from_msg(dev, ps, dsrc, slot, msg, 4, True, seq, do,
+                              dims)
+    ps = _update_clock(dev, ps, dsrc, slot, key, cseq, cpid, do, dims)
     wsrc = jnp.where(do, dsrc, dims.N)
     ps = dict(
         ps,
@@ -884,7 +905,7 @@ def _mretry(dev, ps, msg, me, ctx, dims):
     seq = msg["payload"][1]
     cseq = msg["payload"][2]
     cpid = msg["payload"][3]
-    slot = _slot(seq, dims)
+    slot = dot_slot(seq, dims)
     st = ps["status"][dsrc, slot]
     have = ps["pseq"][dsrc, slot] == seq
     do = have & (st != ST_COMMIT) & (st != ST_EXECUTED)
@@ -895,8 +916,9 @@ def _mretry(dev, ps, msg, me, ctx, dims):
         clk_counter=jnp.maximum(ps["clk_counter"], cseq),
         err=ps["err"] | ~have,
     )
-    ps = _store_deps_from_msg(dev, ps, dsrc, slot, msg, 4, False, seq, do)
-    ps = _update_clock(dev, ps, dsrc, slot, key, cseq, cpid, do)
+    ps = _store_deps_from_msg(dev, ps, dsrc, slot, msg, 4, False, seq, do,
+                              dims)
+    ps = _update_clock(dev, ps, dsrc, slot, key, cseq, cpid, do, dims)
     wsrc = jnp.where(do, dsrc, dims.N)
     ps = dict(
         ps,
@@ -911,7 +933,7 @@ def _mretry(dev, ps, msg, me, ctx, dims):
     pay = jnp.zeros((dims.P,), I32)
     pay = pay.at[0].set(dsrc)
     pay = pay.at[1].set(seq)
-    pay, nd, overflow = _pack_deps(dev, ps, key, pred_mask, 2, pay)
+    pay, nd, overflow = _pack_deps(dev, ps, key, pred_mask, 2, pay, dims)
 
     def add_msg_dep(i, carry):
         pay, nd, err = carry
@@ -948,7 +970,7 @@ def _mretryack(dev, ps, msg, me, ctx, dims):
     """caesar.rs:762-822 + QuorumRetries: union write-quorum dep
     replies; on the last one, commit."""
     seq = msg["payload"][1]
-    slot = _slot(seq, dims)
+    slot = dot_slot(seq, dims)
     live = ps["status"][me, slot] == ST_ACCEPT
     cnt = ps["qr_cnt"][slot] + 1
     ps = dict(
@@ -1004,10 +1026,10 @@ def _exec_drain(dev, ps, msg, me, ctx, dims):
 
 def _gc_drain(dev, ps, msg, me, ctx, dims):
     """Broadcast up to one message's worth of buffered executed dots to
-    all-but-me; chain while the buffer is non-empty."""
+    all-but-me; chain while this GC round's snapshot (gb_gc) remains."""
     DPM = dev.gc_per_msg(dims)
     n_buf = ps["gb_n"]
-    take = jnp.minimum(n_buf, DPM)
+    take = jnp.minimum(jnp.minimum(ps["gb_gc"], n_buf), DPM)
     pay = jnp.zeros((dims.P,), I32)
     pay = pay.at[0].set(take)
     idx = jnp.arange(DPM, dtype=I32)
@@ -1019,15 +1041,17 @@ def _gc_drain(dev, ps, msg, me, ctx, dims):
         ps["gb_seq"][idx], mode="drop"
     )
     # shift the buffer down
-    src_rolled = jnp.roll(ps["gb_src"], -DPM)
-    seq_rolled = jnp.roll(ps["gb_seq"], -DPM)
+    src_rolled = jnp.roll(ps["gb_src"], -take)
+    seq_rolled = jnp.roll(ps["gb_seq"], -take)
     remaining = n_buf - take
+    remaining_gc = ps["gb_gc"] - take
     keep = jnp.arange(dev.EB, dtype=I32) < remaining
     ps = dict(
         ps,
         gb_src=jnp.where(keep, src_rolled, 0),
         gb_seq=jnp.where(keep, seq_rolled, 0),
         gb_n=remaining,
+        gb_gc=remaining_gc,
     )
     ob = emit_broadcast(
         empty_outbox(dims), CaesarDev.MGC, pay, ctx["n"], me,
@@ -1035,6 +1059,6 @@ def _gc_drain(dev, ps, msg, me, ctx, dims):
     )
     ob = dict(ob, valid=ob["valid"] & (take > 0))
     ob = emit(
-        ob, dims.N, me, CaesarDev.GC_DRAIN, [0], valid=remaining > 0
+        ob, dims.N, me, CaesarDev.GC_DRAIN, [0], valid=remaining_gc > 0
     )
     return ps, ob

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import I32, emit, emit_broadcast, empty_outbox
-from ..dims import INF, EngineDims
+from ..dims import INF, EngineDims, dot_slot
 
 
 class FPaxosDev:
@@ -79,8 +79,12 @@ class FPaxosDev:
     def init_state(dims: EngineDims, ctx_np) -> Dict[str, np.ndarray]:
         N, D = dims.N, dims.D
         return {
-            # leader role: commander window (slot number, accept count)
+            # leader role: commander window. cmd_slot holds the slot an
+            # in-flight commander owns (0 = free) — occupancy is tracked
+            # explicitly because acc_count == 0 cannot distinguish a free
+            # entry from a commander that has not heard any MAccepted yet
             "last_slot": np.zeros((N,), np.int32),
+            "cmd_slot": np.zeros((N, D), np.int32),
             "acc_count": np.zeros((N, D), np.int32),
             # acceptor role: window entry → accepted slot (0 = free)
             "acc_slot": np.zeros((N, D), np.int32),
@@ -136,9 +140,6 @@ class FPaxosDev:
         return ps, ob
 
 
-def _slot_idx(slot, dims):
-    return (slot - 1) % dims.D
-
 
 def _submit(ps, msg, me, ctx, dims):
     """SUBMIT/MFORWARD: non-leader forwards to the leader; the leader
@@ -150,12 +151,18 @@ def _submit(ps, msg, me, ctx, dims):
     do = msg["valid"] & is_leader
 
     slot = ps["last_slot"] + 1
-    idx = _slot_idx(slot, dims)
-    dirty = ps["acc_count"][idx] != 0
+    idx = dot_slot(slot, dims)
+    dirty = ps["cmd_slot"][idx] != 0
     ps = dict(
         ps,
         err=ps["err"] | (do & dirty),
         last_slot=jnp.where(do, slot, ps["last_slot"]),
+        cmd_slot=ps["cmd_slot"].at[jnp.where(do, idx, dims.D)].set(
+            slot, mode="drop"
+        ),
+        acc_count=ps["acc_count"].at[jnp.where(do, idx, dims.D)].set(
+            0, mode="drop"
+        ),
     )
 
     # outbox: slot 0 = forward-to-leader, slots 1..N = MAccept broadcast
@@ -191,7 +198,7 @@ def _maccept(ps, msg, me, ctx, dims):
     """Acceptor stores the slot and replies MAccepted to the leader
     (fpaxos.rs:240-262)."""
     slot, client = msg["payload"][0], msg["payload"][1]
-    idx = _slot_idx(slot, dims)
+    idx = dot_slot(slot, dims)
     dirty = ps["acc_slot"][idx] != 0
     ps = dict(
         ps,
@@ -212,14 +219,21 @@ def _maccepted(ps, msg, me, ctx, dims):
     """Commander counts accepts; on exactly f+1 the slot is chosen and
     broadcast to all (fpaxos.rs:264-315)."""
     slot, client = msg["payload"][0], msg["payload"][1]
-    idx = _slot_idx(slot, dims)
+    idx = dot_slot(slot, dims)
+    # a stale MAccepted for a retired commander (slot mismatch) is a
+    # protocol error, not a silent merge into the new occupant's count
+    stale = ps["cmd_slot"][idx] != slot
     cnt = ps["acc_count"][idx] + 1
-    chosen = cnt == ctx["q_size"]
+    chosen = ~stale & (cnt == ctx["q_size"])
     # the commander is retired once the slot is chosen (commanders.pop),
     # freeing the window entry for reuse
     ps = dict(
         ps,
+        err=ps["err"] | stale,
         acc_count=ps["acc_count"].at[idx].set(jnp.where(chosen, 0, cnt)),
+        cmd_slot=ps["cmd_slot"].at[idx].set(
+            jnp.where(chosen, 0, ps["cmd_slot"][idx])
+        ),
     )
     ob = emit_broadcast(
         empty_outbox(dims),

@@ -66,12 +66,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core import I32, emit, emit_broadcast, empty_outbox
-from ..dims import INF, EngineDims
+from ..core import I32, compact_order, emit, emit_broadcast, empty_outbox
+from ..dims import INF, SEQ_BOUND, EngineDims, dot_slot
 from ..iset import iset_add, iset_contains
-
-# dot sequences must fit below this when packed with their source
-_SEQ_BOUND = 1 << 20
 
 
 class _DepDev:
@@ -262,9 +259,6 @@ class EPaxosDev(_DepDev):
 # ----------------------------------------------------------------------
 
 
-def _slot(seq, dims):
-    return (seq - 1) % dims.D
-
 
 def _qd_add(ps, slot, dsrc, dseq, enable):
     """Merge one reported dep into the coordinator's count table
@@ -294,23 +288,21 @@ def _qd_add(ps, slot, dsrc, dseq, enable):
 def _commit_broadcast(dev, ps, me, seq, key, client, ctx, dims, valid):
     """MCommit to all with the aggregated dep union (the single-shard arm
     of mcommit_actions, atlas.rs:393-409)."""
-    slot = _slot(seq, dims)
+    slot = dot_slot(seq, dims)
     Q = dev.dep_slots(dims.N)
     P = dims.P
     present = ps["qd_seq"][slot] > 0
-    nd = jnp.sum(present)
+    # compact present deps to the front so nd prefixes are meaningful
+    order, nd = compact_order(present, Q)
     pay = jnp.zeros((P,), I32)
     pay = pay.at[0].set(me)
     pay = pay.at[1].set(seq)
     pay = pay.at[2].set(key)
     pay = pay.at[3].set(client)
     pay = pay.at[4].set(nd)
-    # compact present deps to the front so nd prefixes are meaningful
-    order = jnp.where(present, jnp.cumsum(present.astype(I32)) - 1, Q)
-    packed = jnp.stack([ps["qd_src"][slot], ps["qd_seq"][slot]], axis=1)
-    lo = jnp.where(order < Q, 5 + 2 * order, P)
-    pay = pay.at[lo].set(packed[:, 0], mode="drop")
-    pay = pay.at[lo + 1].set(packed[:, 1], mode="drop")
+    lo = 5 + 2 * jnp.minimum(order, P)  # > P when order==INF
+    pay = pay.at[lo].set(ps["qd_src"][slot], mode="drop")
+    pay = pay.at[lo + 1].set(ps["qd_seq"][slot], mode="drop")
 
     ob = emit_broadcast(
         empty_outbox(dims), _DepDev.MCOMMIT, pay, ctx["n"]
@@ -330,7 +322,7 @@ def _drain(dev, ps, me, ctx, dims, ob, exec_slot, drain_slot, enable=True):
     N, D = dims.N, dims.D
     dep_src = ps["vx_dep_src"]  # [N, D, Q]
     dep_seq = ps["vx_dep_seq"]
-    dslot = _slot(dep_seq, dims)
+    dslot = dot_slot(dep_seq, dims)
 
     # per-dep static facts: absent deps pass; executed deps pass
     absent = dep_seq == 0
@@ -359,7 +351,7 @@ def _drain(dev, ps, me, ctx, dims, ob, exec_slot, drain_slot, enable=True):
     ready = ok & jnp.all(dep_pass_static, axis=2)
     sel = jnp.where(jnp.any(ready), ready, ok)
     srcs = jnp.arange(N, dtype=I32)[:, None]
-    packed = srcs * _SEQ_BOUND + ps["vx_seq"]
+    packed = srcs * SEQ_BOUND + ps["vx_seq"]
     flat_idx = jnp.argmin(jnp.where(sel, packed, INF))
     esrc, eslot = flat_idx // D, flat_idx % D
     eseq = ps["vx_seq"][esrc, eslot]
@@ -411,7 +403,7 @@ def _submit(dev, ps, msg, me, ctx, dims):
     client = msg["payload"][0]
     key = msg["payload"][2]
     seq = ps["own_seq"] + 1
-    slot = _slot(seq, dims)
+    slot = dot_slot(seq, dims)
     Q = dev.dep_slots(dims.N)
 
     prev_src = ps["latest_src"][key]
@@ -419,7 +411,7 @@ def _submit(dev, ps, msg, me, ctx, dims):
     ps = dict(
         ps,
         # (source, sequence) packing in the drain requires seq < bound
-        err=ps["err"] | (seq >= _SEQ_BOUND),
+        err=ps["err"] | (seq >= SEQ_BOUND),
         own_seq=seq,
         latest_src=ps["latest_src"].at[key].set(me),
         latest_seq=ps["latest_seq"].at[key].set(seq),
@@ -451,7 +443,7 @@ def _mcollect(dev, ps, msg, me, ctx, dims):
         msg["payload"][3],
         msg["payload"][4],
     )
-    slot = _slot(seq, dims)
+    slot = dot_slot(seq, dims)
     dirty = (ps["seq_in_slot"][s, slot] != 0) | (ps["vx_seq"][s, slot] != 0)
     ps = dict(
         ps,
@@ -497,7 +489,7 @@ def _mcollectack(dev, ps, msg, me, ctx, dims):
     """atlas.rs:325-391 / epaxos.rs:297-364: aggregate dep reports; on
     the last expected ack run the fast-path predicate."""
     seq = msg["payload"][0]
-    slot = _slot(seq, dims)
+    slot = dot_slot(seq, dims)
     ps = _qd_add(ps, slot, msg["payload"][1], msg["payload"][2], True)
     ps = _qd_add(ps, slot, msg["payload"][3], msg["payload"][4], True)
     cnt = ps["ack_cnt"][slot] + 1
@@ -549,7 +541,7 @@ def _mcommit(dev, ps, msg, me, ctx, dims):
     key = msg["payload"][2]
     client = msg["payload"][3]
     nd = msg["payload"][4]
-    slot = _slot(seq, dims)
+    slot = dot_slot(seq, dims)
     Q = dev.dep_slots(dims.N)
 
     have = ps["seq_in_slot"][dsrc, slot] == seq
@@ -605,7 +597,7 @@ def _mconsensusack(dev, ps, msg, me, ctx, dims):
     built with the model f even for EPaxos, epaxos.rs:45-70), then
     commit with the dep union gathered during collect."""
     seq = msg["payload"][1]
-    slot = _slot(seq, dims)
+    slot = dot_slot(seq, dims)
     cnt = ps["slow_acks"][slot] + 1
     chosen = cnt == ctx["f"] + 1
     ps = dict(ps, slow_acks=ps["slow_acks"].at[slot].set(cnt))

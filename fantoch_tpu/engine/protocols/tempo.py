@@ -39,12 +39,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import I32, emit, emit_broadcast, empty_outbox
-from ..dims import INF, EngineDims
+from ..dims import INF, SEQ_BOUND, EngineDims, dot_slot
 from ..iset import iset_add, iset_add_range
 
-# dot sequences must fit below this when packed with their source for
-# lexicographic argmin
-_SEQ_BOUND = 1 << 20
 
 
 class TempoDev:
@@ -298,9 +295,6 @@ def _vote_add(tempo, ps, key, voter, start, end, enable):
     )
 
 
-def _slot(seq, dims):
-    return (seq - 1) % dims.D
-
 
 # ----------------------------------------------------------------------
 # table-executor drain
@@ -327,7 +321,7 @@ def _drain(tempo, ps, key, me, ctx, dims, ob, exec_slot, drain_slot,
     num_ready = jnp.sum(ready)
     cmin = jnp.min(jnp.where(ready, clocks, INF))
     tie = ready & (clocks == cmin)
-    packed = ps["pend_src"][key] * _SEQ_BOUND + ps["pend_seq"][key]
+    packed = ps["pend_src"][key] * SEQ_BOUND + ps["pend_seq"][key]
     idx = jnp.argmin(jnp.where(tie, packed, INF))
 
     do = jnp.asarray(enable, bool) & (num_ready > 0)
@@ -384,12 +378,14 @@ def _submit(tempo, ps, msg, me, ctx, dims):
     client = msg["payload"][0]
     key = msg["payload"][2]
     seq = ps["own_seq"] + 1
-    slot = _slot(seq, dims)
+    slot = dot_slot(seq, dims)
 
     cur = ps["clocks"][key]
     clock = cur + 1  # max(0, highest key clock + 1), single key
     ps = dict(
         ps,
+        # (source, sequence) packing in the drain scan requires seq < bound
+        err=ps["err"] | (seq >= SEQ_BOUND),
         own_seq=seq,
         clocks=ps["clocks"].at[key].set(clock),
         ack_cnt=ps["ack_cnt"].at[slot].set(0),
@@ -420,7 +416,7 @@ def _mcollect(tempo, ps, msg, me, ctx, dims):
         msg["payload"][2],
         msg["payload"][3],
     )
-    slot = _slot(seq, dims)
+    slot = dot_slot(seq, dims)
     dirty = ps["seq_in_slot"][s, slot] != 0
     ps = dict(
         ps,
@@ -464,7 +460,7 @@ def _mcollectack(tempo, ps, msg, me, ctx, dims):
         msg["payload"][2],
         msg["payload"][3],
     )
-    slot = _slot(seq, dims)
+    slot = dot_slot(seq, dims)
 
     # merge the ack's vote range
     nv = ps["votes_n"][slot]
@@ -533,7 +529,7 @@ def _mcollectack(tempo, ps, msg, me, ctx, dims):
 def _commit_broadcast(tempo, ps, me, seq, clock, key, client, ctx, dims,
                       valid):
     """Build the MCommit broadcast carrying the aggregated votes."""
-    slot = _slot(seq, dims)
+    slot = dot_slot(seq, dims)
     N, P = dims.N, dims.P
     pay = jnp.zeros((P,), I32)
     pay = pay.at[0].set(me)
@@ -577,7 +573,7 @@ def _mcommit(tempo, ps, msg, me, ctx, dims):
     key = msg["payload"][3]
     client = msg["payload"][4]
     nv = msg["payload"][5]
-    slot = _slot(seq, dims)
+    slot = dot_slot(seq, dims)
     have = ps["seq_in_slot"][dsrc, slot] == seq
     ps = dict(ps, err=ps["err"] | ~have)
 
@@ -660,7 +656,7 @@ def _mconsensus(tempo, ps, msg, me, ctx, dims):
         msg["payload"][1],
         msg["payload"][2],
     )
-    slot = _slot(seq, dims)
+    slot = dot_slot(seq, dims)
     key = ps["key_of"][dsrc, slot]
     has_cmd = ps["seq_in_slot"][dsrc, slot] == seq
     ps = _bump(tempo, ps, key, clock, has_cmd)
@@ -678,7 +674,7 @@ def _mconsensusack(tempo, ps, msg, me, ctx, dims):
     """tempo.rs:775-812: f+1 accepts choose the slow-path clock; commit
     with the votes gathered during collect."""
     seq = msg["payload"][1]
-    slot = _slot(seq, dims)
+    slot = dot_slot(seq, dims)
     cnt = ps["slow_acks"][slot] + 1
     chosen = cnt == ctx["wq_size"]
     ps = dict(ps, slow_acks=ps["slow_acks"].at[slot].set(cnt))

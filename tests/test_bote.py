@@ -101,6 +101,29 @@ def test_search_ranks_and_scores():
     assert abs(top.score - expected) < 1e-3
 
 
+def test_ranked_means_match_host_stats():
+    """Every ranked config's means must equal the host compute_stats
+    (Histogram-of-integers) means exactly — the search reduces the
+    device latencies in f64 (search.rs ranks from Histogram stats)."""
+    planet = Planet.new()
+    servers = sorted(planet.regions())[:6]
+    search = Search(planet, servers=servers, clients=servers)
+    params = RankingParams(
+        min_mean_fpaxos_improv=-1000.0,
+        min_fairness_fpaxos_improv=-1000.0,
+        min_n=3,
+        max_n=3,
+        ft_metric=FTMetric.F1,
+    )
+    ranked = search.rank(params)[3]
+    bote = Bote(planet)
+    for rc in ranked:
+        stats = compute_stats(list(rc.config), servers, bote)
+        assert rc.means["af1"] == stats["af1"].mean()
+        assert rc.means["ff1"] == stats["ff1"].mean()
+        assert rc.means["e"] == stats["e"].mean()
+
+
 def test_tighter_params_filter_configs():
     planet = Planet.new()
     servers = sorted(planet.regions())[:8]

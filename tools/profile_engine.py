@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Profile the batched engine: steps/lane, wall time, scaling with batch.
+
+Usage: python tools/profile_engine.py [batch_sizes...]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import numpy as np
+
+from fantoch_tpu.core import Config, Planet
+from fantoch_tpu.engine import EngineDims
+from fantoch_tpu.engine.core import build_runner, init_lane_state
+from fantoch_tpu.engine.protocols import TempoDev
+from fantoch_tpu.engine.spec import make_lane, stack_lanes
+
+N = 3
+COMMANDS = 50
+CLIENTS_PER_REGION = 1
+
+
+def build_specs(batch, planet, tempo, dims, base):
+    regions = planet.regions()
+    specs = []
+    conflicts = [0, 10, 50, 100]
+    for i in range(batch):
+        rs = regions[(i // len(conflicts)) % 16:][:N]
+        config = base.with_(n=N, f=1)
+        specs.append(
+            make_lane(
+                tempo, planet, config,
+                conflict_rate=conflicts[i % len(conflicts)],
+                pool_size=1,
+                commands_per_client=COMMANDS,
+                clients_per_region=CLIENTS_PER_REGION,
+                process_regions=list(rs), client_regions=list(rs),
+                dims=dims, seed=i,
+            )
+        )
+    return specs
+
+
+def main():
+    batches = [int(x) for x in sys.argv[1:]] or [64, 256, 1024]
+    planet = Planet.new()
+    clients = N * CLIENTS_PER_REGION
+    tempo = TempoDev(keys=1 + clients)
+    total = COMMANDS * clients
+    dims = EngineDims.for_protocol(
+        tempo, n=N, clients=clients, payload=tempo.payload_width(N),
+        total_commands=total, dot_slots=total + 1, regions=N,
+    )
+    base = Config(n=N, f=1, gc_interval_ms=100,
+                  tempo_detached_send_interval_ms=100)
+    print(f"device: {jax.devices()[0]}, dims M={dims.M} D={dims.D} "
+          f"F={dims.F} P={dims.P}")
+    runner = build_runner(tempo, dims)
+    for b in batches:
+        specs = build_specs(b, planet, tempo, dims, base)
+        ctx = stack_lanes(specs)
+        states = [init_lane_state(tempo, dims, s.ctx) for s in specs]
+        state = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *states)
+        t0 = time.perf_counter()
+        out = runner(state, ctx)
+        jax.block_until_ready(out)
+        t_compile = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out = runner(state, ctx)
+        jax.block_until_ready(out)
+        t_run = time.perf_counter() - t0
+        steps = np.asarray(out["steps"])
+        errs = int(np.asarray(out["err"]).sum())
+        print(
+            f"batch={b:5d} run={t_run:7.2f}s (compile+run {t_compile:.1f}s) "
+            f"steps max={steps.max()} mean={steps.mean():.0f} "
+            f"per-step={t_run / steps.max() * 1e3:.2f}ms "
+            f"lanes/s={b / t_run:.2f} errs={errs}"
+        )
+
+
+if __name__ == "__main__":
+    main()

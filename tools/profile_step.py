@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Per-step cost of the batched engine: run a fixed number of steps
+(fori_loop) at several batch sizes and report ms/step and lane-steps/s.
+
+Usage: python tools/profile_step.py [steps] [batch...]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import numpy as np
+
+from fantoch_tpu.core import Config, Planet
+from fantoch_tpu.engine import EngineDims
+from fantoch_tpu.engine.core import _lane_step, init_lane_state
+from fantoch_tpu.engine.protocols import TempoDev
+from fantoch_tpu.engine.spec import make_lane, stack_lanes
+
+N = 3
+COMMANDS = 50
+CONFLICTS = [0, 10, 50, 100]
+
+
+def main():
+    args = [int(x) for x in sys.argv[1:]]
+    steps = args[0] if args else 200
+    batches = args[1:] or [64, 512, 2048]
+    planet = Planet.new()
+    regions = planet.regions()
+    clients = N
+    tempo = TempoDev(keys=1 + clients)
+    total = COMMANDS * clients
+    dims = EngineDims.for_protocol(
+        tempo, n=N, clients=clients, payload=tempo.payload_width(N),
+        total_commands=total, dot_slots=total + 1, regions=N,
+    )
+    base = Config(n=N, f=1, gc_interval_ms=100,
+                  tempo_detached_send_interval_ms=100)
+
+    def run_steps(state, ctx):
+        return jax.lax.fori_loop(
+            0, steps,
+            lambda i, s: jax.vmap(
+                lambda st, cx: _lane_step(tempo, dims, st, cx)
+            )(s, ctx),
+            state,
+        )
+
+    runner = jax.jit(run_steps)
+    print(f"device {jax.devices()[0]} dims M={dims.M} F={dims.F} P={dims.P}")
+    for b in batches:
+        specs = [
+            make_lane(
+                tempo, planet, base.with_(n=N, f=1),
+                conflict_rate=CONFLICTS[i % 4], pool_size=1,
+                commands_per_client=COMMANDS, clients_per_region=1,
+                process_regions=list(regions[(i // 4) % 16:][:N]),
+                client_regions=list(regions[(i // 4) % 16:][:N]),
+                dims=dims, seed=i,
+            )
+            for i in range(b)
+        ]
+        ctx = stack_lanes(specs)
+        states = [init_lane_state(tempo, dims, s.ctx) for s in specs]
+        state = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *states)
+        t0 = time.perf_counter()
+        out = runner(state, ctx)
+        jax.block_until_ready(out)
+        t_compile = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out = runner(state, ctx)
+        jax.block_until_ready(out)
+        t = time.perf_counter() - t0
+        print(
+            f"batch={b:5d} {steps} steps in {t:6.2f}s "
+            f"({t / steps * 1e3:6.2f} ms/step, "
+            f"{b * steps / t:9.0f} lane-steps/s, compile {t_compile:.0f}s)"
+        )
+
+
+if __name__ == "__main__":
+    main()
