@@ -2,22 +2,41 @@
 
 Replaces the reference's heap-driven single-config loop
 (fantoch/src/sim/runner.rs:233-313, schedule.rs:6-61) with a fixed-shape,
-vmappable step:
+vmappable step built on conservative-lookahead parallel DES (the
+Chandy-Misra condition, evaluated with shared memory instead of null
+messages):
 
-  1. T := min arrival time over the lane's message pool and periodic
-     timers (masked min-reduction — the "heap pop");
-  2. every process with a pending message at time T handles its earliest
-     one (tie-break by global sequence number, which makes runs exactly
-     reproducible — the reference leaves heap ties unspecified,
-     schedule.rs:109-119);
+  1. every process p finds its earliest local event time e_p (message
+     arrival or periodic timer) and qualifies to run whenever
+     e_p <= min_q(e_q + lookahead[q, p]), where lookahead is the
+     all-pairs shortest-path matrix over the WAN delay graph — no chain
+     of still-unsent messages can reach p before e_p. The process at the
+     lane-wide minimum always qualifies, so time always advances; with
+     WAN delays large relative to event spacing, most processes qualify
+     every step — this recovers the ~N-fold concurrency a global-time
+     step forfeits when arrivals land at distinct instants;
+  2. each qualifying process handles its earliest message (prio
+     self-messages first, then lowest (src, per-channel emission index)
+     key — a deterministic total order the host oracle's heap shares;
+     the reference leaves heap ties unspecified, schedule.rs:109-119)
+     at its *own* local time. The key is src-major on purpose: counter
+     values are only ever compared between messages of the same
+     (src, dst) channel, where both sides count identically, so no
+     global emission counter has to be reproduced across the
+     out-of-order step interleavings the lookahead rule allows;
   3. handlers run as one `lax.switch` over message type, `vmap`'d over
-     the process axis; periodic timers fire on steps where their process
-     has no message at T;
-  4. emitted messages are scattered into free pool slots; messages bound
-     for clients are *rewritten in place* into the client's next SUBMIT
-     (closed-loop clients are deterministic: record latency, then either
-     issue the next command or finish — client/mod.rs:91-137), so clients
-     never occupy pool destinations.
+     the process axis; a periodic timer due at e_p takes the whole step
+     for its process;
+  4. emitted messages are scattered into free pool slots with arrival =
+     emitter's local time + pair delay; messages bound for clients are
+     *rewritten in place* into the client's next SUBMIT (closed-loop
+     clients are deterministic: record latency, then either issue the
+     next command or finish — client/mod.rs:91-137), so clients never
+     occupy pool destinations.
+
+Event timestamps (and so all latency results) are schedule-independent;
+on tie-free schedules the outcome is bit-identical to the host oracle,
+which the differential tests assert per protocol.
 
 The whole lane step sits in a `lax.while_loop` whose condition is the
 lane's termination predicate; `vmap` over lanes gives the config batch,
@@ -144,17 +163,48 @@ def gen_key(ctx, client, cmd_seq):
 # lane state
 # ----------------------------------------------------------------------
 
-def init_lane_state(protocol, dims: EngineDims, ctx_np: Dict[str, np.ndarray]):
+KEYGEN_CTX_FIELDS = (
+    "rng_key",
+    "conflict_rate",
+    "pool_size",
+    "key_gen_kind",
+    "zipf_cum",
+)
+
+
+def first_keys_fn(C: int):
+    """Jit-able: keygen ctx slice → every client's first command key.
+    Sweep drivers vmap this over the lane batch so host-side state init
+    does one device call instead of one per lane."""
+
+    def one(ctx):
+        return jax.vmap(lambda c: gen_key(ctx, c, 1))(
+            jnp.arange(C, dtype=I32)
+        )
+
+    return one
+
+
+def init_lane_state(
+    protocol,
+    dims: EngineDims,
+    ctx_np: Dict[str, np.ndarray],
+    first_keys: "np.ndarray | None" = None,
+):
     """Build one lane's initial state (numpy, host side).
 
     Prepopulates the pool with every live client's first SUBMIT — the
     reference's ``Simulation::start_clients`` (runner.rs:211-220) — and
-    arms the periodic timers at t = interval.
+    arms the periodic timers at t = interval. ``first_keys`` ([C], from
+    :func:`first_keys_fn`) skips the per-lane device round trip.
     """
     N, C, M, P, R = dims.N, dims.C, dims.M, dims.P, dims.R
     pool = {
         "arrival": np.full((M,), INF, np.int32),
-        "seq": np.zeros((M,), np.int32),
+        # tie-break key: (ksrc, kcnt) = (emitting src, emission index on
+        # the (src, dst) channel), compared lexicographically
+        "ksrc": np.zeros((M,), np.int32),
+        "kcnt": np.zeros((M,), np.int32),
         "src": np.zeros((M,), np.int32),
         "dst": np.zeros((M,), np.int32),
         "mtype": np.zeros((M,), np.int32),
@@ -170,25 +220,19 @@ def init_lane_state(protocol, dims: EngineDims, ctx_np: Dict[str, np.ndarray]):
     assert live.sum() <= M, "pool must hold the initial submit wave"
     # first keys for every client, with the same counter scheme the
     # device uses for subsequent commands
-    keyctx = {
-        k: jnp.asarray(ctx_np[k])
-        for k in (
-            "rng_key",
-            "conflict_rate",
-            "pool_size",
-            "key_gen_kind",
-            "zipf_cum",
-        )
-    }
-    first_keys = np.asarray(
-        jax.vmap(lambda c: gen_key(keyctx, c, 1))(jnp.arange(C, dtype=I32))
-    )
+    if first_keys is None:
+        keyctx = {
+            k: jnp.asarray(ctx_np[k]) for k in KEYGEN_CTX_FIELDS
+        }
+        first_keys = np.asarray(first_keys_fn(C)(keyctx))
     slot = 0
     for c in range(C):
         if not live[c]:
             continue
         pool["arrival"][slot] = ctx_np["client_delay"][c, attach[c]]
-        pool["seq"][slot] = slot
+        # each client's first SUBMIT is emission #1 on its channel
+        pool["ksrc"][slot] = N + c
+        pool["kcnt"][slot] = 1
         pool["src"][slot] = N + c
         pool["dst"][slot] = attach[c]
         pool["mtype"][slot] = protocol.SUBMIT
@@ -222,8 +266,11 @@ def init_lane_state(protocol, dims: EngineDims, ctx_np: Dict[str, np.ndarray]):
             "lat_log": np.full((C, LAT_LOG), -1, np.int32),
         },
         "now": np.int32(0),
-        "msg_seq": np.int32(slot),
+        # per-(src, dst) channel emission counters (dst < N: clients'
+        # SUBMITs use the client's own submit number instead)
+        "pair_cnt": np.zeros((N, N), np.int32),
         "steps": np.int32(0),
+        "max_completion": np.int32(0),
         "done_time": np.int32(INF),
         "err": np.zeros((), bool),
         "hlog": np.full((N, max(DEBUG_LOG, 1), 6), -1, np.int32),
@@ -238,35 +285,66 @@ def init_lane_state(protocol, dims: EngineDims, ctx_np: Dict[str, np.ndarray]):
 def _lane_step(protocol, dims: EngineDims, st, ctx):
     N, C, M, F, R, P = dims.N, dims.C, dims.M, dims.F, dims.R, dims.P
     pool = st["pool"]
-    arrival, seq = pool["arrival"], pool["seq"]
+    arrival = pool["arrival"]
+    procs = jnp.arange(N, dtype=I32)
 
-    # 1. advance time to the earliest pending event ---------------------
-    T = jnp.minimum(jnp.min(arrival), jnp.min(st["next_periodic"]))
+    # 1. per-process local event times + conservative lookahead ---------
+    # Each process p advances to its own earliest pending event e_p
+    # (message arrival or periodic timer) and may process it whenever
+    # e_p <= min_q(e_q + lookahead[q, p]) — no chain of still-unsent
+    # messages can reach p earlier (lookahead = all-pairs shortest path
+    # over the delay matrix, built host-side in make_lane). The process
+    # holding the lane-wide minimum always qualifies, so time advances
+    # every step; typically most processes qualify at once, which is
+    # what beats the one-event-per-step serialization of a heap DES.
+    dstmask = pool["dst"][None, :] == procs[:, None]          # [N, M]
+    arr_p = jnp.min(
+        jnp.where(dstmask, arrival[None, :], INF), axis=1
+    )                                                         # [N]
+    ep = jnp.minimum(arr_p, jnp.min(st["next_periodic"], axis=1))
+    reach = jnp.where(
+        (ep[:, None] >= INF) | (ctx["lookahead"] >= INF),
+        INF,
+        ep[:, None] + ctx["lookahead"],
+    )                                                         # [q, p]
+    bound = jnp.min(reach, axis=0)                            # [N]
+    T = jnp.min(ep)  # lane-wide virtual time
+    # strictly below the bound: at ep == bound a message with a smaller
+    # tie key could still arrive at exactly ep. Processes at the global
+    # minimum T are always safe (nothing can arrive before T) — that
+    # also guarantees progress whatever the delay matrix.
+    active = (ep < INF) & ((ep < bound) | (ep == T))
 
-    # 2. pop at most one message per process at time T ------------------
-    # (T == INF means the lane is idle: consumed slots also hold INF, so
-    # without the guard they would be replayed as stale messages)
+    # 2. pop at most one message per active process at its local time --
     # periodic timers take the whole step for their process: the oracle
     # pops them first (enqueued an interval ago, lowest seq) and delivers
     # their self-targeted emissions inline before any same-instant
     # message — so pending messages wait for the next step
-    fire = (st["next_periodic"] == T) & (T < INF)  # [N, R]
-    fired_any = jnp.any(fire, axis=1)              # [N]
+    fire = (
+        (st["next_periodic"] == ep[:, None]) & active[:, None]
+    )                                                         # [N, R]
+    fired_any = jnp.any(fire, axis=1)                         # [N]
 
-    at_t = (arrival == T) & (T < INF)
-    procs = jnp.arange(N, dtype=I32)
+    at_t = arrival[None, :] == ep[:, None]                    # [N, M]
     cand = (
-        at_t[None, :]
-        & (pool["dst"][None, :] == procs[:, None])
+        at_t
+        & dstmask
+        & active[:, None]
         & ~fired_any[:, None]
     )  # [N, M]
-    # inline self-messages first (oracle recursion), then seq order
+    # inline self-messages first (oracle recursion), then lexicographic
+    # (ksrc, kcnt) order
     cand_prio = cand & pool["prio"][None, :]
     use = jnp.where(jnp.any(cand_prio, axis=1)[:, None], cand_prio, cand)
-    order = jnp.where(use, seq[None, :], INF)
+    usrc = jnp.where(use, pool["ksrc"][None, :], INF)
+    min_src = jnp.min(usrc, axis=1)                                   # [N]
+    order = jnp.where(
+        use & (pool["ksrc"][None, :] == min_src[:, None]),
+        pool["kcnt"][None, :],
+        INF,
+    )
     slot = jnp.argmin(order, axis=1)                                  # [N]
-    seq_handled = jnp.min(order, axis=1)                              # [N]
-    has = seq_handled < INF
+    has = jnp.any(use, axis=1)
     msg = {
         "valid": has,
         "src": pool["src"][slot],
@@ -275,26 +353,27 @@ def _lane_step(protocol, dims: EngineDims, st, ctx):
     }
     arrival = arrival.at[jnp.where(has, slot, M)].set(INF, mode="drop")
 
-    # 3. handlers -------------------------------------------------------
-    def periodic_one(ps_slice, f, me):
-        return protocol.periodic(ps_slice, f, me, T, ctx, dims)
+    # 3. handlers (each at its process's own local time) ----------------
+    def periodic_one(ps_slice, f, me, t):
+        return protocol.periodic(ps_slice, f, me, t, ctx, dims)
 
-    ps, pout = jax.vmap(periodic_one)(st["ps"], fire, procs)  # pout [N,F]
+    ps, pout = jax.vmap(periodic_one)(st["ps"], fire, procs, ep)
     next_periodic = jnp.where(
-        fire, T + ctx["periodic_intervals"][None, :], st["next_periodic"]
+        fire, ep[:, None] + ctx["periodic_intervals"][None, :],
+        st["next_periodic"],
     )
 
-    def handle_one(ps_slice, m, me):
-        return protocol.handle(ps_slice, m, me, T, ctx, dims)
+    def handle_one(ps_slice, m, me, t):
+        return protocol.handle(ps_slice, m, me, t, ctx, dims)
 
-    ps, outbox = jax.vmap(handle_one)(ps, msg, procs)  # outbox [N,F]
+    ps, outbox = jax.vmap(handle_one)(ps, msg, procs, ep)  # outbox [N,F]
 
     # optional debug timeline of handled messages
     hlog, hlog_n = st["hlog"], st["hlog_n"]
     if DEBUG_LOG:
         entry = jnp.stack(
             [
-                jnp.broadcast_to(T, (N,)),
+                ep,
                 msg["mtype"],
                 msg["src"],
                 msg["payload"][:, 0],
@@ -307,36 +386,27 @@ def _lane_step(protocol, dims: EngineDims, st, ctx):
         hlog = hlog.at[procs, widx].set(entry, mode="drop")
         hlog_n = hlog_n + has.astype(I32)
 
-    # 4. flatten emissions (periodic first, mirroring handler order) ----
-    def flat(ob):
-        return jax.tree_util.tree_map(
-            lambda a: a.reshape((-1,) + a.shape[2:]), ob
-        )
-
+    # 4. flatten emissions, keeping each process's rows contiguous with
+    # its periodic emissions first (the oracle pops periodic events
+    # before same-instant messages, so their emissions count first on
+    # each channel)
     out = jax.tree_util.tree_map(
-        lambda a, b: jnp.concatenate([a, b], axis=0), flat(pout), flat(outbox)
+        lambda a, b: jnp.concatenate([a, b], axis=1).reshape(
+            (2 * N * F,) + a.shape[2:]
+        ),
+        pout,
+        outbox,
     )
-    emitter = jnp.concatenate([jnp.repeat(procs, F), jnp.repeat(procs, F)])
+    emitter = jnp.repeat(procs, 2 * F)
     E = 2 * N * F
     valid, dst = out["valid"], out["dst"]
 
-    # sequence-number ordering for emissions: the oracle assigns schedule
-    # seqs in pop order — periodic events first (group 0, by process),
-    # then messages in the order they were handled (their own seq), each
-    # handler's emissions in outbox-slot order
-    grp = jnp.concatenate(
-        [jnp.zeros((N * F,), I32), jnp.ones((N * F,), I32)]
-    )
-    trig = jnp.concatenate(
-        [jnp.repeat(procs, F), jnp.repeat(seq_handled, F)]
-    )
-    slotk = jnp.tile(jnp.arange(F, dtype=I32), 2 * N)
-
     # 5. client rewrite: TO_CLIENT → latency record + next SUBMIT -------
+    ep_e = ep[emitter]  # each emission leaves at its emitter's local time
     is_client = valid & (dst >= N)
     c = jnp.where(is_client, dst - N, 0)
     d_back = ctx["client_delay"][c, emitter]
-    t_arr = T + d_back
+    t_arr = ep_e + d_back
     latency = t_arr - st["clients"]["start_time"][c]
 
     cl = st["clients"]
@@ -373,7 +443,7 @@ def _lane_step(protocol, dims: EngineDims, st, ctx):
     mtype = jnp.where(issue, protocol.SUBMIT, out["mtype"])
     payload = jnp.where(issue[:, None], sub_payload, out["payload"])
     src = jnp.where(is_client, N + c, emitter)
-    base = jnp.where(issue, t_arr, T)
+    base = jnp.where(issue, t_arr, ep_e)
     delay = jnp.where(
         issue,
         ctx["client_delay"][c, ctx["client_attach"][c]],
@@ -383,12 +453,40 @@ def _lane_step(protocol, dims: EngineDims, st, ctx):
     msg_arrival = base + delay
     prio = ~is_client & (dst == emitter)
 
+    # sequence keys: the schedule-independent tie-break total order
+    # (ksrc, kcnt) with kcnt counting emissions per (src, dst) channel.
+    # Same-(arrival, dst) ties compare src first; the counter is only
+    # ever compared between messages of one channel, where both the
+    # oracle and the engine count the same per-channel emission order —
+    # so key values never depend on how steps interleave across
+    # processes. Rewritten SUBMITs carry the client's submit number (the
+    # oracle keys them by the client's counter); a zero-delay client
+    # round trip is safe because every process src ranks before every
+    # client src, so the freshly inserted SUBMIT can never overtake a
+    # process message the oracle had already popped at that instant.
+    F2 = 2 * F
+    rows = jnp.arange(F2)
+    dst_b = dst.reshape(N, F2)
+    chan_b = (valid & ~is_client).reshape(N, F2)  # channel-counted rows
+    same = (dst_b[:, None, :] == dst_b[:, :, None]) & chan_b[:, None, :]
+    rank_b = jnp.sum(
+        same & (rows[None, :] < rows[:, None])[None], axis=2
+    )                                                         # [N, F2]
+    safe_dst = jnp.clip(dst, 0, N - 1)
+    kcnt = jnp.where(
+        issue,
+        next_seq,
+        st["pair_cnt"][emitter, safe_dst] + rank_b.reshape(E) + 1,
+    )
+    ksrc = src  # N + c for client-issued SUBMITs, emitter otherwise
+    pair_cnt = st["pair_cnt"].at[
+        emitter, jnp.where(valid & ~is_client, dst, N)
+    ].add(1, mode="drop")
+
     # 6. scatter into free pool slots ----------------------------------
-    # rank entries in oracle schedule order (grp, trig, slotk) so that
-    # same-instant ties break identically to the host oracle
-    perm = jnp.lexsort((slotk, trig, grp))
-    pos_sorted = jnp.cumsum(valid[perm].astype(I32))          # [E], 1-based
-    rank = jnp.zeros((E,), I32).at[perm].set(pos_sorted)
+    # (slot choice is arbitrary — ordering lives in the (ksrc, kcnt)
+    # keys)
+    rank = jnp.cumsum(valid.astype(I32))                      # [E], 1-based
     free = arrival == INF
     free_cum = jnp.cumsum(free.astype(I32))                   # [M]
     target = jnp.searchsorted(free_cum, rank, side="left")
@@ -396,7 +494,8 @@ def _lane_step(protocol, dims: EngineDims, st, ctx):
     pool_overflow = jnp.sum(valid) > jnp.sum(free)
     new_pool = {
         "arrival": arrival.at[target].set(msg_arrival, mode="drop"),
-        "seq": seq.at[target].set(st["msg_seq"] + rank - 1, mode="drop"),
+        "ksrc": pool["ksrc"].at[target].set(ksrc, mode="drop"),
+        "kcnt": pool["kcnt"].at[target].set(kcnt, mode="drop"),
         "src": pool["src"].at[target].set(src, mode="drop"),
         "dst": pool["dst"].at[target].set(dst, mode="drop"),
         "mtype": pool["mtype"].at[target].set(mtype, mode="drop"),
@@ -405,12 +504,18 @@ def _lane_step(protocol, dims: EngineDims, st, ctx):
     }
 
     # 7. termination bookkeeping ---------------------------------------
+    # under out-of-order (lookahead) execution the globally latest
+    # completion may be recorded steps before all_done flips, so carry a
+    # running max (the oracle anchors extra_sim_time at the pop time of
+    # the last final TO_CLIENT, i.e. the max arrival time)
     live = ctx["cmd_budget"] > 0
     all_done = jnp.all(~live | (completed >= ctx["cmd_budget"]))
-    last_completion = jnp.max(jnp.where(is_client, t_arr, 0))
+    max_completion = jnp.maximum(
+        st["max_completion"], jnp.max(jnp.where(is_client, t_arr, 0))
+    )
     done_time = jnp.where(
         (st["done_time"] == INF) & all_done,
-        jnp.maximum(st["now"], last_completion),
+        max_completion,
         st["done_time"],
     )
     err = st["err"] | pool_overflow | jnp.any(protocol.error(ps))
@@ -431,7 +536,8 @@ def _lane_step(protocol, dims: EngineDims, st, ctx):
             "lat_log": lat_log,
         },
         "now": T,
-        "msg_seq": st["msg_seq"] + jnp.sum(valid, dtype=I32),
+        "pair_cnt": pair_cnt,
+        "max_completion": max_completion,
         "steps": st["steps"] + 1,
         "hlog": hlog,
         "hlog_n": hlog_n,

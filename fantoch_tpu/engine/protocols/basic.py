@@ -28,9 +28,10 @@ import numpy as np
 
 from ..core import I32, emit, emit_broadcast, empty_outbox
 from ..dims import INF, EngineDims
+from .identity import DevIdentity
 
 
-class BasicDev:
+class BasicDev(DevIdentity):
     SUBMIT = 0
     MSTORE = 1
     MSTOREACK = 2

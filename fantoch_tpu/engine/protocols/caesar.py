@@ -53,6 +53,7 @@ import numpy as np
 
 from ..core import I32, compact_order, emit, emit_broadcast, empty_outbox
 from ..dims import INF, SEQ_BOUND, EngineDims, dot_slot
+from .identity import DevIdentity
 from ..iset import iset_add, iset_contains
 
 
@@ -65,7 +66,7 @@ ST_COMMIT = 5
 ST_EXECUTED = 6
 
 
-class CaesarDev:
+class CaesarDev(DevIdentity):
     SUBMIT = 0
     MPROPOSE = 1
     MPROPOSEACK = 2

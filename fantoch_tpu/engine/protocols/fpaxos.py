@@ -33,9 +33,10 @@ import numpy as np
 
 from ..core import I32, emit, emit_broadcast, empty_outbox
 from ..dims import INF, EngineDims, dot_slot
+from .identity import DevIdentity
 
 
-class FPaxosDev:
+class FPaxosDev(DevIdentity):
     SUBMIT = 0
     MFORWARD = 1
     MACCEPT = 2

@@ -68,10 +68,11 @@ import numpy as np
 
 from ..core import I32, compact_order, emit, emit_broadcast, empty_outbox
 from ..dims import INF, SEQ_BOUND, EngineDims, dot_slot
+from .identity import DevIdentity
 from ..iset import iset_add, iset_contains
 
 
-class _DepDev:
+class _DepDev(DevIdentity):
     """Shared device machinery; subclasses pick quorum formulas and the
     fast-path predicate via lane ctx."""
 

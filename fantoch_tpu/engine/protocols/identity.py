@@ -1,0 +1,20 @@
+"""Value identity for device-protocol objects.
+
+Protocol instances parameterize compiled engine runners, and sweep
+drivers cache those runners keyed on the protocol (parallel/sweep.py).
+Device protocols are pure behaviour + a handful of integer shape bounds
+set in ``__init__``, so two instances of the same class with equal
+attributes are interchangeable — give them value semantics so a driver
+constructing a fresh instance per call still hits the compile cache
+instead of pinning one executable per instance.
+"""
+
+from __future__ import annotations
+
+
+class DevIdentity:
+    def __eq__(self, other) -> bool:
+        return type(other) is type(self) and vars(other) == vars(self)
+
+    def __hash__(self) -> int:
+        return hash((type(self),) + tuple(sorted(vars(self).items())))

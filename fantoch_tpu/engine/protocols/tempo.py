@@ -40,11 +40,12 @@ import numpy as np
 
 from ..core import I32, emit, emit_broadcast, empty_outbox
 from ..dims import INF, SEQ_BOUND, EngineDims, dot_slot
+from .identity import DevIdentity
 from ..iset import iset_add, iset_add_range
 
 
 
-class TempoDev:
+class TempoDev(DevIdentity):
     SUBMIT = 0
     MCOLLECT = 1
     MCOLLECTACK = 2

@@ -74,6 +74,33 @@ def make_lane(
         for j, b in enumerate(process_regions):
             delay_pp[i, j] = planet.ping_latency(a, b) // 2
 
+    # conservative-lookahead matrix: lookahead[q, p] = minimum time any
+    # chain of messages starting at q can take to reach p (all-pairs
+    # shortest path over delay_pp; client hops never cross processes —
+    # TO_CLIENT and the rewritten SUBMIT both stay on the attached
+    # process). The engine lets p pop its earliest event at local time
+    # e_p whenever e_p < min_{q != p}(e_q + lookahead[q, p]): nothing
+    # can still arrive at or before e_p. The diagonal is INF — p's own
+    # future emissions to itself land at or after e_p and are ordered by
+    # the pool's prio/pop mechanism, so they never gate p's progress.
+    # Padded rows stay at INF.
+    lookahead = np.full((N, N), INF, np.int64)
+    sp = delay_pp[:n, :n].astype(np.int64)
+    for k in range(n):
+        sp = np.minimum(sp, sp[:, k, None] + sp[None, k, :])
+    lookahead[:n, :n] = sp
+    np.fill_diagonal(lookahead[:n, :n], INF)
+    # the strict bound plus the global-minimum escape hatch are only
+    # tie-safe when distinct processes can never exchange same-instant
+    # messages; with a zero inter-process delay (colocated process
+    # regions) fall back to serialized global-time stepping — such
+    # schedules are inherently tied, so the exact-match contract (which
+    # only covers tie-free schedules) is unaffected, only speed is
+    offdiag = delay_pp[:n, :n][~np.eye(n, dtype=bool)]
+    if n > 1 and offdiag.min() < 1:
+        lookahead[:n, :n] = 0
+        np.fill_diagonal(lookahead[:n, :n], INF)
+
     sorted_idx = _sorted_indices(planet, process_regions)
 
     # clients: clients_per_region per region, attached to the closest
@@ -126,6 +153,7 @@ def make_lane(
         "n": np.int32(n),
         "f": np.int32(config.f),
         "delay_pp": delay_pp,
+        "lookahead": np.minimum(lookahead, INF).astype(np.int32),
         "client_delay": client_delay,
         "client_attach": client_attach,
         "client_region_row": client_region_row,

@@ -10,6 +10,7 @@ duplicate configs whose results are dropped.
 
 from __future__ import annotations
 
+import functools
 import itertools
 from typing import List, Optional, Sequence
 
@@ -26,7 +27,12 @@ from ..engine import (
     collect_results,
     make_lane,
 )
-from ..engine.core import build_runner, init_lane_state
+from ..engine.core import (
+    KEYGEN_CTX_FIELDS,
+    build_runner,
+    first_keys_fn,
+    init_lane_state,
+)
 from ..engine.spec import stack_lanes
 
 
@@ -70,6 +76,21 @@ def make_sweep_specs(
     return specs
 
 
+@functools.lru_cache(maxsize=None)
+def _cached_first_keys(C: int):
+    return jax.jit(jax.vmap(first_keys_fn(C)))
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_runner(protocol, dims: EngineDims, max_steps: int):
+    """One compiled runner per (protocol value, dims, max_steps):
+    ``build_runner`` returns a fresh ``jax.jit`` closure, so without the
+    cache every ``run_sweep`` call would retrace and recompile. Device
+    protocols have value identity (protocols/identity.py), so fresh
+    instances with equal shape bounds share one compiled runner."""
+    return build_runner(protocol, dims, max_steps)
+
+
 def run_sweep(
     protocol,
     dims: EngineDims,
@@ -86,13 +107,21 @@ def run_sweep(
     padded = list(specs) + [specs[-1]] * pad
 
     ctx = stack_lanes(padded)
-    states = [init_lane_state(protocol, dims, s.ctx) for s in padded]
+    # one batched device call for every lane's first client keys (the
+    # per-lane fallback inside init_lane_state would dispatch one tiny
+    # device computation per lane)
+    kctx = {k: ctx[k] for k in KEYGEN_CTX_FIELDS}
+    first_keys = np.asarray(_cached_first_keys(dims.C)(kctx))
+    states = [
+        init_lane_state(protocol, dims, s.ctx, first_keys=first_keys[i])
+        for i, s in enumerate(padded)
+    ]
     state = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *states)
 
     sharding = NamedSharding(mesh, PartitionSpec("sweep"))
     put = lambda tree: jax.tree_util.tree_map(
         lambda a: jax.device_put(a, sharding), tree
     )
-    runner = build_runner(protocol, dims, max_steps)
+    runner = _cached_runner(protocol, dims, max_steps)
     final = runner(put(state), put(ctx))
     return collect_results(protocol, dims, final, padded)[: len(specs)]

@@ -30,7 +30,7 @@ from ..core.planet import Planet
 from ..core.util import closest_process_per_shard, sort_processes_by_distance
 from ..executor.base import Executor
 from ..protocol.base import Protocol, ToForward, ToSend
-from .schedule import Schedule
+from .schedule import KIND_MESSAGE, Schedule
 from .simulation import Simulation
 
 # schedule action kinds
@@ -39,6 +39,10 @@ _SEND = 1
 _TO_CLIENT = 2
 _PERIODIC = 3
 _EXECUTED_NOTIFICATION = 4
+
+# client src keys rank after every process src key in same-instant
+# message tie-breaks (the engine encodes clients as N + client)
+_CLIENT_SRC_OFFSET = 1 << 20
 
 
 class Runner:
@@ -64,6 +68,9 @@ class Runner:
         self.make_distances_symmetric = False
         self.reorder_messages = False
         self.rng = random.Random(seed)
+        # per-(src, dst) channel emission counters for the schedule
+        # tie-break key
+        self._chan_seq: Dict[Tuple[int, int], int] = {}
 
         # single shard in the simulator (runner.rs:84-85)
         shard_id: ShardId = 0
@@ -287,7 +294,27 @@ class Runner:
         distance = self._distance(from_, to)
         if self.reorder_messages:
             distance = int(distance * self.rng.uniform(0.0, 10.0))
-        self.schedule.schedule(self.simulation.time, distance, action)
+        # tie-break key: (message, src, emission index on the (src, dst)
+        # channel), src-major — the same total order the device engine
+        # computes without a global heap. The counter is per channel so
+        # its values are only ever compared between messages both sides
+        # enumerate in the same order (FIFO per channel), whatever the
+        # global interleaving of handler invocations looks like.
+        src_key = self._region_key(from_region)
+        chan = (src_key, self._region_key(to_region))
+        chan_seq = self._chan_seq.get(chan, 0) + 1
+        self._chan_seq[chan] = chan_seq
+        self.schedule.schedule(
+            self.simulation.time,
+            distance,
+            action,
+            key=(KIND_MESSAGE, src_key, chan_seq),
+        )
+
+    @staticmethod
+    def _region_key(message_region) -> int:
+        kind, ident = message_region
+        return ident if kind == "process" else _CLIENT_SRC_OFFSET + ident
 
     def _schedule_periodic(self, process_id, event, delay) -> None:
         self.schedule.schedule(

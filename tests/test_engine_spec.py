@@ -14,6 +14,7 @@ from fantoch_tpu.client.key_gen import zipf_weights
 from fantoch_tpu.core import Config, Planet
 from fantoch_tpu.engine import EngineDims, make_lane, run_lanes
 from fantoch_tpu.engine.core import gen_key, init_lane_state
+from fantoch_tpu.engine.dims import INF
 from fantoch_tpu.engine.protocols import TempoDev
 
 
@@ -55,7 +56,9 @@ def test_make_lane_pool_ctx_feeds_init_lane_state():
     assert spec.ctx["key_gen_kind"] == 0
     assert spec.ctx["zipf_cum"].shape == (1,)
     st = init_lane_state(tempo, dims, spec.ctx)  # round-1 KeyError site
-    assert int(st["msg_seq"]) == dims.C  # one SUBMIT per live client
+    # one SUBMIT per live client, keyed (emission #1, client src)
+    live = (st["pool"]["arrival"] < INF).sum()
+    assert int(live) == dims.C
 
 
 def test_make_lane_zipf_ctx():
@@ -65,7 +68,8 @@ def test_make_lane_zipf_ctx():
     assert spec.ctx["zipf_cum"].shape == (total_keys,)
     assert spec.ctx["zipf_cum"][-1] == pytest.approx(1.0)
     st = init_lane_state(tempo, dims, spec.ctx)
-    assert int(st["msg_seq"]) == dims.C
+    live = (st["pool"]["arrival"] < INF).sum()
+    assert int(live) == dims.C
 
 
 def test_device_zipf_matches_weight_table():
