@@ -1,0 +1,358 @@
+"""Command-line surface — the analog of the reference's binaries.
+
+The reference ships one clap-based binary per protocol plus ``client``,
+``simulation`` and search/plot tools (fantoch_ps/src/bin/common/
+protocol.rs:122-360 defines the flag surface; bin/simulation.rs:48-62
+the sweep grid). Here one entry point covers the same ground:
+
+  python -m fantoch_tpu sim    --protocol tempo --n 3 --f 1 ...
+  python -m fantoch_tpu sweep  --protocol tempo --n 5 --fs 1,2 ...
+  python -m fantoch_tpu bote   --n 5 --metric f1 ...
+  python -m fantoch_tpu plot   --results sweep.jsonl --kind cdf ...
+
+``sim`` drives the host oracle DES (one config, exact); ``sweep`` runs
+a batched device-engine sweep and can persist results + render plots;
+``bote`` runs the closed-form latency search; ``plot`` re-renders saved
+results.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+from .core.config import Config
+from .core.planet import Planet
+
+ENGINE_PROTOCOLS = ("basic", "fpaxos", "tempo", "atlas", "epaxos", "caesar")
+
+
+def _ints(s: str) -> List[int]:
+    return [int(x) for x in s.split(",") if x != ""]
+
+
+def _build_config(name: str, n: int, f: int, args) -> Config:
+    kw = dict(n=n, f=f, gc_interval_ms=args.gc_interval)
+    if name == "tempo":
+        kw["tempo_detached_send_interval_ms"] = args.detached_interval
+        if args.clock_bump_interval:
+            kw["tempo_clock_bump_interval_ms"] = args.clock_bump_interval
+    if name == "caesar":
+        kw["caesar_wait_condition"] = not args.no_wait_condition
+    if name == "fpaxos":
+        kw["leader"] = 1
+    return Config(**kw)
+
+
+def _engine_protocol(name: str, clients: int):
+    from .engine.protocols import (
+        AtlasDev,
+        BasicDev,
+        CaesarDev,
+        EPaxosDev,
+        FPaxosDev,
+        TempoDev,
+    )
+
+    if name == "tempo":
+        return TempoDev.for_load(keys=1 + clients, clients=clients)
+    if name == "basic":
+        return BasicDev
+    if name == "fpaxos":
+        return FPaxosDev
+    if name == "atlas":
+        return AtlasDev(keys=1 + clients)
+    if name == "epaxos":
+        return EPaxosDev(keys=1 + clients)
+    if name == "caesar":
+        return CaesarDev(keys=1 + clients)
+    raise SystemExit(f"unknown protocol {name!r}")
+
+
+def _oracle_protocol(name: str):
+    from . import protocol as p
+
+    return {
+        "basic": p.Basic,
+        "fpaxos": p.FPaxos,
+        "tempo": p.Tempo,
+        "atlas": p.Atlas,
+        "epaxos": p.EPaxos,
+        "caesar": p.Caesar,
+    }[name]
+
+
+def _add_common(sp, sweep: bool):
+    sp.add_argument("--protocol", required=True, choices=ENGINE_PROTOCOLS)
+    sp.add_argument("--n", type=int, default=3)
+    sp.add_argument(
+        "--regions",
+        type=lambda s: s.split(","),
+        default=None,
+        help="comma-separated region names (default: first n of planet)",
+    )
+    sp.add_argument("--aws", action="store_true",
+                    help="use the AWS planet instead of GCP")
+    sp.add_argument("--commands", type=int, default=100,
+                    help="commands per client")
+    sp.add_argument("--clients-per-region", type=int, default=1)
+    sp.add_argument("--conflict", type=int, default=100 if not sweep else None)
+    sp.add_argument("--pool-size", type=int, default=1,
+                    help="ConflictPool shared-key pool size")
+    sp.add_argument("--zipf", default=None,
+                    help="coef,keys — Zipf key generator instead of pool")
+    sp.add_argument("--gc-interval", type=int, default=100)
+    sp.add_argument("--detached-interval", type=int, default=100)
+    sp.add_argument("--clock-bump-interval", type=int, default=None)
+    sp.add_argument("--no-wait-condition", action="store_true")
+    sp.add_argument("--extra-time", type=int, default=1000)
+    sp.add_argument("--seed", type=int, default=0)
+
+
+def _planet(args) -> Planet:
+    if getattr(args, "aws", False):
+        return Planet.from_dataset("latency_aws_2021_02_13")
+    return Planet.new()
+
+
+def cmd_sim(args) -> None:
+    from .client import ConflictPool, Workload, Zipf
+    from .sim import Runner
+
+    planet = _planet(args)
+    regions = args.regions or planet.regions()[: args.n]
+    config = _build_config(args.protocol, args.n, args.f, args)
+    if args.zipf:
+        coef, keys = args.zipf.split(",")
+        key_gen = Zipf(coefficient=float(coef), total_keys_per_shard=int(keys))
+    else:
+        key_gen = ConflictPool(
+            conflict_rate=args.conflict, pool_size=args.pool_size
+        )
+    workload = Workload(
+        shard_count=1,
+        key_gen=key_gen,
+        keys_per_command=1,
+        commands_per_client=args.commands,
+        payload_size=0,
+    )
+    runner = Runner(
+        _oracle_protocol(args.protocol),
+        planet,
+        config,
+        workload,
+        args.clients_per_region,
+        list(regions),
+        list(regions),
+        seed=args.seed,
+    )
+    if args.reorder:
+        runner.reorder_messages = True
+    metrics, _, latencies = runner.run(extra_sim_time_ms=args.extra_time)
+    out = {"protocol": args.protocol, "n": args.n, "f": args.f,
+           "conflict": args.conflict, "regions": {}}
+    for region, (issued, hist) in latencies.items():
+        out["regions"][region] = {
+            "issued": issued,
+            "mean_ms": hist.mean(),
+            "p95_ms": hist.percentile(0.95),
+            "p99_ms": hist.percentile(0.99),
+        }
+    from .protocol.base import ProtocolMetricsKind
+
+    fast = slow = 0
+    for pm, _em in metrics.values():
+        fast += pm.get_aggregated(ProtocolMetricsKind.FAST_PATH) or 0
+        slow += pm.get_aggregated(ProtocolMetricsKind.SLOW_PATH) or 0
+    out["fast_path"], out["slow_path"] = fast, slow
+    print(json.dumps(out, indent=2))
+
+
+def cmd_sweep(args) -> None:
+    import itertools
+
+    from .engine import EngineDims
+    from .parallel.sweep import make_sweep_specs, run_sweep
+
+    planet = _planet(args)
+    all_regions = planet.regions()
+    if args.regions:
+        region_sets = [args.regions]
+    else:
+        region_sets = [
+            [all_regions[i] for i in combo]
+            for combo in itertools.islice(
+                itertools.combinations(range(len(all_regions)), args.n),
+                args.subsets,
+            )
+        ]
+    clients = args.n * args.clients_per_region
+    dev = _engine_protocol(args.protocol, clients)
+    total = args.commands * clients
+    dims = EngineDims.for_protocol(
+        dev,
+        n=args.n,
+        clients=clients,
+        payload=dev.payload_width(args.n),
+        total_commands=None if args.dot_slots else total,
+        dot_slots=args.dot_slots or total + 1,
+        regions=args.n,
+    )
+    fs = args.fs or [1]
+    conflicts = (
+        [args.conflict] if args.conflict is not None else args.conflicts
+    )
+    base = _build_config(args.protocol, args.n, fs[0], args)
+    specs = make_sweep_specs(
+        dev,
+        planet,
+        region_sets=region_sets,
+        fs=fs,
+        conflicts=conflicts,
+        commands_per_client=args.commands,
+        clients_per_region=args.clients_per_region,
+        dims=dims,
+        config_base=base,
+        extra_time_ms=args.extra_time,
+        zipf=(
+            tuple(
+                f(x) for f, x in zip((float, int), args.zipf.split(","))
+            )
+            if args.zipf
+            else None
+        ),
+        pool_size=args.pool_size,
+    )
+    results = run_sweep(dev, dims, specs)
+    errs = sum(1 for r in results if r.err)
+    summary = {
+        "protocol": args.protocol,
+        "points": len(specs),
+        "errors": errs,
+        "error_causes": sorted(
+            {r.err_cause for r in results if r.err}
+        ),
+        "stalled_lanes": sum(1 for r in results if r.requeues),
+    }
+    if args.out:
+        from .plot import save_results
+
+        rows = []
+        for spec, res in zip(specs, results):
+            rows.append(
+                (
+                    {
+                        "protocol": args.protocol,
+                        "n": spec.config.n,
+                        "f": spec.config.f,
+                        "conflict": int(spec.ctx["conflict_rate"]),
+                        "regions": spec.process_regions,
+                    },
+                    res,
+                )
+            )
+        save_results(args.out, rows)
+        summary["out"] = args.out
+    print(json.dumps(summary))
+
+
+def cmd_bote(args) -> None:
+    from .bote.search import RankingParams, Search
+
+    search = Search(planet=_planet(args))
+    params = RankingParams(
+        min_mean_fpaxos_improv=args.min_mean_improv,
+        min_fairness_fpaxos_improv=args.min_fairness_improv,
+        min_n=args.min_n,
+        max_n=args.max_n,
+        ft_metric=args.metric,
+    )
+    ranked = search.rank(params)
+    out = {}
+    for n, configs in sorted(ranked.items()):
+        out[n] = [
+            {"regions": list(c.config), "score": float(c.score)}
+            for c in configs[: args.top]
+        ]
+    print(json.dumps(out, indent=2))
+
+
+def cmd_plot(args) -> None:
+    from .plot import (
+        cdf_plot,
+        latency_bar_plot,
+        load_results,
+    )
+
+    match = {}
+    for kv in args.match or []:
+        k, v = kv.split("=", 1)
+        match[k] = int(v) if v.isdigit() else v
+    rows = load_results(args.results, match or None)
+    if not rows:
+        raise SystemExit("no results match")
+    series = {}
+    for attrs, res in rows[: args.max_series]:
+        label = (
+            f"{attrs.get('protocol')} n={attrs.get('n')} "
+            f"f={attrs.get('f')} c={attrs.get('conflict')}"
+        )
+        if label in series:  # distinct region sets share the key attrs
+            label = f"{label} [{len(series)}]"
+        series[label] = res
+    if args.kind == "cdf":
+        cdf_plot(series, args.out, title=args.title)
+    else:
+        regions = rows[0][1].region_rows
+        latency_bar_plot(series, regions, args.out, title=args.title)
+    print(json.dumps({"plotted": len(series), "out": args.out}))
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(prog="fantoch_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    sim = sub.add_parser("sim", help="one oracle DES run (exact)")
+    _add_common(sim, sweep=False)
+    sim.add_argument("--f", type=int, default=1)
+    sim.add_argument("--reorder", action="store_true")
+    sim.set_defaults(fn=cmd_sim)
+
+    sw = sub.add_parser("sweep", help="batched device-engine sweep")
+    _add_common(sw, sweep=True)
+    sw.add_argument("--fs", type=_ints, default=None)
+    sw.add_argument("--conflicts", type=_ints, default=[0, 10, 50, 100])
+    sw.add_argument("--subsets", type=int, default=16,
+                    help="number of n-region subsets when --regions unset")
+    sw.add_argument("--dot-slots", type=int, default=None)
+    sw.add_argument("--out", default=None, help="results JSONL path")
+    sw.set_defaults(fn=cmd_sweep)
+
+    bt = sub.add_parser("bote", help="closed-form latency config search")
+    bt.add_argument("--metric", default="f1", choices=["f1", "f1f2"])
+    bt.add_argument("--min-mean-improv", type=float, default=0.0)
+    bt.add_argument("--min-fairness-improv", type=float, default=0.0)
+    bt.add_argument("--min-n", type=int, default=3)
+    bt.add_argument("--max-n", type=int, default=7)
+    bt.add_argument("--top", type=int, default=3)
+    bt.add_argument("--aws", action="store_true")
+    bt.set_defaults(fn=cmd_bote)
+
+    pl = sub.add_parser("plot", help="render saved sweep results")
+    pl.add_argument("--results", required=True)
+    pl.add_argument("--kind", default="bars", choices=["bars", "cdf"])
+    pl.add_argument("--match", nargs="*", default=None,
+                    help="attr=value filters (ResultsDB::search)")
+    pl.add_argument("--out", required=True)
+    pl.add_argument("--title", default=None)
+    pl.add_argument("--max-series", type=int, default=8)
+    pl.set_defaults(fn=cmd_plot)
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

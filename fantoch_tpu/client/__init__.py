@@ -1,5 +1,12 @@
 """Client/workload layer (reference: ``fantoch/src/client/``)."""
 
 from .client import Client, ClientData, Pending
-from .key_gen import CONFLICT_COLOR, ConflictPool, KeyGen, KeyGenState, Zipf
+from .key_gen import (
+    CONFLICT_COLOR,
+    ConflictPool,
+    DeviceStream,
+    KeyGen,
+    KeyGenState,
+    Zipf,
+)
 from .workload import Workload

@@ -46,7 +46,28 @@ class Zipf:
         )
 
 
-KeyGen = Union[ConflictPool, Zipf]
+@dataclass(frozen=True)
+class DeviceStream:
+    """Replays the device engine's counter-based threefry key stream
+    host-side (engine/core.py ``gen_key``), so the oracle DES and the
+    device engine run the *same* workload at any conflict rate — the
+    round-1 diff tests were pinned to conflict ∈ {0, 100} because the
+    two sides drew from different PRNGs. Keys are the device's integer
+    keys stringified: pool keys ``0..pool_size-1`` (or Zipf ranks),
+    private key ``pool_size + client_index``."""
+
+    conflict_rate: int = 100
+    pool_size: int = 1
+    zipf: Optional[tuple] = None  # (coefficient, total_keys)
+    seed: int = 0
+
+    def __str__(self) -> str:
+        if self.zipf:
+            return f"devstream_zipf_{self.zipf[0]:.2f}_{self.zipf[1]}"
+        return f"devstream_{self.conflict_rate}_{self.pool_size}"
+
+
+KeyGen = Union[ConflictPool, Zipf, DeviceStream]
 
 
 def zipf_weights(key_count: int, coefficient: float) -> np.ndarray:
@@ -60,6 +81,8 @@ def zipf_weights(key_count: int, coefficient: float) -> np.ndarray:
 class KeyGenState:
     """Per-client generator state (key_gen.rs:54-120)."""
 
+    _BATCH = 512  # device-stream keys computed per jax call
+
     def __init__(self, key_gen: KeyGen, shard_count: int, client_id: ClientId,
                  rng: Optional[random.Random] = None):
         self.key_gen = key_gen
@@ -72,9 +95,12 @@ class KeyGenState:
             )
         else:
             self._zipf_cum = None
+        self._stream: list = []  # DeviceStream key cache
 
     def gen_cmd_key(self) -> Key:
         kg = self.key_gen
+        if isinstance(kg, DeviceStream):
+            return self._device_stream_key(kg)
         if isinstance(kg, ConflictPool):
             if true_if_random_is_less_than(kg.conflict_rate, self.rng):
                 return f"{CONFLICT_COLOR}{self.rng.randrange(kg.pool_size)}"
@@ -83,6 +109,52 @@ class KeyGenState:
         u = self.rng.random()
         rank = int(np.searchsorted(self._zipf_cum, u, side="right")) + 1
         return str(rank)
+
+    def _device_stream_key(self, kg: DeviceStream) -> Key:
+        """Next key of the device's (client, seq)-counter stream; seqs
+        are 1-based like the engine's SUBMIT payloads. Computed in
+        batches (one vmapped call per _BATCH keys); the keygen ctx is a
+        pure function of the frozen generator, built once."""
+        self._cmds_issued = getattr(self, "_cmds_issued", 0) + 1
+        while len(self._stream) < self._cmds_issued:
+            import jax
+            import jax.numpy as jnp
+            import jax.random as jr
+
+            from ..engine.core import gen_key
+
+            ctx = getattr(self, "_stream_ctx", None)
+            if ctx is None:
+                if kg.zipf is None:
+                    ctx = {
+                        "key_gen_kind": jnp.int32(0),
+                        "zipf_cum": jnp.ones((1,), jnp.float32),
+                    }
+                else:
+                    coefficient, total_keys = kg.zipf
+                    ctx = {
+                        "key_gen_kind": jnp.int32(1),
+                        "zipf_cum": jnp.asarray(
+                            np.cumsum(
+                                zipf_weights(total_keys, coefficient)
+                            ),
+                            jnp.float32,
+                        ),
+                    }
+                ctx.update(
+                    rng_key=jr.PRNGKey(kg.seed),
+                    conflict_rate=jnp.int32(kg.conflict_rate),
+                    pool_size=jnp.int32(kg.pool_size),
+                )
+                self._stream_ctx = ctx
+            lo = len(self._stream) + 1
+            seqs = jnp.arange(lo, lo + self._BATCH, dtype=jnp.int32)
+            client_index = self.client_id - 1
+            batch = np.asarray(
+                jax.vmap(lambda s: gen_key(ctx, client_index, s))(seqs)
+            )
+            self._stream.extend(int(k) for k in batch)
+        return str(self._stream[self._cmds_issued - 1])
 
 
 def true_if_random_is_less_than(

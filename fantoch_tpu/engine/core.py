@@ -52,7 +52,14 @@ import jax.numpy as jnp
 import jax.random as jr
 import numpy as np
 
-from .dims import INF, EngineDims
+from .dims import (
+    ERR_POOL,
+    ERR_STUCK,
+    ERR_TRUNCATED,
+    INF,
+    REQUEUE_LIMIT,
+    EngineDims,
+)
 
 I32 = jnp.int32
 
@@ -80,11 +87,20 @@ def empty_outbox(dims: EngineDims, slots: int | None = None) -> Dict[str, Any]:
         "dst": jnp.zeros((f,), I32),
         "mtype": jnp.zeros((f,), I32),
         "payload": jnp.zeros((f, dims.P), I32),
+        # -1 = engine-assigned WAN delay; >= 0 overrides it (requeues)
+        "delay": jnp.full((f,), -1, I32),
+        # -1 = the emitting process; >= 0 preserves an original sender
+        "src": jnp.full((f,), -1, I32),
     }
 
 
-def emit(outbox, i, dst, mtype, payload, valid=True):
-    """Write one message into outbox slot ``i`` (functional)."""
+def emit(outbox, i, dst, mtype, payload, valid=True, delay=-1, src=-1):
+    """Write one message into outbox slot ``i`` (functional).
+
+    ``delay >= 0`` overrides the engine's WAN delay and ``src >= 0``
+    overrides the recorded sender — used by the engine's readiness-gate
+    requeue row (see ``_lane_step`` step 4), not by protocol
+    handlers."""
     pay = jnp.zeros((outbox["payload"].shape[1],), I32)
     payload = jnp.asarray(payload, I32)
     pay = jax.lax.dynamic_update_slice(pay, payload.reshape(-1), (0,))
@@ -93,6 +109,8 @@ def emit(outbox, i, dst, mtype, payload, valid=True):
         "dst": outbox["dst"].at[i].set(jnp.asarray(dst, I32)),
         "mtype": outbox["mtype"].at[i].set(jnp.asarray(mtype, I32)),
         "payload": outbox["payload"].at[i].set(pay),
+        "delay": outbox["delay"].at[i].set(jnp.asarray(delay, I32)),
+        "src": outbox["src"].at[i].set(jnp.asarray(src, I32)),
     }
 
 
@@ -128,6 +146,8 @@ def emit_broadcast(outbox, mtype, payload, n, me=None, exclude_me=False):
         "dst": procs,
         "mtype": jnp.full((nmax,), mtype, I32),
         "payload": pay,
+        "delay": jnp.full((nmax,), -1, I32),
+        "src": jnp.full((nmax,), -1, I32),
     }
 
 
@@ -209,6 +229,8 @@ def init_lane_state(
         "dst": np.zeros((M,), np.int32),
         "mtype": np.zeros((M,), np.int32),
         "payload": np.zeros((M, P), np.int32),
+        # readiness-gate bounce count (ERR_STUCK past REQUEUE_LIMIT)
+        "rq": np.zeros((M,), np.int32),
         # self-messages are delivered inline by the oracle (recursive
         # ToForward/self-target handling, runner.rs:455-471): they beat
         # any other message pending at the same instant
@@ -270,9 +292,16 @@ def init_lane_state(
         # SUBMITs use the client's own submit number instead)
         "pair_cnt": np.zeros((N, N), np.int32),
         "steps": np.int32(0),
+        "pool_peak": np.int32(int(live.sum())),
+        # total readiness-gate bounces: > 0 in a FIFO (non-reorder) lane
+        # means an undersized dot window stalled deliveries and latency
+        # results deviate from the unbounded-buffer reference — loud in
+        # LaneResults without failing the lane (backpressure is still
+        # correct, just slower)
+        "requeues": np.int32(0),
         "max_completion": np.int32(0),
         "done_time": np.int32(INF),
-        "err": np.zeros((), bool),
+        "err": np.zeros((), np.int32),  # error bitmask (dims.ERR_*)
         "hlog": np.full((N, max(DEBUG_LOG, 1), 6), -1, np.int32),
         "hlog_n": np.zeros((N,), np.int32),
     }
@@ -282,7 +311,7 @@ def init_lane_state(
 # the step function
 # ----------------------------------------------------------------------
 
-def _lane_step(protocol, dims: EngineDims, st, ctx):
+def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False):
     N, C, M, F, R, P = dims.N, dims.C, dims.M, dims.F, dims.R, dims.P
     pool = st["pool"]
     arrival = pool["arrival"]
@@ -353,6 +382,27 @@ def _lane_step(protocol, dims: EngineDims, st, ctx):
     }
     arrival = arrival.at[jnp.where(has, slot, M)].set(INF, mode="drop")
 
+    # readiness gate: a message that overtook its prerequisite (possible
+    # only under reordering — FIFO channels deliver prerequisites first)
+    # is requeued to arrive 1 ms later instead of reaching its handler,
+    # the fixed-shape analog of the reference's buffered-commit stores
+    # (tempo.rs buffered mcommits, executor/slot.rs:17-69)
+    if hasattr(protocol, "ready"):
+        rdy = jax.vmap(
+            lambda p, m, me_: protocol.ready(p, m, me_, ctx, dims)
+        )(st["ps"], msg, procs)
+        rdy = jnp.asarray(rdy, bool)
+    else:
+        rdy = jnp.ones((N,), bool)
+    requeued = has & ~rdy
+    rq_next = jnp.where(requeued, pool["rq"][slot] + 1, 0)  # [N]
+    stuck = jnp.any(rq_next > REQUEUE_LIMIT)
+    msg = dict(
+        msg,
+        valid=has & rdy,
+        mtype=jnp.where(has & rdy, msg["mtype"], protocol.NUM_TYPES),
+    )
+
     # 3. handlers (each at its process's own local time) ----------------
     def periodic_one(ps_slice, f, me, t):
         return protocol.periodic(ps_slice, f, me, t, ctx, dims)
@@ -389,23 +439,53 @@ def _lane_step(protocol, dims: EngineDims, st, ctx):
     # 4. flatten emissions, keeping each process's rows contiguous with
     # its periodic emissions first (the oracle pops periodic events
     # before same-instant messages, so their emissions count first on
-    # each channel)
+    # each channel); one engine row per process re-emits a message the
+    # readiness gate bounced
+    rq = {
+        "valid": requeued[:, None],
+        "dst": procs[:, None],
+        "mtype": jnp.where(requeued, pool["mtype"][slot], 0)[:, None],
+        "payload": pool["payload"][slot][:, None, :],
+        "delay": jnp.ones((N, 1), I32),
+        "src": pool["src"][slot][:, None],
+    }
+    F2 = 2 * F + 1
     out = jax.tree_util.tree_map(
-        lambda a, b: jnp.concatenate([a, b], axis=1).reshape(
-            (2 * N * F,) + a.shape[2:]
+        lambda a, b, r: jnp.concatenate([a, b, r], axis=1).reshape(
+            (N * F2,) + a.shape[2:]
         ),
         pout,
         outbox,
+        rq,
     )
-    emitter = jnp.repeat(procs, 2 * F)
-    E = 2 * N * F
+    emitter = jnp.repeat(procs, F2)
+    E = N * F2
     valid, dst = out["valid"], out["dst"]
 
     # 5. client rewrite: TO_CLIENT → latency record + next SUBMIT -------
+    # reorder perturbation (runner.rs:520-524): every hop's delay scales
+    # by an independent uniform [0, 10) draw; the three hop kinds in
+    # this stage (TO_CLIENT return, next SUBMIT, process send) each use
+    # their own slice of the per-step draw block. ``reorder`` is a
+    # trace-time flag so normal sweeps compile without any RNG work.
+    if reorder:
+        u = jr.uniform(
+            jr.fold_in(ctx["reorder_key"], st["steps"]), (3, E),
+            maxval=10.0,
+        )
+
+        def scaled(d, row):
+            return (d * u[row]).astype(I32)
+
+    else:
+
+        def scaled(d, row):
+            return d
+
     ep_e = ep[emitter]  # each emission leaves at its emitter's local time
     is_client = valid & (dst >= N)
     c = jnp.where(is_client, dst - N, 0)
-    d_back = ctx["client_delay"][c, emitter]
+    d_back = scaled(ctx["client_delay"][c, emitter], 0)
     t_arr = ep_e + d_back
     latency = t_arr - st["clients"]["start_time"][c]
 
@@ -443,15 +523,18 @@ def _lane_step(protocol, dims: EngineDims, st, ctx):
     mtype = jnp.where(issue, protocol.SUBMIT, out["mtype"])
     payload = jnp.where(issue[:, None], sub_payload, out["payload"])
     src = jnp.where(is_client, N + c, emitter)
+    src = jnp.where(out["src"] >= 0, out["src"], src)
     base = jnp.where(issue, t_arr, ep_e)
+    overridden = out["delay"] >= 0  # requeues: fixed delay, never scaled
     delay = jnp.where(
         issue,
-        ctx["client_delay"][c, ctx["client_attach"][c]],
-        ctx["delay_pp"][emitter, jnp.clip(dst, 0, N - 1)],
+        scaled(ctx["client_delay"][c, ctx["client_attach"][c]], 1),
+        scaled(ctx["delay_pp"][emitter, jnp.clip(dst, 0, N - 1)], 2),
     )
+    delay = jnp.where(overridden, out["delay"], delay)
     valid = valid & (~is_client | issue)
     msg_arrival = base + delay
-    prio = ~is_client & (dst == emitter)
+    prio = ~is_client & (dst == emitter) & ~overridden
 
     # sequence keys: the schedule-independent tie-break total order
     # (ksrc, kcnt) with kcnt counting emissions per (src, dst) channel.
@@ -464,23 +547,36 @@ def _lane_step(protocol, dims: EngineDims, st, ctx):
     # round trip is safe because every process src ranks before every
     # client src, so the freshly inserted SUBMIT can never overtake a
     # process message the oracle had already popped at that instant.
-    F2 = 2 * F
     rows = jnp.arange(F2)
+    # requeue rows re-enter the pool with their ORIGINAL (ksrc, kcnt)
+    # key — they are deliveries deferred, not new emissions — so they
+    # keep their place in the per-channel FIFO order and never consume
+    # channel counter values
+    is_rq = jnp.zeros((N, F2), bool).at[:, F2 - 1].set(True).reshape(E)
     dst_b = dst.reshape(N, F2)
-    chan_b = (valid & ~is_client).reshape(N, F2)  # channel-counted rows
+    chan_b = (
+        (valid & ~is_client & ~is_rq).reshape(N, F2)
+    )  # channel-counted rows
     same = (dst_b[:, None, :] == dst_b[:, :, None]) & chan_b[:, None, :]
     rank_b = jnp.sum(
         same & (rows[None, :] < rows[:, None])[None], axis=2
     )                                                         # [N, F2]
     safe_dst = jnp.clip(dst, 0, N - 1)
+    orig_kcnt = (
+        jnp.zeros((N, F2), I32)
+        .at[:, F2 - 1]
+        .set(pool["kcnt"][slot])
+        .reshape(E)
+    )
     kcnt = jnp.where(
         issue,
         next_seq,
         st["pair_cnt"][emitter, safe_dst] + rank_b.reshape(E) + 1,
     )
+    kcnt = jnp.where(is_rq, orig_kcnt, kcnt)
     ksrc = src  # N + c for client-issued SUBMITs, emitter otherwise
     pair_cnt = st["pair_cnt"].at[
-        emitter, jnp.where(valid & ~is_client, dst, N)
+        emitter, jnp.where(valid & ~is_client & ~is_rq, dst, N)
     ].add(1, mode="drop")
 
     # 6. scatter into free pool slots ----------------------------------
@@ -491,7 +587,13 @@ def _lane_step(protocol, dims: EngineDims, st, ctx):
     free_cum = jnp.cumsum(free.astype(I32))                   # [M]
     target = jnp.searchsorted(free_cum, rank, side="left")
     target = jnp.where(valid, target, M)
-    pool_overflow = jnp.sum(valid) > jnp.sum(free)
+    n_free = jnp.sum(free)
+    pool_overflow = jnp.sum(valid) > n_free
+    rq_arr = jnp.zeros((N, F2), I32).at[:, F2 - 1].set(rq_next).reshape(E)
+    # diagnostic: peak pool occupancy, for sizing EngineDims.M
+    pool_peak = jnp.maximum(
+        st["pool_peak"], M - n_free + jnp.sum(valid, dtype=I32)
+    )
     new_pool = {
         "arrival": arrival.at[target].set(msg_arrival, mode="drop"),
         "ksrc": pool["ksrc"].at[target].set(ksrc, mode="drop"),
@@ -500,6 +602,7 @@ def _lane_step(protocol, dims: EngineDims, st, ctx):
         "dst": pool["dst"].at[target].set(dst, mode="drop"),
         "mtype": pool["mtype"].at[target].set(mtype, mode="drop"),
         "payload": pool["payload"].at[target].set(payload, mode="drop"),
+        "rq": pool["rq"].at[target].set(rq_arr, mode="drop"),
         "prio": pool["prio"].at[target].set(prio, mode="drop"),
     }
 
@@ -518,7 +621,12 @@ def _lane_step(protocol, dims: EngineDims, st, ctx):
         max_completion,
         st["done_time"],
     )
-    err = st["err"] | pool_overflow | jnp.any(protocol.error(ps))
+    err = (
+        st["err"]
+        | ERR_POOL * pool_overflow
+        | ERR_STUCK * stuck
+        | jnp.bitwise_or.reduce(jnp.asarray(protocol.error(ps), I32))
+    )
 
     return {
         "pool": new_pool,
@@ -537,6 +645,8 @@ def _lane_step(protocol, dims: EngineDims, st, ctx):
         },
         "now": T,
         "pair_cnt": pair_cnt,
+        "pool_peak": pool_peak,
+        "requeues": st["requeues"] + jnp.sum(requeued, dtype=I32),
         "max_completion": max_completion,
         "steps": st["steps"] + 1,
         "hlog": hlog,
@@ -552,22 +662,72 @@ def _lane_running(dims, st, ctx, max_steps):
     )
     finished = (st["done_time"] < INF) & (st["now"] >= end)
     idle = st["now"] >= INF  # nothing scheduled at all
-    return ~(finished | idle | st["err"]) & (st["steps"] < max_steps)
+    return (
+        ~(finished | idle | (st["err"] != 0)) & (st["steps"] < max_steps)
+    )
 
 
-def build_runner(protocol, dims: EngineDims, max_steps: int = 1 << 22):
+def build_runner(
+    protocol, dims: EngineDims, max_steps: int = 1 << 22,
+    reorder: bool = False,
+):
     """Compile the batched sweep runner: (batched state, batched ctx) →
     final batched state. vmap supplies the config-batch axis; the sweep
-    driver shards that axis over the TPU mesh."""
+    driver shards that axis over the TPU mesh. ``reorder`` must match
+    the lanes' ``make_lane(reorder=...)`` flag (one compiled runner per
+    setting — mixing both in one batch is not supported)."""
 
     def run_lane(st, ctx):
         out = jax.lax.while_loop(
             lambda s: _lane_running(dims, s, ctx, max_steps),
-            lambda s: _lane_step(protocol, dims, s, ctx),
+            lambda s: _lane_step(protocol, dims, s, ctx, reorder),
             st,
         )
         # a lane truncated by max_steps must never look like a clean run
         truncated = (out["steps"] >= max_steps) & (out["done_time"] >= INF)
-        return dict(out, err=out["err"] | truncated)
+        return dict(out, err=out["err"] | ERR_TRUNCATED * truncated)
 
     return jax.jit(jax.vmap(run_lane))
+
+
+def build_segment_runner(
+    protocol, dims: EngineDims, max_steps: int = 1 << 22,
+    reorder: bool = False,
+):
+    """Like :func:`build_runner` but each device call advances every
+    still-running lane by at most ``until - steps`` steps and returns,
+    so one sweep becomes several bounded executions with host-side
+    resume — long sweeps stay under transport/watchdog execution-time
+    limits (a single multi-minute while_loop call can kill a tunneled
+    device worker). Returns ``(runner(state, ctx, until), alive(state,
+    ctx))``; drive ``until`` up in fixed increments until ``alive`` is
+    false, then apply truncation via ``finish_segmented``."""
+
+    def run_lane(st, ctx, until):
+        lim = jnp.minimum(until, max_steps)
+        return jax.lax.while_loop(
+            lambda s: _lane_running(dims, s, ctx, max_steps)
+            & (s["steps"] < lim),
+            lambda s: _lane_step(protocol, dims, s, ctx, reorder),
+            st,
+        )
+
+    def alive_lane(st, ctx):
+        return _lane_running(dims, st, ctx, max_steps)
+
+    runner = jax.jit(jax.vmap(run_lane, in_axes=(0, 0, None)))
+    alive = jax.jit(
+        lambda st, ctx: jnp.any(jax.vmap(alive_lane)(st, ctx))
+    )
+    return runner, alive
+
+
+def finish_segmented(state, max_steps: int):
+    """Apply the truncation error bit after a segmented run (host side,
+    numpy arrays)."""
+    truncated = (np.asarray(state["steps"]) >= max_steps) & (
+        np.asarray(state["done_time"]) >= INF
+    )
+    state = dict(state)
+    state["err"] = np.asarray(state["err"]) | ERR_TRUNCATED * truncated
+    return state

@@ -20,6 +20,14 @@ def stack_states(protocol, dims: EngineDims, specs: Sequence[LaneSpec]):
     return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *states)
 
 
+def batch_reorder_flag(specs: Sequence[LaneSpec]) -> bool:
+    """A batch compiles one step function, so every lane must agree on
+    the reorder perturbation (a trace-time flag)."""
+    flags = {bool(s.ctx["reorder"]) for s in specs}
+    assert len(flags) == 1, "cannot mix reorder and FIFO lanes in a batch"
+    return flags.pop()
+
+
 def run_lanes(
     protocol,
     dims: EngineDims,
@@ -28,6 +36,8 @@ def run_lanes(
 ) -> List[LaneResults]:
     ctx = stack_lanes(specs)
     state = stack_states(protocol, dims, specs)
-    runner = build_runner(protocol, dims, max_steps)
+    runner = build_runner(
+        protocol, dims, max_steps, reorder=batch_reorder_flag(specs)
+    )
     final = runner(state, ctx)
     return collect_results(protocol, dims, final, specs)

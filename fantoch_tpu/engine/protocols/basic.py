@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import I32, emit, emit_broadcast, empty_outbox
-from ..dims import INF, EngineDims
+from ..dims import ERR_DOT, ERR_PROTO, INF, EngineDims
 from .identity import DevIdentity
 
 
@@ -81,7 +81,7 @@ class BasicDev(DevIdentity):
             "prev_stable": np.zeros((N, N), np.int32),
             "m_fast_path": np.zeros((N,), np.int32),
             "m_stable": np.zeros((N,), np.int32),
-            "err": np.zeros((N,), bool),
+            "err": np.zeros((N,), np.int32),
         }
 
     @staticmethod
@@ -96,6 +96,18 @@ class BasicDev(DevIdentity):
         }
 
     # -- device handlers ----------------------------------------------
+
+    @staticmethod
+    def ready(ps, msg, me, ctx, dims: EngineDims):
+        """Readiness gate: MStore needs a free dot slot; commits apply
+        in per-source order (committed_cnt is a frontier counter)."""
+        t = msg["mtype"]
+        store_slot = _slot(msg["payload"][0], dims)
+        store_ok = ps["seq_in_slot"][msg["src"], store_slot] == 0
+        dsrc, seq = msg["payload"][0], msg["payload"][1]
+        in_order = seq == ps["committed_cnt"][dsrc] + 1
+        ok = jnp.where(t == BasicDev.MSTORE, store_ok, True)
+        return jnp.where(t == BasicDev.MCOMMIT, in_order, ok)
 
     @staticmethod
     def handle(ps, msg, me, now, ctx, dims: EngineDims):
@@ -145,7 +157,7 @@ def _apply_commit(ps, src, seq, me, do, ob, ob_slot, dims):
     expected = ps["committed_cnt"][src] + 1
     ps = dict(
         ps,
-        err=ps["err"] | (do & (seq != expected)),
+        err=ps["err"] | ERR_PROTO * (do & (seq != expected)),
         committed_cnt=ps["committed_cnt"].at[src].add(do.astype(I32)),
     )
     slot = _slot(seq, dims)
@@ -188,7 +200,7 @@ def _mstore(ps, msg, me, ctx, dims):
     dirty = ps["seq_in_slot"][s, slot] != 0
     ps = dict(
         ps,
-        err=ps["err"] | dirty,  # dot-slot capacity D overflow
+        err=ps["err"] | ERR_DOT * dirty,
         seq_in_slot=ps["seq_in_slot"].at[s, slot].set(seq),
     )
     ob = emit(

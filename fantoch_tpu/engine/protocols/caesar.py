@@ -52,7 +52,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import I32, compact_order, emit, emit_broadcast, empty_outbox
-from ..dims import INF, SEQ_BOUND, EngineDims, dot_slot
+from ..dims import ERR_CAPACITY, ERR_DOT, ERR_PROTO, ERR_SEQ, INF, SEQ_BOUND, EngineDims, dot_slot
 from .identity import DevIdentity
 from ..iset import iset_add, iset_contains
 
@@ -181,7 +181,7 @@ class CaesarDev(DevIdentity):
             "m_fast": np.zeros((N,), np.int32),
             "m_slow": np.zeros((N,), np.int32),
             "m_stable": np.zeros((N,), np.int32),
-            "err": np.zeros((N,), bool),
+            "err": np.zeros((N,), np.int32),
         }
 
     @staticmethod
@@ -197,6 +197,29 @@ class CaesarDev(DevIdentity):
         }
 
     # -- device handlers ----------------------------------------------
+
+    def ready(self, ps, msg, me, ctx, dims: EngineDims):
+        """Readiness gate: MPropose needs a free dot slot; MCommit and
+        MRetry need the MPropose payload; MGC counts only dots whose
+        MPropose has arrived (requeued whole otherwise so sightings are
+        never double-counted)."""
+        t = msg["mtype"]
+        prop_ok = ps["pseq"][msg["src"], dot_slot(msg["payload"][0], dims)] == 0
+        dsrc, seq = msg["payload"][0], msg["payload"][1]
+        have = ps["pseq"][dsrc, dot_slot(seq, dims)] == seq
+        DPM = self.gc_per_msg(dims)
+        idx = jnp.arange(DPM, dtype=I32)
+        gsrc = msg["payload"][1 + 2 * idx]
+        gseq = msg["payload"][2 + 2 * idx]
+        en = idx < msg["payload"][0]
+        gc_ok = jnp.all(
+            ~en | (ps["pseq"][gsrc, dot_slot(gseq, dims)] == gseq)
+        )
+        ok = jnp.where(t == CaesarDev.MPROPOSE, prop_ok, True)
+        ok = jnp.where(
+            (t == CaesarDev.MCOMMIT) | (t == CaesarDev.MRETRY), have, ok
+        )
+        return jnp.where(t == CaesarDev.MGC, gc_ok, ok)
 
     def handle(self, ps, msg, me, now, ctx, dims: EngineDims):
         def _noop(ps, msg):
@@ -268,7 +291,7 @@ def _kc_add(dev, ps, key, src, seq, cseq, cpid, enable):
         kc_seq=ps["kc_seq"].at[key, widx].set(seq, mode="drop"),
         kc_cseq=ps["kc_cseq"].at[key, widx].set(cseq, mode="drop"),
         kc_cpid=ps["kc_cpid"].at[key, widx].set(cpid, mode="drop"),
-        err=ps["err"] | overflow | (do & dup),
+        err=ps["err"] | ERR_CAPACITY * overflow | ERR_PROTO * (do & dup),
     )
 
 
@@ -289,7 +312,7 @@ def _kc_remove(dev, ps, key, cseq, cpid, enable):
         kc_seq=ps["kc_seq"].at[key, widx].set(zero, mode="drop"),
         kc_cseq=ps["kc_cseq"].at[key, widx].set(zero, mode="drop"),
         kc_cpid=ps["kc_cpid"].at[key, widx].set(zero, mode="drop"),
-        err=ps["err"] | (do & ~found),
+        err=ps["err"] | ERR_PROTO * (do & ~found),
     )
 
 
@@ -423,7 +446,7 @@ def _propose_reply(dev, ps, me, wsrc, wslot, wseq, accept, ctx, dims, ob,
     ps = dict(
         ps,
         # the executor's clock packing clk_seq*(N+1)+pid must stay < INF
-        err=ps["err"] | (rej & (new_cseq >= INF // (dims.N + 1))),
+        err=ps["err"] | ERR_SEQ * (rej & (new_cseq >= INF // (dims.N + 1))),
         clk_counter=jnp.where(rej, new_cseq, ps["clk_counter"]),
         status=ps["status"]
         .at[jnp.where(rej, wsrc, dims.N), wslot]
@@ -455,7 +478,7 @@ def _propose_reply(dev, ps, me, wsrc, wslot, wseq, accept, ctx, dims, ob,
     apay = apay.at[order + 1].set(ps["dep_seq"][wsrc, wslot], mode="drop")
 
     pay = jnp.where(rej, rpay, apay)
-    ps = dict(ps, err=ps["err"] | (rej & roverflow))
+    ps = dict(ps, err=ps["err"] | ERR_CAPACITY * (rej & roverflow))
     ob = emit(ob, ob_slot, wsrc, CaesarDev.MPROPOSEACK, pay, valid=do)
     return ps, ob
 
@@ -517,7 +540,7 @@ def _exec_scan(dev, ps, me, ctx, dims, ob, client_slot, chain_slot,
         eb_src=ps["eb_src"].at[widx].set(esrc, mode="drop"),
         eb_seq=ps["eb_seq"].at[widx].set(eseq, mode="drop"),
         eb_n=eb_n + (do & ~eb_overflow).astype(I32),
-        err=ps["err"] | overflow | eb_overflow,
+        err=ps["err"] | ERR_CAPACITY * (overflow | eb_overflow),
     )
     ob = emit(
         ob,
@@ -552,7 +575,7 @@ def _gc_count(dev, ps, me, ctx, dims, src, seq, enable):
     wsrc = jnp.where(do & valid, src, dims.N)
     ps = dict(
         ps,
-        err=ps["err"] | (do & ~valid),
+        err=ps["err"] | ERR_PROTO * (do & ~valid),
         gc_cnt=ps["gc_cnt"].at[wsrc, slot].set(cnt, mode="drop"),
     )
     # free: unregister the clock, clear the slot, count stability
@@ -598,7 +621,7 @@ def _drain_executed_notification(dev, ps, me, ctx, dims, enable):
             gb_src=ps["gb_src"].at[widx].set(src, mode="drop"),
             gb_seq=ps["gb_seq"].at[widx].set(seq, mode="drop"),
             gb_n=gb_n + (take & ~overflow).astype(I32),
-            err=ps["err"] | overflow,
+            err=ps["err"] | ERR_CAPACITY * overflow,
         )
         return _gc_count(dev, ps, me, ctx, dims, src, seq, take)
 
@@ -625,8 +648,7 @@ def _submit(dev, ps, msg, me, ctx, dims):
         # (source, sequence) packing in the scans requires seq < bound;
         # the executor's clock packing clk_seq*(N+1)+pid must stay < INF
         err=ps["err"]
-        | (seq >= SEQ_BOUND)
-        | (cseq >= INF // (dims.N + 1)),
+        | ERR_SEQ * ((seq >= SEQ_BOUND) | (cseq >= INF // (dims.N + 1))),
         own_seq=seq,
         clk_counter=cseq,
         qa_cnt=ps["qa_cnt"].at[slot].set(0),
@@ -664,7 +686,7 @@ def _mpropose(dev, ps, msg, me, ctx, dims):
     ps = dict(
         ps,
         clk_counter=jnp.maximum(ps["clk_counter"], cseq),
-        err=ps["err"] | dirty,
+        err=ps["err"] | ERR_DOT * dirty,
         pseq=ps["pseq"].at[s, slot].set(seq),
         key_of=ps["key_of"].at[s, slot].set(key),
         client_of=ps["client_of"].at[s, slot].set(client),
@@ -692,7 +714,7 @@ def _mpropose(dev, ps, msg, me, ctx, dims):
         dep_seq=ps["dep_seq"].at[s, slot].set(d_seq),
         bb_src=ps["bb_src"].at[s, slot].set(b_src),
         bb_seq=ps["bb_seq"].at[s, slot].set(b_seq),
-        err=ps["err"] | (nd > dev.DEP) | (nb > dev.BB),
+        err=ps["err"] | ERR_CAPACITY * ((nd > dev.DEP) | (nb > dev.BB)),
     )
     ps = _kc_add(dev, ps, key, s, seq, cseq, cpid, True)
 
@@ -735,7 +757,7 @@ def _agg_union(dev, ps, slot, pay_base, msg, enable):
             ps,
             ag_src=ps["ag_src"].at[slot, widx].set(dsrc, mode="drop"),
             ag_seq=ps["ag_seq"].at[slot, widx].set(dseq, mode="drop"),
-            err=ps["err"] | overflow,
+            err=ps["err"] | ERR_CAPACITY * overflow,
         )
 
     return jax.lax.fori_loop(0, dev.DEP, body, ps)
@@ -835,7 +857,7 @@ def _store_deps_from_msg(dev, ps, src, slot, msg, base, skip_self, seq,
         ps,
         dep_src=ps["dep_src"].at[wsrc, slot].set(dsrcs, mode="drop"),
         dep_seq=ps["dep_seq"].at[wsrc, slot].set(dseqs, mode="drop"),
-        err=ps["err"] | (do & (nd > Q)),
+        err=ps["err"] | ERR_CAPACITY * (do & (nd > Q)),
     )
 
 
@@ -873,7 +895,7 @@ def _mcommit(dev, ps, msg, me, ctx, dims):
     ps = dict(
         ps,
         clk_counter=jnp.maximum(ps["clk_counter"], cseq),
-        err=ps["err"] | ~have,
+        err=ps["err"] | ERR_PROTO * ~have,
     )
     ps = _store_deps_from_msg(dev, ps, dsrc, slot, msg, 4, True, seq, do,
                               dims)
@@ -890,7 +912,7 @@ def _mcommit(dev, ps, msg, me, ctx, dims):
         ps,
         cm_front=ps["cm_front"].at[dsrc].set(cf),
         cm_gaps=ps["cm_gaps"].at[dsrc].set(cg),
-        err=ps["err"] | overflow,
+        err=ps["err"] | ERR_CAPACITY * overflow,
     )
     # executor + wait re-evaluation, all at this instant
     ob = empty_outbox(dims)
@@ -915,7 +937,7 @@ def _mretry(dev, ps, msg, me, ctx, dims):
     ps = dict(
         ps,
         clk_counter=jnp.maximum(ps["clk_counter"], cseq),
-        err=ps["err"] | ~have,
+        err=ps["err"] | ERR_PROTO * ~have,
     )
     ps = _store_deps_from_msg(dev, ps, dsrc, slot, msg, 4, False, seq, do,
                               dims)
@@ -958,7 +980,7 @@ def _mretry(dev, ps, msg, me, ctx, dims):
         0, dev.DEP, add_msg_dep, (pay, nd, jnp.asarray(False))
     )
     pay = pay.at[2].set(nd)
-    ps = dict(ps, err=ps["err"] | (do & (overflow | o2)))
+    ps = dict(ps, err=ps["err"] | ERR_CAPACITY * (do & (overflow | o2)))
     ob = emit(
         empty_outbox(dims), 0, msg["src"], CaesarDev.MRETRYACK, pay,
         valid=do,

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import I32, emit, emit_broadcast, empty_outbox
-from ..dims import INF, EngineDims, dot_slot
+from ..dims import ERR_DOT, ERR_PROTO, INF, EngineDims, dot_slot
 from .identity import DevIdentity
 
 
@@ -95,7 +95,7 @@ class FPaxosDev(DevIdentity):
             "others_committed": np.zeros((N, N), np.int32),
             "seen": np.zeros((N, N), bool),
             "m_stable": np.zeros((N,), np.int32),
-            "err": np.zeros((N,), bool),
+            "err": np.zeros((N,), np.int32),
         }
 
     @staticmethod
@@ -107,6 +107,21 @@ class FPaxosDev(DevIdentity):
         return {"stable": ps_np["m_stable"]}
 
     # -- device handlers ----------------------------------------------
+
+    @staticmethod
+    def ready(ps, msg, me, ctx, dims: EngineDims):
+        """Readiness gate: MAccept needs a free acceptor window slot,
+        MChosen executes in slot order (the reference's SlotExecutor
+        buffers out-of-order slots, executor/slot.rs:17-69)."""
+        t = msg["mtype"]
+        slot = msg["payload"][0]
+        idx = dot_slot(slot, dims)
+        ok = jnp.where(
+            t == FPaxosDev.MACCEPT, ps["acc_slot"][idx] == 0, True
+        )
+        return jnp.where(
+            t == FPaxosDev.MCHOSEN, slot == ps["exec_frontier"] + 1, ok
+        )
 
     @staticmethod
     def handle(ps, msg, me, now, ctx, dims: EngineDims):
@@ -156,7 +171,7 @@ def _submit(ps, msg, me, ctx, dims):
     dirty = ps["cmd_slot"][idx] != 0
     ps = dict(
         ps,
-        err=ps["err"] | (do & dirty),
+        err=ps["err"] | ERR_DOT * (do & dirty),
         last_slot=jnp.where(do, slot, ps["last_slot"]),
         cmd_slot=ps["cmd_slot"].at[jnp.where(do, idx, dims.D)].set(
             slot, mode="drop"
@@ -192,7 +207,11 @@ def _submit(ps, msg, me, ctx, dims):
     payload = payload.at[1 : N + 1, 1].set(client)
     payload = payload.at[1 : N + 1, 2].set(key)
 
-    return ps, {"valid": valid, "dst": dst, "mtype": mtype, "payload": payload}
+    return ps, {
+        "valid": valid, "dst": dst, "mtype": mtype, "payload": payload,
+        "delay": jnp.full((valid.shape[0],), -1, I32),
+        "src": jnp.full((valid.shape[0],), -1, I32),
+    }
 
 
 def _maccept(ps, msg, me, ctx, dims):
@@ -203,7 +222,7 @@ def _maccept(ps, msg, me, ctx, dims):
     dirty = ps["acc_slot"][idx] != 0
     ps = dict(
         ps,
-        err=ps["err"] | dirty,
+        err=ps["err"] | ERR_DOT * dirty,
         acc_slot=ps["acc_slot"].at[idx].set(slot),
     )
     ob = emit(
@@ -230,7 +249,7 @@ def _maccepted(ps, msg, me, ctx, dims):
     # freeing the window entry for reuse
     ps = dict(
         ps,
-        err=ps["err"] | stale,
+        err=ps["err"] | ERR_PROTO * stale,
         acc_count=ps["acc_count"].at[idx].set(jnp.where(chosen, 0, cnt)),
         cmd_slot=ps["cmd_slot"].at[idx].set(
             jnp.where(chosen, 0, ps["cmd_slot"][idx])
@@ -254,7 +273,7 @@ def _mchosen(ps, msg, me, ctx, dims):
     in_order = slot == ps["exec_frontier"] + 1
     ps = dict(
         ps,
-        err=ps["err"] | ~in_order,
+        err=ps["err"] | ERR_PROTO * ~in_order,
         exec_frontier=ps["exec_frontier"] + in_order.astype(I32),
     )
     mine = ctx["client_attach"][client] == me

@@ -67,7 +67,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import I32, compact_order, emit, emit_broadcast, empty_outbox
-from ..dims import INF, SEQ_BOUND, EngineDims, dot_slot
+from ..dims import ERR_CAPACITY, ERR_DOT, ERR_PROTO, ERR_SEQ, INF, SEQ_BOUND, EngineDims, dot_slot
 from .identity import DevIdentity
 from ..iset import iset_add, iset_contains
 
@@ -175,7 +175,7 @@ class _DepDev(DevIdentity):
             "m_fast": np.zeros((N,), np.int32),
             "m_slow": np.zeros((N,), np.int32),
             "m_stable": np.zeros((N,), np.int32),
-            "err": np.zeros((N,), bool),
+            "err": np.zeros((N,), np.int32),
         }
 
     @staticmethod
@@ -191,6 +191,20 @@ class _DepDev(DevIdentity):
         }
 
     # -- device handlers ----------------------------------------------
+
+    def ready(self, ps, msg, me, ctx, dims: EngineDims):
+        """Readiness gate: MCollect needs a free dot slot, MCommit needs
+        the MCollect payload (atlas.rs buffers early commits)."""
+        t = msg["mtype"]
+        c_slot = dot_slot(msg["payload"][0], dims)
+        collect_ok = (
+            (ps["seq_in_slot"][msg["src"], c_slot] == 0)
+            & (ps["vx_seq"][msg["src"], c_slot] == 0)
+        )
+        dsrc, seq = msg["payload"][0], msg["payload"][1]
+        have = ps["seq_in_slot"][dsrc, dot_slot(seq, dims)] == seq
+        ok = jnp.where(t == _DepDev.MCOLLECT, collect_ok, True)
+        return jnp.where(t == _DepDev.MCOMMIT, have, ok)
 
     def handle(self, ps, msg, me, now, ctx, dims: EngineDims):
         def _noop(ps, msg):
@@ -282,7 +296,7 @@ def _qd_add(ps, slot, dsrc, dseq, enable):
         qd_cnt=ps["qd_cnt"]
         .at[slot, widx]
         .set(jnp.where(found, ps["qd_cnt"][slot, widx] + 1, 1), mode="drop"),
-        err=ps["err"] | overflow,
+        err=ps["err"] | ERR_CAPACITY * overflow,
     )
 
 
@@ -372,7 +386,7 @@ def _drain(dev, ps, me, ctx, dims, ob, exec_slot, drain_slot, enable=True):
         vx_seq=ps["vx_seq"]
         .at[jnp.where(do, esrc, N), eslot]
         .set(0, mode="drop"),
-        err=ps["err"] | overflow,
+        err=ps["err"] | ERR_CAPACITY * overflow,
     )
     ob = emit(
         ob,
@@ -412,7 +426,7 @@ def _submit(dev, ps, msg, me, ctx, dims):
     ps = dict(
         ps,
         # (source, sequence) packing in the drain requires seq < bound
-        err=ps["err"] | (seq >= SEQ_BOUND),
+        err=ps["err"] | ERR_SEQ * (seq >= SEQ_BOUND),
         own_seq=seq,
         latest_src=ps["latest_src"].at[key].set(me),
         latest_seq=ps["latest_seq"].at[key].set(seq),
@@ -448,7 +462,7 @@ def _mcollect(dev, ps, msg, me, ctx, dims):
     dirty = (ps["seq_in_slot"][s, slot] != 0) | (ps["vx_seq"][s, slot] != 0)
     ps = dict(
         ps,
-        err=ps["err"] | dirty,
+        err=ps["err"] | ERR_DOT * dirty,
         seq_in_slot=ps["seq_in_slot"].at[s, slot].set(seq),
         key_of=ps["key_of"].at[s, slot].set(key),
         client_of=ps["client_of"].at[s, slot].set(client),
@@ -525,12 +539,15 @@ def _mcollectack(dev, ps, msg, me, ctx, dims):
         ctx["write_quorum"][me]
     )
     obc = dict(obc, valid=obc["valid"] & slow & wq)
-    ob = {
-        "valid": jnp.where(fast, ob["valid"], obc["valid"]),
-        "dst": jnp.where(fast, ob["dst"], obc["dst"]),
-        "mtype": jnp.where(fast, ob["mtype"], obc["mtype"]),
-        "payload": jnp.where(fast, ob["payload"], obc["payload"]),
-    }
+    ob = jax.tree_util.tree_map(
+        lambda a, b: jnp.where(
+            fast.reshape((-1,) + (1,) * (a.ndim - 1)) if a.ndim > 1 else fast,
+            a,
+            b,
+        ),
+        ob,
+        obc,
+    )
     return ps, ob
 
 
@@ -548,7 +565,7 @@ def _mcommit(dev, ps, msg, me, ctx, dims):
     have = ps["seq_in_slot"][dsrc, slot] == seq
     already = ps["vx_seq"][dsrc, slot] == seq
     do = have & ~already
-    ps = dict(ps, err=ps["err"] | ~have)
+    ps = dict(ps, err=ps["err"] | ERR_PROTO * ~have)
 
     idxs = 5 + 2 * jnp.arange(Q, dtype=I32)
     dep_en = jnp.arange(Q, dtype=I32) < nd
@@ -574,7 +591,7 @@ def _mcommit(dev, ps, msg, me, ctx, dims):
         ps,
         comm_front=ps["comm_front"].at[dsrc].set(cf),
         comm_gaps=ps["comm_gaps"].at[dsrc].set(cg),
-        err=ps["err"] | overflow,
+        err=ps["err"] | ERR_CAPACITY * overflow,
     )
     return _drain(dev, ps, me, ctx, dims, empty_outbox(dims), 0, 1)
 

@@ -39,7 +39,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import I32, emit, emit_broadcast, empty_outbox
-from ..dims import INF, SEQ_BOUND, EngineDims, dot_slot
+from ..dims import (
+    ERR_CAPACITY, ERR_DOT, ERR_PROTO, ERR_SEQ, INF, SEQ_BOUND, EngineDims,
+    dot_slot,
+)
 from .identity import DevIdentity
 from ..iset import iset_add, iset_add_range
 
@@ -72,6 +75,22 @@ class TempoDev(DevIdentity):
         self.PK = pending_per_key
         self.R = detached_slots
         self.G = gap_slots
+
+    @classmethod
+    def for_load(cls, keys: int, clients: int) -> "TempoDev":
+        """Capacity bounds that survive ``clients`` closed-loop clients
+        hammering one conflict key at f up to 2: pending rows hold every
+        committed-but-unstable command per key, and detached-vote ranges
+        plus frontier gap buffers grow with the stability lag, which
+        scales with the number of concurrent writers (measured: the
+        defaults overflow detached/gap at 10 clients × conflict 100 ×
+        f=2; 2× headroom over the measured need)."""
+        return cls(
+            keys=keys,
+            pending_per_key=max(32, 8 * clients),
+            detached_slots=max(16, 4 * clients),
+            gap_slots=max(8, 2 * clients),
+        )
 
     # -- host-side builders -------------------------------------------
 
@@ -151,7 +170,7 @@ class TempoDev(DevIdentity):
             "m_fast": np.zeros((N,), np.int32),
             "m_slow": np.zeros((N,), np.int32),
             "m_stable": np.zeros((N,), np.int32),
-            "err": np.zeros((N,), bool),
+            "err": np.zeros((N,), np.int32),
         }
 
     @staticmethod
@@ -167,6 +186,23 @@ class TempoDev(DevIdentity):
         }
 
     # -- device handlers ----------------------------------------------
+
+    def ready(self, ps, msg, me, ctx, dims: EngineDims):
+        """Readiness gate (engine/core.py): requeue messages that
+        overtook their prerequisite under reordering. MCollect needs a
+        free dot slot (its predecessor GC'd), MCommit/MConsensus need
+        the MCollect payload (tempo.rs buffers these commits)."""
+        t = msg["mtype"]
+        # MCOLLECT: payload [seq, ...] from msg src
+        c_slot = dot_slot(msg["payload"][0], dims)
+        collect_ok = ps["seq_in_slot"][msg["src"], c_slot] == 0
+        # MCOMMIT / MCONSENSUS: payload [dsrc, seq, ...]
+        dsrc, seq = msg["payload"][0], msg["payload"][1]
+        have = ps["seq_in_slot"][dsrc, dot_slot(seq, dims)] == seq
+        ok = jnp.where(t == TempoDev.MCOLLECT, collect_ok, True)
+        return jnp.where(
+            (t == TempoDev.MCOMMIT) | (t == TempoDev.MCONSENSUS), have, ok
+        )
 
     def handle(self, ps, msg, me, now, ctx, dims: EngineDims):
         def _noop(ps, msg):
@@ -247,7 +283,7 @@ def _det_add(tempo, ps, key, start, end, enable):
     slot = jnp.where(store & ~overflow, slot, tempo.R)
     det = det.at[key, slot, 0].set(start, mode="drop")
     det = det.at[key, slot, 1].set(end, mode="drop")
-    return dict(ps, det=det, err=ps["err"] | overflow)
+    return dict(ps, det=det, err=ps["err"] | ERR_CAPACITY * overflow)
 
 
 def _bump(tempo, ps, key, up_to, enable):
@@ -279,7 +315,7 @@ def _detached_all(tempo, ps, min_clock, enable):
         ps,
         det=det,
         clocks=jnp.where(do, min_clock, clocks),
-        err=ps["err"] | jnp.any(overflow),
+        err=ps["err"] | ERR_CAPACITY * jnp.any(overflow),
     )
 
 
@@ -292,7 +328,7 @@ def _vote_add(tempo, ps, key, voter, start, end, enable):
         ps,
         vote_front=ps["vote_front"].at[key, voter].set(front),
         vote_gaps=ps["vote_gaps"].at[key, voter].set(gaps),
-        err=ps["err"] | overflow,
+        err=ps["err"] | ERR_CAPACITY * overflow,
     )
 
 
@@ -364,7 +400,7 @@ def _pend_insert(tempo, ps, key, clock, src, seq, client):
         pend_src=ps["pend_src"].at[key, widx].set(src, mode="drop"),
         pend_seq=ps["pend_seq"].at[key, widx].set(seq, mode="drop"),
         pend_client=ps["pend_client"].at[key, widx].set(client, mode="drop"),
-        err=ps["err"] | overflow,
+        err=ps["err"] | ERR_CAPACITY * overflow,
     )
 
 
@@ -386,7 +422,7 @@ def _submit(tempo, ps, msg, me, ctx, dims):
     ps = dict(
         ps,
         # (source, sequence) packing in the drain scan requires seq < bound
-        err=ps["err"] | (seq >= SEQ_BOUND),
+        err=ps["err"] | ERR_SEQ * (seq >= SEQ_BOUND),
         own_seq=seq,
         clocks=ps["clocks"].at[key].set(clock),
         ack_cnt=ps["ack_cnt"].at[slot].set(0),
@@ -421,7 +457,7 @@ def _mcollect(tempo, ps, msg, me, ctx, dims):
     dirty = ps["seq_in_slot"][s, slot] != 0
     ps = dict(
         ps,
-        err=ps["err"] | dirty,
+        err=ps["err"] | ERR_DOT * dirty,
         seq_in_slot=ps["seq_in_slot"].at[s, slot].set(seq),
         key_of=ps["key_of"].at[s, slot].set(key),
         client_of=ps["client_of"].at[s, slot].set(client),
@@ -474,7 +510,7 @@ def _mcollectack(tempo, ps, msg, me, ctx, dims):
         votes_s=ps["votes_s"].at[slot, widx].set(vs, mode="drop"),
         votes_e=ps["votes_e"].at[slot, widx].set(ve, mode="drop"),
         votes_n=ps["votes_n"].at[slot].add(fits.astype(I32)),
-        err=ps["err"] | (has_vote & ~fits),
+        err=ps["err"] | ERR_CAPACITY * (has_vote & ~fits),
     )
 
     # quorum clock aggregation
@@ -518,12 +554,15 @@ def _mcollectack(tempo, ps, msg, me, ctx, dims):
         ctx["write_quorum"][me]
     )
     obc = dict(obc, valid=obc["valid"] & slow & wq)
-    ob = {
-        "valid": jnp.where(fast, ob["valid"], obc["valid"]),
-        "dst": jnp.where(fast, ob["dst"], obc["dst"]),
-        "mtype": jnp.where(fast, ob["mtype"], obc["mtype"]),
-        "payload": jnp.where(fast, ob["payload"], obc["payload"]),
-    }
+    ob = jax.tree_util.tree_map(
+        lambda a, b: jnp.where(
+            fast.reshape((-1,) + (1,) * (a.ndim - 1)) if a.ndim > 1 else fast,
+            a,
+            b,
+        ),
+        ob,
+        obc,
+    )
     return ps, ob
 
 
@@ -561,7 +600,14 @@ def _commit_broadcast(tempo, ps, me, seq, clock, key, client, ctx, dims,
         jnp.full((N,), TempoDev.MCOMMIT, I32)
     )
     p = jnp.zeros((F, P), I32).at[:N].set(jnp.broadcast_to(pay, (N, P)))
-    return {"valid": v, "dst": d, "mtype": m, "payload": p}
+    return {
+        "valid": v,
+        "dst": d,
+        "mtype": m,
+        "payload": p,
+        "delay": jnp.full((F,), -1, I32),
+        "src": jnp.full((F,), -1, I32),
+    }
 
 
 def _mcommit(tempo, ps, msg, me, ctx, dims):
@@ -576,7 +622,7 @@ def _mcommit(tempo, ps, msg, me, ctx, dims):
     nv = msg["payload"][5]
     slot = dot_slot(seq, dims)
     have = ps["seq_in_slot"][dsrc, slot] == seq
-    ps = dict(ps, err=ps["err"] | ~have)
+    ps = dict(ps, err=ps["err"] | ERR_PROTO * ~have)
 
     # clock management (real-time mode defers to the periodic bump)
     bump_mode = ctx["clock_bump_mode"]
@@ -614,7 +660,7 @@ def _mcommit(tempo, ps, msg, me, ctx, dims):
         ps,
         vote_front=ps["vote_front"].at[key].set(fronts),
         vote_gaps=ps["vote_gaps"].at[key].set(gaps),
-        err=ps["err"] | jnp.any(ovf),
+        err=ps["err"] | ERR_CAPACITY * jnp.any(ovf),
     )
     ps = _pend_insert(tempo, ps, key, clock, dsrc, seq, client)
 
@@ -626,7 +672,7 @@ def _mcommit(tempo, ps, msg, me, ctx, dims):
         ps,
         comm_front=ps["comm_front"].at[dsrc].set(cf),
         comm_gaps=ps["comm_gaps"].at[dsrc].set(cg),
-        err=ps["err"] | overflow,
+        err=ps["err"] | ERR_CAPACITY * overflow,
     )
     return _drain(
         tempo, ps, key, me, ctx, dims, empty_outbox(dims), 0, 1

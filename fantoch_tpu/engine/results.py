@@ -11,7 +11,7 @@ import jax
 import numpy as np
 
 from ..core.metrics import Histogram
-from .dims import INF, EngineDims
+from .dims import INF, EngineDims, err_names
 from .spec import LaneSpec
 
 
@@ -25,8 +25,17 @@ class LaneResults:
     lat_count: np.ndarray   # [RR]
     protocol_metrics: Dict[str, np.ndarray]  # name → per-process [N]
     steps: int
-    err: bool
+    err: int  # error bitmask (dims.ERR_*); 0 = clean run
     completed: int
+    pool_peak: int = 0  # max in-flight messages (EngineDims.M sizing)
+    # readiness-gate bounces; > 0 in a FIFO lane means the dot window
+    # (EngineDims.D) stalled deliveries — results are correct under
+    # backpressure but latencies deviate from the unbounded reference
+    requeues: int = 0
+
+    @property
+    def err_cause(self) -> str:
+        return err_names(self.err)
 
     def latency_mean(self, region: str) -> float:
         row = self.region_rows.index(region)
@@ -64,8 +73,10 @@ def collect_results(
                 lat_count=st["metrics"]["lat_count"][lane],
                 protocol_metrics=protocol.metrics(ps),
                 steps=int(st["steps"][lane]),
-                err=bool(st["err"][lane]),
+                err=int(st["err"][lane]),
                 completed=int(st["clients"]["completed"][lane].sum()),
+                pool_peak=int(st["pool_peak"][lane]),
+                requeues=int(st["requeues"][lane]),
             )
         )
     return out

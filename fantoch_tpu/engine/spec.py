@@ -59,11 +59,19 @@ def make_lane(
     dims: EngineDims,
     extra_time_ms: int = 1000,
     seed: int = 0,
+    reorder: bool = False,
 ) -> LaneSpec:
     """``zipf=(coefficient, total_keys)`` switches the workload from the
     ConflictPool generator to Zipf sampling over ``total_keys`` keys
     (key_gen.rs:113-119); lanes batched together must share the same
-    zipf table size."""
+    zipf table size.
+
+    ``reorder`` enables the oracle's message-reordering perturbation —
+    every message delay is scaled by a uniform [0, 10) multiplier
+    (runner.rs:520-524) — for race-hunting runs. Randomized delays void
+    the conservative-lookahead bound, so reorder lanes run serialized
+    (global-time stepping), and tie order is engine-defined: assert
+    protocol invariants against these lanes, not oracle equality."""
     n = config.n
     assert len(process_regions) == n <= dims.N
     N, C = dims.N, dims.C
@@ -97,7 +105,7 @@ def make_lane(
     # schedules are inherently tied, so the exact-match contract (which
     # only covers tie-free schedules) is unaffected, only speed is
     offdiag = delay_pp[:n, :n][~np.eye(n, dtype=bool)]
-    if n > 1 and offdiag.min() < 1:
+    if (n > 1 and offdiag.min() < 1) or reorder:
         lookahead[:n, :n] = 0
         np.fill_diagonal(lookahead[:n, :n], INF)
 
@@ -163,6 +171,9 @@ def make_lane(
         "key_gen_kind": key_gen_kind,
         "zipf_cum": zipf_cum,
         "rng_key": np.asarray(jr.PRNGKey(seed)),
+        "reorder": np.int32(1 if reorder else 0),
+        # distinct stream from the workload key generator
+        "reorder_key": np.asarray(jr.fold_in(jr.PRNGKey(seed), 0x5EED)),
         "periodic_intervals": intervals,
         "extra_time": np.int32(extra_time_ms),
     }

@@ -30,9 +30,12 @@ from ..engine import (
 from ..engine.core import (
     KEYGEN_CTX_FIELDS,
     build_runner,
+    build_segment_runner,
+    finish_segmented,
     first_keys_fn,
     init_lane_state,
 )
+from ..engine.driver import batch_reorder_flag
 from ..engine.spec import stack_lanes
 
 
@@ -48,6 +51,8 @@ def make_sweep_specs(
     dims: EngineDims,
     config_base: Optional[Config] = None,
     extra_time_ms: int = 500,
+    zipf=None,
+    pool_size: int = 1,
 ) -> List[LaneSpec]:
     """The sweep grid: one lane per (region set, f, conflict) point."""
     base = config_base or Config(n=len(region_sets[0]), f=1,
@@ -63,7 +68,8 @@ def make_sweep_specs(
                 planet,
                 config,
                 conflict_rate=conflict,
-                pool_size=1,
+                pool_size=pool_size,
+                zipf=zipf,
                 commands_per_client=commands_per_client,
                 clients_per_region=clients_per_region,
                 process_regions=list(regions),
@@ -82,13 +88,15 @@ def _cached_first_keys(C: int):
 
 
 @functools.lru_cache(maxsize=None)
-def _cached_runner(protocol, dims: EngineDims, max_steps: int):
-    """One compiled runner per (protocol value, dims, max_steps):
-    ``build_runner`` returns a fresh ``jax.jit`` closure, so without the
-    cache every ``run_sweep`` call would retrace and recompile. Device
-    protocols have value identity (protocols/identity.py), so fresh
-    instances with equal shape bounds share one compiled runner."""
-    return build_runner(protocol, dims, max_steps)
+def _cached_runner(protocol, dims: EngineDims, max_steps: int,
+                   reorder: bool):
+    """One compiled segmented runner per (protocol value, dims,
+    max_steps): ``build_segment_runner`` returns fresh ``jax.jit``
+    closures, so without the cache every ``run_sweep`` call would
+    retrace and recompile. Device protocols have value identity
+    (protocols/identity.py), so fresh instances with equal shape bounds
+    share one compiled runner."""
+    return build_segment_runner(protocol, dims, max_steps, reorder)
 
 
 def run_sweep(
@@ -97,9 +105,12 @@ def run_sweep(
     specs: Sequence[LaneSpec],
     mesh: Optional[Mesh] = None,
     max_steps: int = 1 << 22,
+    segment_steps: int = 2048,
 ) -> List[LaneResults]:
     """Run a sweep batch, sharded over ``mesh`` (default: all local
-    devices on one axis)."""
+    devices on one axis). The device loop runs in ``segment_steps``
+    increments with host-side resume, keeping each device execution
+    bounded (tunneled workers die on multi-minute single calls)."""
     if mesh is None:
         mesh = Mesh(np.asarray(jax.devices()), ("sweep",))
     shards = mesh.devices.size
@@ -122,6 +133,16 @@ def run_sweep(
     put = lambda tree: jax.tree_util.tree_map(
         lambda a: jax.device_put(a, sharding), tree
     )
-    runner = _cached_runner(protocol, dims, max_steps)
-    final = runner(put(state), put(ctx))
+    runner, alive = _cached_runner(
+        protocol, dims, max_steps, batch_reorder_flag(padded)
+    )
+    state = put(state)
+    ctx = put(ctx)
+    until = 0
+    while until < max_steps:
+        until = min(until + segment_steps, max_steps)
+        state = runner(state, ctx, np.int32(until))
+        if not bool(alive(state, ctx)):
+            break
+    final = finish_segmented(jax.device_get(state), max_steps)
     return collect_results(protocol, dims, final, padded)[: len(specs)]

@@ -1,0 +1,20 @@
+"""Plotting + results persistence — the ``fantoch_plot`` analog.
+
+The reference drives matplotlib through a hand-rolled pyo3 bridge
+(fantoch_plot/src/plot/pyplot.rs:10-40) over a ``ResultsDB`` of
+experiment directories (fantoch_plot/src/db/results_db.rs); here the
+engine's ``LaneResults`` feed matplotlib directly, and a JSONL results
+store stands in for the DB (fantoch_plot/src/lib.rs:184-2042 plot
+families: latency bars, CDFs, throughput-vs-latency).
+"""
+
+from .db import load_results, save_results
+from .latency import cdf_plot, conflict_latency_plot, latency_bar_plot
+
+__all__ = [
+    "cdf_plot",
+    "conflict_latency_plot",
+    "latency_bar_plot",
+    "load_results",
+    "save_results",
+]

@@ -1,0 +1,88 @@
+"""JSONL results store — the ``ResultsDB`` analog.
+
+The reference serializes whole experiment directories and reloads them
+for plotting (fantoch_plot/src/db/results_db.rs:418). Sweep results
+here are small (per-region histograms + metrics), so one JSON line per
+lane keyed by its search attributes (protocol, n, f, conflict,
+client count — the same attributes ResultsDB searches by) is enough,
+and it is diffable and append-friendly.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from ..engine.results import LaneResults
+
+
+def _encode(obj):
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    raise TypeError(f"unserializable {type(obj)}")
+
+
+def save_results(
+    path: "str | Path",
+    rows: Iterable[Tuple[Dict, LaneResults]],
+    append: bool = False,
+) -> None:
+    """``rows`` = (attributes, results) pairs; attributes is the search
+    key dict (protocol, n, f, conflict_rate, clients, ...)."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    mode = "a" if append else "w"
+    with path.open(mode) as f:
+        for attrs, res in rows:
+            f.write(
+                json.dumps(
+                    {"attrs": attrs, "results": asdict(res)},
+                    default=_encode,
+                )
+                + "\n"
+            )
+
+
+def load_results(
+    path: "str | Path",
+    match: Optional[Dict] = None,
+) -> List[Tuple[Dict, LaneResults]]:
+    """Load rows whose attributes contain ``match`` (ResultsDB::search
+    semantics: equality on every given key)."""
+    out = []
+    with Path(path).open() as f:
+        for line in f:
+            row = json.loads(line)
+            attrs = row["attrs"]
+            if match and any(attrs.get(k) != v for k, v in match.items()):
+                continue
+            r = row["results"]
+            out.append(
+                (
+                    attrs,
+                    LaneResults(
+                        region_rows=r["region_rows"],
+                        hist=np.asarray(r["hist"], np.int64),
+                        lat_sum=np.asarray(r["lat_sum"], np.int64),
+                        lat_count=np.asarray(r["lat_count"], np.int64),
+                        protocol_metrics={
+                            k: np.asarray(v)
+                            for k, v in r["protocol_metrics"].items()
+                        },
+                        steps=r["steps"],
+                        err=r["err"],
+                        completed=r["completed"],
+                        pool_peak=r.get("pool_peak", 0),
+                        requeues=r.get("requeues", 0),
+                    ),
+                )
+            )
+    return out

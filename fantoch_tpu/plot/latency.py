@@ -1,0 +1,133 @@
+"""Latency plot families (fantoch_plot/src/lib.rs:184-418).
+
+``latency_bar_plot`` is the EuroSys'21-style figure: grouped per-region
+latency bars, one bar group per region, one colored series per
+protocol/config; ``cdf_plot`` draws per-series latency CDFs from the
+engine's 1 ms histograms. Both take ``{label: results}`` where results
+aggregate one or more lanes of the same config.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import matplotlib.pyplot as plt  # noqa: E402
+import numpy as np  # noqa: E402
+
+from ..engine.results import LaneResults  # noqa: E402
+
+
+def _region_stats(res: LaneResults, region: str):
+    row = res.region_rows.index(region)
+    hist = np.asarray(res.hist[row], np.float64)
+    n = hist.sum()
+    ms = np.arange(hist.shape[0])
+    mean = float(res.lat_sum[row]) / max(float(res.lat_count[row]), 1.0)
+    # stddev from the 1 ms histogram (exact sums are only kept for the
+    # mean; bucketed second moment is within 1 ms of exact)
+    var = float((hist * (ms - mean) ** 2).sum() / max(n, 1.0))
+    return mean, var**0.5
+
+
+def latency_bar_plot(
+    series: Dict[str, LaneResults],
+    regions: Sequence[str],
+    path: str,
+    title: Optional[str] = None,
+    ylabel: str = "latency (ms)",
+):
+    """Grouped per-region mean-latency bars with stddev error bars —
+    fantoch_plot's ``latency_plot`` (lib.rs:184-418)."""
+    fig, ax = plt.subplots(figsize=(1.8 + 1.4 * len(regions), 3.2))
+    width = 0.8 / max(len(series), 1)
+    x = np.arange(len(regions), dtype=float)
+    for i, (label, res) in enumerate(series.items()):
+        stats = [_region_stats(res, r) for r in regions]
+        means = [m for m, _ in stats]
+        errs = [s for _, s in stats]
+        ax.bar(
+            x + (i - (len(series) - 1) / 2) * width,
+            means,
+            width,
+            yerr=errs,
+            capsize=2,
+            label=label,
+        )
+    ax.set_xticks(x)
+    ax.set_xticklabels(list(regions), rotation=20, ha="right", fontsize=8)
+    ax.set_ylabel(ylabel)
+    if title:
+        ax.set_title(title, fontsize=10)
+    ax.legend(fontsize=8)
+    ax.spines["top"].set_visible(False)
+    ax.spines["right"].set_visible(False)
+    fig.tight_layout()
+    fig.savefig(path, dpi=160)
+    plt.close(fig)
+    return path
+
+
+def cdf_plot(
+    series: Dict[str, LaneResults],
+    path: str,
+    regions: Optional[Sequence[str]] = None,
+    title: Optional[str] = None,
+):
+    """Per-series latency CDFs pooled over ``regions`` (default: all) —
+    fantoch_plot's ``cdf_plot`` (lib.rs:420-530)."""
+    fig, ax = plt.subplots(figsize=(4.6, 3.2))
+    for label, res in series.items():
+        rows = (
+            [res.region_rows.index(r) for r in regions]
+            if regions
+            else range(len(res.region_rows))
+        )
+        hist = np.asarray(res.hist, np.float64)[list(rows)].sum(axis=0)
+        total = hist.sum()
+        if total == 0:
+            continue
+        cum = np.cumsum(hist) / total
+        # trim the tail for readability
+        last = int(np.searchsorted(cum, 0.9999)) + 1
+        ax.plot(np.arange(hist.shape[0])[:last], cum[:last], label=label)
+    ax.set_xlabel("latency (ms)")
+    ax.set_ylabel("CDF")
+    ax.set_ylim(0, 1.02)
+    if title:
+        ax.set_title(title, fontsize=10)
+    ax.legend(fontsize=8)
+    ax.spines["top"].set_visible(False)
+    ax.spines["right"].set_visible(False)
+    fig.tight_layout()
+    fig.savefig(path, dpi=160)
+    plt.close(fig)
+    return path
+
+
+def conflict_latency_plot(
+    curves: Dict[str, List[float]],
+    conflicts: Sequence[int],
+    path: str,
+    title: Optional[str] = None,
+    ylabel: str = "mean latency (ms)",
+):
+    """Mean latency vs conflict rate, one line per protocol/config —
+    the Tempo-vs-Atlas comparison shape of the EuroSys'21 figures."""
+    fig, ax = plt.subplots(figsize=(4.6, 3.2))
+    for label, ys in curves.items():
+        ax.plot(list(conflicts), ys, marker="o", markersize=3, label=label)
+    ax.set_xlabel("conflict rate (%)")
+    ax.set_ylabel(ylabel)
+    if title:
+        ax.set_title(title, fontsize=10)
+    ax.legend(fontsize=8)
+    ax.spines["top"].set_visible(False)
+    ax.spines["right"].set_visible(False)
+    fig.tight_layout()
+    fig.savefig(path, dpi=160)
+    plt.close(fig)
+    return path

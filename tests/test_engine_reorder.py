@@ -1,0 +1,95 @@
+"""Device-engine message reordering (runner.rs:520-524 analog).
+
+Every hop's delay scales by a uniform [0, 10) draw, so deliveries race
+and interleave far more aggressively than WAN geometry allows — the
+race-hunting perturbation the reference's sim tests always enable
+(fantoch_ps/src/protocol/mod.rs:660, ``runner.reorder_messages``).
+Randomized delays void the conservative-lookahead bound (lanes run
+serialized) and make tie order engine-defined, so these tests assert
+the protocol invariants the reference's ``sim_test`` checks
+(mod.rs:116-167): every command commits, fast/slow totals account for
+every commit, and GC reaches every process.
+"""
+
+import pytest
+
+from fantoch_tpu.core import Config, Planet
+from fantoch_tpu.engine import EngineDims, make_lane, run_lanes
+from fantoch_tpu.engine.protocols import AtlasDev, CaesarDev, TempoDev
+
+COMMANDS = 20
+CPR = 1
+
+
+def run_reordered(dev_cls, config, conflict, seed, **dev_kw):
+    n = config.n
+    planet = Planet.new()
+    regions = planet.regions()[:n]
+    clients = CPR * n
+    if dev_cls is TempoDev:
+        dev = TempoDev.for_load(keys=1 + clients, clients=clients)
+    else:
+        dev = dev_cls(keys=1 + clients, **dev_kw)
+    total = COMMANDS * clients
+    dims = EngineDims.for_protocol(
+        dev,
+        n=n,
+        clients=clients,
+        payload=dev.payload_width(n),
+        total_commands=total,
+        dot_slots=total + 1,
+        regions=n,
+    )
+    spec = make_lane(
+        dev,
+        planet,
+        config,
+        conflict_rate=conflict,
+        pool_size=1,
+        commands_per_client=COMMANDS,
+        clients_per_region=CPR,
+        process_regions=regions,
+        client_regions=regions,
+        dims=dims,
+        seed=seed,
+        reorder=True,
+    )
+    return run_lanes(dev, dims, [spec])[0], total
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_tempo_reorder_invariants(seed):
+    config = Config(
+        n=3, f=1, gc_interval_ms=100, tempo_detached_send_interval_ms=100
+    )
+    res, total = run_reordered(TempoDev, config, 100, seed)
+    assert res.err == 0, res.err_cause
+    fast = int(res.protocol_metrics["fast_path"].sum())
+    slow = int(res.protocol_metrics["slow_path"].sum())
+    assert fast + slow == total
+    assert int(res.protocol_metrics["stable"].sum()) == config.n * total
+    assert res.completed == total
+
+
+def test_atlas_reorder_invariants():
+    config = Config(n=3, f=1, gc_interval_ms=100)
+    res, total = run_reordered(AtlasDev, config, 100, seed=0)
+    assert res.err == 0, res.err_cause
+    fast = int(res.protocol_metrics["fast_path"].sum())
+    slow = int(res.protocol_metrics["slow_path"].sum())
+    assert fast + slow == total
+    assert int(res.protocol_metrics["stable"].sum()) == config.n * total
+    assert res.completed == total
+
+
+def test_caesar_reorder_invariants():
+    config = Config(
+        n=5, f=2, gc_interval_ms=100, caesar_wait_condition=True
+    )
+    res, total = run_reordered(CaesarDev, config, 100, seed=0)
+    assert res.err == 0, res.err_cause
+    fast = int(res.protocol_metrics["fast_path"].sum())
+    slow = int(res.protocol_metrics["slow_path"].sum())
+    assert fast + slow == total
+    assert int(res.protocol_metrics["stable"].sum()) == config.n * total
+    assert res.completed == total

@@ -1,0 +1,223 @@
+#!/usr/bin/env python
+"""Accuracy milestone: BASELINE configs 2-3 through engine + oracle.
+
+Runs the EPaxos conflict sweep (config 2) and the Atlas-vs-Tempo
+comparison (config 3) on the device engine, replays the same configs
+through the host oracle DES, asserts per-region mean-latency agreement
+within ±2% (the BASELINE.json accuracy target; exact equality holds at
+conflict 0/100 where host and device draw identical workloads), and
+renders the EuroSys'21-style figures into plots/.
+
+Usage: python tools/accuracy.py [--quick]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from fantoch_tpu.client import DeviceStream, Workload  # noqa: E402
+from fantoch_tpu.core import Config, Planet  # noqa: E402
+from fantoch_tpu.engine import EngineDims  # noqa: E402
+from fantoch_tpu.engine.protocols import (  # noqa: E402
+    AtlasDev,
+    EPaxosDev,
+    TempoDev,
+)
+from fantoch_tpu.parallel.sweep import run_sweep  # noqa: E402
+from fantoch_tpu.engine.spec import make_lane  # noqa: E402
+from fantoch_tpu.plot import (  # noqa: E402
+    cdf_plot,
+    conflict_latency_plot,
+    latency_bar_plot,
+    save_results,
+)
+from fantoch_tpu.protocol import Atlas, EPaxos, Tempo  # noqa: E402
+from fantoch_tpu.sim import Runner  # noqa: E402
+
+REGIONS5 = [
+    "europe-west2",
+    "us-east1",
+    "asia-east1",
+    "us-west1",
+    "southamerica-east1",
+]
+TOLERANCE = 0.02
+
+ORACLES = {"atlas": Atlas, "epaxos": EPaxos, "tempo": Tempo}
+
+
+def make_dev(name, clients):
+    if name == "tempo":
+        return TempoDev.for_load(keys=1 + clients, clients=clients)
+    cls = {"atlas": AtlasDev, "epaxos": EPaxosDev}[name]
+    return cls(keys=1 + clients)
+
+
+def config_for(name, n, f):
+    kw = dict(n=n, f=f, gc_interval_ms=100)
+    if name == "tempo":
+        kw["tempo_detached_send_interval_ms"] = 100
+    return Config(**kw)
+
+
+def oracle_means(name, config, conflict, commands, cpr, regions):
+    planet = Planet.new()
+    # DeviceStream replays the engine's exact key stream, so the oracle
+    # and the device run the same workload at every conflict rate
+    wl = Workload(
+        shard_count=1,
+        key_gen=DeviceStream(conflict_rate=conflict, pool_size=1),
+        keys_per_command=1,
+        commands_per_client=commands,
+        payload_size=0,
+    )
+    runner = Runner(
+        ORACLES[name], planet, config, wl, cpr, regions, list(regions)
+    )
+    _, _, lat = runner.run(extra_sim_time_ms=1000)
+    return {r: lat[r][1].mean() for r in regions}
+
+
+def engine_results(name, configs, commands, cpr, regions):
+    """configs = [(config, conflict)]; one sweep batch per protocol."""
+    planet = Planet.new()
+    clients = cpr * len(regions)
+    dev = make_dev(name, clients)
+    total = commands * clients
+    dims = EngineDims.for_protocol(
+        dev,
+        n=len(regions),
+        clients=clients,
+        payload=dev.payload_width(len(regions)),
+        total_commands=total,
+        dot_slots=total + 1,
+        regions=len(regions),
+    )
+    specs = [
+        make_lane(
+            dev,
+            planet,
+            config,
+            conflict_rate=conflict,
+            pool_size=1,
+            commands_per_client=commands,
+            clients_per_region=cpr,
+            process_regions=regions,
+            client_regions=list(regions),
+            dims=dims,
+        )
+        for config, conflict in configs
+    ]
+    return run_sweep(dev, dims, specs)
+
+
+def main() -> None:
+    if "--cpu" in sys.argv:
+        # the environment pre-imports jax aimed at the tunneled TPU and
+        # overrides JAX_PLATFORMS, so flip the config in-process
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 8)
+    quick = "--quick" in sys.argv
+    commands, cpr = (30, 1) if quick else (100, 1)
+    conflicts = [0, 2, 10, 50, 100]
+    plots = Path(__file__).resolve().parent.parent / "plots"
+    plots.mkdir(exist_ok=True)
+    report = {}
+    rows = []
+
+    # -- config 2: EPaxos conflict sweep ------------------------------
+    cfgs = [(config_for("epaxos", 5, 2), c) for c in conflicts]
+    eng = engine_results("epaxos", cfgs, commands, cpr, REGIONS5)
+    curves = {"epaxos (device)": [], "epaxos (oracle)": []}
+    worst = 0.0
+    for (config, conflict), res in zip(cfgs, eng):
+        assert not res.err, (conflict, res.err_cause)
+        om = oracle_means("epaxos", config, conflict, commands, cpr, REGIONS5)
+        dev_all = sum(res.latency_mean(r) for r in REGIONS5) / 5
+        ora_all = sum(om.values()) / 5
+        curves["epaxos (device)"].append(dev_all)
+        curves["epaxos (oracle)"].append(ora_all)
+        for r in REGIONS5:
+            rel = abs(res.latency_mean(r) - om[r]) / om[r]
+            worst = max(worst, rel)
+        rows.append(
+            (
+                {"protocol": "epaxos", "n": 5, "f": 2, "conflict": conflict},
+                res,
+            )
+        )
+    report["epaxos_worst_rel_err"] = worst
+    assert worst <= TOLERANCE, f"EPaxos device-vs-oracle {worst:.3%} > 2%"
+    conflict_latency_plot(
+        curves,
+        conflicts,
+        str(plots / "epaxos_conflict_sweep.png"),
+        title="EPaxos n=5 — mean latency vs conflict (device vs oracle)",
+    )
+
+    # -- config 3: Atlas vs Tempo, f ∈ {1,2} --------------------------
+    curves3 = {}
+    series_bars = {}
+    worst3 = 0.0
+    for name in ("atlas", "tempo"):
+        for f in (1, 2):
+            cfgs = [(config_for(name, 5, f), c) for c in conflicts]
+            eng = engine_results(name, cfgs, commands, cpr, REGIONS5)
+            ys = []
+            for (config, conflict), res in zip(cfgs, eng):
+                assert not res.err, (name, f, conflict, res.err_cause)
+                om = oracle_means(
+                    name, config, conflict, commands, cpr, REGIONS5
+                )
+                for r in REGIONS5:
+                    rel = abs(res.latency_mean(r) - om[r]) / om[r]
+                    worst3 = max(worst3, rel)
+                ys.append(sum(res.latency_mean(r) for r in REGIONS5) / 5)
+                rows.append(
+                    (
+                        {
+                            "protocol": name,
+                            "n": 5,
+                            "f": f,
+                            "conflict": conflict,
+                        },
+                        res,
+                    )
+                )
+                if conflict == 100:
+                    series_bars[f"{name} f={f}"] = res
+            curves3[f"{name} f={f}"] = ys
+    report["atlas_tempo_worst_rel_err"] = worst3
+    assert worst3 <= TOLERANCE, f"Atlas/Tempo {worst3:.3%} > 2%"
+    conflict_latency_plot(
+        curves3,
+        conflicts,
+        str(plots / "atlas_vs_tempo.png"),
+        title="Atlas vs Tempo n=5 — mean latency vs conflict",
+    )
+    latency_bar_plot(
+        series_bars,
+        REGIONS5,
+        str(plots / "atlas_vs_tempo_regions.png"),
+        title="Atlas vs Tempo n=5, conflict 100% — per-region latency",
+    )
+    cdf_plot(
+        series_bars,
+        str(plots / "atlas_vs_tempo_cdf.png"),
+        title="Atlas vs Tempo n=5, conflict 100% — latency CDF",
+    )
+
+    save_results(plots / "accuracy_results.jsonl", rows)
+    report["tolerance"] = TOLERANCE
+    report["commands_per_client"] = commands
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Scale stress — BASELINE config 5: Tempo n=7..11, Zipf keys, ~100k
+commands per lane on device.
+
+This forces what small diff tests never touch: dot-slot recycling (the
+per-source window D turns over total/n ≈ 10k+ times), pool turnover,
+interval-set GC under sustained load, and Zipf key skew. Overflow of
+any bound surfaces as a named per-lane error; readiness-gate stalls
+(undersized D) surface as a requeue count.
+
+Usage: python tools/stress.py [--n 9] [--commands 100000] [--quick]
+Prints one JSON line per lane + a summary line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from fantoch_tpu.core import Config, Planet  # noqa: E402
+from fantoch_tpu.engine import EngineDims  # noqa: E402
+from fantoch_tpu.engine.protocols import TempoDev  # noqa: E402
+from fantoch_tpu.engine.spec import make_lane  # noqa: E402
+from fantoch_tpu.parallel.sweep import run_sweep  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=9)
+    ap.add_argument("--commands", type=int, default=100_000,
+                    help="total commands per lane")
+    ap.add_argument("--clients-per-region", type=int, default=4)
+    ap.add_argument("--zipf-coefficient", type=float, default=0.7)
+    ap.add_argument("--zipf-keys", type=int, default=128)
+    ap.add_argument("--dot-slots", type=int, default=2048)
+    ap.add_argument("--quick", action="store_true",
+                    help="1/10th of the commands (CI-sized)")
+    args = ap.parse_args()
+
+    planet = Planet.new()
+    n = args.n
+    regions = planet.regions()[:n]
+    clients = n * args.clients_per_region
+    total = args.commands // (10 if args.quick else 1)
+    per_client = max(1, total // clients)
+
+    dev = TempoDev.for_load(keys=args.zipf_keys, clients=clients)
+    dims = EngineDims.for_protocol(
+        dev,
+        n=n,
+        clients=clients,
+        payload=dev.payload_width(n),
+        # recycled windows, sized for GC lag not lifetime totals — the
+        # whole point of the stress; overflow is loud (ERR_*/requeues)
+        dot_slots=args.dot_slots,
+        regions=n,
+        hist_buckets=2048,
+    )
+    config = Config(
+        n=n, f=1, gc_interval_ms=100, tempo_detached_send_interval_ms=100
+    )
+    spec = make_lane(
+        dev,
+        planet,
+        config,
+        conflict_rate=0,  # zipf generator decides contention instead
+        zipf=(args.zipf_coefficient, args.zipf_keys),
+        commands_per_client=per_client,
+        clients_per_region=args.clients_per_region,
+        process_regions=regions,
+        client_regions=regions,
+        dims=dims,
+    )
+
+    t0 = time.perf_counter()
+    res = run_sweep(dev, dims, [spec], segment_steps=4096)[0]
+    elapsed = time.perf_counter() - t0
+    report = {
+        "n": n,
+        "clients": clients,
+        "commands": per_client * clients,
+        "zipf": [args.zipf_coefficient, args.zipf_keys],
+        "dot_slots": args.dot_slots,
+        "completed": res.completed,
+        "steps": res.steps,
+        "pool_peak": res.pool_peak,
+        "requeues": res.requeues,
+        "err": res.err_cause,
+        "elapsed_s": round(elapsed, 1),
+        "steps_per_sec": round(res.steps / elapsed),
+        "mean_latency_ms": {
+            r: round(res.latency_mean(r), 1) for r in regions[:3]
+        },
+    }
+    print(json.dumps(report))
+    assert res.err == 0, res.err_cause
+    assert res.completed == per_client * clients
+
+
+if __name__ == "__main__":
+    main()
