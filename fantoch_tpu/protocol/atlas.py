@@ -137,6 +137,9 @@ class _AtlasInfo:
 
 
 class Atlas(Protocol):
+    # implements partial.rs's multi-shard coordination paths
+    PARTIAL_REPLICATION = True
+
     EXECUTOR = GraphExecutor
 
     def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config):

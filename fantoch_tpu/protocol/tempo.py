@@ -189,6 +189,9 @@ class _TempoInfo:
 
 
 class Tempo(Protocol):
+    # implements partial.rs's multi-shard coordination paths
+    PARTIAL_REPLICATION = True
+
     EXECUTOR = TableExecutor
 
     def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config):

@@ -22,7 +22,7 @@ from typing import Dict, List, Optional, Tuple, Type
 
 from ..client.client import Client
 from ..client.workload import Workload
-from ..core.command import Command, CommandResult
+from ..core.command import Command, CommandResult, CommandResultBuilder
 from ..core.config import Config
 from ..core.ids import ClientId, ProcessId, ShardId
 from ..core.metrics import Histogram
@@ -39,6 +39,9 @@ _SEND = 1
 _TO_CLIENT = 2
 _PERIODIC = 3
 _EXECUTED_NOTIFICATION = 4
+_EXECUTOR_INFO = 5       # cross-shard executor-to-executor message
+_TO_CLIENT_PARTIAL = 6   # per-key result partial (multi-shard mode)
+_EXECUTOR_CLEANUP = 7    # periodic executor cleanup tick (multi-shard)
 
 # client src keys rank after every process src key in same-instant
 # message tie-breaks (the engine encodes clients as N + client)
@@ -72,14 +75,27 @@ class Runner:
         # tie-break key
         self._chan_seq: Dict[Tuple[int, int], int] = {}
 
-        # single shard in the simulator (runner.rs:84-85)
-        shard_id: ShardId = 0
+        # the reference's sim is single-shard (runner.rs:84-85) and
+        # exercises partial replication only through its TCP run-layer
+        # tests (fantoch/src/run/mod.rs:575-849); here shard_count > 1
+        # places one process per (shard, region) — the run_test layout —
+        # with client-side result aggregation and WAN-delayed cross-
+        # shard executor messages
+        self.shard_count = config.shard_count
+        if self.shard_count > 1:
+            # only Tempo and Atlas implement the partial-replication
+            # paths (partial.rs's MForwardSubmit aggregation); anything
+            # else would hang waiting for the other shard's partials
+            assert getattr(protocol_cls, "PARTIAL_REPLICATION", False), (
+                f"{protocol_cls.__name__} does not support shard_count > 1"
+            )
         from ..core.ids import process_ids
 
         to_discover = [
-            (process_id, shard_id, region)
+            (process_id, shard, region)
+            for shard in range(self.shard_count)
             for region, process_id in zip(
-                process_regions, process_ids(shard_id, config.n)
+                process_regions, process_ids(shard, config.n)
             )
         ]
         self.process_to_region = {
@@ -88,6 +104,12 @@ class Runner:
 
         periodic: List[Tuple[ProcessId, object, int]] = []
         executed_notifications: List[Tuple[ProcessId, int]] = []
+        # per-process closest process of each shard (discovery view) —
+        # used to route cross-shard executor messages
+        self._closest: Dict[ProcessId, Dict[ShardId, ProcessId]] = {}
+        # multi-shard client-side aggregation (the run layer's
+        # task/client/pending.rs): rifl → partial-result builder
+        self._client_pending: Dict[object, object] = {}
 
         executor_cls = protocol_cls.EXECUTOR  # type: ignore[attr-defined]
         for process_id, shard, region in to_discover:
@@ -100,8 +122,19 @@ class Runner:
             sorted_ = sort_processes_by_distance(
                 region, planet, to_discover
             )
-            connect_ok, _ = process.discover(sorted_)
+            # discovery keeps all same-shard processes (in distance
+            # order) plus the closest process of each other shard
+            seen_shards = set()
+            filtered = []
+            for pid, sid in sorted_:
+                if sid == shard:
+                    filtered.append((pid, sid))
+                elif sid not in seen_shards:
+                    seen_shards.add(sid)
+                    filtered.append((pid, sid))
+            connect_ok, closest = process.discover(filtered)
             assert connect_ok
+            self._closest[process_id] = closest
             executor = executor_cls(process_id, shard, config)
             self.simulation.register_process(process, executor)
 
@@ -126,6 +159,14 @@ class Runner:
             self._schedule_periodic(process_id, event, delay)
         for process_id, delay in executed_notifications:
             self._schedule_executed_notification(process_id, delay)
+        if self.shard_count > 1:
+            # periodic executor cleanup retries buffered cross-shard
+            # requests (the run layer's cleanup tick,
+            # task/server/executor.rs:281-330)
+            for process_id in self.process_to_region:
+                self._schedule_executor_cleanup(
+                    process_id, config.executor_cleanup_interval_ms
+                )
 
     # ------------------------------------------------------------------
 
@@ -166,7 +207,25 @@ class Runner:
             elif kind == _SEND:
                 _, from_, from_shard_id, process_id, msg = action
                 self._handle_send(from_, from_shard_id, process_id, msg)
-            elif kind == _TO_CLIENT:
+            elif kind == _EXECUTOR_INFO:
+                _, process_id, info = action
+                self._handle_executor_info(process_id, info)
+            elif kind == _EXECUTOR_CLEANUP:
+                _, process_id, delay = action
+                _, executor, _, _time = self.simulation.get_process(
+                    process_id
+                )
+                executor.cleanup(_time)
+                for schedule in self._drain_executor(process_id):
+                    schedule()
+                self._schedule_executor_cleanup(process_id, delay)
+            elif kind == _TO_CLIENT_PARTIAL:
+                _, client_id, executor_result = action
+                cmd_result = self._aggregate_partial(executor_result)
+                if cmd_result is not None:
+                    kind = _TO_CLIENT
+                    action = (_TO_CLIENT, client_id, cmd_result)
+            if kind == _TO_CLIENT:
                 _, client_id, cmd_result = action
                 submit = self.simulation.forward_to_client(cmd_result)
                 if submit is not None:
@@ -182,6 +241,20 @@ class Runner:
                         final_time = time.millis() + extra_sim_time_ms
             if final_time is not None and time.millis() > final_time:
                 return
+
+    def _aggregate_partial(self, executor_result):
+        """Client-side partial-result aggregation (the run layer's
+        task/client/pending.rs): complete once every key across every
+        shard reported."""
+        builder = self._client_pending.get(executor_result.rifl)
+        assert builder is not None, "partial for unregistered command"
+        builder.add_partial(
+            executor_result.key, executor_result.partial_results
+        )
+        if builder.ready():
+            del self._client_pending[executor_result.rifl]
+            return builder.build()
+        return None
 
     # -- action handlers (runner.rs:315-377) ----------------------------
 
@@ -203,7 +276,10 @@ class Runner:
         process, _executor, pending, time = self.simulation.get_process(
             process_id
         )
-        pending.wait_for(cmd)
+        if self.shard_count == 1:
+            # process-side aggregation (runner.rs:351-362); multi-shard
+            # registers client-side at submit-schedule time instead
+            pending.wait_for(cmd)
         process.submit(None, cmd, time)
         self._send_to_processes_and_executors(process_id)
 
@@ -211,6 +287,66 @@ class Runner:
         process, _, _, time = self.simulation.get_process(process_id)
         process.handle(from_, from_shard_id, msg, time)
         self._send_to_processes_and_executors(process_id)
+
+    def _handle_executor_info(self, process_id, info) -> None:
+        """Cross-shard executor message delivery (the run layer's
+        executor-to-executor channel, graph/mod.rs:279-330)."""
+        _, executor, _, time = self.simulation.get_process(process_id)
+        executor.handle(info, time)
+        for schedule in self._drain_executor(process_id):
+            schedule()
+
+    def _drain_executor(self, process_id: ProcessId):
+        """Deliver an executor's pending outputs: same-shard infos
+        inline, cross-shard infos and client results as *deferred*
+        schedule thunks — the caller flushes them after protocol
+        actions, preserving runner.rs:395-441's scheduling order."""
+        process, executor, pending, time = self.simulation.get_process(
+            process_id
+        )
+        shard_id = process.shard_id()
+        deferred = []
+        while True:
+            infos = executor.to_executors()
+            results = executor.to_clients()
+            if not infos and not results:
+                break
+            for to_shard, info in infos:
+                if to_shard == shard_id:
+                    executor.handle(info, time)
+                else:
+                    target = self._closest[process_id][to_shard]
+                    deferred.append(
+                        lambda t=target, i=info: self._schedule_message(
+                            ("process", process_id),
+                            ("process", t),
+                            (_EXECUTOR_INFO, t, i),
+                        )
+                    )
+            for executor_result in results:
+                if self.shard_count == 1:
+                    cmd_result = pending.add_executor_result(executor_result)
+                    if cmd_result is not None:
+                        deferred.append(
+                            lambda r=cmd_result: self._schedule_to_client(
+                                ("process", process_id), r
+                            )
+                        )
+                else:
+                    # only the client's connected process of this shard
+                    # reports (run/prelude.rs:35-40 registration)
+                    client_id = executor_result.rifl.source
+                    client, _ = self.simulation.get_client(client_id)
+                    if client.shard_process(shard_id) == process_id:
+                        deferred.append(
+                            lambda c=client_id, er=executor_result:
+                            self._schedule_message(
+                                ("process", process_id),
+                                ("client", c),
+                                (_TO_CLIENT_PARTIAL, c, er),
+                            )
+                        )
+        return deferred
 
     def _send_to_processes_and_executors(self, process_id: ProcessId) -> None:
         """runner.rs:395-441."""
@@ -221,23 +357,18 @@ class Runner:
 
         protocol_actions = process.to_processes()
 
-        ready: List[CommandResult] = []
+        deferred = []
         for info in process.to_executors():
             executor.handle(info, time)
-            # executor messages to self (single shard in sim)
-            for to_shard, self_info in executor.to_executors():
-                assert to_shard == shard_id
-                executor.handle(self_info, time)
-            for executor_result in executor.to_clients():
-                cmd_result = pending.add_executor_result(executor_result)
-                if cmd_result is not None:
-                    ready.append(cmd_result)
+            deferred.extend(self._drain_executor(process_id))
 
         self._schedule_protocol_actions(
             process_id, shard_id, ("process", process_id), protocol_actions
         )
-        for cmd_result in ready:
-            self._schedule_to_client(("process", process_id), cmd_result)
+        # client results and cross-shard infos schedule after protocol
+        # actions (runner.rs:421-440)
+        for schedule in deferred:
+            schedule()
 
     def _schedule_protocol_actions(
         self, process_id, shard_id, from_region, actions
@@ -276,6 +407,12 @@ class Runner:
     # -- scheduling (runner.rs:379-557) ---------------------------------
 
     def _schedule_submit(self, from_region, process_id, cmd) -> None:
+        if self.shard_count > 1:
+            # client-side aggregation registers before the submit leaves
+            # (client_server_task Register, run/task/server/client.rs)
+            self._client_pending[cmd.rifl] = CommandResultBuilder(
+                cmd.rifl, cmd.total_key_count()
+            )
         self._schedule_message(
             from_region, ("process", process_id), (_SUBMIT, process_id, cmd)
         )
@@ -319,6 +456,13 @@ class Runner:
     def _schedule_periodic(self, process_id, event, delay) -> None:
         self.schedule.schedule(
             self.simulation.time, delay, (_PERIODIC, process_id, event, delay)
+        )
+
+    def _schedule_executor_cleanup(self, process_id, delay) -> None:
+        self.schedule.schedule(
+            self.simulation.time,
+            delay,
+            (_EXECUTOR_CLEANUP, process_id, delay),
         )
 
     def _schedule_executed_notification(self, process_id, delay) -> None:

@@ -38,6 +38,8 @@ def main() -> None:
     ap.add_argument("--zipf-coefficient", type=float, default=0.7)
     ap.add_argument("--zipf-keys", type=int, default=128)
     ap.add_argument("--dot-slots", type=int, default=2048)
+    ap.add_argument("--pool", type=int, default=4096,
+                    help="message-pool capacity (ERR_POOL if exceeded)")
     ap.add_argument("--quick", action="store_true",
                     help="1/10th of the commands (CI-sized)")
     args = ap.parse_args()
@@ -58,6 +60,7 @@ def main() -> None:
         # recycled windows, sized for GC lag not lifetime totals — the
         # whole point of the stress; overflow is loud (ERR_*/requeues)
         dot_slots=args.dot_slots,
+        pool=args.pool,
         regions=n,
         hist_buckets=2048,
     )
@@ -86,6 +89,7 @@ def main() -> None:
         "commands": per_client * clients,
         "zipf": [args.zipf_coefficient, args.zipf_keys],
         "dot_slots": args.dot_slots,
+        "pool": args.pool,
         "completed": res.completed,
         "steps": res.steps,
         "pool_peak": res.pool_peak,
