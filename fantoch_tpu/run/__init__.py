@@ -1,0 +1,33 @@
+"""L5 real runtime: processes and clients over TCP (asyncio).
+
+Capability parity with ``fantoch/src/run/`` (run/mod.rs:97-447): a
+process binds a peer listener and a client listener, connects to every
+peer with a ``ProcessHi`` handshake, spawns reader/writer tasks per
+connection, a protocol worker loop, executor tasks routed by key hash,
+periodic-event tasks, a metrics logger and an execution logger; clients
+connect to the closest process per shard and drive closed- or open-loop
+workloads.
+
+Where the reference runs W parallel protocol workers over lock-free
+Atomic/Locked state (run/mod.rs:180-183 asserts ``workers > 1 ⇒
+P::parallel()``), the host protocols here are the *Sequential* variants,
+so the runtime enforces the same rule the reference does for them: one
+protocol worker per process. Executors follow ``Executor.parallel()``:
+key-hash-routed pools for table/basic executors, a single instance
+otherwise (executor/mod.rs:148-167).
+"""
+
+from .client import ClientHandle, client
+from .prelude import ClientHi, ProcessHi
+from .rw import Connection
+from .server import ProcessHandle, process
+
+__all__ = [
+    "ClientHandle",
+    "ClientHi",
+    "Connection",
+    "ProcessHandle",
+    "ProcessHi",
+    "client",
+    "process",
+]

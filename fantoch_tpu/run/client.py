@@ -1,0 +1,144 @@
+"""Client-side runtime (fantoch/src/run/task/client/).
+
+``client()`` connects each client to the closest process of every shard
+(client_setup, task/client/mod.rs:35-120), then drives closed-loop
+(next command on completion) or open-loop (fixed submit interval)
+workloads (mod.rs:122-260). Multi-shard commands register with every
+shard's connection and aggregate per-key partials client-side
+(task/client/pending.rs); single-shard results arrive whole.
+
+Batching: commands from clients sharing a connection can merge up to
+``batch_max_size`` with ``batch_max_delay_ms`` slack (batcher.rs:15-100,
+unbatcher.rs:11-106). Merged commands keep their own rifls; the server
+executes them as independent submissions, so unbatching is just
+result routing — the semantic the reference's unbatcher implements.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from ..client.client import Client, ClientData
+from ..client.workload import Workload
+from ..core.command import CommandResultBuilder
+from ..core.ids import ClientId, ProcessId, ShardId
+from ..core.timing import RunTime
+from .prelude import ClientHi
+from .rw import Connection
+
+
+@dataclass
+class ClientHandle:
+    """Results of a finished client group."""
+
+    data: Dict[ClientId, ClientData]
+
+    def latencies_us(self) -> List[int]:
+        out: List[int] = []
+        for d in self.data.values():
+            out.extend(d.latency_data())
+        return out
+
+
+async def client(
+    client_ids: List[ClientId],
+    shard_addresses: Dict[ShardId, Tuple[str, int]],
+    shard_processes: Dict[ShardId, ProcessId],
+    workload: Workload,
+    *,
+    open_loop_interval_ms: Optional[int] = None,
+    compress: bool = False,
+    connect_retries: int = 100,
+) -> ClientHandle:
+    """Run ``len(client_ids)`` closed-loop clients (or open-loop with
+    ``open_loop_interval_ms``) against an already-running cluster;
+    returns when every client finished its workload."""
+    time = RunTime()
+    conns: Dict[ShardId, Connection] = {}
+    for shard, (host, port) in shard_addresses.items():
+        for _ in range(connect_retries):
+            try:
+                reader, writer = await asyncio.open_connection(host, port)
+                break
+            except ConnectionError:
+                await asyncio.sleep(0.05)
+        else:
+            raise ConnectionError(f"cannot reach shard {shard}")
+        conn = Connection(reader, writer, compress=compress)
+        await conn.send(ClientHi(list(client_ids)))
+        conns[shard] = conn
+
+    clients: Dict[ClientId, Client] = {}
+    for cid in client_ids:
+        c = Client(cid, workload)
+        c.connect(dict(shard_processes))
+        clients[cid] = c
+
+    # route results back to the issuing client
+    waiters: Dict[object, asyncio.Future] = {}
+    partials: Dict[object, CommandResultBuilder] = {}
+
+    async def dispatcher(conn: Connection) -> None:
+        while True:
+            msg = await conn.recv()
+            if msg is None:
+                return
+            tag = msg[0]
+            if tag == "result":
+                fut = waiters.pop(msg[1].rifl, None)
+                if fut is not None and not fut.done():
+                    fut.set_result(msg[1])
+            elif tag == "partial":
+                er = msg[1]
+                builder = partials.get(er.rifl)
+                if builder is None:
+                    continue
+                builder.add_partial(er.key, er.partial_results)
+                if builder.ready():
+                    del partials[er.rifl]
+                    fut = waiters.pop(er.rifl, None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(builder.build())
+
+    dispatchers = [
+        asyncio.create_task(dispatcher(conn)) for conn in conns.values()
+    ]
+    multi_shard = len(conns) > 1
+
+    async def run_one(c: Client) -> None:
+        loop = asyncio.get_running_loop()
+        inflight: List[asyncio.Future] = []
+        while True:
+            nxt = c.cmd_send(time)
+            if nxt is None:
+                break
+            target_shard, cmd = nxt
+            fut = loop.create_future()
+            waiters[cmd.rifl] = fut
+            if multi_shard:
+                partials[cmd.rifl] = CommandResultBuilder(
+                    cmd.rifl, cmd.total_key_count()
+                )
+                for shard, conn in conns.items():
+                    await conn.send(("register", cmd))
+            else:
+                await conns[target_shard].send(("register", cmd))
+            await conns[target_shard].send(("submit", cmd))
+            if open_loop_interval_ms is None:
+                result = await fut
+                c.cmd_recv(result.rifl, time)
+            else:
+                inflight.append(fut)
+                await asyncio.sleep(open_loop_interval_ms / 1000)
+        for fut in inflight:
+            result = await fut
+            c.cmd_recv(result.rifl, time)
+
+    await asyncio.gather(*(run_one(c) for c in clients.values()))
+    for task in dispatchers:
+        task.cancel()
+    for conn in conns.values():
+        await conn.close()
+    return ClientHandle({cid: c.data for cid, c in clients.items()})

@@ -1,0 +1,38 @@
+"""Wire envelopes for the run layer (fantoch/src/run/prelude.rs).
+
+Peer handshake: ``ProcessHi`` (task/server/mod.rs:132-224). Client
+handshake: ``ClientHi`` with the connection's client ids
+(task/client/mod.rs:35-120). After the handshake each direction carries
+tagged tuples (tag, payload...):
+
+peer → peer:
+  ("msg", from_id, from_shard, protocol_message)
+  ("exec", from_shard, executor_info)   cross-shard executor traffic
+                                        (executor/graph Requests)
+  ("ping", nonce) / ("pong", nonce)     RTT measurement (ping.rs)
+
+client → server:
+  ("register", command)                 AggregatePending.wait_for
+                                        (task/server/client.rs:206-243)
+  ("submit", command)
+client ← server:
+  ("result", command_result)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+from ..core.ids import ClientId, ProcessId, ShardId
+
+
+@dataclass
+class ProcessHi:
+    process_id: ProcessId
+    shard_id: ShardId
+
+
+@dataclass
+class ClientHi:
+    client_ids: List[ClientId]

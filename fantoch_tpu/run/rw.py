@@ -1,0 +1,87 @@
+"""Framed connections (fantoch/src/run/rw/).
+
+Length-delimited frames (4-byte big-endian length prefix) carrying
+pickled payloads — the analog of the reference's tokio length-delimited
+codec + bincode (rw/mod.rs:21-90), with the same optional gzip
+compression and the same per-connection artificial-delay injection used
+to emulate WAN latency on localhost (rw/connection.rs:8-41,
+delay.rs:7-40).
+
+Pickle stands in for bincode: like the reference's, this wire format is
+for trusted cluster peers only.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import gzip
+import pickle
+import struct
+from typing import Any, Optional
+
+_LEN = struct.Struct(">I")
+
+
+class Connection:
+    """One framed, optionally delayed, optionally compressed stream."""
+
+    def __init__(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        delay_ms: int = 0,
+        compress: bool = False,
+    ):
+        self.reader = reader
+        self.writer = writer
+        self.delay_ms = delay_ms
+        self.compress = compress
+        self._wlock = asyncio.Lock()
+
+    @property
+    def peername(self):
+        return self.writer.get_extra_info("peername")
+
+    async def recv(self) -> Optional[Any]:
+        """Read one frame; None on clean EOF."""
+        try:
+            head = await self.reader.readexactly(_LEN.size)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            return None
+        (length,) = _LEN.unpack(head)
+        try:
+            body = await self.reader.readexactly(length)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            return None
+        if self.compress:
+            body = gzip.decompress(body)
+        msg = pickle.loads(body)
+        if self.delay_ms:
+            # the reference's delay_task holds messages for `delay` ms
+            # between the reader and the consumer (delay.rs:7-40)
+            await asyncio.sleep(self.delay_ms / 1000)
+        return msg
+
+    def send_bytes_nowait(self, body: bytes) -> None:
+        """Queue one pre-serialized frame (serialize-once fan-out, the
+        reference wraps the serialized message in an Arc —
+        task/server/process.rs:209-285)."""
+        self.writer.write(_LEN.pack(len(body)) + body)
+
+    async def send(self, msg: Any) -> None:
+        async with self._wlock:
+            self.send_bytes_nowait(self.serialize(msg))
+            await self.writer.drain()
+
+    def serialize(self, msg: Any) -> bytes:
+        body = pickle.dumps(msg)
+        if self.compress:
+            body = gzip.compress(body, compresslevel=1)
+        return body
+
+    async def close(self) -> None:
+        try:
+            self.writer.close()
+            await self.writer.wait_closed()
+        except (ConnectionError, OSError):
+            pass

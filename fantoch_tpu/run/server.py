@@ -1,0 +1,619 @@
+"""Process-side runtime (fantoch/src/run/mod.rs:97-416 and
+fantoch/src/run/task/server/).
+
+``process()`` boots one replica: peer listener + ``connect_to_all`` with
+a ``ProcessHi`` handshake, a reader task per peer connection, an
+optional ping round that sorts processes by RTT (ping.rs:13-100), the
+protocol worker loop (task/server/process.rs:96-300 — a select over
+peer messages, client submits, periodic events and executor
+notifications, here one work queue), executor tasks routed by key hash
+(task/server/executor.rs:52-150), a client listener with per-connection
+registration (task/server/client.rs:80-244), a periodic metrics logger
+(metrics_logger.rs) and an execution-info logger replayable by
+``tools/executor_replay.py`` (execution_logger.rs:11-60).
+
+One protocol worker per process: the host protocols are the reference's
+*Sequential* state variants, for which the reference enforces
+``workers == 1`` (run/mod.rs:180-183). Executor pools follow
+``Executor.parallel()`` with key-hash routing (executor/mod.rs:148-167).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import pickle
+import time as _time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Type
+
+from ..core.command import Command, CommandResultBuilder
+from ..core.config import Config
+from ..core.ids import ProcessId, Rifl, ShardId
+from ..core.timing import RunTime
+from ..core.util import key_hash
+from ..executor.base import AggregatePending, Executor
+from ..protocol.base import Protocol, ToForward, ToSend
+from .prelude import ClientHi, ProcessHi
+from .rw import Connection
+
+_GC_EXECUTOR = 0
+
+
+@dataclass
+class ProcessHandle:
+    """In-process view of a running replica — what the reference's
+    ``run_test_with_inspect_fun`` reads back over its inspect channel
+    (run/mod.rs:833-848)."""
+
+    process_id: ProcessId
+    shard_id: ShardId
+    protocol: Protocol
+    executors: List[Executor]
+    task: "asyncio.Task[None]" = None  # type: ignore[assignment]
+    stop_event: asyncio.Event = field(default_factory=asyncio.Event)
+    started: asyncio.Event = field(default_factory=asyncio.Event)
+
+    def metrics(self):
+        return self.protocol.metrics()
+
+    def executor_metrics(self):
+        return [e.metrics() for e in self.executors]
+
+    def monitors(self):
+        return [e.monitor() for e in self.executors if e.monitor() is not None]
+
+    async def stop(self) -> None:
+        self.stop_event.set()
+        if self.task is not None:
+            await self.task
+
+
+def _executor_pool(
+    protocol_cls: Type[Protocol],
+    process_id: ProcessId,
+    shard_id: ShardId,
+    config: Config,
+    executors: int,
+) -> List[Executor]:
+    executor_cls = protocol_cls.EXECUTOR  # type: ignore[attr-defined]
+    if not executor_cls.parallel():
+        assert executors == 1, (
+            f"{executor_cls.__name__} does not support executors > 1"
+        )
+    return [
+        executor_cls(process_id, shard_id, config) for _ in range(executors)
+    ]
+
+
+def _route_info(info: Any, executors: int) -> int:
+    """Key-hash routing (``MessageKey``, executor/mod.rs:148-167);
+    keyless info goes to the reserved executor 0."""
+    key = getattr(info, "key", None)
+    if key is None or executors == 1:
+        return _GC_EXECUTOR
+    return key_hash(key) % executors
+
+
+async def process(
+    protocol_cls: Type[Protocol],
+    process_id: ProcessId,
+    shard_id: ShardId,
+    config: Config,
+    *,
+    peer_addresses: Dict[ProcessId, Tuple[str, int]],
+    peer_shards: Dict[ProcessId, ShardId],
+    peer_sock=None,
+    client_sock=None,
+    listen: Tuple[str, int] = None,
+    client_listen: Tuple[str, int] = None,
+    sorted_processes: Optional[Sequence[Tuple[ProcessId, ShardId]]] = None,
+    executors: int = 1,
+    delay_ms: int = 0,
+    compress: bool = False,
+    metrics_file: Optional[str] = None,
+    metrics_interval_ms: int = 1000,
+    execution_log: Optional[str] = None,
+    connect_retries: int = 100,
+) -> ProcessHandle:
+    """Boot a replica; returns a :class:`ProcessHandle` whose ``task``
+    completes after ``handle.stop_event`` is set and shutdown finishes.
+
+    Pass either pre-bound listening sockets (``peer_sock``/
+    ``client_sock`` — tests bind port 0 first so addresses are known
+    up front, like the reference's random localhost ports,
+    run/mod.rs:575-849) or ``listen``/``client_listen`` addresses.
+    ``peer_addresses`` maps every *other* process to its peer-listener
+    address; ``delay_ms`` injects the reference's artificial
+    per-connection delay (delay.rs:7-40)."""
+    protocol = protocol_cls(process_id, shard_id, config)
+    pool = _executor_pool(
+        protocol_cls, process_id, shard_id, config, executors
+    )
+    handle = ProcessHandle(process_id, shard_id, protocol, pool)
+    handle.task = asyncio.create_task(
+        _process_main(
+            protocol,
+            pool,
+            handle,
+            config,
+            peer_addresses=peer_addresses,
+            peer_shards=peer_shards,
+            peer_sock=peer_sock,
+            client_sock=client_sock,
+            listen=listen,
+            client_listen=client_listen,
+            sorted_processes=sorted_processes,
+            delay_ms=delay_ms,
+            compress=compress,
+            metrics_file=metrics_file,
+            metrics_interval_ms=metrics_interval_ms,
+            execution_log=execution_log,
+            connect_retries=connect_retries,
+        ),
+        name=f"process-{process_id}",
+    )
+    return handle
+
+
+async def _process_main(
+    protocol: Protocol,
+    pool: List[Executor],
+    handle: ProcessHandle,
+    config: Config,
+    **kw,
+) -> None:
+    rt = _Runtime(protocol, pool, handle, config, **kw)
+    try:
+        await rt.run()
+    finally:
+        await rt.shutdown()
+
+
+class _Runtime:
+    def __init__(
+        self,
+        protocol: Protocol,
+        pool: List[Executor],
+        handle: ProcessHandle,
+        config: Config,
+        *,
+        peer_addresses,
+        peer_shards,
+        peer_sock,
+        client_sock,
+        listen,
+        client_listen,
+        sorted_processes,
+        delay_ms,
+        compress,
+        metrics_file,
+        metrics_interval_ms,
+        execution_log,
+        connect_retries,
+    ):
+        self.protocol = protocol
+        self.pool = pool
+        self.handle = handle
+        self.config = config
+        self.process_id = handle.process_id
+        self.shard_id = handle.shard_id
+        self.time = RunTime()
+        self.peer_addresses = peer_addresses
+        self.peer_shards = peer_shards
+        self.peer_sock = peer_sock
+        self.client_sock = client_sock
+        self.peer_server = None
+        self.client_server = None
+        self.listen = listen
+        self.client_listen = client_listen
+        self.sorted_processes = sorted_processes
+        self.delay_ms = delay_ms
+        self.compress = compress
+        self.metrics_file = metrics_file
+        self.metrics_interval_ms = metrics_interval_ms
+        self.execution_log = execution_log
+        self.connect_retries = connect_retries
+
+        # the worker loop's single select queue (the reference's
+        # process_task selects over 4 channels; one queue keeps their
+        # arrival order total)
+        self.work: "asyncio.Queue[Tuple]" = asyncio.Queue()
+        self.exec_queues: List["asyncio.Queue[Tuple]"] = [
+            asyncio.Queue() for _ in pool
+        ]
+        # outgoing peer connections (sends ride these; receives ride the
+        # connections peers opened to us)
+        self.out: Dict[ProcessId, Connection] = {}
+        self.client_conns: Dict[int, Connection] = {}
+        self.client_pending: Dict[int, AggregatePending] = {}
+        # rifl → client-connection id that registered it
+        self.rifl_conn: Dict[Rifl, int] = {}
+        self.rifl_shard_conn: Dict[Rifl, int] = {}
+        self.tasks: List[asyncio.Task] = []
+        self.exec_log_fh = None
+        self._conn_seq = 0
+        self._rtt: Dict[ProcessId, float] = {}
+
+    # -- bootstrap -----------------------------------------------------
+
+    async def run(self) -> None:
+        if self.execution_log:
+            self.exec_log_fh = open(self.execution_log, "ab")
+        await self._start_listeners()
+        await self._connect_to_all()
+        await self._ping_round()
+        self._discover()
+        self._start_tasks()
+        self.handle.started.set()
+        await self.handle.stop_event.wait()
+
+    async def _start_listeners(self) -> None:
+        if self.peer_sock is not None:
+            self.peer_server = await asyncio.start_server(
+                self._accept_peer, sock=self.peer_sock
+            )
+        else:
+            host, port = self.listen
+            self.peer_server = await asyncio.start_server(
+                self._accept_peer, host, port
+            )
+        if self.client_sock is not None:
+            self.client_server = await asyncio.start_server(
+                self._accept_client, sock=self.client_sock
+            )
+        else:
+            host, port = self.client_listen
+            self.client_server = await asyncio.start_server(
+                self._accept_client, host, port
+            )
+
+    async def _connect_to_all(self) -> None:
+        """Open one outgoing connection per peer, say hi
+        (task/server/mod.rs:40-224; incoming connections carry the
+        peer's sends to us)."""
+        for peer, (host, port) in self.peer_addresses.items():
+            for attempt in range(self.connect_retries):
+                try:
+                    reader, writer = await asyncio.open_connection(host, port)
+                    break
+                except ConnectionError:
+                    await asyncio.sleep(0.05)
+            else:
+                raise ConnectionError(f"cannot reach peer {peer}")
+            conn = Connection(reader, writer, compress=self.compress)
+            await conn.send(ProcessHi(self.process_id, self.shard_id))
+            self.out[peer] = conn
+
+    async def _accept_peer(self, reader, writer) -> None:
+        conn = Connection(
+            reader, writer, delay_ms=self.delay_ms, compress=self.compress
+        )
+        hi = await conn.recv()
+        if not isinstance(hi, ProcessHi):
+            await conn.close()
+            return
+        self.tasks.append(
+            asyncio.create_task(
+                self._peer_reader(hi.process_id, hi.shard_id, conn),
+                name=f"reader-{self.process_id}<-{hi.process_id}",
+            )
+        )
+
+    async def _ping_round(self) -> None:
+        """One RTT measurement per peer (ping.rs:13-100); used for
+        RTT-sorted discovery when ``sorted_processes`` is not given."""
+        for peer, conn in self.out.items():
+            t0 = _time.monotonic()
+            await conn.send(("ping", t0))
+            # pongs come back on the incoming connection; readers fill
+            # self._rtt. Give them a moment without blocking the boot on
+            # a slow peer.
+        if self.sorted_processes is None:
+            deadline = _time.monotonic() + 1.0
+            while (
+                len(self._rtt) < len(self.out)
+                and _time.monotonic() < deadline
+            ):
+                await asyncio.sleep(0.01)
+
+    def _discover(self) -> None:
+        if self.sorted_processes is not None:
+            sorted_ps = list(self.sorted_processes)
+        else:
+            by_rtt = sorted(
+                self.out, key=lambda p: self._rtt.get(p, float("inf"))
+            )
+            sorted_ps = [(self.process_id, self.shard_id)] + [
+                (p, self.peer_shards[p]) for p in by_rtt
+            ]
+        connected, _ = self.protocol.discover(sorted_ps)
+        assert connected, "discovery failed: quorum unavailable"
+
+    def _start_tasks(self) -> None:
+        t = self.tasks.append
+        t(asyncio.create_task(self._worker_loop(), name="worker"))
+        for i in range(len(self.pool)):
+            t(
+                asyncio.create_task(
+                    self._executor_loop(i), name=f"executor-{i}"
+                )
+            )
+        for event, interval in self.protocol.periodic_events():
+            t(
+                asyncio.create_task(
+                    self._periodic_loop(event, interval),
+                    name=f"periodic-{event}",
+                )
+            )
+        t(
+            asyncio.create_task(
+                self._executed_notification_loop(),
+                name="executed-notification",
+            )
+        )
+        cleanup = self.config.executor_cleanup_interval_ms
+        if cleanup:
+            t(
+                asyncio.create_task(
+                    self._executor_cleanup_loop(cleanup), name="cleanup"
+                )
+            )
+        if self.metrics_file:
+            t(
+                asyncio.create_task(
+                    self._metrics_logger_loop(), name="metrics-logger"
+                )
+            )
+
+    # -- readers -------------------------------------------------------
+
+    async def _peer_reader(self, peer, peer_shard, conn: Connection) -> None:
+        while True:
+            msg = await conn.recv()
+            if msg is None:
+                return
+            tag = msg[0]
+            if tag == "msg":
+                _, from_id, from_shard, pmsg = msg
+                await self.work.put(("msg", from_id, from_shard, pmsg))
+            elif tag == "exec":
+                _, from_shard, info = msg
+                await self.exec_queues[
+                    _route_info(info, len(self.pool))
+                ].put(("info", info))
+            elif tag == "ping":
+                out = self.out.get(peer)
+                if out is not None:
+                    await out.send(("pong", msg[1]))
+            elif tag == "pong":
+                self._rtt[peer] = _time.monotonic() - msg[1]
+
+    async def _accept_client(self, reader, writer) -> None:
+        conn = Connection(
+            reader, writer, delay_ms=self.delay_ms, compress=self.compress
+        )
+        hi = await conn.recv()
+        if not isinstance(hi, ClientHi):
+            await conn.close()
+            return
+        self._conn_seq += 1
+        conn_id = self._conn_seq
+        self.client_conns[conn_id] = conn
+        self.client_pending[conn_id] = AggregatePending(
+            self.process_id, self.shard_id
+        )
+        self.tasks.append(
+            asyncio.create_task(
+                self._client_reader(conn_id, conn),
+                name=f"client-conn-{conn_id}",
+            )
+        )
+
+    async def _client_reader(self, conn_id: int, conn: Connection) -> None:
+        """task/server/client.rs:80-244: Register wires the rifl to this
+        connection; Submit hands the command to the worker."""
+        while True:
+            msg = await conn.recv()
+            if msg is None:
+                self.client_conns.pop(conn_id, None)
+                return
+            tag = msg[0]
+            if tag == "register":
+                cmd: Command = msg[1]
+                self.rifl_conn[cmd.rifl] = conn_id
+                if self.config.shard_count == 1:
+                    self.client_pending[conn_id].wait_for(cmd)
+                else:
+                    # multi-shard: every shard's connected process sends
+                    # partials; this side only tracks which connection
+                    # wants them (client aggregates)
+                    self.rifl_shard_conn[cmd.rifl] = conn_id
+            elif tag == "submit":
+                await self.work.put(("submit", msg[1]))
+
+    # -- the protocol worker -------------------------------------------
+
+    async def _worker_loop(self) -> None:
+        while True:
+            item = await self.work.get()
+            tag = item[0]
+            if tag == "msg":
+                _, from_id, from_shard, pmsg = item
+                self.protocol.handle(from_id, from_shard, pmsg, self.time)
+            elif tag == "submit":
+                self.protocol.submit(None, item[1], self.time)
+            elif tag == "periodic":
+                self.protocol.handle_event(item[1], self.time)
+            elif tag == "executed":
+                self.protocol.handle_executed(item[1], self.time)
+            await self._send_to_processes_and_executors()
+
+    async def _send_to_processes_and_executors(self) -> None:
+        """task/server/process.rs:209-285: ToSend fans out over writer
+        connections with one serialization, ToForward re-enters the work
+        queue, execution info routes to the executor pool by key."""
+        actions = self.protocol.to_processes()
+        for info in self.protocol.to_executors():
+            await self.exec_queues[_route_info(info, len(self.pool))].put(
+                ("info", info)
+            )
+        for action in actions:
+            if isinstance(action, ToForward):
+                await self.work.put(
+                    ("msg", self.process_id, self.shard_id, action.msg)
+                )
+                continue
+            assert isinstance(action, ToSend)
+            targets = sorted(action.target)
+            wire = None
+            for to in targets:
+                if to == self.process_id:
+                    msg = (
+                        copy.deepcopy(action.msg)
+                        if len(targets) > 1
+                        else action.msg
+                    )
+                    await self.work.put(
+                        ("msg", self.process_id, self.shard_id, msg)
+                    )
+                else:
+                    conn = self.out[to]
+                    if wire is None:
+                        wire = conn.serialize(
+                            (
+                                "msg",
+                                self.process_id,
+                                self.shard_id,
+                                action.msg,
+                            )
+                        )
+                    conn.send_bytes_nowait(wire)
+        for to in {t for a in actions if isinstance(a, ToSend)
+                   for t in a.target if t != self.process_id}:
+            await self.out[to].writer.drain()
+
+    # -- executors -----------------------------------------------------
+
+    async def _executor_loop(self, idx: int) -> None:
+        """task/server/executor.rs:52-150."""
+        executor = self.pool[idx]
+        queue = self.exec_queues[idx]
+        while True:
+            item = await queue.get()
+            tag = item[0]
+            if tag == "info":
+                if self.exec_log_fh is not None:
+                    pickle.dump(item[1], self.exec_log_fh)
+                executor.handle(item[1], self.time)
+            elif tag == "cleanup":
+                executor.cleanup(self.time)
+            await self._drain_executor(executor)
+
+    async def _drain_executor(self, executor: Executor) -> None:
+        while True:
+            infos = executor.to_executors()
+            results = executor.to_clients()
+            if not infos and not results:
+                return
+            for to_shard, info in infos:
+                if to_shard == self.shard_id:
+                    await self.exec_queues[
+                        _route_info(info, len(self.pool))
+                    ].put(("info", info))
+                else:
+                    target = self.protocol.bp.closest_process(to_shard)
+                    await self.out[target].send(
+                        ("exec", self.shard_id, info)
+                    )
+            for er in results:
+                await self._to_client(er)
+
+    async def _to_client(self, executor_result) -> None:
+        rifl = executor_result.rifl
+        if self.config.shard_count == 1:
+            conn_id = self.rifl_conn.get(rifl)
+            if conn_id is None:
+                return  # registered at another process of this shard
+            pending = self.client_pending[conn_id]
+            cmd_result = pending.add_executor_result(executor_result)
+            if cmd_result is not None:
+                self.rifl_conn.pop(rifl, None)
+                conn = self.client_conns.get(conn_id)
+                if conn is not None:
+                    await conn.send(("result", cmd_result))
+        else:
+            conn_id = self.rifl_shard_conn.get(rifl)
+            if conn_id is None:
+                return
+            conn = self.client_conns.get(conn_id)
+            if conn is not None:
+                await conn.send(("partial", executor_result))
+
+    # -- periodic tasks ------------------------------------------------
+
+    async def _periodic_loop(self, event, interval_ms: int) -> None:
+        while True:
+            await asyncio.sleep(interval_ms / 1000)
+            await self.work.put(("periodic", event))
+
+    async def _executed_notification_loop(self) -> None:
+        interval = self.config.executor_executed_notification_interval_ms
+        while True:
+            await asyncio.sleep(interval / 1000)
+            for executor in self.pool:
+                executed = executor.executed(self.time)
+                if executed is not None:
+                    await self.work.put(("executed", executed))
+
+    async def _executor_cleanup_loop(self, interval_ms: int) -> None:
+        while True:
+            await asyncio.sleep(interval_ms / 1000)
+            for q in self.exec_queues:
+                await q.put(("cleanup",))
+
+    async def _metrics_logger_loop(self) -> None:
+        """metrics_logger.rs: periodic (worker, metrics) snapshots."""
+        while True:
+            await asyncio.sleep(self.metrics_interval_ms / 1000)
+            self._dump_metrics()
+
+    def _dump_metrics(self) -> None:
+        with open(self.metrics_file, "wb") as fh:
+            pickle.dump(
+                {
+                    "process_id": self.process_id,
+                    "shard_id": self.shard_id,
+                    "protocol": self.protocol.metrics(),
+                    "executors": [e.metrics() for e in self.pool],
+                },
+                fh,
+            )
+
+    # -- shutdown ------------------------------------------------------
+
+    async def shutdown(self) -> None:
+        if self.metrics_file:
+            self._dump_metrics()
+        for task in self.tasks:
+            task.cancel()
+        for task in self.tasks:
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+        for conn in list(self.out.values()) + list(
+            self.client_conns.values()
+        ):
+            try:
+                await asyncio.wait_for(conn.close(), timeout=1)
+            except (asyncio.TimeoutError, Exception):
+                pass
+        for server in (self.peer_server, self.client_server):
+            if server is not None:
+                # not wait_closed(): in 3.12 it blocks until every
+                # handler connection closes, which deadlocks a cluster
+                # stopping replica by replica
+                server.close()
+        if self.exec_log_fh is not None:
+            self.exec_log_fh.close()

@@ -162,8 +162,12 @@ class Runner:
         if self.shard_count > 1:
             # periodic executor cleanup retries buffered cross-shard
             # requests (the run layer's cleanup tick,
-            # task/server/executor.rs:281-330)
+            # task/server/executor.rs:281-330); skip executors whose
+            # cleanup is the base-class no-op (e.g. Tempo's table)
             for process_id in self.process_to_region:
+                _, executor, _, _ = self.simulation.get_process(process_id)
+                if type(executor).cleanup is Executor.cleanup:
+                    continue
                 self._schedule_executor_cleanup(
                     process_id, config.executor_cleanup_interval_ms
                 )

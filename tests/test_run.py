@@ -1,0 +1,224 @@
+"""Run-layer tests: the full TCP stack inside one process.
+
+The analog of the reference's ``run_test`` (fantoch/src/run/mod.rs:
+575-849 boots n [× shard_count] real processes on random localhost
+ports plus real clients; fantoch_ps/src/protocol/mod.rs:579-637 wraps
+it per protocol): every replica and client here runs over real asyncio
+TCP connections with artificial per-connection delays, and the checks
+are the same — identical per-key execution order on every replica,
+complete GC, sane fast/slow-path counts.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+
+import pytest
+
+from fantoch_tpu.client import ConflictPool, Workload
+from fantoch_tpu.core import Config
+from fantoch_tpu.core.ids import process_ids
+from fantoch_tpu.protocol import Atlas, Basic, Caesar, EPaxos, FPaxos, Tempo
+from fantoch_tpu.run import client as run_client
+from fantoch_tpu.run import process as run_process
+
+from harness import check_metrics, check_monitors, extract_process_metrics
+
+COMMANDS = 10
+CLIENTS_PER_PROCESS = 2
+
+
+def _bind() -> socket.socket:
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind(("127.0.0.1", 0))
+    return s
+
+
+async def _boot_cluster(protocol_cls, config, delay_ms=1):
+    """Start config.n × config.shard_count replicas on pre-bound
+    localhost ports; returns (handles, client_addresses)."""
+    ids = [
+        (pid, shard)
+        for shard in range(config.shard_count)
+        for pid in process_ids(shard, config.n)
+    ]
+    peer_socks = {pid: _bind() for pid, _ in ids}
+    client_socks = {pid: _bind() for pid, _ in ids}
+    peer_addr = {
+        pid: ("127.0.0.1", sock.getsockname()[1])
+        for pid, sock in peer_socks.items()
+    }
+    client_addr = {
+        pid: ("127.0.0.1", sock.getsockname()[1])
+        for pid, sock in client_socks.items()
+    }
+    shards = dict(ids)
+    handles = []
+    for pid, shard in ids:
+        # same-shard processes in id order, plus the co-located (same
+        # region index) process of every other shard — discovery expects
+        # exactly one closest process per remote shard (base.rs:57-131)
+        mine = process_ids(shard, config.n)
+        idx = mine.index(pid)
+        sorted_ps = [(pid, shard)] + [
+            (q, shard) for q in mine if q != pid
+        ] + [
+            (process_ids(s, config.n)[idx], s)
+            for s in range(config.shard_count)
+            if s != shard
+        ]
+        handles.append(
+            await run_process(
+                protocol_cls,
+                pid,
+                shard,
+                config,
+                peer_addresses={
+                    q: peer_addr[q] for q, _ in ids if q != pid
+                },
+                peer_shards={q: s for q, s in ids if q != pid},
+                peer_sock=peer_socks[pid],
+                client_sock=client_socks[pid],
+                sorted_processes=sorted_ps,
+                delay_ms=delay_ms,
+                executors=1,
+            )
+        )
+    await asyncio.gather(*(h.started.wait() for h in handles))
+    return handles, client_addr, shards
+
+
+async def _run_cluster(protocol_cls, config, keys_per_command=2):
+    config = config.with_(
+        executor_monitor_execution_order=True,
+        gc_interval_ms=25,
+        executor_executed_notification_interval_ms=25,
+        executor_cleanup_interval_ms=5,
+    )
+    handles, client_addr, shards = await _boot_cluster(protocol_cls, config)
+    workload = Workload(
+        shard_count=config.shard_count,
+        key_gen=ConflictPool(conflict_rate=50, pool_size=1),
+        keys_per_command=keys_per_command,
+        commands_per_client=COMMANDS,
+        payload_size=1,
+    )
+    # one client group per shard-0 process; multi-shard groups connect
+    # to the same region's process of every shard
+    groups = []
+    shard0 = [h for h in handles if h.shard_id == 0]
+    for i, h in enumerate(shard0):
+        cids = [
+            1 + i * CLIENTS_PER_PROCESS + j
+            for j in range(CLIENTS_PER_PROCESS)
+        ]
+        shard_processes = {0: h.process_id}
+        for shard in range(1, config.shard_count):
+            peer = process_ids(shard, config.n)[i]
+            shard_processes[shard] = peer
+        groups.append(
+            run_client(
+                cids,
+                {s: client_addr[p] for s, p in shard_processes.items()},
+                shard_processes,
+                workload,
+            )
+        )
+    results = await asyncio.gather(*groups)
+    total = COMMANDS * CLIENTS_PER_PROCESS * len(shard0)
+    for r in results:
+        assert all(
+            len(d.latency_data()) == COMMANDS for d in r.data.values()
+        )
+
+    # wait for GC to complete everywhere (the sim harness's
+    # extra_sim_time analog, bounded instead of fixed)
+    # each command is GC'd at the n processes of its dot's shard
+    # (test_sim_partial.py's `stable == n * total_cmds`); FPaxos GCs at
+    # the f+1 acceptors
+    expected = (config.f + 1 if protocol_cls is FPaxos else config.n) * total
+    for _ in range(100):
+        stable = sum(
+            extract_process_metrics(h.metrics())[2] for h in handles
+        )
+        if stable >= expected:
+            break
+        await asyncio.sleep(0.05)
+
+    per_process = {
+        h.process_id: extract_process_metrics(h.metrics())
+        for h in handles
+        if h.shard_id == 0
+    }
+    monitors = {}
+    for h in handles:
+        ms = h.monitors()
+        assert len(ms) == 1
+        monitors[(h.shard_id, h.process_id)] = ms[0]
+    for h in handles:
+        await h.stop()
+
+    # per-shard execution-order equality (each shard owns its keys);
+    # Basic is the toy protocol and promises no such thing (the
+    # reference's sim/run tests only check it for the real protocols)
+    if protocol_cls is not Basic:
+        for shard in range(config.shard_count):
+            check_monitors(
+                {
+                    pid: m
+                    for (s, pid), m in monitors.items()
+                    if s == shard
+                }
+            )
+    if config.shard_count == 1 and protocol_cls is not Basic:
+        check_metrics(
+            config, COMMANDS, CLIENTS_PER_PROCESS, per_process
+        )
+    else:
+        # Basic / multi-shard: GC completeness only (Basic commits are
+        # not fast/slow-path classified)
+        stable = sum(
+            extract_process_metrics(h.metrics())[2] for h in handles
+        )
+        assert stable >= expected, f"incomplete GC: {stable} < {expected}"
+
+
+def _run(protocol_cls, config, **kw):
+    asyncio.run(_run_cluster(protocol_cls, config, **kw))
+
+
+def test_run_basic():
+    _run(Basic, Config(n=3, f=1))
+
+
+def test_run_fpaxos():
+    _run(FPaxos, Config(n=3, f=1, leader=1))
+
+
+def test_run_tempo():
+    _run(Tempo, Config(n=3, f=1, tempo_detached_send_interval_ms=25))
+
+
+def test_run_atlas():
+    _run(Atlas, Config(n=3, f=1))
+
+
+def test_run_epaxos():
+    _run(EPaxos, Config(n=3, f=1))
+
+
+def test_run_caesar():
+    _run(Caesar, Config(n=3, f=1, caesar_wait_condition=True))
+
+
+def test_run_tempo_partial_replication():
+    _run(
+        Tempo,
+        Config(n=3, f=1, shard_count=2, tempo_detached_send_interval_ms=25),
+    )
+
+
+def test_run_atlas_partial_replication():
+    _run(Atlas, Config(n=3, f=1, shard_count=2))

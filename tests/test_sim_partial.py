@@ -11,8 +11,8 @@ invariants run deterministically:
 - per-shard linearizability-ish check: all n processes of a shard
   record identical per-key execution orders;
 - commit accounting: each command commits once per touched shard, so
-  total commits ∈ [cmds, cmds × shards]; GC frees every commit at all
-  n processes of its shard (stable == n × commits).
+  total commits ∈ [cmds, cmds × shards]; stability is counted per
+  command at its dot's (target) shard, so stable == n × cmds.
 """
 
 import pytest
