@@ -1,0 +1,13 @@
+"""Native (C++) runtime components, bound via ctypes.
+
+The reference implements its intra-process concurrency primitives in
+native code (Rust: the lock-free ``AtomicKeyClocks`` sequencer and the
+sharded ``SharedMap``); the analogs here are C++ (see keyclocks.cpp),
+compiled on first use with the toolchain's g++ and cached next to the
+source. ``pybind11`` is not available in this image, so the boundary is
+a plain C ABI + ctypes.
+"""
+
+from .keyclocks import AtomicKeyClocks, available, stress
+
+__all__ = ["AtomicKeyClocks", "available", "stress"]

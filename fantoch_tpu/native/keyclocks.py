@@ -1,0 +1,164 @@
+"""ctypes binding for the native atomic key-clock sequencer
+(keyclocks.cpp — the ``AtomicKeyClocks`` + ``SharedMap`` analog,
+atomic.rs:13-90, shared.rs:18-112).
+
+Keys are integers here (the sequencer benchmark's universe); the Python
+`SequentialKeyClocks` (protocol/table.py) remains the canonical
+string-keyed variant used by the oracle protocols.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+from typing import List, Optional, Tuple
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "keyclocks.cpp")
+
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+u64 = ctypes.c_uint64
+u64p = ctypes.POINTER(ctypes.c_uint64)
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    """Compile (once per source hash) and load the shared library."""
+    global _lib, _build_error
+    if _lib is not None or _build_error is not None:
+        return _lib
+    with open(_SRC, "rb") as fh:
+        tag = hashlib.sha256(fh.read()).hexdigest()[:16]
+    so = os.path.join(_DIR, f"_keyclocks_{tag}.so")
+    if not os.path.exists(so):
+        tmp = so + f".tmp{os.getpid()}"
+        cmd = [
+            "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+            _SRC, "-o", tmp,
+        ]
+        try:
+            subprocess.run(
+                cmd, check=True, capture_output=True, timeout=120
+            )
+            os.replace(tmp, so)
+        except (OSError, subprocess.SubprocessError) as e:
+            _build_error = f"native build failed: {e}"
+            return None
+    lib = ctypes.CDLL(so)
+    lib.kc_new.restype = ctypes.c_void_p
+    lib.kc_new.argtypes = [u64]
+    lib.kc_free.argtypes = [ctypes.c_void_p]
+    lib.kc_clock.restype = u64
+    lib.kc_clock.argtypes = [ctypes.c_void_p, u64]
+    lib.kc_proposal.restype = u64
+    lib.kc_proposal.argtypes = [
+        ctypes.c_void_p, u64p, u64, u64, u64p, u64, u64p,
+    ]
+    lib.kc_detached.restype = u64
+    lib.kc_detached.argtypes = [
+        ctypes.c_void_p, u64p, u64, u64, u64p, u64, u64p,
+    ]
+    lib.kc_stress.restype = ctypes.c_int32
+    lib.kc_stress.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32, u64, u64, ctypes.c_uint32,
+        u64, u64p,
+    ]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class AtomicKeyClocks:
+    """Integer-keyed atomic key clocks; safe to share across Python
+    threads (the GIL is released during native calls)."""
+
+    def __init__(self, capacity: int):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(_build_error or "native library unavailable")
+        self._lib = lib
+        self._h = lib.kc_new(capacity)
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.kc_free(self._h)
+            self._h = None
+
+    def clock(self, key: int) -> int:
+        return self._lib.kc_clock(self._h, key)
+
+    def proposal(
+        self, keys: List[int], min_clock: int = 0
+    ) -> Tuple[int, List[Tuple[int, int, int]]]:
+        """Two-round bump; returns (clock, [(key, start, end) votes])."""
+        nk = len(keys)
+        arr = (u64 * nk)(*keys)
+        cap = 3 * 2 * nk
+        out = (u64 * cap)()
+        out_n = u64(0)
+        clock = self._lib.kc_proposal(
+            self._h, arr, nk, min_clock, out, cap, ctypes.byref(out_n)
+        )
+        if clock == 0:
+            raise RuntimeError("key table full or vote buffer overflow")
+        n = out_n.value
+        return clock, [
+            (out[3 * i], out[3 * i + 1], out[3 * i + 2]) for i in range(n)
+        ]
+
+    def detached(
+        self, keys: List[int], up_to: int
+    ) -> List[Tuple[int, int, int]]:
+        nk = len(keys)
+        arr = (u64 * nk)(*keys)
+        cap = 3 * nk
+        out = (u64 * cap)()
+        out_n = u64(0)
+        ok = self._lib.kc_detached(
+            self._h, arr, nk, up_to, out, cap, ctypes.byref(out_n)
+        )
+        if not ok:
+            raise RuntimeError("key table full or vote buffer overflow")
+        return [
+            (out[3 * i], out[3 * i + 1], out[3 * i + 2])
+            for i in range(out_n.value)
+        ]
+
+    def stress(
+        self,
+        threads: int,
+        ops_per_thread: int,
+        key_count: int,
+        keys_per_op: int = 2,
+        seed: int = 0,
+    ) -> Tuple[bool, float]:
+        """Hammer + verify (the reference's multi-thread test); returns
+        (invariants_held, elapsed_seconds)."""
+        ns = u64(0)
+        ok = self._lib.kc_stress(
+            self._h,
+            threads,
+            ops_per_thread,
+            key_count,
+            keys_per_op,
+            seed,
+            ctypes.byref(ns),
+        )
+        return bool(ok), ns.value / 1e9
+
+
+def stress(
+    threads: int,
+    ops_per_thread: int,
+    key_count: int = 100,
+    keys_per_op: int = 2,
+    seed: int = 0,
+) -> Tuple[bool, float]:
+    kc = AtomicKeyClocks(key_count)
+    return kc.stress(threads, ops_per_thread, key_count, keys_per_op, seed)

@@ -109,7 +109,13 @@ async def client(
 
     async def run_one(c: Client) -> None:
         loop = asyncio.get_running_loop()
-        inflight: List[asyncio.Future] = []
+        inflight: List[asyncio.Task] = []
+
+        async def record(fut: asyncio.Future) -> None:
+            # latency is measured at completion time, not at drain time
+            result = await fut
+            c.cmd_recv(result.rifl, time)
+
         while True:
             nxt = c.cmd_send(time)
             if nxt is None:
@@ -127,14 +133,12 @@ async def client(
                 await conns[target_shard].send(("register", cmd))
             await conns[target_shard].send(("submit", cmd))
             if open_loop_interval_ms is None:
-                result = await fut
-                c.cmd_recv(result.rifl, time)
+                await record(fut)
             else:
-                inflight.append(fut)
+                inflight.append(asyncio.create_task(record(fut)))
                 await asyncio.sleep(open_loop_interval_ms / 1000)
-        for fut in inflight:
-            result = await fut
-            c.cmd_recv(result.rifl, time)
+        for task in inflight:
+            await task
 
     await asyncio.gather(*(run_one(c) for c in clients.values()))
     for task in dispatchers:

@@ -229,7 +229,19 @@ class _Runtime:
         self.client_pending: Dict[int, AggregatePending] = {}
         # rifl → client-connection id that registered it
         self.rifl_conn: Dict[Rifl, int] = {}
-        self.rifl_shard_conn: Dict[Rifl, int] = {}
+        # multi-shard: rifl → [conn id, partials still expected from
+        # this shard] (entries drop at 0 — partial counts are known
+        # from the command's key set)
+        self.rifl_shard_conn: Dict[Rifl, List[int]] = {}
+        # multi-shard partials that raced ahead of their register
+        # (cross-connection ordering is not guaranteed: the client
+        # registers on shard B's connection while submitting on shard
+        # A's). Entries are (monotonic time, result); a sweeper evicts
+        # entries no register ever claims — every process of a shard
+        # executes every command, but only the client's connected
+        # process has a register for it.
+        self.partial_buf: Dict[Rifl, List[Tuple[float, Any]]] = {}
+        self.partial_buf_ttl_s = 10.0
         self.tasks: List[asyncio.Task] = []
         self.exec_log_fh = None
         self._conn_seq = 0
@@ -365,6 +377,12 @@ class _Runtime:
                     self._metrics_logger_loop(), name="metrics-logger"
                 )
             )
+        if self.config.shard_count > 1:
+            t(
+                asyncio.create_task(
+                    self._partial_buf_sweeper(), name="partial-sweeper"
+                )
+            )
 
     # -- readers -------------------------------------------------------
 
@@ -383,9 +401,15 @@ class _Runtime:
                     _route_info(info, len(self.pool))
                 ].put(("info", info))
             elif tag == "ping":
-                out = self.out.get(peer)
-                if out is not None:
-                    await out.send(("pong", msg[1]))
+                # a ping can arrive while our own connect_to_all is
+                # still retrying; wait (bounded) for the outgoing
+                # connection instead of dropping the pong
+                for _ in range(200):
+                    out = self.out.get(peer)
+                    if out is not None:
+                        await out.send(("pong", msg[1]))
+                        break
+                    await asyncio.sleep(0.01)
             elif tag == "pong":
                 self._rtt[peer] = _time.monotonic() - msg[1]
 
@@ -421,14 +445,19 @@ class _Runtime:
             tag = msg[0]
             if tag == "register":
                 cmd: Command = msg[1]
-                self.rifl_conn[cmd.rifl] = conn_id
                 if self.config.shard_count == 1:
+                    self.rifl_conn[cmd.rifl] = conn_id
                     self.client_pending[conn_id].wait_for(cmd)
                 else:
                     # multi-shard: every shard's connected process sends
                     # partials; this side only tracks which connection
-                    # wants them (client aggregates)
-                    self.rifl_shard_conn[cmd.rifl] = conn_id
+                    # wants them (client aggregates). Commands that do
+                    # not touch this shard produce no partials here.
+                    expected = cmd.key_count(self.shard_id)
+                    if expected:
+                        self.rifl_shard_conn[cmd.rifl] = [conn_id, expected]
+                        for _, er in self.partial_buf.pop(cmd.rifl, []):
+                            await self._to_client(er)
             elif tag == "submit":
                 await self.work.put(("submit", msg[1]))
 
@@ -543,9 +572,16 @@ class _Runtime:
                 if conn is not None:
                     await conn.send(("result", cmd_result))
         else:
-            conn_id = self.rifl_shard_conn.get(rifl)
-            if conn_id is None:
+            entry = self.rifl_shard_conn.get(rifl)
+            if entry is None:
+                self.partial_buf.setdefault(rifl, []).append(
+                    (_time.monotonic(), executor_result)
+                )
                 return
+            conn_id, remaining = entry
+            entry[1] = remaining - 1
+            if entry[1] <= 0:
+                del self.rifl_shard_conn[rifl]
             conn = self.client_conns.get(conn_id)
             if conn is not None:
                 await conn.send(("partial", executor_result))
@@ -571,6 +607,18 @@ class _Runtime:
             await asyncio.sleep(interval_ms / 1000)
             for q in self.exec_queues:
                 await q.put(("cleanup",))
+
+    async def _partial_buf_sweeper(self) -> None:
+        while True:
+            await asyncio.sleep(self.partial_buf_ttl_s / 2)
+            cutoff = _time.monotonic() - self.partial_buf_ttl_s
+            stale = [
+                rifl
+                for rifl, entries in self.partial_buf.items()
+                if entries and entries[0][0] < cutoff
+            ]
+            for rifl in stale:
+                del self.partial_buf[rifl]
 
     async def _metrics_logger_loop(self) -> None:
         """metrics_logger.rs: periodic (worker, metrics) snapshots."""

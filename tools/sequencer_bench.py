@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Microbenchmark of the native atomic key-clock sequencer — the analog
+of the reference's ``sequencer_bench`` binary
+(fantoch_ps/src/bin/sequencer_bench.rs:17-23; defaults: 100 keys,
+10 clients x 10,000 commands).
+
+Usage: python tools/sequencer_bench.py [--clients 10] [--ops 10000]
+       [--keys 100] [--keys-per-op 2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fantoch_tpu.native import AtomicKeyClocks, available
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clients", type=int, default=10)
+    ap.add_argument("--ops", type=int, default=10_000)
+    ap.add_argument("--keys", type=int, default=100)
+    ap.add_argument("--keys-per-op", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    if not available():
+        sys.exit("native library unavailable (g++ build failed)")
+    kc = AtomicKeyClocks(args.keys)
+    ok, secs = kc.stress(
+        args.clients, args.ops, args.keys, args.keys_per_op, args.seed
+    )
+    total = args.clients * args.ops
+    print(
+        f"{total} proposals over {args.keys} keys by {args.clients} "
+        f"threads in {secs:.3f}s = {total / secs:,.0f} ops/s "
+        f"({'votes gap-free' if ok else 'INVARIANT VIOLATED'})"
+    )
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()
