@@ -132,9 +132,20 @@ class Search:
         self.region_index = {r: i for i, r in enumerate(regions)}
         self.lat = self.planet.latency_matrix(regions).astype(np.float32)
 
-    def rank(self, params: RankingParams, xp=np) -> Dict[int, List[RankedConfig]]:
+    def rank(
+        self,
+        params: RankingParams,
+        xp=np,
+        cache_path: "str | None" = None,
+    ) -> Dict[int, List[RankedConfig]]:
         """Rank all configs per n; pass ``xp=jax.numpy`` to evaluate the
-        subset batches on device."""
+        subset batches on device. ``cache_path`` persists results keyed
+        by (servers, clients, params) — the reference's bincode search
+        cache (search.rs:47-96)."""
+        if cache_path is not None:
+            cached = self._cache_load(cache_path, params)
+            if cached is not None:
+                return cached
         out: Dict[int, List[RankedConfig]] = {}
         for n in range(params.min_n, params.max_n + 1, 2):
             subsets = list(
@@ -145,7 +156,46 @@ class Search:
             if not subsets:
                 continue
             out[n] = self._rank_n(n, np.asarray(subsets), params, xp)
+        if cache_path is not None:
+            self._cache_store(cache_path, params, out)
         return out
+
+    # -- result cache (search.rs:47-96, pickle instead of bincode) -----
+
+    def _cache_key(self, params: RankingParams) -> str:
+        import hashlib
+
+        h = hashlib.sha256(
+            repr((sorted(self.servers), sorted(self.clients), params)).encode()
+        )
+        # the latency data is part of the key: same region names over a
+        # different planet must not collide
+        h.update(self.lat.tobytes())
+        return h.hexdigest()[:24]
+
+    def _cache_load(self, path: str, params: RankingParams):
+        import os
+        import pickle
+
+        f = os.path.join(path, f"search_{self._cache_key(params)}.pkl")
+        if not os.path.exists(f):
+            return None
+        try:
+            with open(f, "rb") as fh:
+                return pickle.load(fh)
+        except Exception:
+            return None  # corrupt/truncated cache: recompute
+
+    def _cache_store(self, path: str, params: RankingParams, out) -> None:
+        import os
+        import pickle
+
+        os.makedirs(path, exist_ok=True)
+        f = os.path.join(path, f"search_{self._cache_key(params)}.pkl")
+        tmp = f + f".tmp{os.getpid()}"
+        with open(tmp, "wb") as fh:
+            pickle.dump(out, fh)
+        os.replace(tmp, f)
 
     def _rank_n(self, n, subsets, params: RankingParams, xp):
         client_idx = np.asarray(

@@ -311,6 +311,11 @@ def cmd_plot(args) -> None:
 
 
 def main(argv=None) -> None:
+    # honor $FANTOCH_TRACE (off|info|debug|trace) like the reference's
+    # tracing features (util.rs:73-116)
+    from .core.trace import init_tracing
+
+    init_tracing()
     parser = argparse.ArgumentParser(prog="fantoch_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
 

@@ -1,0 +1,14 @@
+"""Model checking for protocol implementations.
+
+The analog of ``fantoch_mc`` — the reference adapts ``Protocol`` to a
+stateright ``Actor`` but its init/next logic is commented out
+(fantoch_mc/src/lib.rs:84-238, excluded from the workspace); this
+module is a working explicit-state explorer over the same host
+``Protocol`` interface: it enumerates message-delivery interleavings
+exhaustively (depth-first, bounded) and checks safety properties on
+every reachable quiescent state.
+"""
+
+from .checker import CheckResult, ModelChecker
+
+__all__ = ["CheckResult", "ModelChecker"]

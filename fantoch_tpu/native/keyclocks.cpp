@@ -200,6 +200,10 @@ int32_t kc_stress(void* h, uint32_t threads, uint64_t ops,
                   uint64_t key_count, uint32_t keys_per_op, uint64_t seed,
                   uint64_t* elapsed_ns) {
     auto* kc = static_cast<KeyClocks*>(h);
+    *elapsed_ns = 0;
+    // distinct keys per command are impossible otherwise (the
+    // rejection-sampling loop below would never terminate)
+    if (keys_per_op == 0 || keys_per_op > key_count) return 0;
     std::vector<std::vector<Range>> votes(threads);
     std::vector<std::thread> pool;
     auto t0 = std::chrono::steady_clock::now();

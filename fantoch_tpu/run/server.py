@@ -31,6 +31,7 @@ from ..core.command import Command, CommandResultBuilder
 from ..core.config import Config
 from ..core.ids import ProcessId, Rifl, ShardId
 from ..core.timing import RunTime
+from ..core.trace import trace, tracer
 from ..core.util import key_hash
 from ..executor.base import AggregatePending, Executor
 from ..protocol.base import Protocol, ToForward, ToSend
@@ -38,6 +39,8 @@ from .prelude import ClientHi, ProcessHi
 from .rw import Connection
 
 _GC_EXECUTOR = 0
+
+log = tracer("run.server")
 
 
 @dataclass
@@ -126,6 +129,9 @@ async def process(
     ``peer_addresses`` maps every *other* process to its peer-listener
     address; ``delay_ms`` injects the reference's artificial
     per-connection delay (delay.rs:7-40)."""
+    from ..core.trace import init_tracing
+
+    init_tracing()  # $FANTOCH_TRACE; idempotent, keeps explicit setups
     protocol = protocol_cls(process_id, shard_id, config)
     pool = _executor_pool(
         protocol_cls, process_id, shard_id, config, executors
@@ -341,6 +347,10 @@ class _Runtime:
             ]
         connected, _ = self.protocol.discover(sorted_ps)
         assert connected, "discovery failed: quorum unavailable"
+        log.info(
+            "process %s (shard %s) discovered %s",
+            self.process_id, self.shard_id, sorted_ps,
+        )
 
     def _start_tasks(self) -> None:
         t = self.tasks.append
@@ -402,16 +412,21 @@ class _Runtime:
                 ].put(("info", info))
             elif tag == "ping":
                 # a ping can arrive while our own connect_to_all is
-                # still retrying; wait (bounded) for the outgoing
-                # connection instead of dropping the pong
-                for _ in range(200):
-                    out = self.out.get(peer)
-                    if out is not None:
-                        await out.send(("pong", msg[1]))
-                        break
-                    await asyncio.sleep(0.01)
+                # still retrying; answer from a side task so the reader
+                # never stalls protocol traffic behind the wait
+                self.tasks.append(
+                    asyncio.create_task(self._pong(peer, msg[1]))
+                )
             elif tag == "pong":
                 self._rtt[peer] = _time.monotonic() - msg[1]
+
+    async def _pong(self, peer, nonce) -> None:
+        for _ in range(200):
+            out = self.out.get(peer)
+            if out is not None:
+                await out.send(("pong", nonce))
+                return
+            await asyncio.sleep(0.01)
 
     async def _accept_client(self, reader, writer) -> None:
         conn = Connection(
@@ -469,6 +484,9 @@ class _Runtime:
             tag = item[0]
             if tag == "msg":
                 _, from_id, from_shard, pmsg = item
+                trace(
+                    log, "p%s <- p%s: %s", self.process_id, from_id, pmsg
+                )
                 self.protocol.handle(from_id, from_shard, pmsg, self.time)
             elif tag == "submit":
                 self.protocol.submit(None, item[1], self.time)

@@ -27,6 +27,7 @@ from ..core.config import Config
 from ..core.ids import ClientId, ProcessId, ShardId
 from ..core.metrics import Histogram
 from ..core.planet import Planet
+from ..core.trace import trace, tracer
 from ..core.util import closest_process_per_shard, sort_processes_by_distance
 from ..executor.base import Executor
 from ..protocol.base import Protocol, ToForward, ToSend
@@ -34,6 +35,8 @@ from .schedule import KIND_MESSAGE, Schedule
 from .simulation import Simulation
 
 # schedule action kinds
+_log = tracer("sim.runner")
+
 _SUBMIT = 0
 _SEND = 1
 _TO_CLIENT = 2
@@ -289,6 +292,10 @@ class Runner:
 
     def _handle_send(self, from_, from_shard_id, process_id, msg) -> None:
         process, _, _, time = self.simulation.get_process(process_id)
+        trace(
+            _log, "t=%s p%s <- p%s: %s",
+            time.millis(), process_id, from_, msg,
+        )
         process.handle(from_, from_shard_id, msg, time)
         self._send_to_processes_and_executors(process_id)
 

@@ -1,0 +1,133 @@
+"""Auxiliary-subsystem tests: tracing, execution-log replay, bote
+search cache, shard-distribution tool (SURVEY.md §5 parity:
+util.rs:73-116, execution_logger.rs + graph_executor_replay.rs,
+search.rs:47-96, shard_distribution.rs).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import socket
+import subprocess
+import sys
+
+from fantoch_tpu.bote.search import FTMetric, RankingParams, Search
+from fantoch_tpu.client import ConflictPool, Workload
+from fantoch_tpu.core import Config, Planet
+from fantoch_tpu.core.trace import init_tracing, tracer
+from fantoch_tpu.protocol import Tempo
+from fantoch_tpu.sim import Runner
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_tracing_to_file(tmp_path):
+    log_file = str(tmp_path / "trace.log")
+    init_tracing("trace", log_file)
+    try:
+        planet = Planet.new()
+        config = Config(n=3, f=1, gc_interval_ms=100,
+                        tempo_detached_send_interval_ms=100)
+        wl = Workload(
+            shard_count=1,
+            key_gen=ConflictPool(conflict_rate=50, pool_size=1),
+            keys_per_command=1, commands_per_client=3, payload_size=1,
+        )
+        regions = planet.regions()[:3]
+        Runner(Tempo, planet, config, wl, 1, regions, regions).run(500)
+    finally:
+        init_tracing("off")
+    with open(log_file) as fh:
+        lines = fh.readlines()
+    assert any("sim.runner" in line and "<- p" in line for line in lines), (
+        lines[:3]
+    )
+
+
+def test_execution_log_replay(tmp_path):
+    """Capture an execution log from a real run-layer replica, then
+    replay it through a fresh executor offline."""
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from test_run import _bind
+
+    from fantoch_tpu.core.ids import process_ids
+    from fantoch_tpu.run import client as run_client
+    from fantoch_tpu.run import process as run_process
+
+    log_path = str(tmp_path / "execution.log")
+
+    async def main():
+        config = Config(
+            n=3, f=1, gc_interval_ms=25,
+            tempo_detached_send_interval_ms=25,
+            executor_monitor_execution_order=True,
+        )
+        ids = [(pid, 0) for pid in process_ids(0, 3)]
+        ps = {pid: _bind() for pid, _ in ids}
+        cs = {pid: _bind() for pid, _ in ids}
+        paddr = {p: ("127.0.0.1", s.getsockname()[1]) for p, s in ps.items()}
+        caddr = {p: ("127.0.0.1", s.getsockname()[1]) for p, s in cs.items()}
+        handles = []
+        for pid, shard in ids:
+            handles.append(await run_process(
+                Tempo, pid, shard, config,
+                peer_addresses={q: paddr[q] for q, _ in ids if q != pid},
+                peer_shards={q: s for q, s in ids if q != pid},
+                peer_sock=ps[pid], client_sock=cs[pid],
+                sorted_processes=[(pid, shard)]
+                + [(q, s) for q, s in ids if q != pid],
+                execution_log=log_path if pid == 1 else None,
+            ))
+        for h in handles:
+            await h.started.wait()
+        wl = Workload(
+            shard_count=1,
+            key_gen=ConflictPool(conflict_rate=100, pool_size=1),
+            keys_per_command=1, commands_per_client=5, payload_size=1,
+        )
+        res = await run_client([1], {0: caddr[1]}, {0: 1}, wl)
+        assert len(res.latencies_us()) == 5
+        await asyncio.sleep(0.1)
+        for h in handles:
+            await h.stop()
+
+    asyncio.run(main())
+    assert os.path.getsize(log_path) > 0
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "executor_replay.py"),
+         log_path, "--protocol", "tempo", "--n", "3", "--f", "1"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "replayed" in out.stdout
+    assert "5 executions" in out.stdout, out.stdout
+
+
+def test_bote_search_cache(tmp_path):
+    planet = Planet.new()
+    servers = planet.regions()[:7]
+    search = Search(planet, servers, servers)
+    params = RankingParams(
+        min_mean_fpaxos_improv=float("-inf"),
+        min_fairness_fpaxos_improv=float("-inf"),
+        min_n=3, max_n=3, ft_metric=FTMetric.F1,
+    )
+    first = search.rank(params, cache_path=str(tmp_path))
+    files = os.listdir(tmp_path)
+    assert len(files) == 1 and files[0].startswith("search_")
+    again = search.rank(params, cache_path=str(tmp_path))
+    assert {n: [(c.score, c.config) for c in v] for n, v in first.items()} \
+        == {n: [(c.score, c.config) for c in v] for n, v in again.items()}
+
+
+def test_shard_distribution_tool():
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "shard_distribution.py"),
+         "--keys", "1000", "--shards", "2", "--samples", "2000"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "shard 0" in out.stdout and "shard 1" in out.stdout

@@ -1,0 +1,56 @@
+"""Model-checker tests: exhaustive interleaving exploration on tiny
+conflicting workloads (the working analog of fantoch_mc's intended
+checks, fantoch_mc/src/lib.rs:84-238).
+"""
+
+import pytest
+
+from fantoch_tpu.core import Config
+from fantoch_tpu.mc import ModelChecker
+from fantoch_tpu.protocol import Atlas, EPaxos, FPaxos, Tempo
+
+
+@pytest.mark.parametrize(
+    "protocol_cls,kw",
+    [
+        (Tempo, dict(tempo_detached_send_interval_ms=1000)),
+        (Atlas, {}),
+        (EPaxos, {}),
+        (FPaxos, dict(leader=1)),
+    ],
+)
+def test_two_conflicting_commands_all_interleavings(protocol_cls, kw):
+    """2 clients × 1 command on one conflicting key, n=3: every
+    explored delivery interleaving must quiesce with identical,
+    exactly-once execution orders on every process."""
+    mc = ModelChecker(
+        protocol_cls,
+        Config(n=3, f=1, **kw),
+        clients=2,
+        commands_per_client=1,
+        max_states=5_000,
+    )
+    result = mc.run()
+    assert result.ok, result.violation
+    # the full interleaving space is factorial; the bounded DFS still
+    # drives hundreds of complete schedules to quiescence and checks
+    # every one (truncation of the remaining tree is expected)
+    assert result.quiescent > 100, result.quiescent
+
+
+def test_detects_divergence():
+    """Sanity: the checker is not vacuous — a protocol that executes at
+    commit (skipping the ordering layer) must be caught."""
+
+    class TempoUnordered(Tempo):
+        pass
+
+    mc = ModelChecker(
+        TempoUnordered,
+        Config(n=3, f=1, execute_at_commit=True),
+        clients=2,
+        commands_per_client=1,
+        max_states=50_000,
+    )
+    result = mc.run()
+    assert not result.ok, "execute_at_commit must break agreement"

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Replay a run-layer execution log through a fresh executor — the
+analog of the reference's ``graph_executor_replay`` binary
+(fantoch_ps/src/bin/graph_executor_replay.rs): the run layer's
+``execution_log`` option captures every ExecutionInfo an executor
+handled (execution_logger.rs:11-60); replaying it reproduces the
+executor's decisions offline for debugging.
+
+Usage: python tools/executor_replay.py LOG --protocol tempo --n 3 --f 1
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pickle
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fantoch_tpu.core import Config
+from fantoch_tpu.core.timing import SimTime
+
+PROTOCOLS = ("basic", "fpaxos", "tempo", "atlas", "epaxos", "caesar")
+
+
+def protocol_cls(name: str):
+    from fantoch_tpu import protocol as p
+
+    return {
+        "basic": p.Basic,
+        "fpaxos": p.FPaxos,
+        "tempo": p.Tempo,
+        "atlas": p.Atlas,
+        "epaxos": p.EPaxos,
+        "caesar": p.Caesar,
+    }[name]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("log")
+    ap.add_argument("--protocol", choices=PROTOCOLS, required=True)
+    ap.add_argument("--n", type=int, required=True)
+    ap.add_argument("--f", type=int, required=True)
+    ap.add_argument("--process-id", type=int, default=1)
+    ap.add_argument("--shard-id", type=int, default=0)
+    args = ap.parse_args()
+
+    cls = protocol_cls(args.protocol)
+    config = Config(
+        n=args.n,
+        f=args.f,
+        gc_interval_ms=1000,
+        executor_monitor_execution_order=True,
+        leader=1 if args.protocol == "fpaxos" else None,
+    )
+    executor = cls.EXECUTOR(args.process_id, args.shard_id, config)
+    time = SimTime()
+
+    infos = 0
+    with open(args.log, "rb") as fh:
+        while True:
+            try:
+                info = pickle.load(fh)
+            except EOFError:
+                break
+            executor.handle(info, time)
+            infos += 1
+            executor.to_clients()
+            executor.to_executors()
+
+    print(f"replayed {infos} execution infos")
+    monitor = executor.monitor()
+    if monitor is not None:
+        for key in sorted(monitor.keys()):
+            order = monitor.get_order(key)
+            print(f"  key {key!r}: {len(order)} executions -> {order}")
+    for kind, hist in executor.metrics().collected.items():
+        print(f"  metric {kind}: {hist}")
+
+
+if __name__ == "__main__":
+    main()

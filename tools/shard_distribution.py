@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Zipf shard-hit statistics — the analog of the reference's
+``shard_distribution`` binary (fantoch_ps/src/bin/shard_distribution.rs):
+sample the Zipf key generator and report how key accesses distribute
+over shards.
+
+Usage: python tools/shard_distribution.py [--keys 1000000]
+       [--coefficient 1.0] [--shards 2] [--samples 100000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fantoch_tpu.client.key_gen import zipf_weights
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--keys", type=int, default=1_000_000)
+    ap.add_argument("--coefficient", type=float, default=1.0)
+    ap.add_argument("--shards", type=int, default=2)
+    ap.add_argument("--samples", type=int, default=100_000)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    weights = zipf_weights(args.keys, args.coefficient)
+    probs = weights / weights.sum()
+    rng = np.random.default_rng(args.seed)
+    keys = rng.choice(args.keys, size=args.samples, p=probs)
+    shards = keys % args.shards
+    counts = np.bincount(shards, minlength=args.shards)
+    print(
+        f"zipf(coefficient={args.coefficient}, keys={args.keys}) over "
+        f"{args.shards} shards, {args.samples} samples:"
+    )
+    for s, c in enumerate(counts):
+        frac = c / args.samples
+        bar = "#" * int(frac * 60)
+        print(f"  shard {s}: {frac:7.2%} {bar}")
+    top = np.argsort(-probs)[:5]
+    print("hottest keys:", {int(k): f"{probs[k]:.2%}" for k in top})
+
+
+if __name__ == "__main__":
+    main()
