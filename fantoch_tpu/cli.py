@@ -310,6 +310,133 @@ def cmd_plot(args) -> None:
     print(json.dumps({"plotted": len(series), "out": args.out}))
 
 
+def _kv_pairs(s: str, parse=str):
+    """"2=a,3=b" -> {2: parse("a"), 3: parse("b")}."""
+    out = {}
+    for part in s.split(","):
+        if not part:
+            continue
+        k, v = part.split("=", 1)
+        out[int(k)] = parse(v)
+    return out
+
+
+def _addr(s: str):
+    host, port = s.rsplit(":", 1)
+    return host, int(port)
+
+
+def cmd_proc(args) -> None:
+    """One replica server — the analog of the reference's per-protocol
+    binaries (bin/common/protocol.rs:122-360 defines the flag surface).
+    Prints a started marker the orchestrator greps for
+    (fantoch_exp bench.rs wait_process_started) and runs until
+    SIGTERM."""
+    import asyncio
+    import signal
+
+    from .run import process as run_process
+
+    config = _build_config(args.protocol, args.n, args.f, args)
+    config = config.with_(
+        shard_count=args.shard_count,
+        executor_monitor_execution_order=args.monitor_execution_order,
+    )
+    peer_addresses = _kv_pairs(args.addresses, _addr)
+    peer_shards = _kv_pairs(args.peer_shards or "", int)
+    for pid in peer_addresses:
+        peer_shards.setdefault(pid, 0)
+    sorted_ps = None
+    if args.sorted:
+        sorted_ps = [
+            (int(p.split(":")[0]), int(p.split(":")[1]))
+            for p in args.sorted.split(",")
+        ]
+
+    async def main_() -> None:
+        handle = await run_process(
+            _oracle_protocol(args.protocol),
+            args.id,
+            args.shard_id,
+            config,
+            peer_addresses=peer_addresses,
+            peer_shards=peer_shards,
+            listen=("0.0.0.0", args.port),
+            client_listen=("0.0.0.0", args.client_port),
+            sorted_processes=sorted_ps,
+            executors=args.executors,
+            delay_ms=args.delay,
+            metrics_file=args.metrics_file,
+            metrics_interval_ms=args.metrics_interval,
+            execution_log=args.execution_log,
+        )
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            loop.add_signal_handler(sig, handle.stop_event.set)
+        await handle.started.wait()
+        print(f"process {args.id} started", flush=True)
+        await handle.task
+
+    asyncio.run(main_())
+
+
+def cmd_client(args) -> None:
+    """Closed/open-loop client runner (the reference's client binary,
+    fantoch_ps/src/bin/client.rs); writes per-client latency series to
+    ``--output``."""
+    import asyncio
+
+    from .client import ConflictPool, Workload, Zipf
+    from .run import client as run_client
+
+    shard_addresses = _kv_pairs(args.addresses, _addr)
+    shard_processes = _kv_pairs(args.shard_processes, int)
+    lo, _, hi = args.ids.partition("-")
+    client_ids = list(range(int(lo), int(hi or lo) + 1))
+    if args.zipf:
+        coef, keys = args.zipf.split(",")
+        key_gen = Zipf(coefficient=float(coef), total_keys_per_shard=int(keys))
+    else:
+        key_gen = ConflictPool(
+            conflict_rate=args.conflict, pool_size=args.pool_size
+        )
+    workload = Workload(
+        shard_count=args.shard_count,
+        key_gen=key_gen,
+        keys_per_command=args.keys_per_command,
+        commands_per_client=args.commands,
+        payload_size=args.payload_size,
+    )
+
+    handle = asyncio.run(
+        run_client(
+            client_ids,
+            shard_addresses,
+            shard_processes,
+            workload,
+            open_loop_interval_ms=args.open_loop_interval,
+        )
+    )
+    out = {
+        str(cid): data.latency_data()
+        for cid, data in handle.data.items()
+    }
+    if args.output:
+        with open(args.output, "w") as fh:
+            json.dump(out, fh)
+    lats = handle.latencies_us()
+    lats.sort()
+    print(
+        json.dumps(
+            {
+                "clients": len(client_ids),
+                "commands": sum(len(v) for v in out.values()),
+                "median_ms": lats[len(lats) // 2] / 1000 if lats else None,
+            }
+        )
+    )
+
+
 def main(argv=None) -> None:
     # honor $FANTOCH_TRACE (off|info|debug|trace) like the reference's
     # tracing features (util.rs:73-116)
@@ -344,6 +471,53 @@ def main(argv=None) -> None:
     bt.add_argument("--top", type=int, default=3)
     bt.add_argument("--aws", action="store_true")
     bt.set_defaults(fn=cmd_bote)
+
+    pr = sub.add_parser(
+        "proc", help="run one replica server over TCP (run layer)"
+    )
+    pr.add_argument("--protocol", required=True, choices=ENGINE_PROTOCOLS)
+    pr.add_argument("--id", type=int, required=True)
+    pr.add_argument("--shard-id", type=int, default=0)
+    pr.add_argument("--n", type=int, required=True)
+    pr.add_argument("--f", type=int, default=1)
+    pr.add_argument("--shard-count", type=int, default=1)
+    pr.add_argument("--port", type=int, required=True)
+    pr.add_argument("--client-port", type=int, required=True)
+    pr.add_argument("--addresses", required=True,
+                    help="peer addresses: 2=host:port,3=host:port")
+    pr.add_argument("--peer-shards", default=None,
+                    help="peer shard ids: 2=0,3=1 (default all 0)")
+    pr.add_argument("--sorted", default=None,
+                    help="discovery order: id:shard,id:shard,...")
+    pr.add_argument("--executors", type=int, default=1)
+    pr.add_argument("--delay", type=int, default=0,
+                    help="artificial per-connection delay (ms)")
+    pr.add_argument("--metrics-file", default=None)
+    pr.add_argument("--metrics-interval", type=int, default=1000)
+    pr.add_argument("--execution-log", default=None)
+    pr.add_argument("--monitor-execution-order", action="store_true")
+    pr.add_argument("--gc-interval", type=int, default=100)
+    pr.add_argument("--detached-interval", type=int, default=100)
+    pr.add_argument("--clock-bump-interval", type=int, default=None)
+    pr.add_argument("--no-wait-condition", action="store_true")
+    pr.set_defaults(fn=cmd_proc)
+
+    cl = sub.add_parser("client", help="run closed/open-loop clients")
+    cl.add_argument("--addresses", required=True,
+                    help="shard client-ports: 0=host:port[,1=...]")
+    cl.add_argument("--shard-processes", required=True,
+                    help="connected process per shard: 0=1[,1=4]")
+    cl.add_argument("--ids", required=True, help="client id range: 1-4")
+    cl.add_argument("--commands", type=int, default=100)
+    cl.add_argument("--conflict", type=int, default=100)
+    cl.add_argument("--pool-size", type=int, default=1)
+    cl.add_argument("--zipf", default=None, help="coef,keys")
+    cl.add_argument("--keys-per-command", type=int, default=1)
+    cl.add_argument("--payload-size", type=int, default=0)
+    cl.add_argument("--shard-count", type=int, default=1)
+    cl.add_argument("--open-loop-interval", type=int, default=None)
+    cl.add_argument("--output", default=None)
+    cl.set_defaults(fn=cmd_client)
 
     pl = sub.add_parser("plot", help="render saved sweep results")
     pl.add_argument("--results", required=True)

@@ -42,12 +42,17 @@ def init_tracing(
     ``$FANTOCH_TRACE`` (or off); ``log_file`` appends records to a file
     instead of stderr. Idempotent; returns the package root logger."""
     global _initialized
-    explicit = level is not None
+    explicit = level is not None or log_file is not None
     level = level or os.environ.get("FANTOCH_TRACE", "off")
     # an env-driven (implicit) init never downgrades an explicit setup
     if explicit or not _initialized:
         _root.setLevel(_LEVELS.get(level.lower(), logging.INFO))
-    if not _initialized:
+    if explicit or not _initialized:
+        # an explicit re-init replaces the handlers (e.g. switching to a
+        # log file after an implicit boot-time init)
+        for h in list(_root.handlers):
+            _root.removeHandler(h)
+            h.close()
         handler: logging.Handler
         if log_file:
             handler = logging.FileHandler(log_file)

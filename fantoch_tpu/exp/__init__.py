@@ -1,0 +1,23 @@
+"""Experiment orchestration — the ``fantoch_exp`` analog.
+
+The reference orchestrates benchmarks over testbeds (AWS via tsunami,
+baremetal over SSH, or localhost; fantoch_exp/src/lib.rs, bench.rs:43):
+per (protocol, config, clients) it starts server binaries with
+generated CLI args, waits for a started marker in their logs, runs
+client binaries, stops everything and pulls metrics files into an
+experiment directory. The same loop here drives this package's own CLI
+binaries (``python -m fantoch_tpu proc|client``) as subprocesses on a
+Local testbed; the remote testbeds' SSH/cloud plumbing is out of scope
+for a simulation-first framework (documented N/A, like the reference's
+cloud credentials requirement).
+"""
+
+from .bench import ExperimentConfig, bench_experiment
+from .config import ClientConfig, ProtocolConfig
+
+__all__ = [
+    "ClientConfig",
+    "ExperimentConfig",
+    "ProtocolConfig",
+    "bench_experiment",
+]

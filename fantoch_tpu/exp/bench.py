@@ -1,0 +1,304 @@
+"""The experiment loop (fantoch_exp/src/bench.rs:43-187): per
+(protocol, config, client load): start servers from generated CLI args,
+wait for their started markers, run clients, stop servers, and collect
+metrics + client latency files into a per-run experiment directory that
+``ResultsDB``-style loaders can search.
+
+Testbed = Local: each server/client is a ``python -m fantoch_tpu ...``
+subprocess on this machine (the reference's ``Testbed::Local``); the
+dstat system-metrics collection becomes a lightweight /proc snapshot
+pair taken around the run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import signal
+import socket
+import subprocess
+import sys
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .config import ClientConfig, ProtocolConfig
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+@dataclass
+class ExperimentConfig:
+    """What gets serialized into every experiment dir (the reference's
+    ExperimentConfig, bench.rs)."""
+
+    protocol: str
+    n: int
+    f: int
+    shard_count: int
+    clients: int
+    commands_per_client: int
+    conflict: int
+    extra: Dict = field(default_factory=dict)
+
+
+def _free_ports(count: int) -> List[int]:
+    """Probe free ports, holding every socket until the last is bound
+    to shrink (not eliminate — the servers bind in subprocesses) the
+    reuse window."""
+    socks, ports = [], []
+    for _ in range(count):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _wait_markers(
+    servers: List[subprocess.Popen],
+    markers: List[str],
+    deadline: float,
+) -> None:
+    """Wait for every server's started marker without blocking reads
+    (bench.rs wait_process_started greps logs the same way)."""
+    buffers = ["" for _ in servers]
+    seen = [False for _ in servers]
+    for proc in servers:
+        os.set_blocking(proc.stdout.fileno(), False)
+    while not all(seen):
+        if time.monotonic() > deadline:
+            missing = [m for m, s in zip(markers, seen) if not s]
+            raise TimeoutError(f"never started: {missing}")
+        progress = False
+        for i, proc in enumerate(servers):
+            if seen[i]:
+                continue
+            try:
+                chunk = proc.stdout.read()
+            except (BlockingIOError, TypeError):
+                chunk = None
+            if chunk:
+                buffers[i] += chunk
+                progress = True
+                if markers[i] in buffers[i]:
+                    seen[i] = True
+            elif proc.poll() is not None:
+                raise RuntimeError(
+                    f"server exited rc={proc.returncode}: {buffers[i]}"
+                )
+        if not progress:
+            time.sleep(0.02)
+    for proc in servers:
+        os.set_blocking(proc.stdout.fileno(), True)
+
+
+def _drain(proc: subprocess.Popen) -> None:
+    """Discard a server's further output from a daemon thread so a
+    chatty process (FANTOCH_TRACE=debug) can never block on a full
+    pipe."""
+    import threading
+
+    def loop():
+        try:
+            while proc.stdout.read(1 << 16):
+                pass
+        except (OSError, ValueError):
+            pass
+
+    threading.Thread(target=loop, daemon=True).start()
+
+
+def _proc_snapshot() -> Dict[str, float]:
+    """Minimal dstat analog: cpu + memory counters from /proc."""
+    out: Dict[str, float] = {"time": time.time()}
+    try:
+        with open("/proc/stat") as fh:
+            cpu = fh.readline().split()[1:8]
+        out["cpu_jiffies"] = float(sum(int(x) for x in cpu))
+        with open("/proc/meminfo") as fh:
+            for line in fh:
+                if line.startswith(("MemTotal", "MemAvailable")):
+                    k, v = line.split(":")
+                    out[k.strip().lower()] = float(v.split()[0])
+    except OSError:
+        pass
+    return out
+
+
+def bench_experiment(
+    exp: ExperimentConfig,
+    output_dir: str,
+    *,
+    clients_per_group: Optional[int] = None,
+    start_timeout_s: float = 30.0,
+    run_timeout_s: float = 300.0,
+    python: str = sys.executable,
+) -> str:
+    """Run one experiment; returns its result directory.
+
+    Spawns ``n × shard_count`` server subprocesses and one client
+    subprocess per shard-0 server (clients spread over servers like the
+    reference's client machines), then collects ``.metrics_*`` pickles,
+    client latency JSON, the experiment config and dstat-style
+    snapshots.
+    """
+    run_dir = os.path.join(
+        output_dir,
+        f"{exp.protocol}_n{exp.n}_f{exp.f}_s{exp.shard_count}"
+        f"_c{exp.clients}",
+    )
+    os.makedirs(run_dir, exist_ok=True)
+
+    from ..core.ids import process_ids
+
+    ids = [
+        (pid, shard)
+        for shard in range(exp.shard_count)
+        for pid in process_ids(shard, exp.n)
+    ]
+    ports = _free_ports(2 * len(ids))
+    port_of = {pid: ports[2 * i] for i, (pid, _) in enumerate(ids)}
+    cport_of = {pid: ports[2 * i + 1] for i, (pid, _) in enumerate(ids)}
+
+    servers: List[subprocess.Popen] = []
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_REPO)
+    dstat0 = _proc_snapshot()
+    try:
+        for pid, shard in ids:
+            mine = process_ids(shard, exp.n)
+            idx = mine.index(pid)
+            sorted_ps = (
+                [(pid, shard)]
+                + [(q, shard) for q in mine if q != pid]
+                + [
+                    (process_ids(s, exp.n)[idx], s)
+                    for s in range(exp.shard_count)
+                    if s != shard
+                ]
+            )
+            cfg = ProtocolConfig(
+                protocol=exp.protocol,
+                process_id=pid,
+                shard_id=shard,
+                n=exp.n,
+                f=exp.f,
+                shard_count=exp.shard_count,
+                port=port_of[pid],
+                client_port=cport_of[pid],
+                addresses={
+                    q: ("127.0.0.1", port_of[q]) for q, _ in ids if q != pid
+                },
+                peer_shards={q: s for q, s in ids if q != pid},
+                sorted_processes=sorted_ps,
+                gc_interval_ms=exp.extra.get("gc_interval_ms", 50),
+                metrics_file=os.path.join(
+                    run_dir, f".metrics_process_{pid}"
+                ),
+                execution_log=exp.extra.get("execution_log"),
+            )
+            servers.append(
+                subprocess.Popen(
+                    [python, "-m", "fantoch_tpu"] + cfg.to_args(),
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT,
+                    text=True,
+                    env=env,
+                    cwd=_REPO,
+                )
+            )
+        # wait for every started marker (bench.rs wait_process_started)
+        _wait_markers(
+            servers,
+            [f"process {pid} started" for pid, _ in ids],
+            time.monotonic() + start_timeout_s,
+        )
+        for proc in servers:
+            _drain(proc)
+
+        # clients: spread exp.clients over the shard-0 servers exactly
+        # (group sizes differ by at most one; empty groups are skipped)
+        shard0 = [x for x in ids if x[1] == 0]
+        groups = len(shard0)
+        sizes = [
+            exp.clients // groups + (1 if i < exp.clients % groups else 0)
+            for i in range(groups)
+        ]
+        if clients_per_group is not None:
+            sizes = [clients_per_group] * groups
+        client_procs = []
+        cid = 1
+        for i, ((pid, shard), size) in enumerate(zip(shard0, sizes)):
+            if size == 0:
+                continue
+            shard_processes = {
+                s: process_ids(s, exp.n)[i] for s in range(exp.shard_count)
+            }
+            ccfg = ClientConfig(
+                ids=(cid, cid + size - 1),
+                addresses={
+                    s: ("127.0.0.1", cport_of[p])
+                    for s, p in shard_processes.items()
+                },
+                shard_processes=shard_processes,
+                commands=exp.commands_per_client,
+                conflict=exp.conflict,
+                keys_per_command=exp.extra.get("keys_per_command", 1),
+                shard_count=exp.shard_count,
+                output=os.path.join(run_dir, f"client_{cid}.json"),
+            )
+            cid += size
+            client_procs.append(
+                subprocess.Popen(
+                    [python, "-m", "fantoch_tpu"] + ccfg.to_args(),
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT,
+                    text=True,
+                    env=env,
+                    cwd=_REPO,
+                )
+            )
+        for cp in client_procs:
+            out, _ = cp.communicate(timeout=run_timeout_s)
+            if cp.returncode != 0:
+                raise RuntimeError(f"client failed: {out}")
+        # let GC finish before the final metrics dump
+        time.sleep(0.3)
+    finally:
+        for proc in servers:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for proc in servers:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    with open(os.path.join(run_dir, "dstat.json"), "w") as fh:
+        json.dump({"start": dstat0, "end": _proc_snapshot()}, fh)
+    with open(os.path.join(run_dir, "exp_config.json"), "w") as fh:
+        json.dump(asdict(exp), fh, indent=2)
+    return run_dir
+
+
+def load_experiment(run_dir: str) -> Dict:
+    """ResultsDB-style loader for one experiment directory: the config,
+    per-process metrics pickles, and per-client latency series."""
+    out: Dict = {"dir": run_dir}
+    with open(os.path.join(run_dir, "exp_config.json")) as fh:
+        out["config"] = json.load(fh)
+    out["metrics"] = {}
+    out["clients"] = {}
+    for name in sorted(os.listdir(run_dir)):
+        path = os.path.join(run_dir, name)
+        if name.startswith(".metrics_process_"):
+            with open(path, "rb") as fh:
+                out["metrics"][int(name.rsplit("_", 1)[1])] = pickle.load(fh)
+        elif name.startswith("client_") and name.endswith(".json"):
+            with open(path) as fh:
+                out["clients"].update(json.load(fh))
+    return out

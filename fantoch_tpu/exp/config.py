@@ -1,0 +1,121 @@
+"""CLI-argument generation for experiment runs — the analog of
+``ProtocolConfig::to_args``/``ClientConfig::to_args``
+(fantoch_exp/src/config.rs:128-270, 318-384): experiment-level structs
+that regenerate the exact flag surface of the server/client binaries.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class ProtocolConfig:
+    protocol: str
+    process_id: int
+    shard_id: int
+    n: int
+    f: int
+    port: int
+    client_port: int
+    addresses: Dict[int, Tuple[str, int]]
+    peer_shards: Dict[int, int] = field(default_factory=dict)
+    shard_count: int = 1
+    executors: int = 1
+    delay_ms: int = 0
+    gc_interval_ms: int = 100
+    detached_interval_ms: int = 100
+    metrics_file: Optional[str] = None
+    execution_log: Optional[str] = None
+    monitor_execution_order: bool = True
+    sorted_processes: Optional[List[Tuple[int, int]]] = None
+
+    def to_args(self) -> List[str]:
+        args = [
+            "proc",
+            "--protocol", self.protocol,
+            "--id", str(self.process_id),
+            "--shard-id", str(self.shard_id),
+            "--n", str(self.n),
+            "--f", str(self.f),
+            "--shard-count", str(self.shard_count),
+            "--port", str(self.port),
+            "--client-port", str(self.client_port),
+            "--addresses",
+            ",".join(
+                f"{pid}={host}:{port}"
+                for pid, (host, port) in sorted(self.addresses.items())
+            ),
+            "--executors", str(self.executors),
+            "--gc-interval", str(self.gc_interval_ms),
+            "--detached-interval", str(self.detached_interval_ms),
+        ]
+        if self.peer_shards:
+            args += [
+                "--peer-shards",
+                ",".join(
+                    f"{p}={s}" for p, s in sorted(self.peer_shards.items())
+                ),
+            ]
+        if self.sorted_processes:
+            args += [
+                "--sorted",
+                ",".join(f"{p}:{s}" for p, s in self.sorted_processes),
+            ]
+        if self.delay_ms:
+            args += ["--delay", str(self.delay_ms)]
+        if self.metrics_file:
+            args += ["--metrics-file", self.metrics_file]
+        if self.execution_log:
+            args += ["--execution-log", self.execution_log]
+        if self.monitor_execution_order:
+            args += ["--monitor-execution-order"]
+        return args
+
+
+@dataclass
+class ClientConfig:
+    ids: Tuple[int, int]  # inclusive range
+    addresses: Dict[int, Tuple[str, int]]  # shard -> client port
+    shard_processes: Dict[int, int]
+    commands: int
+    conflict: int = 100
+    pool_size: int = 1
+    keys_per_command: int = 1
+    payload_size: int = 0
+    shard_count: int = 1
+    zipf: Optional[Tuple[float, int]] = None
+    open_loop_interval_ms: Optional[int] = None
+    output: Optional[str] = None
+
+    def to_args(self) -> List[str]:
+        args = [
+            "client",
+            "--addresses",
+            ",".join(
+                f"{s}={host}:{port}"
+                for s, (host, port) in sorted(self.addresses.items())
+            ),
+            "--shard-processes",
+            ",".join(
+                f"{s}={p}" for s, p in sorted(self.shard_processes.items())
+            ),
+            "--ids", f"{self.ids[0]}-{self.ids[1]}",
+            "--commands", str(self.commands),
+            "--keys-per-command", str(self.keys_per_command),
+            "--payload-size", str(self.payload_size),
+            "--shard-count", str(self.shard_count),
+        ]
+        if self.zipf:
+            args += ["--zipf", f"{self.zipf[0]},{self.zipf[1]}"]
+        else:
+            args += [
+                "--conflict", str(self.conflict),
+                "--pool-size", str(self.pool_size),
+            ]
+        if self.open_loop_interval_ms is not None:
+            args += ["--open-loop-interval", str(self.open_loop_interval_ms)]
+        if self.output:
+            args += ["--output", self.output]
+        return args

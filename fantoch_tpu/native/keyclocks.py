@@ -140,10 +140,10 @@ class AtomicKeyClocks:
     ) -> Tuple[bool, float]:
         """Hammer + verify (the reference's multi-thread test); returns
         (invariants_held, elapsed_seconds)."""
-        if keys_per_op > key_count:
+        if keys_per_op == 0 or keys_per_op > key_count:
             raise ValueError(
-                f"keys_per_op={keys_per_op} > key_count={key_count}: "
-                "distinct keys per command are impossible"
+                f"keys_per_op={keys_per_op} must be in "
+                f"[1, key_count={key_count}]"
             )
         ns = u64(0)
         ok = self._lib.kc_stress(

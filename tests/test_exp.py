@@ -1,0 +1,59 @@
+"""Experiment-orchestration tests: the full fantoch_exp-style loop —
+real server and client subprocesses started from generated CLI args on
+the Local testbed, metrics pulled into an experiment dir
+(fantoch_exp/src/bench.rs:43-187).
+"""
+
+from __future__ import annotations
+
+from fantoch_tpu.exp import (
+    ClientConfig,
+    ExperimentConfig,
+    ProtocolConfig,
+    bench_experiment,
+)
+from fantoch_tpu.exp.bench import load_experiment
+from fantoch_tpu.protocol.base import ProtocolMetricsKind
+
+
+def test_to_args_roundtrip():
+    cfg = ProtocolConfig(
+        protocol="tempo", process_id=1, shard_id=0, n=3, f=1,
+        port=4000, client_port=5000,
+        addresses={2: ("127.0.0.1", 4001), 3: ("127.0.0.1", 4002)},
+        metrics_file="/tmp/m1",
+    )
+    args = cfg.to_args()
+    assert args[0] == "proc"
+    assert "--addresses" in args
+    assert args[args.index("--addresses") + 1] == (
+        "2=127.0.0.1:4001,3=127.0.0.1:4002"
+    )
+    ccfg = ClientConfig(
+        ids=(1, 4), addresses={0: ("127.0.0.1", 5000)},
+        shard_processes={0: 1}, commands=10,
+    )
+    cargs = ccfg.to_args()
+    assert cargs[0] == "client"
+    assert cargs[cargs.index("--ids") + 1] == "1-4"
+
+
+def test_local_experiment_tempo(tmp_path):
+    exp = ExperimentConfig(
+        protocol="tempo", n=3, f=1, shard_count=1,
+        clients=3, commands_per_client=5, conflict=50,
+    )
+    run_dir = bench_experiment(exp, str(tmp_path))
+    loaded = load_experiment(run_dir)
+    assert loaded["config"]["protocol"] == "tempo"
+    # every client group completed its budget
+    total = sum(len(v) for v in loaded["clients"].values())
+    assert total == 3 * 5
+    # per-process metrics pulled for all replicas, with commits recorded
+    assert sorted(loaded["metrics"]) == [1, 2, 3]
+    fast = slow = 0
+    for snap in loaded["metrics"].values():
+        pm = snap["protocol"]
+        fast += pm.get_aggregated(ProtocolMetricsKind.FAST_PATH) or 0
+        slow += pm.get_aggregated(ProtocolMetricsKind.SLOW_PATH) or 0
+    assert fast + slow == 15, (fast, slow)

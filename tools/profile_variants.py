@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Bisect the engine step's cost: time fixed-step runs of the full
+Tempo step against stubbed variants at the bench shape (n=5, 512
+lanes) to attribute ms/step between the engine stages and the handler
+switch.
+
+Usage: python tools/profile_variants.py [steps] [batch] [variant...]
+Variants: full, nohandle (protocol handlers no-op'd), nodetach
+(detached-vote branches no-op'd), noperiodic.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+from fantoch_tpu.core import Config, Planet
+from fantoch_tpu.engine import EngineDims
+from fantoch_tpu.engine.core import _lane_step, empty_outbox, init_lane_state
+from fantoch_tpu.engine.protocols import TempoDev
+from fantoch_tpu.engine.spec import make_lane, stack_lanes
+
+N = 5
+COMMANDS = 50
+
+
+class NoHandle(TempoDev):
+    def handle(self, ps, msg, me, now, ctx, dims):
+        return ps, empty_outbox(dims)
+
+
+class NoPeriodic(TempoDev):
+    def periodic(self, ps, fire, me, now, ctx, dims):
+        return ps, empty_outbox(dims)
+
+
+class NoDetach(TempoDev):
+    def handle(self, ps, msg, me, now, ctx, dims):
+        import jax.numpy as jnp
+
+        # route MDETACHED / DETACH_DRAIN to the no-op branch
+        t = msg["mtype"]
+        squash = (t == TempoDev.MDETACHED) | (t == TempoDev.DETACH_DRAIN)
+        msg = dict(msg, mtype=jnp.where(squash, TempoDev.NUM_TYPES, t))
+        return super().handle(ps, msg, me, now, ctx, dims)
+
+
+VARIANTS = {
+    "full": TempoDev,
+    "nohandle": NoHandle,
+    "nodetach": NoDetach,
+    "noperiodic": NoPeriodic,
+}
+
+
+def main():
+    args = sys.argv[1:]
+    steps = int(args[0]) if args else 100
+    batch = int(args[1]) if len(args) > 1 else 512
+    names = args[2:] or list(VARIANTS)
+
+    planet = Planet.new()
+    regions = planet.regions()[:N]
+    clients = N
+    base = Config(n=N, f=1, gc_interval_ms=100,
+                  tempo_detached_send_interval_ms=100)
+    for name in names:
+        cls = VARIANTS[name]
+        tempo = cls.for_load(keys=1 + clients, clients=clients)
+        dims = EngineDims.for_protocol(
+            tempo, n=N, clients=clients, payload=tempo.payload_width(N),
+            dot_slots=64, regions=N,
+        )
+
+        def run_steps(state, ctx):
+            return jax.lax.fori_loop(
+                0, steps,
+                lambda i, s: jax.vmap(
+                    lambda st, cx: _lane_step(tempo, dims, st, cx)
+                )(s, ctx),
+                state,
+            )
+
+        runner = jax.jit(run_steps)
+        specs = [
+            make_lane(
+                tempo, planet, base, conflict_rate=[0, 10, 50, 100][i % 4],
+                pool_size=1, commands_per_client=COMMANDS,
+                clients_per_region=1, process_regions=regions,
+                client_regions=regions, dims=dims, seed=i,
+            )
+            for i in range(batch)
+        ]
+        ctx = stack_lanes(specs)
+        states = [init_lane_state(tempo, dims, s.ctx) for s in specs]
+        state = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *states)
+        t0 = time.perf_counter()
+        out = runner(state, ctx)
+        jax.block_until_ready(out)
+        t_compile = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out = runner(state, ctx)
+        jax.block_until_ready(out)
+        t = time.perf_counter() - t0
+        print(
+            f"{name:10s} batch={batch} {steps} steps in {t:6.2f}s "
+            f"({t / steps * 1e3:6.2f} ms/step, compile {t_compile:.0f}s)",
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()
