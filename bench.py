@@ -78,6 +78,11 @@ def main() -> None:
         config_base=base,
     )
 
+    # vmapped lanes run until the slowest lane of their batch finishes,
+    # so chunk by expected cost (f, conflict drive the step count) to
+    # keep each batch homogeneous instead of letting every chunk pay
+    # the global straggler
+    specs.sort(key=lambda s: (s.config.f, int(s.ctx["conflict_rate"])))
     chunks = [specs[i : i + CHUNK] for i in range(0, len(specs), CHUNK)]
     # compile + warm up on the first chunk, then time the full sweep
     run_sweep(tempo, dims, chunks[0])

@@ -77,6 +77,96 @@ def enable_debug_log(depth: int) -> None:
 
 
 # ----------------------------------------------------------------------
+# kernel-lean primitives
+#
+# The target runtime charges a large fixed cost per emitted kernel
+# (measured ~0.25 ms on the tunneled v5e VM runtime), so the engine
+# avoids multi-kernel lowerings where a single fusion or one MXU matmul
+# does the job: jnp.cumsum lowers to log-depth shifted adds (8+ kernels
+# at M≈200) and jnp.searchsorted to a while loop (~3 ms); both collapse
+# to one kernel below.
+# ----------------------------------------------------------------------
+
+# above this size the O(m²) matmul / O(v·m) comparison materialization
+# stops paying for itself and the stock lowerings win
+_MM_CUMSUM_LIMIT = 4096
+
+
+def cumsum_i32(x):
+    """Inclusive cumsum along the last axis as one f32 matmul (exact:
+    counts are bounded by the axis length « 2^24)."""
+    m = x.shape[-1]
+    if m > _MM_CUMSUM_LIMIT:
+        return jnp.cumsum(x.astype(I32), axis=-1)
+    tri = jnp.triu(jnp.ones((m, m), jnp.float32))
+    return (x.astype(jnp.float32) @ tri).astype(I32)
+
+
+def searchsorted_left(a, v):
+    """``jnp.searchsorted(a, v, side="left")`` for a nondecreasing last
+    axis of ``a``, as one comparison/reduction fusion."""
+    if a.shape[-1] * v.shape[-1] > 1 << 22:
+        return jnp.searchsorted(a, v, side="left")
+    return jnp.sum(
+        a[..., None, :] < v[..., :, None], axis=-1
+    ).astype(I32)
+
+
+def oh_set(arr, i, v):
+    """``arr.at[i].set(v)`` for a scalar index on axis 0 as a one-hot
+    select: fuses into neighboring elementwise work where a scatter
+    would be its own kernel. An out-of-range index (a drop sentinel)
+    selects nothing — same as ``mode="drop"``."""
+    hit = jnp.arange(arr.shape[0], dtype=I32) == i
+    return jnp.where(hit.reshape(hit.shape + (1,) * (arr.ndim - 1)), v, arr)
+
+
+def oh_set2(arr, i, j, v):
+    """``arr.at[i, j].set(v)`` for scalar indexes, as one fused select."""
+    hit = (jnp.arange(arr.shape[0], dtype=I32)[:, None] == i) & (
+        jnp.arange(arr.shape[1], dtype=I32)[None, :] == j
+    )
+    return jnp.where(hit.reshape(hit.shape + (1,) * (arr.ndim - 2)), v, arr)
+
+
+def oh_get(arr, i):
+    """``arr[i]`` for a scalar index on axis 0 as a masked reduction
+    (gathers at small sizes are kernels too). OOB yields 0/False."""
+    hit = jnp.arange(arr.shape[0], dtype=I32) == i
+    hit = hit.reshape(hit.shape + (1,) * (arr.ndim - 1))
+    if arr.dtype == jnp.bool_:
+        return jnp.any(hit & arr, axis=0)
+    return jnp.sum(jnp.where(hit, arr, 0), axis=0).astype(arr.dtype)
+
+
+def oh_take(vec, idxs):
+    """``vec[idxs]`` for a small 1-D ``vec`` and an index array, as one
+    masked-sum fusion instead of a gather kernel. OOB yields 0/False."""
+    hit = idxs[..., None] == jnp.arange(vec.shape[0], dtype=I32)
+    if vec.dtype == jnp.bool_:
+        return jnp.any(hit & vec, axis=-1)
+    return jnp.sum(jnp.where(hit, vec, 0), axis=-1).astype(vec.dtype)
+
+
+# ----------------------------------------------------------------------
+# message pool layout: one packed [M, 8 + P] i32 image so pops gather a
+# whole message row in one kernel and the step's emissions land in one
+# row scatter (field-per-array pools cost one scatter per field)
+# ----------------------------------------------------------------------
+
+PA = 0    # arrival time (INF = free slot)
+PKS = 1   # tie-break key: emitting src
+PKC = 2   # tie-break key: per-(src, dst) channel emission index
+PSRC = 3  # sender
+PDST = 4  # destination process
+PMT = 5   # message type
+PRQ = 6   # readiness-gate bounce count
+PPR = 7   # priority (inline self-message) flag
+PPAY = 8  # payload words start here
+POOL_FIELDS = 8
+
+
+# ----------------------------------------------------------------------
 # outbox helpers (used by protocol handler modules)
 # ----------------------------------------------------------------------
 
@@ -122,7 +212,7 @@ def compact_order(mask, limit):
     Returns (order, true_count) — callers flag ``true_count > limit`` as
     their overflow condition."""
     mask = jnp.asarray(mask, bool)
-    order = jnp.cumsum(mask.astype(I32)) - 1
+    order = cumsum_i32(mask) - 1
     order = jnp.where(mask & (order < limit), order, INF)
     return order, jnp.sum(mask)
 
@@ -205,6 +295,27 @@ def first_keys_fn(C: int):
     return one
 
 
+def key_table_fn(C: int, T: int):
+    """Jit-able: keygen ctx slice → the full [C, T] key table (seq is
+    the column index; column 0 is unused — seqs are 1-based).
+
+    Threefry is the dominant per-step cost when keys are drawn inside
+    the engine loop (6 foldings per emission row per step); since a
+    key depends only on (client, seq), the sweep driver precomputes the
+    whole table in one batched call and the step gathers from
+    ``ctx["key_table"]`` instead (bit-identical keys, RNG work moved
+    entirely out of the loop)."""
+
+    def one(ctx):
+        return jax.vmap(
+            lambda c: jax.vmap(lambda s: gen_key(ctx, c, s))(
+                jnp.arange(T, dtype=I32)
+            )
+        )(jnp.arange(C, dtype=I32))
+
+    return one
+
+
 def init_lane_state(
     protocol,
     dims: EngineDims,
@@ -219,23 +330,14 @@ def init_lane_state(
     :func:`first_keys_fn`) skips the per-lane device round trip.
     """
     N, C, M, P, R = dims.N, dims.C, dims.M, dims.P, dims.R
-    pool = {
-        "arrival": np.full((M,), INF, np.int32),
-        # tie-break key: (ksrc, kcnt) = (emitting src, emission index on
-        # the (src, dst) channel), compared lexicographically
-        "ksrc": np.zeros((M,), np.int32),
-        "kcnt": np.zeros((M,), np.int32),
-        "src": np.zeros((M,), np.int32),
-        "dst": np.zeros((M,), np.int32),
-        "mtype": np.zeros((M,), np.int32),
-        "payload": np.zeros((M, P), np.int32),
-        # readiness-gate bounce count (ERR_STUCK past REQUEUE_LIMIT)
-        "rq": np.zeros((M,), np.int32),
-        # self-messages are delivered inline by the oracle (recursive
-        # ToForward/self-target handling, runner.rs:455-471): they beat
-        # any other message pending at the same instant
-        "prio": np.zeros((M,), bool),
-    }
+    # packed pool image: columns PA..PPR then P payload words (see the
+    # layout constants above); tie-break key (ksrc, kcnt) = (emitting
+    # src, emission index on the (src, dst) channel), compared
+    # lexicographically; prio marks self-messages the oracle delivers
+    # inline (recursive ToForward/self-target handling,
+    # runner.rs:455-471) — they beat any other same-instant message
+    pool = np.zeros((M, POOL_FIELDS + P), np.int32)
+    pool[:, PA] = INF
     budget = ctx_np["cmd_budget"]          # [C]
     attach = ctx_np["client_attach"]       # [C]
     live = budget > 0
@@ -251,16 +353,16 @@ def init_lane_state(
     for c in range(C):
         if not live[c]:
             continue
-        pool["arrival"][slot] = ctx_np["client_delay"][c, attach[c]]
+        pool[slot, PA] = ctx_np["client_delay"][c, attach[c]]
         # each client's first SUBMIT is emission #1 on its channel
-        pool["ksrc"][slot] = N + c
-        pool["kcnt"][slot] = 1
-        pool["src"][slot] = N + c
-        pool["dst"][slot] = attach[c]
-        pool["mtype"][slot] = protocol.SUBMIT
-        pool["payload"][slot, 0] = c
-        pool["payload"][slot, 1] = 1
-        pool["payload"][slot, 2] = first_keys[c]
+        pool[slot, PKS] = N + c
+        pool[slot, PKC] = 1
+        pool[slot, PSRC] = N + c
+        pool[slot, PDST] = attach[c]
+        pool[slot, PMT] = protocol.SUBMIT
+        pool[slot, PPAY + 0] = c
+        pool[slot, PPAY + 1] = 1
+        pool[slot, PPAY + 2] = first_keys[c]
         slot += 1
 
     intervals = ctx_np["periodic_intervals"]  # [R]
@@ -313,8 +415,11 @@ def init_lane_state(
 
 def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False):
     N, C, M, F, R, P = dims.N, dims.C, dims.M, dims.F, dims.R, dims.P
-    pool = st["pool"]
-    arrival = pool["arrival"]
+    pool = st["pool"]                     # [M, POOL_FIELDS + P]
+    arrival = pool[:, PA]
+    pool_dst = pool[:, PDST]
+    pool_ksrc = pool[:, PKS]
+    pool_prio = pool[:, PPR] != 0
     procs = jnp.arange(N, dtype=I32)
 
     # 1. per-process local event times + conservative lookahead ---------
@@ -326,7 +431,7 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False):
     # holding the lane-wide minimum always qualifies, so time advances
     # every step; typically most processes qualify at once, which is
     # what beats the one-event-per-step serialization of a heap DES.
-    dstmask = pool["dst"][None, :] == procs[:, None]          # [N, M]
+    dstmask = pool_dst[None, :] == procs[:, None]             # [N, M]
     arr_p = jnp.min(
         jnp.where(dstmask, arrival[None, :], INF), axis=1
     )                                                         # [N]
@@ -363,24 +468,33 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False):
     )  # [N, M]
     # inline self-messages first (oracle recursion), then lexicographic
     # (ksrc, kcnt) order
-    cand_prio = cand & pool["prio"][None, :]
+    cand_prio = cand & pool_prio[None, :]
     use = jnp.where(jnp.any(cand_prio, axis=1)[:, None], cand_prio, cand)
-    usrc = jnp.where(use, pool["ksrc"][None, :], INF)
+    usrc = jnp.where(use, pool_ksrc[None, :], INF)
     min_src = jnp.min(usrc, axis=1)                                   # [N]
     order = jnp.where(
-        use & (pool["ksrc"][None, :] == min_src[:, None]),
-        pool["kcnt"][None, :],
+        use & (pool_ksrc[None, :] == min_src[:, None]),
+        pool[:, PKC][None, :],
         INF,
     )
     slot = jnp.argmin(order, axis=1)                                  # [N]
     has = jnp.any(use, axis=1)
+    popped_rows = pool[slot]               # [N, POOL_FIELDS + P]
     msg = {
         "valid": has,
-        "src": pool["src"][slot],
-        "mtype": jnp.where(has, pool["mtype"][slot], protocol.NUM_TYPES),
-        "payload": pool["payload"][slot],
+        "src": popped_rows[:, PSRC],
+        "mtype": jnp.where(
+            has, popped_rows[:, PMT], protocol.NUM_TYPES
+        ),
+        "payload": popped_rows[:, PPAY:],
     }
-    arrival = arrival.at[jnp.where(has, slot, M)].set(INF, mode="drop")
+    # free the popped slots (one-hot, fuses; a scatter is a kernel)
+    popped = jnp.any(
+        (jnp.arange(M, dtype=I32)[None, :] == slot[:, None])
+        & has[:, None],
+        axis=0,
+    )
+    arrival = jnp.where(popped, INF, arrival)
 
     # readiness gate: a message that overtook its prerequisite (possible
     # only under reordering — FIFO channels deliver prerequisites first)
@@ -395,7 +509,7 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False):
     else:
         rdy = jnp.ones((N,), bool)
     requeued = has & ~rdy
-    rq_next = jnp.where(requeued, pool["rq"][slot] + 1, 0)  # [N]
+    rq_next = jnp.where(requeued, popped_rows[:, PRQ] + 1, 0)  # [N]
     stuck = jnp.any(rq_next > REQUEUE_LIMIT)
     msg = dict(
         msg,
@@ -444,10 +558,10 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False):
     rq = {
         "valid": requeued[:, None],
         "dst": procs[:, None],
-        "mtype": jnp.where(requeued, pool["mtype"][slot], 0)[:, None],
-        "payload": pool["payload"][slot][:, None, :],
+        "mtype": jnp.where(requeued, popped_rows[:, PMT], 0)[:, None],
+        "payload": popped_rows[:, PPAY:][:, None, :],
         "delay": jnp.ones((N, 1), I32),
-        "src": pool["src"][slot][:, None],
+        "src": popped_rows[:, PSRC][:, None],
     }
     F2 = 2 * F + 1
     out = jax.tree_util.tree_map(
@@ -490,29 +604,44 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False):
     latency = t_arr - st["clients"]["start_time"][c]
 
     cl = st["clients"]
-    completed = cl["completed"].at[jnp.where(is_client, c, C)].add(
-        1, mode="drop"
-    )
+    # per-client updates as one-hot reductions (C is tiny; scatters are
+    # one kernel each on the target runtime, these fuse away). The
+    # closed loop guarantees at most one completion per client per step,
+    # so a masked max routes the start-time value.
+    iota_c = jnp.arange(C, dtype=I32)
+    oh_done = is_client[:, None] & (c[:, None] == iota_c[None, :])  # [E, C]
+    completed = cl["completed"] + jnp.sum(oh_done, axis=0, dtype=I32)
     more = cl["issued"][c] < ctx["cmd_budget"][c]
     issue = is_client & more
-    issued = cl["issued"].at[jnp.where(issue, c, C)].add(1, mode="drop")
-    start_time = cl["start_time"].at[jnp.where(issue, c, C)].set(
-        t_arr, mode="drop"
+    oh_issue = oh_done & more[:, None]                              # [E, C]
+    issued = cl["issued"] + jnp.sum(oh_issue, axis=0, dtype=I32)
+    st_new = jnp.max(
+        jnp.where(oh_issue, t_arr[:, None], -1), axis=0
     )
+    start_time = jnp.where(st_new >= 0, st_new, cl["start_time"])
     next_seq = cl["issued"][c] + 1
-    key = jax.vmap(lambda cc, ss: gen_key(ctx, cc, ss))(c, next_seq)
+    if "key_table" in ctx:
+        # precomputed (client, seq) → key table: no RNG in the loop
+        T_keys = ctx["key_table"].shape[1]
+        key = ctx["key_table"][c, jnp.minimum(next_seq, T_keys - 1)]
+    else:
+        key = jax.vmap(lambda cc, ss: gen_key(ctx, cc, ss))(c, next_seq)
     sub_payload = jnp.zeros((E, P), I32)
     sub_payload = sub_payload.at[:, 0].set(c)
     sub_payload = sub_payload.at[:, 1].set(next_seq)
     sub_payload = sub_payload.at[:, 2].set(key)
 
-    # metrics
+    # metrics (hist/lat_log keep their scatters — their one-hot forms
+    # would materialize [E, RR, H]-scale intermediates)
     row = jnp.where(is_client, ctx["client_region_row"][c], dims.RR)
     bucket = jnp.clip(latency, 0, dims.H - 1)
     metrics = st["metrics"]
     hist = metrics["hist"].at[row, bucket].add(1, mode="drop")
-    lat_sum = metrics["lat_sum"].at[row].add(latency, mode="drop")
-    lat_count = metrics["lat_count"].at[row].add(1, mode="drop")
+    oh_row = row[:, None] == jnp.arange(dims.RR, dtype=I32)[None, :]
+    lat_sum = metrics["lat_sum"] + jnp.sum(
+        jnp.where(oh_row, latency[:, None], 0), axis=0, dtype=I32
+    )
+    lat_count = metrics["lat_count"] + jnp.sum(oh_row, axis=0, dtype=I32)
     log_idx = jnp.where(is_client, cl["completed"][c], LAT_LOG)
     lat_log = metrics["lat_log"].at[
         jnp.where(is_client, c, C), log_idx
@@ -565,7 +694,7 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False):
     orig_kcnt = (
         jnp.zeros((N, F2), I32)
         .at[:, F2 - 1]
-        .set(pool["kcnt"][slot])
+        .set(popped_rows[:, PKC])
         .reshape(E)
     )
     kcnt = jnp.where(
@@ -575,17 +704,20 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False):
     )
     kcnt = jnp.where(is_rq, orig_kcnt, kcnt)
     ksrc = src  # N + c for client-issued SUBMITs, emitter otherwise
-    pair_cnt = st["pair_cnt"].at[
-        emitter, jnp.where(valid & ~is_client & ~is_rq, dst, N)
-    ].add(1, mode="drop")
+    counted = valid & ~is_client & ~is_rq
+    ohe = emitter[:, None] == procs[None, :]                  # [E, N]
+    ohd = (dst[:, None] == procs[None, :]) & counted[:, None]
+    pair_cnt = st["pair_cnt"] + jnp.sum(
+        ohe[:, :, None] & ohd[:, None, :], axis=0, dtype=I32
+    )
 
-    # 6. scatter into free pool slots ----------------------------------
-    # (slot choice is arbitrary — ordering lives in the (ksrc, kcnt)
-    # keys)
-    rank = jnp.cumsum(valid.astype(I32))                      # [E], 1-based
+    # 6. pack the emissions and land them in free pool slots with ONE
+    # row scatter (slot choice is arbitrary — ordering lives in the
+    # (ksrc, kcnt) keys)
+    rank = cumsum_i32(valid)                                  # [E], 1-based
     free = arrival == INF
-    free_cum = jnp.cumsum(free.astype(I32))                   # [M]
-    target = jnp.searchsorted(free_cum, rank, side="left")
+    free_cum = cumsum_i32(free)                               # [M]
+    target = searchsorted_left(free_cum, rank)
     target = jnp.where(valid, target, M)
     n_free = jnp.sum(free)
     pool_overflow = jnp.sum(valid) > n_free
@@ -594,17 +726,23 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False):
     pool_peak = jnp.maximum(
         st["pool_peak"], M - n_free + jnp.sum(valid, dtype=I32)
     )
-    new_pool = {
-        "arrival": arrival.at[target].set(msg_arrival, mode="drop"),
-        "ksrc": pool["ksrc"].at[target].set(ksrc, mode="drop"),
-        "kcnt": pool["kcnt"].at[target].set(kcnt, mode="drop"),
-        "src": pool["src"].at[target].set(src, mode="drop"),
-        "dst": pool["dst"].at[target].set(dst, mode="drop"),
-        "mtype": pool["mtype"].at[target].set(mtype, mode="drop"),
-        "payload": pool["payload"].at[target].set(payload, mode="drop"),
-        "rq": pool["rq"].at[target].set(rq_arr, mode="drop"),
-        "prio": pool["prio"].at[target].set(prio, mode="drop"),
-    }
+    new_rows = jnp.concatenate(
+        [
+            msg_arrival[:, None],
+            ksrc[:, None],
+            kcnt[:, None],
+            src[:, None],
+            dst[:, None],
+            mtype[:, None],
+            rq_arr[:, None],
+            prio.astype(I32)[:, None],
+            payload,
+        ],
+        axis=1,
+    )                                                         # [E, 8 + P]
+    new_pool = pool.at[:, PA].set(arrival).at[target].set(
+        new_rows, mode="drop"
+    )
 
     # 7. termination bookkeeping ---------------------------------------
     # under out-of-order (lookahead) execution the globally latest
@@ -699,25 +837,40 @@ def build_segment_runner(
     so one sweep becomes several bounded executions with host-side
     resume — long sweeps stay under transport/watchdog execution-time
     limits (a single multi-minute while_loop call can kill a tunneled
-    device worker). Returns ``(runner(state, ctx, until), alive(state,
-    ctx))``; drive ``until`` up in fixed increments until ``alive`` is
-    false, then apply truncation via ``finish_segmented``."""
+    device worker). Returns ``(runner, alive)`` where
+    ``runner(state, ctx, until) -> (state, any_alive)`` (the liveness
+    flag rides back with the state — a separate call would pay the
+    tunnel's per-call overhead every segment) and ``alive(state, ctx)``
+    serves callers resuming saved states; drive ``until`` up in fixed
+    increments until the flag is false, then apply truncation via
+    ``finish_segmented``."""
 
     def run_lane(st, ctx, until):
         lim = jnp.minimum(until, max_steps)
-        return jax.lax.while_loop(
+        out = jax.lax.while_loop(
             lambda s: _lane_running(dims, s, ctx, max_steps)
             & (s["steps"] < lim),
             lambda s: _lane_step(protocol, dims, s, ctx, reorder),
             st,
         )
+        return out, _lane_running(dims, out, ctx, max_steps)
 
-    def alive_lane(st, ctx):
-        return _lane_running(dims, st, ctx, max_steps)
+    def run_batch(st, ctx, until):
+        out, alive = jax.vmap(run_lane, in_axes=(0, 0, None))(
+            st, ctx, until
+        )
+        # the alive flag rides back with the state: a separate jitted
+        # alive() call would pay the tunnel's ~1s per-call overhead
+        # once per segment
+        return out, jnp.any(alive)
 
-    runner = jax.jit(jax.vmap(run_lane, in_axes=(0, 0, None)))
+    runner = jax.jit(run_batch)
     alive = jax.jit(
-        lambda st, ctx: jnp.any(jax.vmap(alive_lane)(st, ctx))
+        lambda st, ctx: jnp.any(
+            jax.vmap(lambda s, c: _lane_running(dims, s, c, max_steps))(
+                st, ctx
+            )
+        )
     )
     return runner, alive
 

@@ -17,7 +17,6 @@ drop votes.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
 I32 = jnp.int32
@@ -45,21 +44,24 @@ def iset_add_range(frontier, gaps, start, end, enable=True):
     slot = jnp.argmax(free)
     overflow = store & ~jnp.any(free)
     slot = jnp.where(store & ~overflow, slot, g)
-    gaps = gaps.at[slot, 0].set(start, mode="drop")
-    gaps = gaps.at[slot, 1].set(end, mode="drop")
+    # one-hot instead of scatters: G is tiny and a scatter is one whole
+    # kernel on the target runtime while this fuses away
+    hit_slot = jnp.arange(g) == slot
+    gaps = jnp.where(
+        hit_slot[:, None], jnp.stack([start, end])[None, :], gaps
+    )
 
     # absorb gaps that touch the (possibly advanced) frontier; one pass
-    # per buffered gap bounds the chain
-    def absorb(_, carry):
-        frontier, gaps = carry
+    # per buffered gap bounds the chain. Statically unrolled: the loop
+    # body is pure elementwise/reduce work, so unrolling keeps the whole
+    # absorption inside one fusion instead of paying per-iteration
+    # kernel launches inside a lax loop.
+    for _ in range(g):
         hit = (gaps[:, 0] > 0) & (gaps[:, 0] <= frontier + 1)
-        new_frontier = jnp.maximum(
+        frontier = jnp.maximum(
             frontier, jnp.max(jnp.where(hit, gaps[:, 1], 0))
         )
         gaps = jnp.where(hit[:, None], 0, gaps)
-        return new_frontier, gaps
-
-    frontier, gaps = jax.lax.fori_loop(0, g, absorb, (frontier, gaps))
     return frontier, gaps, overflow
 
 

@@ -26,7 +26,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core import I32, emit, emit_broadcast, empty_outbox
+from ..core import (
+    I32, emit, emit_broadcast, empty_outbox, oh_get, oh_set, oh_set2,
+)
 from ..dims import ERR_DOT, ERR_PROTO, INF, EngineDims
 from .identity import DevIdentity
 
@@ -103,9 +105,9 @@ class BasicDev(DevIdentity):
         in per-source order (committed_cnt is a frontier counter)."""
         t = msg["mtype"]
         store_slot = _slot(msg["payload"][0], dims)
-        store_ok = ps["seq_in_slot"][msg["src"], store_slot] == 0
+        store_ok = oh_get(oh_get(ps["seq_in_slot"], msg["src"]), store_slot) == 0
         dsrc, seq = msg["payload"][0], msg["payload"][1]
-        in_order = seq == ps["committed_cnt"][dsrc] + 1
+        in_order = seq == oh_get(ps["committed_cnt"], dsrc) + 1
         ok = jnp.where(t == BasicDev.MSTORE, store_ok, True)
         return jnp.where(t == BasicDev.MCOMMIT, in_order, ok)
 
@@ -154,14 +156,17 @@ def _apply_commit(ps, src, seq, me, do, ob, ob_slot, dims):
     """Commit (src, seq): advance the per-source frontier, and if I am
     the coordinator, report back to the waiting client. ``do`` masks the
     whole operation (commit may be buffered awaiting the payload)."""
-    expected = ps["committed_cnt"][src] + 1
+    expected = oh_get(ps["committed_cnt"], src) + 1
     ps = dict(
         ps,
         err=ps["err"] | ERR_PROTO * (do & (seq != expected)),
-        committed_cnt=ps["committed_cnt"].at[src].add(do.astype(I32)),
+        committed_cnt=oh_set(
+            ps["committed_cnt"], src,
+            oh_get(ps["committed_cnt"], src) + do.astype(I32),
+        ),
     )
     slot = _slot(seq, dims)
-    client = ps["client_of"][slot]
+    client = oh_get(ps["client_of"], slot)
     ob = emit(
         ob,
         ob_slot,
@@ -182,8 +187,8 @@ def _submit(ps, msg, me, ctx, dims):
     ps = dict(
         ps,
         own_seq=seq,
-        client_of=ps["client_of"].at[slot].set(client),
-        acks=ps["acks"].at[slot].set(0),
+        client_of=oh_set(ps["client_of"], slot, client),
+        acks=oh_set(ps["acks"], slot, 0),
     )
     ob = emit_broadcast(
         empty_outbox(dims), BasicDev.MSTORE, [seq, key], ctx["n"]
@@ -197,11 +202,11 @@ def _mstore(ps, msg, me, ctx, dims):
     before payload) is applied now (basic.rs:152-162)."""
     s, seq = msg["src"], msg["payload"][0]
     slot = _slot(seq, dims)
-    dirty = ps["seq_in_slot"][s, slot] != 0
+    dirty = oh_get(oh_get(ps["seq_in_slot"], s), slot) != 0
     ps = dict(
         ps,
         err=ps["err"] | ERR_DOT * dirty,
-        seq_in_slot=ps["seq_in_slot"].at[s, slot].set(seq),
+        seq_in_slot=oh_set2(ps["seq_in_slot"], s, slot, seq),
     )
     ob = emit(
         empty_outbox(dims),
@@ -209,12 +214,12 @@ def _mstore(ps, msg, me, ctx, dims):
         s,
         BasicDev.MSTOREACK,
         [seq],
-        valid=ctx["quorum"][s, me],
+        valid=oh_get(oh_get(ctx["quorum"], s), me),
     )
-    buffered = ps["buffered_commit"][s, slot]
+    buffered = oh_get(oh_get(ps["buffered_commit"], s), slot)
     ps, ob = _apply_commit(ps, s, seq, me, buffered, ob, 1, dims)
     ps = dict(
-        ps, buffered_commit=ps["buffered_commit"].at[s, slot].set(False)
+        ps, buffered_commit=oh_set2(ps["buffered_commit"], s, slot, False)
     )
     return ps, ob
 
@@ -224,11 +229,11 @@ def _mstoreack(ps, msg, me, ctx, dims):
     (basic.rs:163-169)."""
     seq = msg["payload"][0]
     slot = _slot(seq, dims)
-    cnt = ps["acks"][slot] + 1
+    cnt = oh_get(ps["acks"], slot) + 1
     reached = cnt == ctx["q_size"]
     ps = dict(
         ps,
-        acks=ps["acks"].at[slot].set(cnt),
+        acks=oh_set(ps["acks"], slot, cnt),
         m_fast_path=ps["m_fast_path"] + reached.astype(I32),
     )
     ob = emit_broadcast(
@@ -243,15 +248,16 @@ def _mcommit(ps, msg, me, ctx, dims):
     (basic.rs:171-186)."""
     dsrc, seq = msg["payload"][0], msg["payload"][1]
     slot = _slot(seq, dims)
-    have = ps["seq_in_slot"][dsrc, slot] == seq
+    have = oh_get(oh_get(ps["seq_in_slot"], dsrc), slot) == seq
     ps, ob = _apply_commit(
         ps, dsrc, seq, me, have, empty_outbox(dims), 0, dims
     )
     ps = dict(
         ps,
-        buffered_commit=ps["buffered_commit"]
-        .at[dsrc, slot]
-        .set(ps["buffered_commit"][dsrc, slot] | ~have),
+        buffered_commit=oh_set2(
+            ps["buffered_commit"], dsrc, slot,
+            oh_get(oh_get(ps["buffered_commit"], dsrc), slot) | ~have,
+        ),
     )
     return ps, ob
 
@@ -264,8 +270,8 @@ def _mgc(ps, msg, me, ctx, dims):
     s = msg["src"]
     frontier = msg["payload"][:N]
     of = ps["others_frontier"]
-    of = of.at[s].set(jnp.maximum(of[s], frontier))
-    seen = ps["seen"].at[s].set(True)
+    of = oh_set(of, s, jnp.maximum(oh_get(of, s), frontier))
+    seen = oh_set(ps["seen"], s, True)
 
     procs = jnp.arange(N, dtype=I32)
     nmask = procs < ctx["n"]

@@ -31,7 +31,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core import I32, emit, emit_broadcast, empty_outbox
+from ..core import (
+    I32, emit, emit_broadcast, empty_outbox, oh_get, oh_set,
+)
 from ..dims import ERR_DOT, ERR_PROTO, INF, EngineDims, dot_slot
 from .identity import DevIdentity
 
@@ -117,7 +119,7 @@ class FPaxosDev(DevIdentity):
         slot = msg["payload"][0]
         idx = dot_slot(slot, dims)
         ok = jnp.where(
-            t == FPaxosDev.MACCEPT, ps["acc_slot"][idx] == 0, True
+            t == FPaxosDev.MACCEPT, oh_get(ps["acc_slot"], idx) == 0, True
         )
         return jnp.where(
             t == FPaxosDev.MCHOSEN, slot == ps["exec_frontier"] + 1, ok
@@ -168,17 +170,13 @@ def _submit(ps, msg, me, ctx, dims):
 
     slot = ps["last_slot"] + 1
     idx = dot_slot(slot, dims)
-    dirty = ps["cmd_slot"][idx] != 0
+    dirty = oh_get(ps["cmd_slot"], idx) != 0
     ps = dict(
         ps,
         err=ps["err"] | ERR_DOT * (do & dirty),
         last_slot=jnp.where(do, slot, ps["last_slot"]),
-        cmd_slot=ps["cmd_slot"].at[jnp.where(do, idx, dims.D)].set(
-            slot, mode="drop"
-        ),
-        acc_count=ps["acc_count"].at[jnp.where(do, idx, dims.D)].set(
-            0, mode="drop"
-        ),
+        cmd_slot=oh_set(ps["cmd_slot"], jnp.where(do, idx, dims.D), slot),
+        acc_count=oh_set(ps["acc_count"], jnp.where(do, idx, dims.D), 0),
     )
 
     # outbox: slot 0 = forward-to-leader, slots 1..N = MAccept broadcast
@@ -219,11 +217,11 @@ def _maccept(ps, msg, me, ctx, dims):
     (fpaxos.rs:240-262)."""
     slot, client = msg["payload"][0], msg["payload"][1]
     idx = dot_slot(slot, dims)
-    dirty = ps["acc_slot"][idx] != 0
+    dirty = oh_get(ps["acc_slot"], idx) != 0
     ps = dict(
         ps,
         err=ps["err"] | ERR_DOT * dirty,
-        acc_slot=ps["acc_slot"].at[idx].set(slot),
+        acc_slot=oh_set(ps["acc_slot"], idx, slot),
     )
     ob = emit(
         empty_outbox(dims),
@@ -242,17 +240,18 @@ def _maccepted(ps, msg, me, ctx, dims):
     idx = dot_slot(slot, dims)
     # a stale MAccepted for a retired commander (slot mismatch) is a
     # protocol error, not a silent merge into the new occupant's count
-    stale = ps["cmd_slot"][idx] != slot
-    cnt = ps["acc_count"][idx] + 1
+    stale = oh_get(ps["cmd_slot"], idx) != slot
+    cnt = oh_get(ps["acc_count"], idx) + 1
     chosen = ~stale & (cnt == ctx["q_size"])
     # the commander is retired once the slot is chosen (commanders.pop),
     # freeing the window entry for reuse
     ps = dict(
         ps,
         err=ps["err"] | ERR_PROTO * stale,
-        acc_count=ps["acc_count"].at[idx].set(jnp.where(chosen, 0, cnt)),
-        cmd_slot=ps["cmd_slot"].at[idx].set(
-            jnp.where(chosen, 0, ps["cmd_slot"][idx])
+        acc_count=oh_set(ps["acc_count"], idx, jnp.where(chosen, 0, cnt)),
+        cmd_slot=oh_set(
+            ps["cmd_slot"], idx,
+            jnp.where(chosen, 0, oh_get(ps["cmd_slot"], idx)),
         ),
     )
     ob = emit_broadcast(
@@ -276,7 +275,7 @@ def _mchosen(ps, msg, me, ctx, dims):
         err=ps["err"] | ERR_PROTO * ~in_order,
         exec_frontier=ps["exec_frontier"] + in_order.astype(I32),
     )
-    mine = ctx["client_attach"][client] == me
+    mine = oh_get(ctx["client_attach"], client) == me
     ob = emit(
         empty_outbox(dims),
         0,
@@ -294,10 +293,12 @@ def _mgc(ps, msg, me, ctx, dims):
     slots this process actually accepted (synod/gc.rs, acceptor.gc)."""
     s = msg["src"]
     committed = msg["payload"][0]
-    oc = ps["others_committed"].at[s].set(
-        jnp.maximum(ps["others_committed"][s], committed)
+    oc = oh_set(
+        ps["others_committed"],
+        s,
+        jnp.maximum(oh_get(ps["others_committed"], s), committed),
     )
-    seen = ps["seen"].at[s].set(True)
+    seen = oh_set(ps["seen"], s, True)
     procs = jnp.arange(dims.N, dtype=I32)
     others = (procs < ctx["n"]) & (procs != me)
     ready = jnp.all(seen | ~others)

@@ -66,7 +66,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core import I32, compact_order, emit, emit_broadcast, empty_outbox
+from ..core import (
+    I32, compact_order, emit, emit_broadcast, empty_outbox, oh_get,
+    oh_set, oh_set2, oh_take,
+)
 from ..dims import ERR_CAPACITY, ERR_DOT, ERR_PROTO, ERR_SEQ, INF, SEQ_BOUND, EngineDims, dot_slot
 from .identity import DevIdentity
 from ..iset import iset_add, iset_contains
@@ -198,11 +201,14 @@ class _DepDev(DevIdentity):
         t = msg["mtype"]
         c_slot = dot_slot(msg["payload"][0], dims)
         collect_ok = (
-            (ps["seq_in_slot"][msg["src"], c_slot] == 0)
-            & (ps["vx_seq"][msg["src"], c_slot] == 0)
+            (oh_get(oh_get(ps["seq_in_slot"], msg["src"]), c_slot) == 0)
+            & (oh_get(oh_get(ps["vx_seq"], msg["src"]), c_slot) == 0)
         )
         dsrc, seq = msg["payload"][0], msg["payload"][1]
-        have = ps["seq_in_slot"][dsrc, dot_slot(seq, dims)] == seq
+        have = (
+            oh_get(oh_get(ps["seq_in_slot"], dsrc), dot_slot(seq, dims))
+            == seq
+        )
         ok = jnp.where(t == _DepDev.MCOLLECT, collect_ok, True)
         return jnp.where(t == _DepDev.MCOMMIT, have, ok)
 
@@ -278,8 +284,8 @@ class EPaxosDev(_DepDev):
 def _qd_add(ps, slot, dsrc, dseq, enable):
     """Merge one reported dep into the coordinator's count table
     (QuorumDeps.add, quorum.rs:24-34)."""
-    src_row = ps["qd_src"][slot]
-    seq_row = ps["qd_seq"][slot]
+    src_row = oh_get(ps["qd_src"], slot)
+    seq_row = oh_get(ps["qd_seq"], slot)
     Q = src_row.shape[0]
     do = jnp.asarray(enable, bool) & (dseq > 0)
     match = (seq_row == dseq) & (src_row == dsrc)
@@ -291,11 +297,14 @@ def _qd_add(ps, slot, dsrc, dseq, enable):
     widx = jnp.where(do & ~overflow, jnp.where(found, midx, fidx), Q)
     return dict(
         ps,
-        qd_src=ps["qd_src"].at[slot, widx].set(dsrc, mode="drop"),
-        qd_seq=ps["qd_seq"].at[slot, widx].set(dseq, mode="drop"),
-        qd_cnt=ps["qd_cnt"]
-        .at[slot, widx]
-        .set(jnp.where(found, ps["qd_cnt"][slot, widx] + 1, 1), mode="drop"),
+        qd_src=oh_set2(ps["qd_src"], slot, widx, dsrc),
+        qd_seq=oh_set2(ps["qd_seq"], slot, widx, dseq),
+        qd_cnt=oh_set2(
+            ps["qd_cnt"], slot, widx,
+            jnp.where(
+                found, oh_get(oh_get(ps["qd_cnt"], slot), widx) + 1, 1
+            ),
+        ),
         err=ps["err"] | ERR_CAPACITY * overflow,
     )
 
@@ -306,7 +315,8 @@ def _commit_broadcast(dev, ps, me, seq, key, client, ctx, dims, valid):
     slot = dot_slot(seq, dims)
     Q = dev.dep_slots(dims.N)
     P = dims.P
-    present = ps["qd_seq"][slot] > 0
+    qd_seq_row = oh_get(ps["qd_seq"], slot)
+    present = qd_seq_row > 0
     # compact present deps to the front so nd prefixes are meaningful
     order, nd = compact_order(present, Q)
     pay = jnp.zeros((P,), I32)
@@ -316,8 +326,15 @@ def _commit_broadcast(dev, ps, me, seq, key, client, ctx, dims, valid):
     pay = pay.at[3].set(client)
     pay = pay.at[4].set(nd)
     lo = 5 + 2 * jnp.minimum(order, P)  # > P when order==INF
-    pay = pay.at[lo].set(ps["qd_src"][slot], mode="drop")
-    pay = pay.at[lo + 1].set(ps["qd_seq"][slot], mode="drop")
+    iota_p = jnp.arange(P, dtype=I32)
+    oh_lo = lo[:, None] == iota_p[None, :]
+    oh_hi = (lo + 1)[:, None] == iota_p[None, :]
+    pay = pay + jnp.sum(
+        jnp.where(oh_lo, oh_get(ps["qd_src"], slot)[:, None], 0)
+        + jnp.where(oh_hi, qd_seq_row[:, None], 0),
+        axis=0,
+        dtype=I32,
+    )
 
     ob = emit_broadcast(
         empty_outbox(dims), _DepDev.MCOMMIT, pay, ctx["n"]
@@ -369,23 +386,22 @@ def _drain(dev, ps, me, ctx, dims, ob, exec_slot, drain_slot, enable=True):
     packed = srcs * SEQ_BOUND + ps["vx_seq"]
     flat_idx = jnp.argmin(jnp.where(sel, packed, INF))
     esrc, eslot = flat_idx // D, flat_idx % D
-    eseq = ps["vx_seq"][esrc, eslot]
-    client = ps["vx_client"][esrc, eslot]
+    eseq = oh_get(oh_get(ps["vx_seq"], esrc), eslot)
+    client = oh_get(oh_get(ps["vx_client"], esrc), eslot)
 
     do = jnp.asarray(enable, bool) & (num_ok > 0)
     front, gaps, overflow = iset_add(
-        ps["exec_front"][esrc], ps["exec_gaps"][esrc], eseq, do
+        oh_get(ps["exec_front"], esrc), oh_get(ps["exec_gaps"], esrc),
+        eseq, do,
     )
     ps = dict(
         ps,
-        exec_front=ps["exec_front"].at[esrc].set(front),
-        exec_gaps=ps["exec_gaps"].at[esrc].set(gaps),
-        vx_committed=ps["vx_committed"]
-        .at[jnp.where(do, esrc, N), eslot]
-        .set(False, mode="drop"),
-        vx_seq=ps["vx_seq"]
-        .at[jnp.where(do, esrc, N), eslot]
-        .set(0, mode="drop"),
+        exec_front=oh_set(ps["exec_front"], esrc, front),
+        exec_gaps=oh_set(ps["exec_gaps"], esrc, gaps),
+        vx_committed=oh_set2(
+            ps["vx_committed"], jnp.where(do, esrc, N), eslot, False
+        ),
+        vx_seq=oh_set2(ps["vx_seq"], jnp.where(do, esrc, N), eslot, 0),
         err=ps["err"] | ERR_CAPACITY * overflow,
     )
     ob = emit(
@@ -394,7 +410,7 @@ def _drain(dev, ps, me, ctx, dims, ob, exec_slot, drain_slot, enable=True):
         dims.N + client,
         _DepDev.TO_CLIENT,
         [0],
-        valid=do & (ctx["client_attach"][client] == me),
+        valid=do & (oh_get(ctx["client_attach"], client) == me),
     )
     ob = emit(
         ob,
@@ -421,20 +437,20 @@ def _submit(dev, ps, msg, me, ctx, dims):
     slot = dot_slot(seq, dims)
     Q = dev.dep_slots(dims.N)
 
-    prev_src = ps["latest_src"][key]
-    prev_seq = ps["latest_seq"][key]
+    prev_src = oh_get(ps["latest_src"], key)
+    prev_seq = oh_get(ps["latest_seq"], key)
     ps = dict(
         ps,
         # (source, sequence) packing in the drain requires seq < bound
         err=ps["err"] | ERR_SEQ * (seq >= SEQ_BOUND),
         own_seq=seq,
-        latest_src=ps["latest_src"].at[key].set(me),
-        latest_seq=ps["latest_seq"].at[key].set(seq),
-        ack_cnt=ps["ack_cnt"].at[slot].set(0),
-        slow_acks=ps["slow_acks"].at[slot].set(0),
-        qd_src=ps["qd_src"].at[slot].set(jnp.zeros((Q,), I32)),
-        qd_seq=ps["qd_seq"].at[slot].set(jnp.zeros((Q,), I32)),
-        qd_cnt=ps["qd_cnt"].at[slot].set(jnp.zeros((Q,), I32)),
+        latest_src=oh_set(ps["latest_src"], key, me),
+        latest_seq=oh_set(ps["latest_seq"], key, seq),
+        ack_cnt=oh_set(ps["ack_cnt"], slot, 0),
+        slow_acks=oh_set(ps["slow_acks"], slot, 0),
+        qd_src=oh_set(ps["qd_src"], slot, jnp.zeros((Q,), I32)),
+        qd_seq=oh_set(ps["qd_seq"], slot, jnp.zeros((Q,), I32)),
+        qd_cnt=oh_set(ps["qd_cnt"], slot, jnp.zeros((Q,), I32)),
     )
     ob = emit_broadcast(
         empty_outbox(dims),
@@ -459,34 +475,36 @@ def _mcollect(dev, ps, msg, me, ctx, dims):
         msg["payload"][4],
     )
     slot = dot_slot(seq, dims)
-    dirty = (ps["seq_in_slot"][s, slot] != 0) | (ps["vx_seq"][s, slot] != 0)
+    dirty = (
+        oh_get(oh_get(ps["seq_in_slot"], s), slot) != 0
+    ) | (oh_get(oh_get(ps["vx_seq"], s), slot) != 0)
     ps = dict(
         ps,
         err=ps["err"] | ERR_DOT * dirty,
-        seq_in_slot=ps["seq_in_slot"].at[s, slot].set(seq),
-        key_of=ps["key_of"].at[s, slot].set(key),
-        client_of=ps["client_of"].at[s, slot].set(client),
+        seq_in_slot=oh_set2(ps["seq_in_slot"], s, slot, seq),
+        key_of=oh_set2(ps["key_of"], s, slot, key),
+        client_of=oh_set2(ps["client_of"], s, slot, client),
     )
-    in_q = ctx["fast_quorum"][s, me]
+    in_q = oh_get(oh_get(ctx["fast_quorum"], s), me)
     from_self = s == me
 
     # quorum member (not the coordinator): add_cmd with the
     # coordinator's deps as past (sequential.rs:62-86)
     member = in_q & ~from_self
-    d1src = jnp.where(member, ps["latest_src"][key], cdsrc)
-    d1seq = jnp.where(member, ps["latest_seq"][key], cdseq)
+    d1src = jnp.where(member, oh_get(ps["latest_src"], key), cdsrc)
+    d1seq = jnp.where(member, oh_get(ps["latest_seq"], key), cdseq)
     # second dep = coordinator's, dropped when identical to mine
     dup = (d1src == cdsrc) & (d1seq == cdseq)
     d2src = jnp.where(member & ~dup, cdsrc, 0)
     d2seq = jnp.where(member & ~dup, cdseq, 0)
     ps = dict(
         ps,
-        latest_src=ps["latest_src"]
-        .at[jnp.where(member, key, dev.K)]
-        .set(s, mode="drop"),
-        latest_seq=ps["latest_seq"]
-        .at[jnp.where(member, key, dev.K)]
-        .set(seq, mode="drop"),
+        latest_src=oh_set(
+            ps["latest_src"], jnp.where(member, key, dev.K), s
+        ),
+        latest_seq=oh_set(
+            ps["latest_seq"], jnp.where(member, key, dev.K), seq
+        ),
     )
     ack = in_q & (ctx["ack_self"] | ~from_self)
     ob = emit(
@@ -511,7 +529,8 @@ def _mcollectack(dev, ps, msg, me, ctx, dims):
     ps = dict(ps, ack_cnt=ps["ack_cnt"].at[slot].set(cnt))
 
     all_acks = cnt == ctx["expected_acks"]
-    present = ps["qd_seq"][slot] > 0
+    qd_seq_row = oh_get(ps["qd_seq"], slot)
+    present = qd_seq_row > 0
     counts = ps["qd_cnt"][slot]
     # Atlas: every dep seen >= f times; EPaxos: every dep seen by all
     threshold = jnp.where(
@@ -526,8 +545,8 @@ def _mcollectack(dev, ps, msg, me, ctx, dims):
         m_slow=ps["m_slow"] + slow.astype(I32),
     )
 
-    key = ps["key_of"][me, slot]
-    client = ps["client_of"][me, slot]
+    key = oh_get(oh_get(ps["key_of"], me), slot)
+    client = oh_get(oh_get(ps["client_of"], me), slot)
     ob = _commit_broadcast(dev, ps, me, seq, key, client, ctx, dims, fast)
     obc = emit_broadcast(
         empty_outbox(dims),
@@ -536,7 +555,7 @@ def _mcollectack(dev, ps, msg, me, ctx, dims):
         ctx["n"],
     )
     wq = jnp.zeros((dims.F,), bool).at[: dims.N].set(
-        ctx["write_quorum"][me]
+        oh_get(ctx["write_quorum"], me)
     )
     obc = dict(obc, valid=obc["valid"] & slow & wq)
     ob = jax.tree_util.tree_map(
@@ -562,35 +581,36 @@ def _mcommit(dev, ps, msg, me, ctx, dims):
     slot = dot_slot(seq, dims)
     Q = dev.dep_slots(dims.N)
 
-    have = ps["seq_in_slot"][dsrc, slot] == seq
-    already = ps["vx_seq"][dsrc, slot] == seq
+    have = oh_get(oh_get(ps["seq_in_slot"], dsrc), slot) == seq
+    already = oh_get(oh_get(ps["vx_seq"], dsrc), slot) == seq
     do = have & ~already
     ps = dict(ps, err=ps["err"] | ERR_PROTO * ~have)
 
     idxs = 5 + 2 * jnp.arange(Q, dtype=I32)
     dep_en = jnp.arange(Q, dtype=I32) < nd
-    dsrcs = jnp.where(dep_en, msg["payload"][idxs], 0)
-    dseqs = jnp.where(dep_en, msg["payload"][idxs + 1], 0)
+    dsrcs = jnp.where(dep_en, oh_take(msg["payload"], idxs), 0)
+    dseqs = jnp.where(dep_en, oh_take(msg["payload"], idxs + 1), 0)
 
     wsrc = jnp.where(do, dsrc, dims.N)
     ps = dict(
         ps,
-        vx_committed=ps["vx_committed"].at[wsrc, slot].set(True, mode="drop"),
-        vx_seq=ps["vx_seq"].at[wsrc, slot].set(seq, mode="drop"),
-        vx_key=ps["vx_key"].at[wsrc, slot].set(key, mode="drop"),
-        vx_client=ps["vx_client"].at[wsrc, slot].set(client, mode="drop"),
-        vx_nd=ps["vx_nd"].at[wsrc, slot].set(nd, mode="drop"),
-        vx_dep_src=ps["vx_dep_src"].at[wsrc, slot].set(dsrcs, mode="drop"),
-        vx_dep_seq=ps["vx_dep_seq"].at[wsrc, slot].set(dseqs, mode="drop"),
+        vx_committed=oh_set2(ps["vx_committed"], wsrc, slot, True),
+        vx_seq=oh_set2(ps["vx_seq"], wsrc, slot, seq),
+        vx_key=oh_set2(ps["vx_key"], wsrc, slot, key),
+        vx_client=oh_set2(ps["vx_client"], wsrc, slot, client),
+        vx_nd=oh_set2(ps["vx_nd"], wsrc, slot, nd),
+        vx_dep_src=oh_set2(ps["vx_dep_src"], wsrc, slot, dsrcs),
+        vx_dep_seq=oh_set2(ps["vx_dep_seq"], wsrc, slot, dseqs),
     )
 
     cf, cg, overflow = iset_add(
-        ps["comm_front"][dsrc], ps["comm_gaps"][dsrc], seq, do
+        oh_get(ps["comm_front"], dsrc), oh_get(ps["comm_gaps"], dsrc),
+        seq, do,
     )
     ps = dict(
         ps,
-        comm_front=ps["comm_front"].at[dsrc].set(cf),
-        comm_gaps=ps["comm_gaps"].at[dsrc].set(cg),
+        comm_front=oh_set(ps["comm_front"], dsrc, cf),
+        comm_gaps=oh_set(ps["comm_gaps"], dsrc, cg),
         err=ps["err"] | ERR_CAPACITY * overflow,
     )
     return _drain(dev, ps, me, ctx, dims, empty_outbox(dims), 0, 1)
@@ -616,11 +636,11 @@ def _mconsensusack(dev, ps, msg, me, ctx, dims):
     commit with the dep union gathered during collect."""
     seq = msg["payload"][1]
     slot = dot_slot(seq, dims)
-    cnt = ps["slow_acks"][slot] + 1
+    cnt = oh_get(ps["slow_acks"], slot) + 1
     chosen = cnt == ctx["f"] + 1
-    ps = dict(ps, slow_acks=ps["slow_acks"].at[slot].set(cnt))
-    key = ps["key_of"][me, slot]
-    client = ps["client_of"][me, slot]
+    ps = dict(ps, slow_acks=oh_set(ps["slow_acks"], slot, cnt))
+    key = oh_get(oh_get(ps["key_of"], me), slot)
+    client = oh_get(oh_get(ps["client_of"], me), slot)
     ob = _commit_broadcast(
         dev, ps, me, seq, key, client, ctx, dims, chosen
     )
@@ -633,10 +653,12 @@ def _mgc(dev, ps, msg, me, ctx, dims):
     N = dims.N
     s = msg["src"]
     frontier = msg["payload"][:N]
-    of = ps["others_frontier"].at[s].set(
-        jnp.maximum(ps["others_frontier"][s], frontier)
+    of = oh_set(
+        ps["others_frontier"],
+        s,
+        jnp.maximum(oh_get(ps["others_frontier"], s), frontier),
     )
-    seen = ps["seen"].at[s].set(True)
+    seen = oh_set(ps["seen"], s, True)
     procs = jnp.arange(N, dtype=I32)
     nmask = procs < ctx["n"]
     others = nmask & (procs != me)

@@ -38,7 +38,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core import I32, emit, emit_broadcast, empty_outbox
+from ..core import (
+    I32, cumsum_i32, emit, emit_broadcast, empty_outbox, oh_get, oh_set,
+    oh_set2, oh_take,
+)
 from ..dims import (
     ERR_CAPACITY, ERR_DOT, ERR_PROTO, ERR_SEQ, INF, SEQ_BOUND, EngineDims,
     dot_slot,
@@ -195,10 +198,13 @@ class TempoDev(DevIdentity):
         t = msg["mtype"]
         # MCOLLECT: payload [seq, ...] from msg src
         c_slot = dot_slot(msg["payload"][0], dims)
-        collect_ok = ps["seq_in_slot"][msg["src"], c_slot] == 0
+        collect_ok = oh_get(oh_get(ps["seq_in_slot"], msg["src"]), c_slot) == 0
         # MCOMMIT / MCONSENSUS: payload [dsrc, seq, ...]
         dsrc, seq = msg["payload"][0], msg["payload"][1]
-        have = ps["seq_in_slot"][dsrc, dot_slot(seq, dims)] == seq
+        have = (
+            oh_get(oh_get(ps["seq_in_slot"], dsrc), dot_slot(seq, dims))
+            == seq
+        )
         ok = jnp.where(t == TempoDev.MCOLLECT, collect_ok, True)
         return jnp.where(
             (t == TempoDev.MCOMMIT) | (t == TempoDev.MCONSENSUS), have, ok
@@ -263,38 +269,52 @@ class TempoDev(DevIdentity):
 
 def _det_add(tempo, ps, key, start, end, enable):
     """Append a detached vote range for ``key`` (Votes::add; ranges stay
-    exact because attached votes interleave)."""
-    det = ps["det"]
-    row = det[key]  # [R, 2]
+    exact because attached votes interleave). All updates are one-hot
+    selects: scatters cost a kernel each on the target runtime."""
+    det = ps["det"]  # [K, R, 2]
+    krow = jnp.arange(tempo.K, dtype=I32) == key               # [K]
+    row = jnp.sum(jnp.where(krow[:, None, None], det, 0), axis=0)  # [R, 2]
     # compress with an existing contiguous range (votes.rs:131-147)
     touch = (row[:, 0] > 0) & (row[:, 1] + 1 == start)
     can_compress = jnp.any(touch)
     cslot = jnp.argmax(touch)
     do = jnp.asarray(enable, bool) & (end >= start)
     comp = do & can_compress
-    det = det.at[key, jnp.where(comp, cslot, tempo.R), 1].set(
-        end, mode="drop"
+    hit_c = (
+        krow[:, None]
+        & (jnp.arange(tempo.R, dtype=I32) == cslot)[None, :]
+        & comp
+    )                                                          # [K, R]
+    det = jnp.where(
+        hit_c[:, :, None] & jnp.array([False, True])[None, None, :],
+        end,
+        det,
     )
     # otherwise take a free slot
     free = row[:, 0] == 0
     slot = jnp.argmax(free)
     store = do & ~can_compress
     overflow = store & ~jnp.any(free)
-    slot = jnp.where(store & ~overflow, slot, tempo.R)
-    det = det.at[key, slot, 0].set(start, mode="drop")
-    det = det.at[key, slot, 1].set(end, mode="drop")
+    hit_s = (
+        krow[:, None]
+        & (jnp.arange(tempo.R, dtype=I32) == slot)[None, :]
+        & (store & ~overflow)
+    )
+    det = jnp.where(
+        hit_s[:, :, None], jnp.stack([start, end])[None, None, :], det
+    )
     return dict(ps, det=det, err=ps["err"] | ERR_CAPACITY * overflow)
 
 
 def _bump(tempo, ps, key, up_to, enable):
     """key_clocks.detached: vote (clock+1..up_to) and lift the clock
     (clocks/keys/sequential.rs:96-104)."""
-    cur = ps["clocks"][key]
+    cur = oh_get(ps["clocks"], key)
     do = jnp.asarray(enable, bool) & (cur < up_to)
     ps = _det_add(tempo, ps, key, cur + 1, up_to, do)
     return dict(
         ps,
-        clocks=ps["clocks"].at[key].set(jnp.where(do, up_to, cur)),
+        clocks=oh_set(ps["clocks"], key, jnp.where(do, up_to, cur)),
     )
 
 
@@ -307,10 +327,12 @@ def _detached_all(tempo, ps, min_clock, enable):
     free = det[:, :, 0] == 0  # [K, R]
     slot = jnp.argmax(free, axis=1)  # [K]
     overflow = do & ~jnp.any(free, axis=1)
-    kidx = jnp.arange(tempo.K)
     slot_w = jnp.where(do & ~overflow, slot, tempo.R)
-    det = det.at[kidx, slot_w, 0].set(clocks + 1, mode="drop")
-    det = det.at[kidx, slot_w, 1].set(min_clock, mode="drop")
+    hit = jnp.arange(tempo.R, dtype=I32)[None, :] == slot_w[:, None]
+    vals = jnp.stack(
+        [clocks + 1, jnp.broadcast_to(min_clock, clocks.shape)], axis=-1
+    )  # [K, 2]
+    det = jnp.where(hit[:, :, None], vals[:, None, :], det)
     return dict(
         ps,
         det=det,
@@ -321,13 +343,13 @@ def _detached_all(tempo, ps, min_clock, enable):
 
 def _vote_add(tempo, ps, key, voter, start, end, enable):
     """Union a vote range into the (key, voter) interval clock."""
-    front = ps["vote_front"][key, voter]
-    gaps = ps["vote_gaps"][key, voter]
+    front = oh_get(oh_get(ps["vote_front"], key), voter)
+    gaps = oh_get(oh_get(ps["vote_gaps"], key), voter)
     front, gaps, overflow = iset_add_range(front, gaps, start, end, enable)
     return dict(
         ps,
-        vote_front=ps["vote_front"].at[key, voter].set(front),
-        vote_gaps=ps["vote_gaps"].at[key, voter].set(gaps),
+        vote_front=oh_set2(ps["vote_front"], key, voter, front),
+        vote_gaps=oh_set2(ps["vote_gaps"], key, voter, gaps),
         err=ps["err"] | ERR_CAPACITY * overflow,
     )
 
@@ -339,12 +361,19 @@ def _vote_add(tempo, ps, key, voter, start, end, enable):
 
 
 def _stable_clock(tempo, ps, key, ctx, dims):
-    """Threshold-ranked frontier over voters (table/mod.rs:243-263)."""
-    fronts = ps["vote_front"][key]  # [N]
+    """Threshold-ranked frontier over voters (table/mod.rs:243-263).
+    The (n - threshold)-th order statistic over N values, computed by
+    comparison ranking in one fusion (jnp.sort is a kernel)."""
+    fronts = oh_get(ps["vote_front"], key)  # [N]
     procs = jnp.arange(dims.N, dtype=I32)
     masked = jnp.where(procs < ctx["n"], fronts, INF)
-    ordered = jnp.sort(masked)
-    return jnp.take(ordered, ctx["n"] - ctx["threshold"])
+    rank = jnp.sum(
+        (masked[None, :] < masked[:, None])
+        | ((masked[None, :] == masked[:, None]) & (procs[None, :] < procs[:, None])),
+        axis=1,
+    )
+    k = ctx["n"] - ctx["threshold"]
+    return jnp.sum(jnp.where(rank == k, masked, 0))
 
 
 def _drain(tempo, ps, key, me, ctx, dims, ob, exec_slot, drain_slot,
@@ -353,21 +382,24 @@ def _drain(tempo, ps, key, me, ctx, dims, ob, exec_slot, drain_slot,
     re-schedule when more are ready (the VotesTable stable_ops loop,
     spread across zero-delay self-messages)."""
     stable = _stable_clock(tempo, ps, key, ctx, dims)
-    clocks = ps["pend_clock"][key]  # [PK]
+    clocks = oh_get(ps["pend_clock"], key)  # [PK]
     ready = (clocks > 0) & (clocks <= stable)
     num_ready = jnp.sum(ready)
     cmin = jnp.min(jnp.where(ready, clocks, INF))
     tie = ready & (clocks == cmin)
-    packed = ps["pend_src"][key] * SEQ_BOUND + ps["pend_seq"][key]
+    packed = (
+        oh_get(ps["pend_src"], key) * SEQ_BOUND
+        + oh_get(ps["pend_seq"], key)
+    )
     idx = jnp.argmin(jnp.where(tie, packed, INF))
 
     do = jnp.asarray(enable, bool) & (num_ready > 0)
-    client = ps["pend_client"][key, idx]
+    client = oh_get(oh_get(ps["pend_client"], key), idx)
     ps = dict(
         ps,
-        pend_clock=ps["pend_clock"]
-        .at[key, jnp.where(do, idx, tempo.PK)]
-        .set(0, mode="drop"),
+        pend_clock=oh_set2(
+            ps["pend_clock"], key, jnp.where(do, idx, tempo.PK), 0
+        ),
     )
     ob = emit(
         ob,
@@ -375,7 +407,7 @@ def _drain(tempo, ps, key, me, ctx, dims, ob, exec_slot, drain_slot,
         dims.N + client,
         TempoDev.TO_CLIENT,
         [0],
-        valid=do & (ctx["client_attach"][client] == me),
+        valid=do & (oh_get(ctx["client_attach"], client) == me),
     )
     ob = emit(
         ob,
@@ -389,17 +421,17 @@ def _drain(tempo, ps, key, me, ctx, dims, ob, exec_slot, drain_slot,
 
 
 def _pend_insert(tempo, ps, key, clock, src, seq, client):
-    slots = ps["pend_clock"][key]
+    slots = oh_get(ps["pend_clock"], key)
     free = slots == 0
     idx = jnp.argmax(free)
     overflow = ~jnp.any(free)
     widx = jnp.where(overflow, tempo.PK, idx)
     return dict(
         ps,
-        pend_clock=ps["pend_clock"].at[key, widx].set(clock, mode="drop"),
-        pend_src=ps["pend_src"].at[key, widx].set(src, mode="drop"),
-        pend_seq=ps["pend_seq"].at[key, widx].set(seq, mode="drop"),
-        pend_client=ps["pend_client"].at[key, widx].set(client, mode="drop"),
+        pend_clock=oh_set2(ps["pend_clock"], key, widx, clock),
+        pend_src=oh_set2(ps["pend_src"], key, widx, src),
+        pend_seq=oh_set2(ps["pend_seq"], key, widx, seq),
+        pend_client=oh_set2(ps["pend_client"], key, widx, client),
         err=ps["err"] | ERR_CAPACITY * overflow,
     )
 
@@ -417,22 +449,22 @@ def _submit(tempo, ps, msg, me, ctx, dims):
     seq = ps["own_seq"] + 1
     slot = dot_slot(seq, dims)
 
-    cur = ps["clocks"][key]
+    cur = oh_get(ps["clocks"], key)
     clock = cur + 1  # max(0, highest key clock + 1), single key
     ps = dict(
         ps,
         # (source, sequence) packing in the drain scan requires seq < bound
         err=ps["err"] | ERR_SEQ * (seq >= SEQ_BOUND),
         own_seq=seq,
-        clocks=ps["clocks"].at[key].set(clock),
-        ack_cnt=ps["ack_cnt"].at[slot].set(0),
-        max_clock=ps["max_clock"].at[slot].set(0),
-        max_cnt=ps["max_cnt"].at[slot].set(0),
-        slow_acks=ps["slow_acks"].at[slot].set(0),
-        votes_n=ps["votes_n"].at[slot].set(1),
-        votes_by=ps["votes_by"].at[slot, 0].set(me),
-        votes_s=ps["votes_s"].at[slot, 0].set(cur + 1),
-        votes_e=ps["votes_e"].at[slot, 0].set(clock),
+        clocks=oh_set(ps["clocks"], key, clock),
+        ack_cnt=oh_set(ps["ack_cnt"], slot, 0),
+        max_clock=oh_set(ps["max_clock"], slot, 0),
+        max_cnt=oh_set(ps["max_cnt"], slot, 0),
+        slow_acks=oh_set(ps["slow_acks"], slot, 0),
+        votes_n=oh_set(ps["votes_n"], slot, 1),
+        votes_by=oh_set2(ps["votes_by"], slot, 0, me),
+        votes_s=oh_set2(ps["votes_s"], slot, 0, cur + 1),
+        votes_e=oh_set2(ps["votes_e"], slot, 0, clock),
     )
     ob = emit_broadcast(
         empty_outbox(dims),
@@ -454,24 +486,24 @@ def _mcollect(tempo, ps, msg, me, ctx, dims):
         msg["payload"][3],
     )
     slot = dot_slot(seq, dims)
-    dirty = ps["seq_in_slot"][s, slot] != 0
+    dirty = oh_get(oh_get(ps["seq_in_slot"], s), slot) != 0
     ps = dict(
         ps,
         err=ps["err"] | ERR_DOT * dirty,
-        seq_in_slot=ps["seq_in_slot"].at[s, slot].set(seq),
-        key_of=ps["key_of"].at[s, slot].set(key),
-        client_of=ps["client_of"].at[s, slot].set(client),
+        seq_in_slot=oh_set2(ps["seq_in_slot"], s, slot, seq),
+        key_of=oh_set2(ps["key_of"], s, slot, key),
+        client_of=oh_set2(ps["client_of"], s, slot, client),
     )
-    in_q = ctx["fast_quorum"][s, me]
+    in_q = oh_get(oh_get(ctx["fast_quorum"], s), me)
     from_self = s == me
 
     # non-self quorum member: proposal(cmd, remote clock)
-    cur = ps["clocks"][key]
+    cur = oh_get(ps["clocks"], key)
     clock = jnp.maximum(rclock, cur + 1)
     propose = in_q & ~from_self
     ps = dict(
         ps,
-        clocks=ps["clocks"].at[key].set(jnp.where(propose, clock, cur)),
+        clocks=oh_set(ps["clocks"], key, jnp.where(propose, clock, cur)),
     )
     ack_clock = jnp.where(from_self, rclock, clock)
     vs = jnp.where(propose, cur + 1, 0)
@@ -500,35 +532,35 @@ def _mcollectack(tempo, ps, msg, me, ctx, dims):
     slot = dot_slot(seq, dims)
 
     # merge the ack's vote range
-    nv = ps["votes_n"][slot]
+    nv = oh_get(ps["votes_n"], slot)
     has_vote = vs > 0
     fits = has_vote & (nv < dims.N)
     widx = jnp.where(fits, nv, dims.N)
     ps = dict(
         ps,
-        votes_by=ps["votes_by"].at[slot, widx].set(src, mode="drop"),
-        votes_s=ps["votes_s"].at[slot, widx].set(vs, mode="drop"),
-        votes_e=ps["votes_e"].at[slot, widx].set(ve, mode="drop"),
-        votes_n=ps["votes_n"].at[slot].add(fits.astype(I32)),
+        votes_by=oh_set2(ps["votes_by"], slot, widx, src),
+        votes_s=oh_set2(ps["votes_s"], slot, widx, vs),
+        votes_e=oh_set2(ps["votes_e"], slot, widx, ve),
+        votes_n=oh_set(ps["votes_n"], slot, nv + fits.astype(I32)),
         err=ps["err"] | ERR_CAPACITY * (has_vote & ~fits),
     )
 
     # quorum clock aggregation
-    old_max = ps["max_clock"][slot]
+    old_max = oh_get(ps["max_clock"], slot)
     new_max = jnp.maximum(old_max, clock)
     new_cnt = jnp.where(
-        clock > old_max, 1, ps["max_cnt"][slot] + (clock == old_max)
+        clock > old_max, 1, oh_get(ps["max_cnt"], slot) + (clock == old_max)
     )
-    cnt = ps["ack_cnt"][slot] + 1
+    cnt = oh_get(ps["ack_cnt"], slot) + 1
     ps = dict(
         ps,
-        max_clock=ps["max_clock"].at[slot].set(new_max),
-        max_cnt=ps["max_cnt"].at[slot].set(new_cnt),
-        ack_cnt=ps["ack_cnt"].at[slot].set(cnt),
+        max_clock=oh_set(ps["max_clock"], slot, new_max),
+        max_cnt=oh_set(ps["max_cnt"], slot, new_cnt),
+        ack_cnt=oh_set(ps["ack_cnt"], slot, cnt),
     )
 
     # bump own keys to the running max (tempo.rs:497-514)
-    key = ps["key_of"][me, slot]
+    key = oh_get(oh_get(ps["key_of"], me), slot)
     ps = _bump(tempo, ps, key, new_max, src != me)
 
     all_acks = cnt == ctx["fq_size"]
@@ -540,7 +572,7 @@ def _mcollectack(tempo, ps, msg, me, ctx, dims):
         m_slow=ps["m_slow"] + slow.astype(I32),
     )
 
-    client = ps["client_of"][me, slot]
+    client = oh_get(oh_get(ps["client_of"], me), slot)
     ob = _commit_broadcast(
         tempo, ps, me, seq, new_max, key, client, ctx, dims, fast
     )
@@ -551,7 +583,7 @@ def _mcollectack(tempo, ps, msg, me, ctx, dims):
         ctx["n"],
     )
     wq = jnp.zeros((dims.F,), bool).at[: dims.N].set(
-        ctx["write_quorum"][me]
+        oh_get(ctx["write_quorum"], me)
     )
     obc = dict(obc, valid=obc["valid"] & slow & wq)
     ob = jax.tree_util.tree_map(
@@ -577,14 +609,14 @@ def _commit_broadcast(tempo, ps, me, seq, clock, key, client, ctx, dims,
     pay = pay.at[2].set(clock)
     pay = pay.at[3].set(key)
     pay = pay.at[4].set(client)
-    pay = pay.at[5].set(ps["votes_n"][slot])
+    pay = pay.at[5].set(oh_get(ps["votes_n"], slot))
     pay = jax.lax.dynamic_update_slice(
         pay,
         jnp.stack(
             [
-                ps["votes_by"][slot],
-                ps["votes_s"][slot],
-                ps["votes_e"][slot],
+                oh_get(ps["votes_by"], slot),
+                oh_get(ps["votes_s"], slot),
+                oh_get(ps["votes_e"], slot),
             ],
             axis=1,
         ).reshape(-1),
@@ -621,7 +653,7 @@ def _mcommit(tempo, ps, msg, me, ctx, dims):
     client = msg["payload"][4]
     nv = msg["payload"][5]
     slot = dot_slot(seq, dims)
-    have = ps["seq_in_slot"][dsrc, slot] == seq
+    have = oh_get(oh_get(ps["seq_in_slot"], dsrc), slot) == seq
     ps = dict(ps, err=ps["err"] | ERR_PROTO * ~have)
 
     # clock management (real-time mode defers to the periodic bump)
@@ -641,37 +673,40 @@ def _mcommit(tempo, ps, msg, me, ctx, dims):
     # per-voter lanes and union them with one vmapped interval-set add
     # instead of a sequential loop.
     idxs = 6 + 3 * jnp.arange(dims.N, dtype=I32)
-    bys = msg["payload"][idxs]
+    bys = oh_take(msg["payload"], idxs)
     enable = jnp.arange(dims.N, dtype=I32) < nv
     bys = jnp.where(enable, bys, dims.N)
-    per_s = jnp.zeros((dims.N,), I32).at[bys].set(
-        msg["payload"][idxs + 1], mode="drop"
-    )
-    per_e = jnp.zeros((dims.N,), I32).at[bys].set(
-        msg["payload"][idxs + 2], mode="drop"
-    )
-    per_enable = jnp.zeros((dims.N,), bool).at[bys].set(
-        enable, mode="drop"
-    )
+    # voters are distinct, so route (start, end, enable) to per-voter
+    # lanes with one-hot sums (each .at[bys].set was a scatter kernel)
+    oh_by = bys[:, None] == jnp.arange(dims.N, dtype=I32)[None, :]
+    starts = oh_take(msg["payload"], idxs + 1)
+    ends = oh_take(msg["payload"], idxs + 2)
+    per_s = jnp.sum(jnp.where(oh_by, starts[:, None], 0), axis=0)
+    per_e = jnp.sum(jnp.where(oh_by, ends[:, None], 0), axis=0)
+    per_enable = jnp.any(oh_by & enable[:, None], axis=0)
     fronts, gaps, ovf = jax.vmap(iset_add_range)(
-        ps["vote_front"][key], ps["vote_gaps"][key], per_s, per_e, per_enable
+        oh_get(ps["vote_front"], key),
+        oh_get(ps["vote_gaps"], key),
+        per_s,
+        per_e,
+        per_enable,
     )
     ps = dict(
         ps,
-        vote_front=ps["vote_front"].at[key].set(fronts),
-        vote_gaps=ps["vote_gaps"].at[key].set(gaps),
+        vote_front=oh_set(ps["vote_front"], key, fronts),
+        vote_gaps=oh_set(ps["vote_gaps"], key, gaps),
         err=ps["err"] | ERR_CAPACITY * jnp.any(ovf),
     )
     ps = _pend_insert(tempo, ps, key, clock, dsrc, seq, client)
 
     # GC committed clock
     cf, cg, overflow = iset_add(
-        ps["comm_front"][dsrc], ps["comm_gaps"][dsrc], seq
+        oh_get(ps["comm_front"], dsrc), oh_get(ps["comm_gaps"], dsrc), seq
     )
     ps = dict(
         ps,
-        comm_front=ps["comm_front"].at[dsrc].set(cf),
-        comm_gaps=ps["comm_gaps"].at[dsrc].set(cg),
+        comm_front=oh_set(ps["comm_front"], dsrc, cf),
+        comm_gaps=oh_set(ps["comm_gaps"], dsrc, cg),
         err=ps["err"] | ERR_CAPACITY * overflow,
     )
     return _drain(
@@ -686,12 +721,12 @@ def _mdetached(tempo, ps, msg, me, ctx, dims):
     key = msg["payload"][0]
     nr = msg["payload"][1]
 
-    def add(i, ps):
+    # statically unrolled (payload indexes become slices and the whole
+    # chain fuses; as a lax loop each iteration pays kernel launches)
+    for i in range(tempo.detached_per_msg(dims)):
         s = msg["payload"][2 + 2 * i]
         e = msg["payload"][2 + 2 * i + 1]
-        return _vote_add(tempo, ps, key, voter, s, e, i < nr)
-
-    ps = jax.lax.fori_loop(0, tempo.detached_per_msg(dims), add, ps)
+        ps = _vote_add(tempo, ps, key, voter, s, e, i < nr)
     return _drain(tempo, ps, key, me, ctx, dims, empty_outbox(dims), 0, 1)
 
 
@@ -704,8 +739,8 @@ def _mconsensus(tempo, ps, msg, me, ctx, dims):
         msg["payload"][2],
     )
     slot = dot_slot(seq, dims)
-    key = ps["key_of"][dsrc, slot]
-    has_cmd = ps["seq_in_slot"][dsrc, slot] == seq
+    key = oh_get(oh_get(ps["key_of"], dsrc), slot)
+    has_cmd = oh_get(oh_get(ps["seq_in_slot"], dsrc), slot) == seq
     ps = _bump(tempo, ps, key, clock, has_cmd)
     ob = emit(
         empty_outbox(dims),
@@ -722,17 +757,17 @@ def _mconsensusack(tempo, ps, msg, me, ctx, dims):
     with the votes gathered during collect."""
     seq = msg["payload"][1]
     slot = dot_slot(seq, dims)
-    cnt = ps["slow_acks"][slot] + 1
+    cnt = oh_get(ps["slow_acks"], slot) + 1
     chosen = cnt == ctx["wq_size"]
-    ps = dict(ps, slow_acks=ps["slow_acks"].at[slot].set(cnt))
-    key = ps["key_of"][me, slot]
-    client = ps["client_of"][me, slot]
+    ps = dict(ps, slow_acks=oh_set(ps["slow_acks"], slot, cnt))
+    key = oh_get(oh_get(ps["key_of"], me), slot)
+    client = oh_get(oh_get(ps["client_of"], me), slot)
     ob = _commit_broadcast(
         tempo,
         ps,
         me,
         seq,
-        ps["max_clock"][slot],
+        oh_get(ps["max_clock"], slot),
         key,
         client,
         ctx,
@@ -748,10 +783,12 @@ def _mgc(tempo, ps, msg, me, ctx, dims):
     N = dims.N
     s = msg["src"]
     frontier = msg["payload"][:N]
-    of = ps["others_frontier"].at[s].set(
-        jnp.maximum(ps["others_frontier"][s], frontier)
+    of = oh_set(
+        ps["others_frontier"],
+        s,
+        jnp.maximum(oh_get(ps["others_frontier"], s), frontier),
     )
-    seen = ps["seen"].at[s].set(True)
+    seen = oh_set(ps["seen"], s, True)
     procs = jnp.arange(N, dtype=I32)
     nmask = procs < ctx["n"]
     others = nmask & (procs != me)
@@ -790,22 +827,29 @@ def _detach_drain(tempo, ps, msg, me, ctx, dims):
     key = jnp.argmax(key_has)
     any_key = jnp.any(key_has)
 
-    row = det[key]  # [R, 2]
+    row = oh_get(det, key)  # [R, 2]
     occ = row[:, 0] > 0
-    order = jnp.cumsum(occ.astype(I32))
+    order = cumsum_i32(occ)
     per_msg = tempo.detached_per_msg(dims)
     take = occ & (order <= per_msg)
     nr = jnp.sum(take)
 
-    # pack taken ranges into the payload
+    # pack taken ranges into the payload (one-hot writes; each
+    # .at[lo].set was a scatter kernel)
     pay = jnp.zeros((dims.P,), I32)
     pay = pay.at[0].set(key)
     pay = pay.at[1].set(nr)
     lo = jnp.where(take, 2 + 2 * (order - 1), dims.P)
-    pay = pay.at[lo].set(row[:, 0], mode="drop")
-    pay = pay.at[lo + 1].set(row[:, 1], mode="drop")
+    iota_p = jnp.arange(dims.P, dtype=I32)
+    oh_lo = lo[:, None] == iota_p[None, :]          # [R, P]
+    oh_hi = (lo + 1)[:, None] == iota_p[None, :]
+    pay = pay + jnp.sum(
+        jnp.where(oh_lo, row[:, :1], 0) + jnp.where(oh_hi, row[:, 1:], 0),
+        axis=0,
+        dtype=I32,
+    )
 
-    det = det.at[key].set(jnp.where(take[:, None], 0, row))
+    det = oh_set(det, key, jnp.where(take[:, None], 0, row))
     ps = dict(ps, det=det)
 
     ob = emit_broadcast(

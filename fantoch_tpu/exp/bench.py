@@ -150,7 +150,7 @@ def bench_experiment(
     run_dir = os.path.join(
         output_dir,
         f"{exp.protocol}_n{exp.n}_f{exp.f}_s{exp.shard_count}"
-        f"_c{exp.clients}",
+        f"_c{exp.clients}_k{exp.commands_per_client}_r{exp.conflict}",
     )
     os.makedirs(run_dir, exist_ok=True)
 
@@ -166,6 +166,7 @@ def bench_experiment(
     cport_of = {pid: ports[2 * i + 1] for i, (pid, _) in enumerate(ids)}
 
     servers: List[subprocess.Popen] = []
+    client_procs: List[subprocess.Popen] = []
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_REPO)
     dstat0 = _proc_snapshot()
     try:
@@ -230,7 +231,6 @@ def bench_experiment(
         ]
         if clients_per_group is not None:
             sizes = [clients_per_group] * groups
-        client_procs = []
         cid = 1
         for i, ((pid, shard), size) in enumerate(zip(shard0, sizes)):
             if size == 0:
@@ -269,10 +269,12 @@ def bench_experiment(
         # let GC finish before the final metrics dump
         time.sleep(0.3)
     finally:
-        for proc in servers:
+        # clients first (they die quickly on SIGTERM), then servers; a
+        # hung or failed run must never leave orphan subprocesses
+        for proc in client_procs + servers:
             if proc.poll() is None:
                 proc.send_signal(signal.SIGTERM)
-        for proc in servers:
+        for proc in client_procs + servers:
             try:
                 proc.wait(timeout=10)
             except subprocess.TimeoutExpired:

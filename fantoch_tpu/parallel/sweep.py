@@ -32,8 +32,8 @@ from ..engine.core import (
     build_runner,
     build_segment_runner,
     finish_segmented,
-    first_keys_fn,
     init_lane_state,
+    key_table_fn,
 )
 from ..engine.driver import batch_reorder_flag
 from ..engine.spec import stack_lanes
@@ -82,9 +82,14 @@ def make_sweep_specs(
     return specs
 
 
+# total key-table entries (lanes × clients × budget) above which the
+# sweep skips precomputation and the step derives keys in-loop instead
+# (a [512, 50, 10k] table would be ~1 GB over a ~30 MB/s tunnel)
+KEY_TABLE_LIMIT = 1 << 24
+
 @functools.lru_cache(maxsize=None)
-def _cached_first_keys(C: int):
-    return jax.jit(jax.vmap(first_keys_fn(C)))
+def _cached_key_table(C: int, T: int):
+    return jax.jit(jax.vmap(key_table_fn(C, T)))
 
 
 @functools.lru_cache(maxsize=None)
@@ -105,12 +110,22 @@ def run_sweep(
     specs: Sequence[LaneSpec],
     mesh: Optional[Mesh] = None,
     max_steps: int = 1 << 22,
-    segment_steps: int = 2048,
+    segment_steps: int = 8192,
 ) -> List[LaneResults]:
     """Run a sweep batch, sharded over ``mesh`` (default: all local
     devices on one axis). The device loop runs in ``segment_steps``
     increments with host-side resume, keeping each device execution
     bounded (tunneled workers die on multi-minute single calls)."""
+    import os
+    import time as _t
+
+    dbg = os.environ.get("FANTOCH_SWEEP_DEBUG")
+    marks = [("start", _t.perf_counter())]
+
+    def mark(label):
+        if dbg:
+            marks.append((label, _t.perf_counter()))
+
     if mesh is None:
         mesh = Mesh(np.asarray(jax.devices()), ("sweep",))
     shards = mesh.devices.size
@@ -118,16 +133,30 @@ def run_sweep(
     padded = list(specs) + [specs[-1]] * pad
 
     ctx = stack_lanes(padded)
-    # one batched device call for every lane's first client keys (the
-    # per-lane fallback inside init_lane_state would dispatch one tiny
-    # device computation per lane)
+    mark("stack_lanes")
+    # one batched device call precomputes every lane's full
+    # (client, seq) → key table: the engine step gathers keys instead
+    # of re-deriving them with threefry (the dominant per-step cost),
+    # and lane-state init reuses column 1 as each client's first key.
+    # Huge command budgets (the 100k-command stress shape) would
+    # materialize a lanes × clients × budget table, so past the cap the
+    # engine falls back to in-loop gen_key (bit-identical keys).
+    T_keys = int(max(2, ctx["cmd_budget"].max() + 2))
     kctx = {k: ctx[k] for k in KEYGEN_CTX_FIELDS}
-    first_keys = np.asarray(_cached_first_keys(dims.C)(kctx))
+    if len(padded) * dims.C * T_keys <= KEY_TABLE_LIMIT:
+        key_table = np.asarray(_cached_key_table(dims.C, T_keys)(kctx))
+        ctx["key_table"] = key_table
+        first = lambda i: key_table[i, :, 1]
+    else:
+        first_keys = np.asarray(_cached_key_table(dims.C, 2)(kctx))
+        first = lambda i: first_keys[i, :, 1]
+    mark("key_table")
     states = [
-        init_lane_state(protocol, dims, s.ctx, first_keys=first_keys[i])
+        init_lane_state(protocol, dims, s.ctx, first_keys=first(i))
         for i, s in enumerate(padded)
     ]
     state = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *states)
+    mark("init+stack_states")
 
     sharding = NamedSharding(mesh, PartitionSpec("sweep"))
     put = lambda tree: jax.tree_util.tree_map(
@@ -138,11 +167,38 @@ def run_sweep(
     )
     state = put(state)
     ctx = put(ctx)
+    mark("device_put")
     until = 0
     while until < max_steps:
         until = min(until + segment_steps, max_steps)
-        state = runner(state, ctx, np.int32(until))
-        if not bool(alive(state, ctx)):
+        state, any_alive = runner(state, ctx, np.int32(until))
+        if not bool(any_alive):
             break
-    final = finish_segmented(jax.device_get(state), max_steps)
-    return collect_results(protocol, dims, final, padded)[: len(specs)]
+        mark(f"segment@{until}")
+    mark("segments")
+    # fetch only what result collection reads (protocol metric fields
+    # follow the m_* convention) — the full state is ~100 MB per 512
+    # lanes and the tunnel moves ~30 MB/s
+    fetch = {
+        "metrics": state["metrics"],
+        "steps": state["steps"],
+        "err": state["err"],
+        "done_time": state["done_time"],
+        "clients": {"completed": state["clients"]["completed"]},
+        "pool_peak": state["pool_peak"],
+        "requeues": state["requeues"],
+        "ps": {
+            k: v for k, v in state["ps"].items() if k.startswith("m_")
+        },
+    }
+    final = finish_segmented(jax.device_get(fetch), max_steps)
+    mark("device_get")
+    out = collect_results(protocol, dims, final, padded)[: len(specs)]
+    mark("collect")
+    if dbg:
+        spans = ", ".join(
+            f"{label}={t1 - t0:.2f}s"
+            for (_, t0), (label, t1) in zip(marks, marks[1:])
+        )
+        print(f"[run_sweep {len(specs)} lanes] {spans}", flush=True)
+    return out

@@ -13,7 +13,7 @@ import pytest
 from fantoch_tpu.client.key_gen import zipf_weights
 from fantoch_tpu.core import Config, Planet
 from fantoch_tpu.engine import EngineDims, make_lane, run_lanes
-from fantoch_tpu.engine.core import gen_key, init_lane_state
+from fantoch_tpu.engine.core import PA, gen_key, init_lane_state
 from fantoch_tpu.engine.dims import INF
 from fantoch_tpu.engine.protocols import TempoDev
 
@@ -57,7 +57,7 @@ def test_make_lane_pool_ctx_feeds_init_lane_state():
     assert spec.ctx["zipf_cum"].shape == (1,)
     st = init_lane_state(tempo, dims, spec.ctx)  # round-1 KeyError site
     # one SUBMIT per live client, keyed (emission #1, client src)
-    live = (st["pool"]["arrival"] < INF).sum()
+    live = (st["pool"][:, PA] < INF).sum()
     assert int(live) == dims.C
 
 
@@ -68,7 +68,7 @@ def test_make_lane_zipf_ctx():
     assert spec.ctx["zipf_cum"].shape == (total_keys,)
     assert spec.ctx["zipf_cum"][-1] == pytest.approx(1.0)
     st = init_lane_state(tempo, dims, spec.ctx)
-    live = (st["pool"]["arrival"] < INF).sum()
+    live = (st["pool"][:, PA] < INF).sum()
     assert int(live) == dims.C
 
 
