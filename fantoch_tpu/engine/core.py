@@ -139,6 +139,21 @@ def oh_get(arr, i):
     return jnp.sum(jnp.where(hit, arr, 0), axis=0).astype(arr.dtype)
 
 
+def oh_pack_pairs(pay, lo, a, b):
+    """Scatter (a[i], b[i]) pairs into payload positions (lo[i],
+    lo[i] + 1) as one-hot add-reductions — the fusable form of two
+    ``pay.at[lo].set`` scatters. Correct only because the target slots
+    are zero (add == set there); out-of-range lo entries drop."""
+    iota = jnp.arange(pay.shape[0], dtype=I32)
+    oh_lo = lo[:, None] == iota[None, :]
+    oh_hi = (lo + 1)[:, None] == iota[None, :]
+    return pay + jnp.sum(
+        jnp.where(oh_lo, a[:, None], 0) + jnp.where(oh_hi, b[:, None], 0),
+        axis=0,
+        dtype=I32,
+    )
+
+
 def oh_take(vec, idxs):
     """``vec[idxs]`` for a small 1-D ``vec`` and an index array, as one
     masked-sum fusion instead of a gather kernel. OOB yields 0/False."""

@@ -72,7 +72,12 @@ def iset_add(frontier, gaps, event, enable=True):
 def iset_contains(frontier, gaps, x):
     """Membership test; broadcasts over leading axes of ``x`` when
     ``frontier``/``gaps`` are gathered to matching shapes (gaps'
-    trailing axes must be [..., G, 2])."""
+    trailing axes must be [..., G, 2]).
+
+    Callers whose gathered ``gaps`` operand is huge (per-dependency
+    gathers at sweep scale) should use :func:`iset_contains_gathered`
+    instead — one fusion holding the [..., G]-wide comparison block
+    can overflow VMEM on the TPU runtime (worker kernel fault)."""
     in_gap = jnp.any(
         (gaps[..., 0] > 0)
         & (gaps[..., 0] <= x[..., None])
@@ -82,3 +87,17 @@ def iset_contains(frontier, gaps, x):
     # events are 1-based; 0 is the codebase's empty-slot marker and is
     # never a member
     return (x >= 1) & ((x <= frontier) | in_gap)
+
+
+def iset_contains_gathered(front_by_src, gaps_by_src, src, x):
+    """Membership of ``x[...]`` in the interval set of ``src[...]``,
+    with per-source state ``front_by_src [S]`` / ``gaps_by_src
+    [S, G, 2]``. Gathers one [*, 2] gap slice per g instead of the full
+    [..., G, 2] block, keeping every intermediate at ``x``'s size — the
+    VMEM-safe form of ``iset_contains(front[src], gaps[src], x)``."""
+    out = (x >= 1) & (x <= front_by_src[src])
+    for g in range(gaps_by_src.shape[-2]):
+        s = gaps_by_src[src, g, 0]
+        e = gaps_by_src[src, g, 1]
+        out = out | ((s > 0) & (s <= x) & (x <= e))
+    return out

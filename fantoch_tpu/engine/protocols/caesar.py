@@ -51,10 +51,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core import I32, compact_order, emit, emit_broadcast, empty_outbox
+from ..core import (
+    I32, compact_order, emit, emit_broadcast, empty_outbox, oh_get,
+    oh_pack_pairs, oh_set, oh_set2, oh_take,
+)
 from ..dims import ERR_CAPACITY, ERR_DOT, ERR_PROTO, ERR_SEQ, INF, SEQ_BOUND, EngineDims, dot_slot
 from .identity import DevIdentity
-from ..iset import iset_add, iset_contains
+from ..iset import iset_add, iset_contains_gathered
 
 
 # statuses (caesar.rs Status; PROPOSE_BEGIN is transient host-side only)
@@ -204,13 +207,17 @@ class CaesarDev(DevIdentity):
         MPropose has arrived (requeued whole otherwise so sightings are
         never double-counted)."""
         t = msg["mtype"]
-        prop_ok = ps["pseq"][msg["src"], dot_slot(msg["payload"][0], dims)] == 0
+        prop_ok = (
+            oh_get(oh_get(ps["pseq"], msg["src"]),
+                   dot_slot(msg["payload"][0], dims))
+            == 0
+        )
         dsrc, seq = msg["payload"][0], msg["payload"][1]
-        have = ps["pseq"][dsrc, dot_slot(seq, dims)] == seq
+        have = oh_get(oh_get(ps["pseq"], dsrc), dot_slot(seq, dims)) == seq
         DPM = self.gc_per_msg(dims)
         idx = jnp.arange(DPM, dtype=I32)
-        gsrc = msg["payload"][1 + 2 * idx]
-        gseq = msg["payload"][2 + 2 * idx]
+        gsrc = oh_take(msg["payload"], 1 + 2 * idx)
+        gseq = oh_take(msg["payload"], 2 + 2 * idx)
         en = idx < msg["payload"][0]
         gc_ok = jnp.all(
             ~en | (ps["pseq"][gsrc, dot_slot(gseq, dims)] == gseq)
@@ -277,8 +284,8 @@ def _clk_lt(a_seq, a_pid, b_seq, b_pid):
 def _kc_add(dev, ps, key, src, seq, cseq, cpid, enable):
     """Register (dot, clock) on the key (locked.rs add); a duplicate
     clock or a full row raises the lane error flag."""
-    row_cseq = ps["kc_cseq"][key]
-    row_cpid = ps["kc_cpid"][key]
+    row_cseq = oh_get(ps["kc_cseq"], key)
+    row_cpid = oh_get(ps["kc_cpid"], key)
     do = jnp.asarray(enable, bool)
     dup = jnp.any((row_cseq == cseq) & (row_cpid == cpid) & (row_cseq > 0))
     free = row_cseq == 0
@@ -287,10 +294,10 @@ def _kc_add(dev, ps, key, src, seq, cseq, cpid, enable):
     widx = jnp.where(do & ~overflow & ~dup, slot, dev.S)
     return dict(
         ps,
-        kc_src=ps["kc_src"].at[key, widx].set(src, mode="drop"),
-        kc_seq=ps["kc_seq"].at[key, widx].set(seq, mode="drop"),
-        kc_cseq=ps["kc_cseq"].at[key, widx].set(cseq, mode="drop"),
-        kc_cpid=ps["kc_cpid"].at[key, widx].set(cpid, mode="drop"),
+        kc_src=oh_set2(ps["kc_src"], key, widx, src),
+        kc_seq=oh_set2(ps["kc_seq"], key, widx, seq),
+        kc_cseq=oh_set2(ps["kc_cseq"], key, widx, cseq),
+        kc_cpid=oh_set2(ps["kc_cpid"], key, widx, cpid),
         err=ps["err"] | ERR_CAPACITY * overflow | ERR_PROTO * (do & dup),
     )
 
@@ -298,8 +305,8 @@ def _kc_add(dev, ps, key, src, seq, cseq, cpid, enable):
 def _kc_remove(dev, ps, key, cseq, cpid, enable):
     """Unregister the clock from the key (locked.rs remove); missing
     entries raise the lane error flag."""
-    row_cseq = ps["kc_cseq"][key]
-    row_cpid = ps["kc_cpid"][key]
+    row_cseq = oh_get(ps["kc_cseq"], key)
+    row_cpid = oh_get(ps["kc_cpid"], key)
     match = (row_cseq == cseq) & (row_cpid == cpid) & (row_cseq > 0)
     do = jnp.asarray(enable, bool)
     found = jnp.any(match)
@@ -308,10 +315,10 @@ def _kc_remove(dev, ps, key, cseq, cpid, enable):
     zero = jnp.zeros((), I32)
     return dict(
         ps,
-        kc_src=ps["kc_src"].at[key, widx].set(zero, mode="drop"),
-        kc_seq=ps["kc_seq"].at[key, widx].set(zero, mode="drop"),
-        kc_cseq=ps["kc_cseq"].at[key, widx].set(zero, mode="drop"),
-        kc_cpid=ps["kc_cpid"].at[key, widx].set(zero, mode="drop"),
+        kc_src=oh_set2(ps["kc_src"], key, widx, zero),
+        kc_seq=oh_set2(ps["kc_seq"], key, widx, zero),
+        kc_cseq=oh_set2(ps["kc_cseq"], key, widx, zero),
+        kc_cpid=oh_set2(ps["kc_cpid"], key, widx, zero),
         err=ps["err"] | ERR_PROTO * (do & ~found),
     )
 
@@ -319,8 +326,8 @@ def _kc_remove(dev, ps, key, cseq, cpid, enable):
 def _predecessors(dev, ps, key, cseq, cpid):
     """Masked row compare (locked.rs:85-131): returns (pred_mask [S],
     blocker_mask [S]) over the key row relative to clock (cseq, cpid)."""
-    row_cseq = ps["kc_cseq"][key]
-    row_cpid = ps["kc_cpid"][key]
+    row_cseq = oh_get(ps["kc_cseq"], key)
+    row_cpid = oh_get(ps["kc_cpid"], key)
     present = row_cseq > 0
     lower = _clk_lt(row_cseq, row_cpid, cseq, cpid)
     higher = _clk_lt(cseq, cpid, row_cseq, row_cpid)
@@ -336,9 +343,10 @@ def _pack_deps(dev, ps, key, pred_mask, base, pay, dims):
     order, nd = compact_order(pred_mask, dev.DEP)
     overflow = nd > dev.DEP
     lo = base + 1 + 2 * jnp.minimum(order, dims.P)  # > P when order==INF
-    pay = pay.at[base].set(nd)
-    pay = pay.at[lo].set(ps["kc_src"][key], mode="drop")
-    pay = pay.at[lo + 1].set(ps["kc_seq"][key], mode="drop")
+    pay = oh_set(pay, base, nd)
+    pay = oh_pack_pairs(
+        pay, lo, oh_get(ps["kc_src"], key), oh_get(ps["kc_seq"], key)
+    )
     return pay, nd, overflow
 
 
@@ -381,15 +389,15 @@ def _blocker_verdicts_one(dev, ps, src, slot, dims):
     """Single-dot variant of :func:`_blocker_verdicts` for the dot at
     (src, slot): returns (resolved [BB], reject [BB]) without gathering
     the whole [N, D, BB, DEP] state."""
-    bsrc = ps["bb_src"][src, slot]            # [BB]
-    bseq = ps["bb_seq"][src, slot]
+    bsrc = oh_get(oh_get(ps["bb_src"], src), slot)  # [BB]
+    bseq = oh_get(oh_get(ps["bb_seq"], src), slot)
     bslot = dot_slot(bseq, dims)
     present = bseq > 0
     valid = ps["pseq"][bsrc, bslot] == bseq
     gcd = present & ~valid                    # freed ⇒ executed everywhere
     b_st = ps["status"][bsrc, bslot]
     safe = present & valid & (b_st >= ST_ACCEPT)
-    my_seq = ps["pseq"][src, slot]
+    my_seq = oh_get(oh_get(ps["pseq"], src), slot)
     b_dsrc = ps["dep_src"][bsrc, bslot]       # [BB, DEP]
     b_dseq = ps["dep_seq"][bsrc, bslot]
     member = jnp.any(
@@ -418,8 +426,8 @@ def _wait_scan(dev, ps, me, ctx, dims, ob, ack_slot, chain_slot,
     packed = srcs * SEQ_BOUND + ps["pseq"]
     flat = jnp.argmin(jnp.where(actionable, packed, INF))
     wsrc, wslot = flat // dims.D, flat % dims.D
-    wseq = ps["pseq"][wsrc, wslot]
-    is_rej = w_rej[wsrc, wslot]
+    wseq = oh_get(oh_get(ps["pseq"], wsrc), wslot)
+    is_rej = oh_get(oh_get(w_rej, wsrc), wslot)
 
     do = jnp.asarray(enable, bool) & (num > 0)
     ps, ob = _propose_reply(
@@ -438,7 +446,7 @@ def _propose_reply(dev, ps, me, wsrc, wslot, wseq, accept, ctx, dims, ob,
     recomputes deps at it (_accept_command/_reject_command)."""
     do = jnp.asarray(enable, bool)
     rej = do & ~jnp.asarray(accept, bool)
-    key = ps["key_of"][wsrc, wslot]
+    key = oh_get(oh_get(ps["key_of"], wsrc), wslot)
 
     # reject: new clock from my counter; deps = all lower-clock entries
     # on the key (including this dot's own old registration)
@@ -448,13 +456,14 @@ def _propose_reply(dev, ps, me, wsrc, wslot, wseq, accept, ctx, dims, ob,
         # the executor's clock packing clk_seq*(N+1)+pid must stay < INF
         err=ps["err"] | ERR_SEQ * (rej & (new_cseq >= INF // (dims.N + 1))),
         clk_counter=jnp.where(rej, new_cseq, ps["clk_counter"]),
-        status=ps["status"]
-        .at[jnp.where(rej, wsrc, dims.N), wslot]
-        .set(ST_REJECT, mode="drop"),
+        status=oh_set2(
+            ps["status"], jnp.where(rej, wsrc, dims.N), wslot, ST_REJECT
+        ),
         # accept: clear the blocker list so the scan never re-fires
-        bb_seq=ps["bb_seq"]
-        .at[jnp.where(do & ~rej, wsrc, dims.N), wslot]
-        .set(jnp.zeros((dev.BB,), I32), mode="drop"),
+        bb_seq=oh_set2(
+            ps["bb_seq"], jnp.where(do & ~rej, wsrc, dims.N), wslot,
+            jnp.zeros((dev.BB,), I32),
+        ),
     )
 
     # reject payload: fresh clock + deps recomputed at it (this dot's
@@ -469,13 +478,22 @@ def _propose_reply(dev, ps, me, wsrc, wslot, wseq, accept, ctx, dims, ob,
     # accept payload: registered clock + propose-time deps (compact)
     apay = jnp.zeros((dims.P,), I32)
     apay = apay.at[0].set(wseq)
-    apay = apay.at[1].set(ps["clk_seq"][wsrc, wslot])
-    apay = apay.at[2].set(ps["clk_pid"][wsrc, wslot])
+    my_dep_src = oh_get(oh_get(ps["dep_src"], wsrc), wslot)
+    my_dep_seq = oh_get(oh_get(ps["dep_seq"], wsrc), wslot)
+    apay = apay.at[1].set(oh_get(oh_get(ps["clk_seq"], wsrc), wslot))
+    apay = apay.at[2].set(oh_get(oh_get(ps["clk_pid"], wsrc), wslot))
     apay = apay.at[3].set(1)
-    apay = apay.at[4].set(jnp.sum(ps["dep_seq"][wsrc, wslot] > 0))
+    apay = apay.at[4].set(jnp.sum(my_dep_seq > 0))
     order = 5 + 2 * jnp.arange(dev.DEP, dtype=I32)
-    apay = apay.at[order].set(ps["dep_src"][wsrc, wslot], mode="drop")
-    apay = apay.at[order + 1].set(ps["dep_seq"][wsrc, wslot], mode="drop")
+    iota_ap = jnp.arange(dims.P, dtype=I32)
+    oh_o = order[:, None] == iota_ap[None, :]
+    oh_o1 = (order + 1)[:, None] == iota_ap[None, :]
+    apay = apay + jnp.sum(
+        jnp.where(oh_o, my_dep_src[:, None], 0)
+        + jnp.where(oh_o1, my_dep_seq[:, None], 0),
+        axis=0,
+        dtype=I32,
+    )
 
     pay = jnp.where(rej, rpay, apay)
     ps = dict(ps, err=ps["err"] | ERR_CAPACITY * (rej & roverflow))
@@ -498,11 +516,11 @@ def _exec_scan(dev, ps, me, ctx, dims, ob, client_slot, chain_slot,
     dseq = ps["dep_seq"]
     dslot = dot_slot(dseq, dims)
     absent = dseq == 0
-    committed = iset_contains(
-        ps["cm_front"][dsrc], ps["cm_gaps"][dsrc], dseq
+    committed = iset_contains_gathered(
+        ps["cm_front"], ps["cm_gaps"], dsrc, dseq
     )
-    executed = iset_contains(
-        ps["ex_front"][dsrc], ps["ex_gaps"][dsrc], dseq
+    executed = iset_contains_gathered(
+        ps["ex_front"], ps["ex_gaps"], dsrc, dseq
     )
     d_cseq = ps["clk_seq"][dsrc, dslot]
     d_cpid = ps["clk_pid"][dsrc, dslot]
@@ -519,12 +537,12 @@ def _exec_scan(dev, ps, me, ctx, dims, ob, client_slot, chain_slot,
     packed = ps["clk_seq"] * (dims.N + 1) + ps["clk_pid"]
     flat = jnp.argmin(jnp.where(ready, packed, INF))
     esrc, eslot = flat // dims.D, flat % dims.D
-    eseq = ps["pseq"][esrc, eslot]
-    client = ps["client_of"][esrc, eslot]
+    eseq = oh_get(oh_get(ps["pseq"], esrc), eslot)
+    client = oh_get(oh_get(ps["client_of"], esrc), eslot)
 
     do = jnp.asarray(enable, bool) & (num > 0)
     front, gaps, overflow = iset_add(
-        ps["ex_front"][esrc], ps["ex_gaps"][esrc], eseq, do
+        oh_get(ps["ex_front"], esrc), oh_get(ps["ex_gaps"], esrc), eseq, do
     )
     # buffer the executed dot for the notification tick
     eb_n = ps["eb_n"]
@@ -532,13 +550,13 @@ def _exec_scan(dev, ps, me, ctx, dims, ob, client_slot, chain_slot,
     widx = jnp.where(do & ~eb_overflow, eb_n, dev.EB)
     ps = dict(
         ps,
-        ex_front=ps["ex_front"].at[esrc].set(front),
-        ex_gaps=ps["ex_gaps"].at[esrc].set(gaps),
-        status=ps["status"]
-        .at[jnp.where(do, esrc, dims.N), eslot]
-        .set(ST_EXECUTED, mode="drop"),
-        eb_src=ps["eb_src"].at[widx].set(esrc, mode="drop"),
-        eb_seq=ps["eb_seq"].at[widx].set(eseq, mode="drop"),
+        ex_front=oh_set(ps["ex_front"], esrc, front),
+        ex_gaps=oh_set(ps["ex_gaps"], esrc, gaps),
+        status=oh_set2(
+            ps["status"], jnp.where(do, esrc, dims.N), eslot, ST_EXECUTED
+        ),
+        eb_src=oh_set(ps["eb_src"], widx, esrc),
+        eb_seq=oh_set(ps["eb_seq"], widx, eseq),
         eb_n=eb_n + (do & ~eb_overflow).astype(I32),
         err=ps["err"] | ERR_CAPACITY * (overflow | eb_overflow),
     )
@@ -548,7 +566,7 @@ def _exec_scan(dev, ps, me, ctx, dims, ob, client_slot, chain_slot,
         dims.N + client,
         CaesarDev.TO_CLIENT,
         [0],
-        valid=do & (ctx["client_attach"][client] == me),
+        valid=do & (oh_get(ctx["client_attach"], client) == me),
     )
     # always re-chain after an execution: executing this command may
     # make lower-frontier commands ready (the oracle's pending-index
@@ -569,34 +587,36 @@ def _gc_count(dev, ps, me, ctx, dims, src, seq, enable):
     (caesar.rs _gc_command + bp.stable)."""
     slot = dot_slot(seq, dims)
     do = jnp.asarray(enable, bool) & (seq > 0)
-    valid = ps["pseq"][src, slot] == seq
-    cnt = ps["gc_cnt"][src, slot] + 1
+    valid = oh_get(oh_get(ps["pseq"], src), slot) == seq
+    cnt = oh_get(oh_get(ps["gc_cnt"], src), slot) + 1
     full = do & valid & (cnt == ctx["n"])
     wsrc = jnp.where(do & valid, src, dims.N)
     ps = dict(
         ps,
         err=ps["err"] | ERR_PROTO * (do & ~valid),
-        gc_cnt=ps["gc_cnt"].at[wsrc, slot].set(cnt, mode="drop"),
+        gc_cnt=oh_set2(ps["gc_cnt"], wsrc, slot, cnt),
     )
     # free: unregister the clock, clear the slot, count stability
-    key = ps["key_of"][src, slot]
+    key = oh_get(oh_get(ps["key_of"], src), slot)
     ps = _kc_remove(
-        dev, ps, key, ps["clk_seq"][src, slot], ps["clk_pid"][src, slot],
+        dev, ps, key,
+        oh_get(oh_get(ps["clk_seq"], src), slot),
+        oh_get(oh_get(ps["clk_pid"], src), slot),
         full,
     )
     fsrc = jnp.where(full, src, dims.N)
     zero = jnp.zeros((), I32)
     ps = dict(
         ps,
-        pseq=ps["pseq"].at[fsrc, slot].set(zero, mode="drop"),
-        status=ps["status"].at[fsrc, slot].set(zero, mode="drop"),
-        gc_cnt=ps["gc_cnt"].at[fsrc, slot].set(zero, mode="drop"),
-        dep_seq=ps["dep_seq"]
-        .at[fsrc, slot]
-        .set(jnp.zeros((dev.DEP,), I32), mode="drop"),
-        bb_seq=ps["bb_seq"]
-        .at[fsrc, slot]
-        .set(jnp.zeros((dev.BB,), I32), mode="drop"),
+        pseq=oh_set2(ps["pseq"], fsrc, slot, zero),
+        status=oh_set2(ps["status"], fsrc, slot, zero),
+        gc_cnt=oh_set2(ps["gc_cnt"], fsrc, slot, zero),
+        dep_seq=oh_set2(
+            ps["dep_seq"], fsrc, slot, jnp.zeros((dev.DEP,), I32)
+        ),
+        bb_seq=oh_set2(
+            ps["bb_seq"], fsrc, slot, jnp.zeros((dev.BB,), I32)
+        ),
         m_stable=ps["m_stable"] + full.astype(I32),
     )
     return ps
@@ -609,6 +629,8 @@ def _drain_executed_notification(dev, ps, me, ctx, dims, enable):
     do = jnp.asarray(enable, bool)
     n_dots = jnp.where(do, ps["eb_n"], 0)
 
+    # a lax loop, not an unroll: the body embeds _gc_count (a large
+    # subgraph) and EB copies of it explode compile time
     def body(i, ps):
         take = i < n_dots
         src = ps["eb_src"][i]
@@ -618,8 +640,8 @@ def _drain_executed_notification(dev, ps, me, ctx, dims, enable):
         widx = jnp.where(take & ~overflow, gb_n, dev.EB)
         ps = dict(
             ps,
-            gb_src=ps["gb_src"].at[widx].set(src, mode="drop"),
-            gb_seq=ps["gb_seq"].at[widx].set(seq, mode="drop"),
+            gb_src=oh_set(ps["gb_src"], widx, src),
+            gb_seq=oh_set(ps["gb_seq"], widx, seq),
             gb_n=gb_n + (take & ~overflow).astype(I32),
             err=ps["err"] | ERR_CAPACITY * overflow,
         )
@@ -651,14 +673,14 @@ def _submit(dev, ps, msg, me, ctx, dims):
         | ERR_SEQ * ((seq >= SEQ_BOUND) | (cseq >= INF // (dims.N + 1))),
         own_seq=seq,
         clk_counter=cseq,
-        qa_cnt=ps["qa_cnt"].at[slot].set(0),
-        qa_ok=ps["qa_ok"].at[slot].set(True),
-        qa_done=ps["qa_done"].at[slot].set(False),
-        qa_cseq=ps["qa_cseq"].at[slot].set(0),
-        qa_cpid=ps["qa_cpid"].at[slot].set(0),
-        qr_cnt=ps["qr_cnt"].at[slot].set(0),
-        ag_src=ps["ag_src"].at[slot].set(jnp.zeros((DEP,), I32)),
-        ag_seq=ps["ag_seq"].at[slot].set(jnp.zeros((DEP,), I32)),
+        qa_cnt=oh_set(ps["qa_cnt"], slot, 0),
+        qa_ok=oh_set(ps["qa_ok"], slot, True),
+        qa_done=oh_set(ps["qa_done"], slot, False),
+        qa_cseq=oh_set(ps["qa_cseq"], slot, 0),
+        qa_cpid=oh_set(ps["qa_cpid"], slot, 0),
+        qr_cnt=oh_set(ps["qr_cnt"], slot, 0),
+        ag_src=oh_set(ps["ag_src"], slot, jnp.zeros((DEP,), I32)),
+        ag_seq=oh_set(ps["ag_seq"], slot, jnp.zeros((DEP,), I32)),
     )
     ob = emit_broadcast(
         empty_outbox(dims),
@@ -682,38 +704,40 @@ def _mpropose(dev, ps, msg, me, ctx, dims):
     )
     cpid = s
     slot = dot_slot(seq, dims)
-    dirty = ps["pseq"][s, slot] != 0
+    dirty = oh_get(oh_get(ps["pseq"], s), slot) != 0
     ps = dict(
         ps,
         clk_counter=jnp.maximum(ps["clk_counter"], cseq),
         err=ps["err"] | ERR_DOT * dirty,
-        pseq=ps["pseq"].at[s, slot].set(seq),
-        key_of=ps["key_of"].at[s, slot].set(key),
-        client_of=ps["client_of"].at[s, slot].set(client),
-        clk_seq=ps["clk_seq"].at[s, slot].set(cseq),
-        clk_pid=ps["clk_pid"].at[s, slot].set(cpid),
-        status=ps["status"].at[s, slot].set(ST_PROPOSE_END),
+        pseq=oh_set2(ps["pseq"], s, slot, seq),
+        key_of=oh_set2(ps["key_of"], s, slot, key),
+        client_of=oh_set2(ps["client_of"], s, slot, client),
+        clk_seq=oh_set2(ps["clk_seq"], s, slot, cseq),
+        clk_pid=oh_set2(ps["clk_pid"], s, slot, cpid),
+        status=oh_set2(ps["status"], s, slot, ST_PROPOSE_END),
     )
 
     # predecessors + blockers over the key row, then register the dot
     # (compact_order's INF sentinel can never alias a valid index of the
     # DEP-/BB-wide arrays, whatever their size relative to S)
     pred_mask, block_mask = _predecessors(dev, ps, key, cseq, cpid)
-    row_src = ps["kc_src"][key]
-    row_seq = ps["kc_seq"][key]
-    # store deps
+    row_src = oh_get(ps["kc_src"], key)
+    row_seq = oh_get(ps["kc_seq"], key)
+    # store deps, scattered through one-hot compaction masks
     order, nd = compact_order(pred_mask, dev.DEP)
-    d_src = jnp.zeros((dev.DEP,), I32).at[order].set(row_src, mode="drop")
-    d_seq = jnp.zeros((dev.DEP,), I32).at[order].set(row_seq, mode="drop")
+    oh_ord = order[:, None] == jnp.arange(dev.DEP, dtype=I32)[None, :]
+    d_src = jnp.sum(jnp.where(oh_ord, row_src[:, None], 0), axis=0, dtype=I32)
+    d_seq = jnp.sum(jnp.where(oh_ord, row_seq[:, None], 0), axis=0, dtype=I32)
     border, nb = compact_order(block_mask, dev.BB)
-    b_src = jnp.zeros((dev.BB,), I32).at[border].set(row_src, mode="drop")
-    b_seq = jnp.zeros((dev.BB,), I32).at[border].set(row_seq, mode="drop")
+    oh_bord = border[:, None] == jnp.arange(dev.BB, dtype=I32)[None, :]
+    b_src = jnp.sum(jnp.where(oh_bord, row_src[:, None], 0), axis=0, dtype=I32)
+    b_seq = jnp.sum(jnp.where(oh_bord, row_seq[:, None], 0), axis=0, dtype=I32)
     ps = dict(
         ps,
-        dep_src=ps["dep_src"].at[s, slot].set(d_src),
-        dep_seq=ps["dep_seq"].at[s, slot].set(d_seq),
-        bb_src=ps["bb_src"].at[s, slot].set(b_src),
-        bb_seq=ps["bb_seq"].at[s, slot].set(b_seq),
+        dep_src=oh_set2(ps["dep_src"], s, slot, d_src),
+        dep_seq=oh_set2(ps["dep_seq"], s, slot, d_seq),
+        bb_src=oh_set2(ps["bb_src"], s, slot, b_src),
+        bb_seq=oh_set2(ps["bb_seq"], s, slot, b_seq),
         err=ps["err"] | ERR_CAPACITY * ((nd > dev.DEP) | (nb > dev.BB)),
     )
     ps = _kc_add(dev, ps, key, s, seq, cseq, cpid, True)
@@ -740,12 +764,14 @@ def _agg_union(dev, ps, slot, pay_base, msg, enable):
     (QuorumClocks/QuorumRetries dep union)."""
     nd = msg["payload"][pay_base]
 
-    def body(i, ps):
+    # statically unrolled (payload reads become slices; the union chain
+    # is sequential but fuses)
+    for i in range(dev.DEP):
         take = jnp.asarray(enable, bool) & (i < nd)
         dsrc = msg["payload"][pay_base + 1 + 2 * i]
         dseq = msg["payload"][pay_base + 2 + 2 * i]
-        row_src = ps["ag_src"][slot]
-        row_seq = ps["ag_seq"][slot]
+        row_src = oh_get(ps["ag_src"], slot)
+        row_seq = oh_get(ps["ag_seq"], slot)
         exists = jnp.any(
             (row_seq == dseq) & (row_src == dsrc) & (row_seq > 0)
         )
@@ -753,21 +779,21 @@ def _agg_union(dev, ps, slot, pay_base, msg, enable):
         fidx = jnp.argmax(free)
         overflow = take & ~exists & ~jnp.any(free)
         widx = jnp.where(take & ~exists & ~overflow, fidx, dev.DEP)
-        return dict(
+        ps = dict(
             ps,
-            ag_src=ps["ag_src"].at[slot, widx].set(dsrc, mode="drop"),
-            ag_seq=ps["ag_seq"].at[slot, widx].set(dseq, mode="drop"),
+            ag_src=oh_set2(ps["ag_src"], slot, widx, dsrc),
+            ag_seq=oh_set2(ps["ag_seq"], slot, widx, dseq),
             err=ps["err"] | ERR_CAPACITY * overflow,
         )
-
-    return jax.lax.fori_loop(0, dev.DEP, body, ps)
+    return ps
 
 
 def _agg_broadcast(dev, ps, me, seq, cseq, cpid, mtype, ctx, dims, valid):
     """Broadcast MCommit/MRetry carrying the aggregated clock + deps."""
     slot = dot_slot(seq, dims)
     P = dims.P
-    present = ps["ag_seq"][slot] > 0
+    ag_seq_row = oh_get(ps["ag_seq"], slot)
+    present = ag_seq_row > 0
     order, nd = compact_order(present, dev.DEP)
     pay = jnp.zeros((P,), I32)
     pay = pay.at[0].set(me)
@@ -776,8 +802,7 @@ def _agg_broadcast(dev, ps, me, seq, cseq, cpid, mtype, ctx, dims, valid):
     pay = pay.at[3].set(cpid)
     pay = pay.at[4].set(nd)
     lo = 5 + 2 * jnp.minimum(order, P)  # > P when order==INF
-    pay = pay.at[lo].set(ps["ag_src"][slot], mode="drop")
-    pay = pay.at[lo + 1].set(ps["ag_seq"][slot], mode="drop")
+    pay = oh_pack_pairs(pay, lo, oh_get(ps["ag_src"], slot), ag_seq_row)
     ob = emit_broadcast(empty_outbox(dims), mtype, pay, ctx["n"])
     return dict(ob, valid=ob["valid"] & jnp.asarray(valid, bool))
 
@@ -792,26 +817,27 @@ def _mproposeack(dev, ps, msg, me, ctx, dims):
     ok = msg["payload"][3] > 0
     slot = dot_slot(seq, dims)
 
-    st = ps["status"][me, slot]
-    live = ((st == ST_PROPOSE_END) | (st == ST_REJECT)) & ~ps["qa_done"][slot]
+    st = oh_get(oh_get(ps["status"], me), slot)
+    qa_done_s = oh_get(ps["qa_done"], slot)
+    live = ((st == ST_PROPOSE_END) | (st == ST_REJECT)) & ~qa_done_s
 
-    join_hi = _clk_lt(
-        ps["qa_cseq"][slot], ps["qa_cpid"][slot], cseq, cpid
-    )
-    cnt = ps["qa_cnt"][slot] + 1
-    all_ok = ps["qa_ok"][slot] & ok
+    qa_cseq_s = oh_get(ps["qa_cseq"], slot)
+    qa_cpid_s = oh_get(ps["qa_cpid"], slot)
+    join_hi = _clk_lt(qa_cseq_s, qa_cpid_s, cseq, cpid)
+    qa_cnt_s = oh_get(ps["qa_cnt"], slot)
+    cnt = qa_cnt_s + 1
+    qa_ok_s = oh_get(ps["qa_ok"], slot)
+    all_ok = qa_ok_s & ok
     ps = dict(
         ps,
-        qa_cnt=ps["qa_cnt"].at[slot].set(jnp.where(live, cnt,
-                                                   ps["qa_cnt"][slot])),
-        qa_ok=ps["qa_ok"].at[slot].set(jnp.where(live, all_ok,
-                                                 ps["qa_ok"][slot])),
-        qa_cseq=ps["qa_cseq"]
-        .at[slot]
-        .set(jnp.where(live & join_hi, cseq, ps["qa_cseq"][slot])),
-        qa_cpid=ps["qa_cpid"]
-        .at[slot]
-        .set(jnp.where(live & join_hi, cpid, ps["qa_cpid"][slot])),
+        qa_cnt=oh_set(ps["qa_cnt"], slot, jnp.where(live, cnt, qa_cnt_s)),
+        qa_ok=oh_set(ps["qa_ok"], slot, jnp.where(live, all_ok, qa_ok_s)),
+        qa_cseq=oh_set(
+            ps["qa_cseq"], slot, jnp.where(live & join_hi, cseq, qa_cseq_s)
+        ),
+        qa_cpid=oh_set(
+            ps["qa_cpid"], slot, jnp.where(live & join_hi, cpid, qa_cpid_s)
+        ),
     )
     ps = _agg_union(dev, ps, slot, 4, msg, live)
 
@@ -823,12 +849,12 @@ def _mproposeack(dev, ps, msg, me, ctx, dims):
     slow = done & ~all_ok
     ps = dict(
         ps,
-        qa_done=ps["qa_done"].at[slot].set(ps["qa_done"][slot] | done),
+        qa_done=oh_set(ps["qa_done"], slot, qa_done_s | done),
         m_fast=ps["m_fast"] + fast.astype(I32),
         m_slow=ps["m_slow"] + slow.astype(I32),
     )
-    cseq_f = ps["qa_cseq"][slot]
-    cpid_f = ps["qa_cpid"][slot]
+    cseq_f = oh_get(ps["qa_cseq"], slot)
+    cpid_f = oh_get(ps["qa_cpid"], slot)
     # one broadcast: identical payload either way, only the type differs
     mtype = jnp.where(fast, CaesarDev.MCOMMIT, CaesarDev.MRETRY)
     ob = _agg_broadcast(
@@ -845,8 +871,8 @@ def _store_deps_from_msg(dev, ps, src, slot, msg, base, skip_self, seq,
     nd = msg["payload"][base]
     idxs = base + 1 + 2 * jnp.arange(Q, dtype=I32)
     en = jnp.arange(Q, dtype=I32) < nd
-    dsrcs = jnp.where(en, msg["payload"][idxs], 0)
-    dseqs = jnp.where(en, msg["payload"][idxs + 1], 0)
+    dsrcs = jnp.where(en, oh_take(msg["payload"], idxs), 0)
+    dseqs = jnp.where(en, oh_take(msg["payload"], idxs + 1), 0)
     if skip_self:
         selfdep = (dsrcs == src) & (dseqs == seq)
         dsrcs = jnp.where(selfdep, 0, dsrcs)
@@ -855,8 +881,8 @@ def _store_deps_from_msg(dev, ps, src, slot, msg, base, skip_self, seq,
     wsrc = jnp.where(do, src, dims.N)
     return dict(
         ps,
-        dep_src=ps["dep_src"].at[wsrc, slot].set(dsrcs, mode="drop"),
-        dep_seq=ps["dep_seq"].at[wsrc, slot].set(dseqs, mode="drop"),
+        dep_src=oh_set2(ps["dep_src"], wsrc, slot, dsrcs),
+        dep_seq=oh_set2(ps["dep_seq"], wsrc, slot, dseqs),
         err=ps["err"] | ERR_CAPACITY * (do & (nd > Q)),
     )
 
@@ -864,18 +890,19 @@ def _store_deps_from_msg(dev, ps, src, slot, msg, base, skip_self, seq,
 def _update_clock(dev, ps, src, slot, key, new_cseq, new_cpid, enable, dims):
     """Swap the registered clock (caesar.rs:893-918)."""
     do = jnp.asarray(enable, bool)
-    old_cseq = ps["clk_seq"][src, slot]
-    old_cpid = ps["clk_pid"][src, slot]
+    old_cseq = oh_get(oh_get(ps["clk_seq"], src), slot)
+    old_cpid = oh_get(oh_get(ps["clk_pid"], src), slot)
     changed = do & ((old_cseq != new_cseq) | (old_cpid != new_cpid))
     ps = _kc_remove(dev, ps, key, old_cseq, old_cpid, changed)
     ps = _kc_add(
-        dev, ps, key, src, ps["pseq"][src, slot], new_cseq, new_cpid, changed
+        dev, ps, key, src, oh_get(oh_get(ps["pseq"], src), slot),
+        new_cseq, new_cpid, changed,
     )
     wsrc = jnp.where(do, src, dims.N)
     return dict(
         ps,
-        clk_seq=ps["clk_seq"].at[wsrc, slot].set(new_cseq, mode="drop"),
-        clk_pid=ps["clk_pid"].at[wsrc, slot].set(new_cpid, mode="drop"),
+        clk_seq=oh_set2(ps["clk_seq"], wsrc, slot, new_cseq),
+        clk_pid=oh_set2(ps["clk_pid"], wsrc, slot, new_cpid),
     )
 
 
@@ -887,10 +914,10 @@ def _mcommit(dev, ps, msg, me, ctx, dims):
     cseq = msg["payload"][2]
     cpid = msg["payload"][3]
     slot = dot_slot(seq, dims)
-    st = ps["status"][dsrc, slot]
-    have = ps["pseq"][dsrc, slot] == seq
+    st = oh_get(oh_get(ps["status"], dsrc), slot)
+    have = oh_get(oh_get(ps["pseq"], dsrc), slot) == seq
     do = have & (st != ST_COMMIT) & (st != ST_EXECUTED)
-    key = ps["key_of"][dsrc, slot]
+    key = oh_get(oh_get(ps["key_of"], dsrc), slot)
 
     ps = dict(
         ps,
@@ -903,15 +930,15 @@ def _mcommit(dev, ps, msg, me, ctx, dims):
     wsrc = jnp.where(do, dsrc, dims.N)
     ps = dict(
         ps,
-        status=ps["status"].at[wsrc, slot].set(ST_COMMIT, mode="drop"),
+        status=oh_set2(ps["status"], wsrc, slot, ST_COMMIT),
     )
     cf, cg, overflow = iset_add(
-        ps["cm_front"][dsrc], ps["cm_gaps"][dsrc], seq, do
+        oh_get(ps["cm_front"], dsrc), oh_get(ps["cm_gaps"], dsrc), seq, do
     )
     ps = dict(
         ps,
-        cm_front=ps["cm_front"].at[dsrc].set(cf),
-        cm_gaps=ps["cm_gaps"].at[dsrc].set(cg),
+        cm_front=oh_set(ps["cm_front"], dsrc, cf),
+        cm_gaps=oh_set(ps["cm_gaps"], dsrc, cg),
         err=ps["err"] | ERR_CAPACITY * overflow,
     )
     # executor + wait re-evaluation, all at this instant
@@ -929,10 +956,10 @@ def _mretry(dev, ps, msg, me, ctx, dims):
     cseq = msg["payload"][2]
     cpid = msg["payload"][3]
     slot = dot_slot(seq, dims)
-    st = ps["status"][dsrc, slot]
-    have = ps["pseq"][dsrc, slot] == seq
+    st = oh_get(oh_get(ps["status"], dsrc), slot)
+    have = oh_get(oh_get(ps["pseq"], dsrc), slot) == seq
     do = have & (st != ST_COMMIT) & (st != ST_EXECUTED)
-    key = ps["key_of"][dsrc, slot]
+    key = oh_get(oh_get(ps["key_of"], dsrc), slot)
 
     ps = dict(
         ps,
@@ -945,10 +972,10 @@ def _mretry(dev, ps, msg, me, ctx, dims):
     wsrc = jnp.where(do, dsrc, dims.N)
     ps = dict(
         ps,
-        status=ps["status"].at[wsrc, slot].set(ST_ACCEPT, mode="drop"),
-        bb_seq=ps["bb_seq"]
-        .at[wsrc, slot]
-        .set(jnp.zeros((dev.BB,), I32), mode="drop"),
+        status=oh_set2(ps["status"], wsrc, slot, ST_ACCEPT),
+        bb_seq=oh_set2(
+            ps["bb_seq"], wsrc, slot, jnp.zeros((dev.BB,), I32)
+        ),
     )
 
     # reply: my predecessors at the new clock ∪ the message deps
@@ -958,27 +985,25 @@ def _mretry(dev, ps, msg, me, ctx, dims):
     pay = pay.at[1].set(seq)
     pay, nd, overflow = _pack_deps(dev, ps, key, pred_mask, 2, pay, dims)
 
-    def add_msg_dep(i, carry):
-        pay, nd, err = carry
+    o2 = jnp.asarray(False)
+    dep_idxs = 3 + 2 * jnp.arange(dev.DEP, dtype=I32)
+    # statically unrolled; payload updates are one-hot selects
+    for i in range(dev.DEP):
         take = i < msg["payload"][4]
         msrc = msg["payload"][5 + 2 * i]
         mseq = msg["payload"][6 + 2 * i]
-        idxs = 3 + 2 * jnp.arange(dev.DEP, dtype=I32)
         have_already = jnp.any(
             (jnp.arange(dev.DEP) < nd)
-            & (pay[idxs] == msrc)
-            & (pay[idxs + 1] == mseq)
+            & (oh_take(pay, dep_idxs) == msrc)
+            & (oh_take(pay, dep_idxs + 1) == mseq)
         )
         add = take & ~have_already
         ovf = add & (nd >= dev.DEP)
         lo = jnp.where(add & ~ovf, 3 + 2 * nd, dims.P)
-        pay = pay.at[lo].set(msrc, mode="drop")
-        pay = pay.at[lo + 1].set(mseq, mode="drop")
-        return pay, nd + (add & ~ovf).astype(I32), err | ovf
-
-    pay, nd, o2 = jax.lax.fori_loop(
-        0, dev.DEP, add_msg_dep, (pay, nd, jnp.asarray(False))
-    )
+        pay = oh_set(pay, lo, msrc)
+        pay = oh_set(pay, lo + 1, mseq)
+        nd = nd + (add & ~ovf).astype(I32)
+        o2 = o2 | ovf
     pay = pay.at[2].set(nd)
     ps = dict(ps, err=ps["err"] | ERR_CAPACITY * (do & (overflow | o2)))
     ob = emit(
@@ -994,13 +1019,12 @@ def _mretryack(dev, ps, msg, me, ctx, dims):
     replies; on the last one, commit."""
     seq = msg["payload"][1]
     slot = dot_slot(seq, dims)
-    live = ps["status"][me, slot] == ST_ACCEPT
-    cnt = ps["qr_cnt"][slot] + 1
+    live = oh_get(oh_get(ps["status"], me), slot) == ST_ACCEPT
+    qr_cnt_s = oh_get(ps["qr_cnt"], slot)
+    cnt = qr_cnt_s + 1
     ps = dict(
         ps,
-        qr_cnt=ps["qr_cnt"].at[slot].set(
-            jnp.where(live, cnt, ps["qr_cnt"][slot])
-        ),
+        qr_cnt=oh_set(ps["qr_cnt"], slot, jnp.where(live, cnt, qr_cnt_s)),
     )
     ps = _agg_union(dev, ps, slot, 2, msg, live)
     chosen = live & (cnt == ctx["wq_size"])
@@ -1009,8 +1033,8 @@ def _mretryack(dev, ps, msg, me, ctx, dims):
         ps,
         me,
         seq,
-        ps["clk_seq"][me, slot],
-        ps["clk_pid"][me, slot],
+        oh_get(oh_get(ps["clk_seq"], me), slot),
+        oh_get(oh_get(ps["clk_pid"], me), slot),
         CaesarDev.MCOMMIT,
         ctx,
         dims,
@@ -1024,14 +1048,15 @@ def _mgc(dev, ps, msg, me, ctx, dims):
     (BasicGCTrack; frees at n sightings)."""
     nd = msg["payload"][0]
 
+    # a lax loop, not an unroll: gc_per_msg copies of _gc_count's
+    # subgraph explode compile time
     def body(i, ps):
         take = i < nd
         src = msg["payload"][1 + 2 * i]
         seq = msg["payload"][2 + 2 * i]
         return _gc_count(dev, ps, me, ctx, dims, src, seq, take)
 
-    DPM = dev.gc_per_msg(dims)
-    ps = jax.lax.fori_loop(0, DPM, body, ps)
+    ps = jax.lax.fori_loop(0, dev.gc_per_msg(dims), body, ps)
     return ps, empty_outbox(dims)
 
 
@@ -1057,12 +1082,8 @@ def _gc_drain(dev, ps, msg, me, ctx, dims):
     pay = pay.at[0].set(take)
     idx = jnp.arange(DPM, dtype=I32)
     en = idx < take
-    pay = pay.at[jnp.where(en, 1 + 2 * idx, dims.P)].set(
-        ps["gb_src"][idx], mode="drop"
-    )
-    pay = pay.at[jnp.where(en, 2 + 2 * idx, dims.P)].set(
-        ps["gb_seq"][idx], mode="drop"
-    )
+    lo_gc = jnp.where(en, 1 + 2 * idx, dims.P)
+    pay = oh_pack_pairs(pay, lo_gc, ps["gb_src"][idx], ps["gb_seq"][idx])
     # shift the buffer down
     src_rolled = jnp.roll(ps["gb_src"], -take)
     seq_rolled = jnp.roll(ps["gb_seq"], -take)

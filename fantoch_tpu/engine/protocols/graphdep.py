@@ -68,11 +68,11 @@ import numpy as np
 
 from ..core import (
     I32, compact_order, emit, emit_broadcast, empty_outbox, oh_get,
-    oh_set, oh_set2, oh_take,
+    oh_pack_pairs, oh_set, oh_set2, oh_take,
 )
 from ..dims import ERR_CAPACITY, ERR_DOT, ERR_PROTO, ERR_SEQ, INF, SEQ_BOUND, EngineDims, dot_slot
 from .identity import DevIdentity
-from ..iset import iset_add, iset_contains
+from ..iset import iset_add, iset_contains_gathered
 
 
 class _DepDev(DevIdentity):
@@ -326,15 +326,7 @@ def _commit_broadcast(dev, ps, me, seq, key, client, ctx, dims, valid):
     pay = pay.at[3].set(client)
     pay = pay.at[4].set(nd)
     lo = 5 + 2 * jnp.minimum(order, P)  # > P when order==INF
-    iota_p = jnp.arange(P, dtype=I32)
-    oh_lo = lo[:, None] == iota_p[None, :]
-    oh_hi = (lo + 1)[:, None] == iota_p[None, :]
-    pay = pay + jnp.sum(
-        jnp.where(oh_lo, oh_get(ps["qd_src"], slot)[:, None], 0)
-        + jnp.where(oh_hi, qd_seq_row[:, None], 0),
-        axis=0,
-        dtype=I32,
-    )
+    pay = oh_pack_pairs(pay, lo, oh_get(ps["qd_src"], slot), qd_seq_row)
 
     ob = emit_broadcast(
         empty_outbox(dims), _DepDev.MCOMMIT, pay, ctx["n"]
@@ -357,10 +349,12 @@ def _drain(dev, ps, me, ctx, dims, ob, exec_slot, drain_slot, enable=True):
     dslot = dot_slot(dep_seq, dims)
 
     # per-dep static facts: absent deps pass; executed deps pass
+    # (gathered membership: the full [N, D, Q, G, 2] gap gather in one
+    # fusion overflows VMEM at sweep scale)
     absent = dep_seq == 0
-    ex_front = ps["exec_front"][dep_src]           # [N, D, Q]
-    ex_gaps = ps["exec_gaps"][dep_src]             # [N, D, Q, G, 2]
-    dep_executed = iset_contains(ex_front, ex_gaps, dep_seq)
+    dep_executed = iset_contains_gathered(
+        ps["exec_front"], ps["exec_gaps"], dep_src, dep_seq
+    )
     # the dep's vertex-store cell only counts if it still holds that seq
     dep_cell_valid = ps["vx_seq"][dep_src, dslot] == dep_seq
     dep_pass_static = absent | dep_executed

@@ -40,7 +40,7 @@ import numpy as np
 
 from ..core import (
     I32, cumsum_i32, emit, emit_broadcast, empty_outbox, oh_get, oh_set,
-    oh_set2, oh_take,
+    oh_pack_pairs, oh_set2, oh_take,
 )
 from ..dims import (
     ERR_CAPACITY, ERR_DOT, ERR_PROTO, ERR_SEQ, INF, SEQ_BOUND, EngineDims,
@@ -840,14 +840,7 @@ def _detach_drain(tempo, ps, msg, me, ctx, dims):
     pay = pay.at[0].set(key)
     pay = pay.at[1].set(nr)
     lo = jnp.where(take, 2 + 2 * (order - 1), dims.P)
-    iota_p = jnp.arange(dims.P, dtype=I32)
-    oh_lo = lo[:, None] == iota_p[None, :]          # [R, P]
-    oh_hi = (lo + 1)[:, None] == iota_p[None, :]
-    pay = pay + jnp.sum(
-        jnp.where(oh_lo, row[:, :1], 0) + jnp.where(oh_hi, row[:, 1:], 0),
-        axis=0,
-        dtype=I32,
-    )
+    pay = oh_pack_pairs(pay, lo, row[:, 0], row[:, 1])
 
     det = oh_set(det, key, jnp.where(take[:, None], 0, row))
     ps = dict(ps, det=det)

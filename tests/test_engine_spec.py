@@ -99,3 +99,30 @@ def test_engine_runs_zipf_lane_end_to_end():
     assert int(res.protocol_metrics["fast_path"].sum()) + int(
         res.protocol_metrics["slow_path"].sum()
     ) == 5 * dims.C
+
+
+def test_iset_contains_forms_agree():
+    """`iset_contains` (vectorized reference form) and
+    `iset_contains_gathered` (the VMEM-safe per-g form the protocols
+    use) must agree on random interval sets."""
+    import numpy as np
+
+    from fantoch_tpu.engine.iset import (
+        iset_contains,
+        iset_contains_gathered,
+    )
+
+    rng = np.random.default_rng(7)
+    S, G = 4, 5
+    front = rng.integers(0, 6, size=(S,)).astype(np.int32)
+    gaps = np.zeros((S, G, 2), np.int32)
+    for s in range(S):
+        for g in range(rng.integers(0, G + 1)):
+            start = int(rng.integers(int(front[s]) + 2, 20))
+            gaps[s, g] = (start, start + int(rng.integers(0, 4)))
+    src = rng.integers(0, S, size=(3, 7)).astype(np.int32)
+    x = rng.integers(0, 25, size=(3, 7)).astype(np.int32)
+
+    got = np.asarray(iset_contains_gathered(front, gaps, src, x))
+    want = np.asarray(iset_contains(front[src], gaps[src], x))
+    np.testing.assert_array_equal(got, want)
