@@ -115,4 +115,12 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception:
+        # the tunneled device worker occasionally crashes/restarts
+        # mid-run; one retry distinguishes a flake from a real failure
+        import traceback
+
+        traceback.print_exc()
+        main()
