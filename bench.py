@@ -115,12 +115,24 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    import os
+    import sys
+
     try:
         main()
-    except Exception:
+    except Exception as e:
         # the tunneled device worker occasionally crashes/restarts
-        # mid-run; one retry distinguishes a flake from a real failure
+        # mid-run; one retry IN A FRESH PROCESS (the in-process JAX
+        # client is dead after a worker crash) distinguishes a flake
+        # from a real failure. Deterministic failures (assertion on
+        # failing lanes) are not retried.
         import traceback
 
         traceback.print_exc()
-        main()
+        retriable = type(e).__name__ in (
+            "JaxRuntimeError", "XlaRuntimeError", "OSError",
+        )
+        if retriable and not os.environ.get("FANTOCH_BENCH_RETRIED"):
+            os.environ["FANTOCH_BENCH_RETRIED"] = "1"
+            os.execv(sys.executable, [sys.executable] + sys.argv)
+        raise

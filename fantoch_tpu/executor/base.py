@@ -140,6 +140,10 @@ class BasicExecutor(Executor):
             ExecutorResult(info.rifl, info.key, partial)
         )
 
+    # per-key independent: safe behind a key-hash executor pool
+    # (MessageKey routing, executor/mod.rs:148-167)
+    KEY_HASH_ROUTED = True
+
     @staticmethod
     def parallel() -> bool:
         return True

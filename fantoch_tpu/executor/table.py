@@ -158,6 +158,14 @@ class TableExecutor(Executor):
         else:
             raise TypeError(f"unexpected execution info {info!r}")
 
+    # NOT safe behind this runtime's key-hash executor pools: a
+    # multi-key command's stability count (rifl_to_stable_count,
+    # executor.rs:318-330) must see every key of the rifl, which the
+    # reference provides through state shared between executor workers;
+    # per-instance pools would deadlock such commands. parallel() stays
+    # true for the reference's own shared-state scheme.
+    KEY_HASH_ROUTED = False
+
     @staticmethod
     def parallel() -> bool:
         return True

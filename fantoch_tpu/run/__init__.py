@@ -12,9 +12,10 @@ Where the reference runs W parallel protocol workers over lock-free
 Atomic/Locked state (run/mod.rs:180-183 asserts ``workers > 1 ⇒
 P::parallel()``), the host protocols here are the *Sequential* variants,
 so the runtime enforces the same rule the reference does for them: one
-protocol worker per process. Executors follow ``Executor.parallel()``:
-key-hash-routed pools for table/basic executors, a single instance
-otherwise (executor/mod.rs:148-167).
+protocol worker per process. Executor pools are key-hash routed
+(executor/mod.rs:148-167) and allowed only for executors declaring
+``KEY_HASH_ROUTED`` per-key independence (the basic executor); others
+run as a single instance.
 """
 
 from .client import ClientHandle, client

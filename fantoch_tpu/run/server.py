@@ -14,8 +14,9 @@ registration (task/server/client.rs:80-244), a periodic metrics logger
 
 One protocol worker per process: the host protocols are the reference's
 *Sequential* state variants, for which the reference enforces
-``workers == 1`` (run/mod.rs:180-183). Executor pools follow
-``Executor.parallel()`` with key-hash routing (executor/mod.rs:148-167).
+``workers == 1`` (run/mod.rs:180-183). Executor pools are key-hash
+routed (executor/mod.rs:148-167) and allowed only for executors
+declaring ``KEY_HASH_ROUTED`` per-key independence.
 """
 
 from __future__ import annotations
@@ -80,9 +81,17 @@ def _executor_pool(
     executors: int,
 ) -> List[Executor]:
     executor_cls = protocol_cls.EXECUTOR  # type: ignore[attr-defined]
-    if not executor_cls.parallel():
-        assert executors == 1, (
-            f"{executor_cls.__name__} does not support executors > 1"
+    if executors > 1:
+        # key-hash pools require per-key independence; configs asking
+        # for a pool of any other executor are rejected at boot. (The
+        # graph executor is ``parallel()`` in the reference only
+        # through its executor-0-runs-the-graph request protocol,
+        # executor/graph/mod.rs:54-67, which this runtime does not
+        # implement; the table executor's cross-key stability counting
+        # needs state shared between pool members.)
+        assert getattr(executor_cls, "KEY_HASH_ROUTED", False), (
+            f"{executor_cls.__name__} does not support key-hash executor"
+            " pools in this runtime"
         )
     return [
         executor_cls(process_id, shard_id, config) for _ in range(executors)

@@ -222,3 +222,84 @@ def test_run_tempo_partial_replication():
 
 def test_run_atlas_partial_replication():
     _run(Atlas, Config(n=3, f=1, shard_count=2))
+
+
+def test_run_basic_executor_pool():
+    """A 2-wide key-hash executor pool (task/server/executor.rs:
+    MessageKey routing) on the Basic protocol: keys split across the
+    pool, every command completes, and the per-process execution counts
+    add up across executors."""
+
+    async def main():
+        config = Config(
+            n=3, f=1,
+            executor_monitor_execution_order=True,
+            gc_interval_ms=25,
+            executor_executed_notification_interval_ms=25,
+        )
+        ids = [(pid, 0) for pid in process_ids(0, config.n)]
+        peer_socks = {pid: _bind() for pid, _ in ids}
+        client_socks = {pid: _bind() for pid, _ in ids}
+        paddr = {
+            p: ("127.0.0.1", s.getsockname()[1])
+            for p, s in peer_socks.items()
+        }
+        caddr = {
+            p: ("127.0.0.1", s.getsockname()[1])
+            for p, s in client_socks.items()
+        }
+        handles = []
+        for pid, shard in ids:
+            handles.append(await run_process(
+                Basic, pid, shard, config,
+                peer_addresses={q: paddr[q] for q, _ in ids if q != pid},
+                peer_shards={q: s for q, s in ids if q != pid},
+                peer_sock=peer_socks[pid], client_sock=client_socks[pid],
+                sorted_processes=[(pid, shard)]
+                + [(q, s) for q, s in ids if q != pid],
+                executors=2,
+            ))
+        for h in handles:
+            await h.started.wait()
+        workload = Workload(
+            shard_count=1,
+            key_gen=ConflictPool(conflict_rate=50, pool_size=4),
+            keys_per_command=2, commands_per_client=COMMANDS,
+            payload_size=1,
+        )
+        res = await run_client([1, 2], {0: caddr[1]}, {0: 1}, workload)
+        assert all(
+            len(d.latency_data()) == COMMANDS for d in res.data.values()
+        )
+        # commits reach the non-coordinator replicas after the client
+        # already has its result: poll, don't sleep
+        def totals():
+            return [
+                sum(
+                    len(m.get_order(k))
+                    for m in h.monitors()
+                    for k in m.keys()
+                )
+                for h in handles
+            ]
+
+        # every process executes each command once per key
+        expect = 2 * COMMANDS * 2
+        for _ in range(100):
+            if all(t == expect for t in totals()):
+                break
+            await asyncio.sleep(0.05)
+        assert all(t == expect for t in totals()), totals()
+        for h in handles:
+            monitors = h.monitors()
+            assert len(monitors) == 2, "expected one monitor per executor"
+            keys0 = set(monitors[0].keys())
+            keys1 = set(monitors[1].keys())
+            assert keys0.isdisjoint(keys1), "executors must split keys"
+            assert keys0 and keys1, (
+                "both executors should own keys with a 4-key pool"
+            )
+        for h in handles:
+            await h.stop()
+
+    asyncio.run(main())
