@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""All-five-protocol region-subset sweep — BASELINE config 4's shape
+(all protocols × C(20, n) GCP region subsets × f; the reference's
+simulation binary iterates protocols in its outer rayon loop,
+fantoch_ps/src/bin/simulation.rs:161-217).
+
+One engine batch per protocol (each has its own state shapes); results
+land in a JSONL store searchable by protocol for plotting.
+
+Usage: python tools/full_sweep.py [--subsets 8] [--n 5] [--commands 20]
+       [--out sweep.jsonl] [--cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from fantoch_tpu.core import Config, Planet  # noqa: E402
+
+
+def build_protocol(name, n, clients):
+    from fantoch_tpu.engine.protocols import (
+        AtlasDev,
+        BasicDev,
+        CaesarDev,
+        EPaxosDev,
+        FPaxosDev,
+        TempoDev,
+    )
+
+    keys = 1 + clients
+    if name == "tempo":
+        return TempoDev.for_load(keys=keys, clients=clients)
+    return {
+        "basic": lambda: BasicDev,
+        "fpaxos": lambda: FPaxosDev,
+        "atlas": lambda: AtlasDev(keys=keys),
+        "epaxos": lambda: EPaxosDev(keys=keys),
+        "caesar": lambda: CaesarDev(keys=keys),
+    }[name]()
+
+
+def config_for(name, n, f):
+    kw = dict(n=n, f=f, gc_interval_ms=100)
+    if name == "tempo":
+        kw["tempo_detached_send_interval_ms"] = 100
+    if name == "fpaxos":
+        kw["leader"] = 1
+    if name == "caesar":
+        kw["caesar_wait_condition"] = True
+    return Config(**kw)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--subsets", type=int, default=8)
+    ap.add_argument("--n", type=int, default=5)
+    ap.add_argument("--f", type=int, default=1)
+    ap.add_argument("--commands", type=int, default=20)
+    ap.add_argument("--conflict", type=int, default=50)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 8)
+
+    from fantoch_tpu.engine import EngineDims  # noqa: E402
+    from fantoch_tpu.parallel import make_sweep_specs, run_sweep  # noqa: E402
+
+    planet = Planet.new()
+    regions = planet.regions()
+    combos = list(itertools.combinations(range(len(regions)), args.n))
+    stride = max(1, len(combos) // args.subsets)
+    region_sets = [
+        [regions[i] for i in c] for c in combos[::stride][: args.subsets]
+    ]
+    clients = args.n
+    total = args.commands * clients
+
+    protocols = ["basic", "fpaxos", "tempo", "atlas", "epaxos", "caesar"]
+    summary = {}
+    rows = []
+    t0 = time.perf_counter()
+    for name in protocols:
+        dev = build_protocol(name, args.n, clients)
+        dims = EngineDims.for_protocol(
+            dev,
+            n=args.n,
+            clients=clients,
+            payload=dev.payload_width(args.n),
+            total_commands=total,
+            dot_slots=min(total + 1, 128),
+            regions=args.n,
+        )
+        specs = make_sweep_specs(
+            dev,
+            planet,
+            region_sets=region_sets,
+            fs=[args.f],
+            conflicts=[args.conflict],
+            commands_per_client=args.commands,
+            clients_per_region=1,
+            dims=dims,
+            config_base=config_for(name, args.n, args.f),
+        )
+        t1 = time.perf_counter()
+        results = run_sweep(dev, dims, specs)
+        dt = time.perf_counter() - t1
+        errs = [r.err_cause for r in results if r.err]
+        summary[name] = {
+            "points": len(specs),
+            "seconds": round(dt, 2),
+            "errors": errs,
+        }
+        assert not errs, f"{name}: failing lanes {errs[:4]}"
+        for spec, res in zip(specs, results):
+            rows.append(
+                (
+                    {
+                        "protocol": name,
+                        "n": spec.config.n,
+                        "f": spec.config.f,
+                        "conflict": args.conflict,
+                        "regions": spec.process_regions,
+                    },
+                    res,
+                )
+            )
+    elapsed = time.perf_counter() - t0
+
+    if args.out:
+        from fantoch_tpu.plot import save_results
+
+        save_results(args.out, rows)
+    print(
+        json.dumps(
+            {
+                "protocols": summary,
+                "total_points": sum(v["points"] for v in summary.values()),
+                "total_seconds": round(elapsed, 2),
+                "out": args.out,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
