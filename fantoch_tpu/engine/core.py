@@ -232,13 +232,16 @@ def compact_order(mask, limit):
     return order, jnp.sum(mask)
 
 
-def emit_broadcast(outbox, mtype, payload, n, me=None, exclude_me=False):
-    """Fill slots 0..N-1 with a broadcast to processes < n (the
-    reference's ``ToSend{target: all()}``; ``all_but_me()`` with
-    ``exclude_me``). Occupies the first N outbox slots."""
+def emit_broadcast(outbox, mtype, payload, n, me=None, exclude_me=False,
+                   base=0):
+    """Fill slots 0..N-1 with a broadcast to processes ``base`` ..
+    ``base + n - 1`` (the reference's ``ToSend{target: all()}``;
+    ``all_but_me()`` with ``exclude_me``; ``base`` > 0 targets one
+    shard's process block under partial replication). Occupies the
+    first N outbox slots; destinations are base + slot index."""
     nmax = outbox["dst"].shape[0]
-    procs = jnp.arange(nmax, dtype=I32)
-    valid = procs < n
+    procs = jnp.arange(nmax, dtype=I32) + base
+    valid = procs < base + n  # i.e. slot index < n
     if exclude_me:
         valid = valid & (procs != me)
     pay = jnp.zeros((nmax, outbox["payload"].shape[1]), I32)
@@ -354,7 +357,14 @@ def init_lane_state(
     pool = np.zeros((M, POOL_FIELDS + P), np.int32)
     pool[:, PA] = INF
     budget = ctx_np["cmd_budget"]          # [C]
-    attach = ctx_np["client_attach"]       # [C]
+    if "cmd_target" in ctx_np:
+        # partial replication: each client's first SUBMIT targets its
+        # connected process of the first command's target shard
+        attach = ctx_np["client_attach_s"][
+            np.arange(C), ctx_np["cmd_target"][:, 1]
+        ]
+    else:
+        attach = ctx_np["client_attach"]   # [C]
     live = budget > 0
     assert live.sum() <= M, "pool must hold the initial submit wave"
     # first keys for every client, with the same counter scheme the
@@ -384,8 +394,10 @@ def init_lane_state(
     next_periodic = np.broadcast_to(
         np.where(intervals >= INF, INF, intervals), (N, R)
     ).astype(np.int32).copy()
-    # timers only run on live processes
-    next_periodic[ctx_np["n"]:, :] = INF
+    # timers only run on live processes (``rows`` = all shards' rows
+    # under partial replication; single-shard lanes predate the key)
+    live_rows = int(ctx_np.get("rows", ctx_np["n"]))
+    next_periodic[live_rows:, :] = INF
 
     return {
         "pool": pool,
@@ -395,6 +407,10 @@ def init_lane_state(
             "issued": live.astype(np.int32),
             "completed": np.zeros((C,), np.int32),
             "start_time": np.zeros((C,), np.int32),
+            # result parts (per-key/per-shard partials) of the command
+            # in flight + latest part arrival
+            "parts": np.zeros((C,), np.int32),
+            "part_max": np.zeros((C,), np.int32),
         },
         "metrics": {
             "hist": np.zeros((dims.RR, dims.H), np.int32),
@@ -616,29 +632,64 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False):
     c = jnp.where(is_client, dst - N, 0)
     d_back = scaled(ctx["client_delay"][c, emitter], 0)
     t_arr = ep_e + d_back
-    latency = t_arr - st["clients"]["start_time"][c]
 
     cl = st["clients"]
     # per-client updates as one-hot reductions (C is tiny; scatters are
-    # one kernel each on the target runtime, these fuse away). The
-    # closed loop guarantees at most one completion per client per step,
-    # so a masked max routes the start-time value.
+    # one kernel each on the target runtime, these fuse away). Each
+    # TO_CLIENT is one result *part* (a per-key/per-shard partial under
+    # partial replication, run/task/client/pending.rs); a command
+    # completes when its parts count reaches ``cmd_parts`` (1 without
+    # multi-key tables), at the latest part's arrival time. The closed
+    # loop guarantees at most one *completion* per client per step.
     iota_c = jnp.arange(C, dtype=I32)
     oh_done = is_client[:, None] & (c[:, None] == iota_c[None, :])  # [E, C]
-    completed = cl["completed"] + jnp.sum(oh_done, axis=0, dtype=I32)
-    more = cl["issued"][c] < ctx["cmd_budget"][c]
-    issue = is_client & more
-    oh_issue = oh_done & more[:, None]                              # [E, C]
-    issued = cl["issued"] + jnp.sum(oh_issue, axis=0, dtype=I32)
-    st_new = jnp.max(
-        jnp.where(oh_issue, t_arr[:, None], -1), axis=0
+    arrivals = jnp.sum(oh_done, axis=0, dtype=I32)                  # [C]
+    if "cmd_parts" in ctx:
+        T_parts = ctx["cmd_parts"].shape[1]
+        need = ctx["cmd_parts"][
+            iota_c, jnp.minimum(cl["issued"], T_parts - 1)
+        ]
+    else:
+        need = jnp.ones((C,), I32)
+    parts_new = cl["parts"] + arrivals
+    # latest part arrival per client (parts can arrive out of step
+    # order under lookahead execution, so carry a running max)
+    part_max = jnp.maximum(
+        cl["part_max"],
+        jnp.max(jnp.where(oh_done, t_arr[:, None], 0), axis=0),
     )
+    complete_c = (arrivals > 0) & (parts_new >= need)               # [C]
+    completed = cl["completed"] + complete_c.astype(I32)
+    parts = jnp.where(complete_c, 0, parts_new)
+    done_t = part_max                                               # [C]
+    latency_c = done_t - cl["start_time"]
+    part_max = jnp.where(complete_c, 0, part_max)
+
+    # the completing row: the last row per client this step (row choice
+    # only picks which outbox slot carries the next SUBMIT; its base
+    # time comes from done_t)
+    row_idx = jnp.arange(E, dtype=I32)
+    last_row = jnp.max(
+        jnp.where(oh_done, row_idx[:, None], -1), axis=0
+    )                                                               # [C]
+    is_completing = is_client & (row_idx == last_row[c]) & complete_c[c]
+
+    more = cl["issued"][c] < ctx["cmd_budget"][c]
+    issue = is_completing & more
+    oh_issue = (
+        oh_done & (row_idx[:, None] == last_row[None, :])
+        & complete_c[None, :] & more[:, None]
+    )                                                               # [E, C]
+    issued = cl["issued"] + jnp.sum(oh_issue, axis=0, dtype=I32)
+    st_new = jnp.where(jnp.any(oh_issue, axis=0), done_t, -1)
     start_time = jnp.where(st_new >= 0, st_new, cl["start_time"])
     next_seq = cl["issued"][c] + 1
     if "key_table" in ctx:
         # precomputed (client, seq) → key table: no RNG in the loop
         T_keys = ctx["key_table"].shape[1]
         key = ctx["key_table"][c, jnp.minimum(next_seq, T_keys - 1)]
+    elif "cmd_target" in ctx:
+        key = jnp.zeros((E,), I32)  # keys live in ctx cmd tables
     else:
         key = jax.vmap(lambda cc, ss: gen_key(ctx, cc, ss))(c, next_seq)
     sub_payload = jnp.zeros((E, P), I32)
@@ -646,9 +697,13 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False):
     sub_payload = sub_payload.at[:, 1].set(next_seq)
     sub_payload = sub_payload.at[:, 2].set(key)
 
-    # metrics (hist/lat_log keep their scatters — their one-hot forms
-    # would materialize [E, RR, H]-scale intermediates)
-    row = jnp.where(is_client, ctx["client_region_row"][c], dims.RR)
+    # metrics on completion only (hist/lat_log keep their scatters —
+    # their one-hot forms would materialize [E, RR, H]-scale
+    # intermediates)
+    latency = latency_c[c]
+    row = jnp.where(
+        is_completing, ctx["client_region_row"][c], dims.RR
+    )
     bucket = jnp.clip(latency, 0, dims.H - 1)
     metrics = st["metrics"]
     hist = metrics["hist"].at[row, bucket].add(1, mode="drop")
@@ -657,22 +712,33 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False):
         jnp.where(oh_row, latency[:, None], 0), axis=0, dtype=I32
     )
     lat_count = metrics["lat_count"] + jnp.sum(oh_row, axis=0, dtype=I32)
-    log_idx = jnp.where(is_client, cl["completed"][c], LAT_LOG)
+    log_idx = jnp.where(is_completing, cl["completed"][c], LAT_LOG)
     lat_log = metrics["lat_log"].at[
-        jnp.where(is_client, c, C), log_idx
+        jnp.where(is_completing, c, C), log_idx
     ].set(latency, mode="drop")
 
     # rewrite entries in place
-    dst = jnp.where(issue, ctx["client_attach"][c], dst)
+    if "cmd_target" in ctx:
+        # partial replication: the next SUBMIT goes to the client's
+        # connected process of the command's target shard (the shard
+        # of its first key, client/workload.py:84)
+        T_t = ctx["cmd_target"].shape[1]
+        tgt_shard = ctx["cmd_target"][c, jnp.minimum(next_seq, T_t - 1)]
+        attach = ctx["client_attach_s"][c, tgt_shard]
+    else:
+        attach = ctx["client_attach"][c]
+    dst = jnp.where(issue, attach, dst)
     mtype = jnp.where(issue, protocol.SUBMIT, out["mtype"])
     payload = jnp.where(issue[:, None], sub_payload, out["payload"])
     src = jnp.where(is_client, N + c, emitter)
     src = jnp.where(out["src"] >= 0, out["src"], src)
-    base = jnp.where(issue, t_arr, ep_e)
+    # the next SUBMIT leaves at the command's completion time (the
+    # latest part's arrival, == t_arr for single-part commands)
+    base = jnp.where(issue, done_t[c], ep_e)
     overridden = out["delay"] >= 0  # requeues: fixed delay, never scaled
     delay = jnp.where(
         issue,
-        scaled(ctx["client_delay"][c, ctx["client_attach"][c]], 1),
+        scaled(ctx["client_delay"][c, attach], 1),
         scaled(ctx["delay_pp"][emitter, jnp.clip(dst, 0, N - 1)], 2),
     )
     delay = jnp.where(overridden, out["delay"], delay)
@@ -767,7 +833,8 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False):
     live = ctx["cmd_budget"] > 0
     all_done = jnp.all(~live | (completed >= ctx["cmd_budget"]))
     max_completion = jnp.maximum(
-        st["max_completion"], jnp.max(jnp.where(is_client, t_arr, 0))
+        st["max_completion"],
+        jnp.max(jnp.where(is_completing, done_t[c], 0)),
     )
     done_time = jnp.where(
         (st["done_time"] == INF) & all_done,
@@ -789,6 +856,8 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False):
             "issued": issued,
             "completed": completed,
             "start_time": start_time,
+            "parts": parts,
+            "part_max": part_max,
         },
         "metrics": {
             "hist": hist,

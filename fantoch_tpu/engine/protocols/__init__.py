@@ -12,6 +12,7 @@ from .caesar import CaesarDev
 from .fpaxos import FPaxosDev
 from .graphdep import AtlasDev, EPaxosDev
 from .tempo import TempoDev
+from .tempo_partial import TempoPartialDev
 
 __all__ = [
     "AtlasDev",
@@ -20,4 +21,42 @@ __all__ = [
     "EPaxosDev",
     "FPaxosDev",
     "TempoDev",
+    "TempoPartialDev",
+    "dev_protocol",
+    "dev_config_kwargs",
 ]
+
+
+def dev_protocol(name: str, clients: int, keys: "int | None" = None):
+    """The one protocol-name → device-protocol switch (bench, graft
+    entry, sweep tools and the CLI all construct through here so a new
+    protocol or capacity knob is one edit)."""
+    keys = keys if keys is not None else 1 + clients
+    if name == "tempo":
+        return TempoDev.for_load(keys=keys, clients=clients)
+    if name == "basic":
+        return BasicDev
+    if name == "fpaxos":
+        return FPaxosDev
+    if name == "atlas":
+        return AtlasDev(keys=keys)
+    if name == "epaxos":
+        return EPaxosDev(keys=keys)
+    if name == "caesar":
+        return CaesarDev(keys=keys)
+    raise ValueError(f"unknown protocol {name!r}")
+
+
+def dev_config_kwargs(name: str, n: int, f: int, **overrides):
+    """Default Config kwargs per protocol (leader for FPaxos, wait
+    condition for Caesar, detached sends for Tempo); ``overrides``
+    win."""
+    kw = dict(n=n, f=f, gc_interval_ms=100)
+    if name == "tempo":
+        kw["tempo_detached_send_interval_ms"] = 100
+    if name == "fpaxos":
+        kw["leader"] = 1
+    if name == "caesar":
+        kw["caesar_wait_condition"] = True
+    kw.update(overrides)
+    return kw

@@ -1,0 +1,1249 @@
+"""Device Tempo with partial replication and multi-key commands.
+
+The partial-mode twin of :class:`TempoDev` — same protocol core
+(fantoch_ps/src/protocol/tempo.rs, host oracle protocol/tempo.py) plus
+the reference's shard-coordination paths:
+
+- ``MForwardSubmit`` hands the dot to the closest process of every
+  other shard the command touches (partial.rs:8-35); each shard runs
+  its own collect round for the shared dot;
+- quorum members ``MBump`` other shards' closest processes with their
+  clock so remote keys advance (tempo.rs:674-701, 1013-1049);
+- per-shard commit clocks aggregate at the dot-owner process via
+  ``MShardCommit`` → ``MShardAggregatedCommit`` (partial.rs:37-167);
+  each shard coordinator then broadcasts the final-clock ``MCommit``
+  inside its shard with its locally-held votes;
+- the table executor's multi-key/multi-shard readiness protocol:
+  per-key pending queues, ``StableAtShard`` fan-out once all local keys
+  are stable, cross-shard messages through the closest process
+  (executor/table/executor.rs:171-360);
+- clients aggregate per-key result partials (task/client/pending.rs) —
+  the engine core's ``cmd_parts`` completion counting.
+
+Array encoding notes. A command is fully determined by (client, cseq):
+its per-shard keys, touched-shard bitmask and part count live in ctx
+tables (``cmd_skey``/``cmd_kmask``/``cmd_parts``, engine/spec.py
+``_partial_tables``), so messages carry (client, cseq) instead of key
+lists. Coordinator state is per (dot source, slot) — a process
+coordinates foreign dots when it is the forwarded shard coordinator.
+Parked executor entries keep the reference's invariant that at most
+one entry per key (the queue head) has contributed to the
+``rifl_to_stable_count`` / sent its ``StableAtShard`` fan-out.
+
+Single-shard single-key lanes should use :class:`TempoDev` — its
+narrower state arrays compile leaner; this class exists for
+``shard_count > 1`` or ``keys_per_cmd > 1`` lanes and matches the
+oracle exactly on tie-free schedules (tests/test_engine_partial.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import (
+    I32, cumsum_i32, emit, emit_broadcast, empty_outbox, oh_get, oh_set,
+    oh_pack_pairs, oh_set2, oh_take,
+)
+from ..dims import (
+    ERR_CAPACITY, ERR_DOT, ERR_PROTO, ERR_SEQ, INF, SEQ_BOUND, EngineDims,
+    dot_slot,
+)
+from ..iset import iset_add, iset_add_range
+from .tempo import TempoDev, _bump, _det_add
+
+
+class TempoPartialDev(TempoDev):
+    SUBMIT = 0
+    MCOLLECT = 1
+    MCOLLECTACK = 2
+    MCOMMIT = 3
+    MDETACHED = 4
+    MCONSENSUS = 5
+    MCONSENSUSACK = 6
+    MGC = 7
+    MDRAIN = 8
+    DETACH_DRAIN = 9
+    MFWDSUBMIT = 10
+    MBUMP = 11
+    MSHARDCOMMIT = 12
+    MSHARDAGG = 13
+    STABLEAT = 14
+    NUM_TYPES = 15
+    TO_CLIENT = 16
+
+    PERIODIC_ROWS = 3
+
+    def __init__(
+        self,
+        keys: int,
+        shards: int = 2,
+        keys_per_cmd: int = 2,
+        pending_per_key: int = 32,
+        detached_slots: int = 16,
+        gap_slots: int = 8,
+    ):
+        super().__init__(keys, pending_per_key, detached_slots, gap_slots)
+        self.S = shards
+        self.KPC = keys_per_cmd
+
+    # -- host-side builders -------------------------------------------
+
+    def payload_width(self, n: int) -> int:
+        # MCommit: [dsrc, dseq, clock, client, cseq, nv] then voter ids
+        # and per-(key, voter) ranges over the FULL process-row axis
+        # N = S*n (voters of one shard occupy n of the N columns)
+        N = self.S * n
+        return max(6 + N + 2 * self.KPC * N, N, 10)
+
+    def fanout(self, n: int) -> int:
+        """Outbox rows one handler may need: a shard broadcast occupies
+        slots 0..N-1 (N = S*n), plus forward/bump/stable extras."""
+        N = self.S * n
+        return max(N + self.S + 2, 3 + self.S * self.KPC)
+
+    def lane_ctx(self, config, dims: EngineDims, sorted_idx: np.ndarray):
+        N, n, S = dims.N, config.n, config.shard_count
+        fq_size, wq_size, threshold = config.tempo_quorum_sizes()
+        fq = np.zeros((N, N), bool)
+        wq = np.zeros((N, N), bool)
+        # block-diagonal per shard: quorums never cross shards
+        for s in range(S):
+            for p in range(n):
+                row = s * n + p
+                for member in sorted_idx[p][:fq_size]:
+                    fq[row, s * n + member] = True
+                for member in sorted_idx[p][:wq_size]:
+                    wq[row, s * n + member] = True
+        return {
+            "fast_quorum": fq,
+            "write_quorum": wq,
+            "fq_size": np.int32(fq_size),
+            "wq_size": np.int32(wq_size),
+            "threshold": np.int32(threshold),
+            "clock_bump_mode": np.bool_(
+                config.tempo_clock_bump_interval_ms is not None
+            ),
+        }
+
+    def init_state(self, dims: EngineDims, ctx_np) -> Dict[str, np.ndarray]:
+        N, D, C = dims.N, dims.D, dims.C
+        K, PK, R, G, KPC = self.K, self.PK, self.R, self.G, self.KPC
+        return {
+            # key clocks + detached accumulator (protocol)
+            "clocks": np.zeros((N, K), np.int32),
+            "det": np.zeros((N, K, R, 2), np.int32),
+            "max_commit_clock": np.zeros((N,), np.int32),
+            # per-dot payload pointers (dot → (client, cseq))
+            "seq_in_slot": np.zeros((N, N, D), np.int32),
+            "client_of": np.zeros((N, N, D), np.int32),
+            "cseq_of": np.zeros((N, N, D), np.int32),
+            # coordinator per (dot source, slot): a process coordinates
+            # its own dots plus forwarded dots of other shards' owners
+            "own_seq": np.zeros((N,), np.int32),
+            "ack_cnt": np.zeros((N, N, D), np.int32),
+            "max_clock": np.zeros((N, N, D), np.int32),
+            "max_cnt": np.zeros((N, N, D), np.int32),
+            "slow_acks": np.zeros((N, N, D), np.int32),
+            "votes_n": np.zeros((N, N, D), np.int32),
+            "votes_by": np.zeros((N, N, D, N), np.int32),
+            "votes_s": np.zeros((N, N, D, KPC, N), np.int32),
+            "votes_e": np.zeros((N, N, D, KPC, N), np.int32),
+            # shard-commit aggregation at the dot owner (own dots only)
+            "shag_cnt": np.zeros((N, D), np.int32),
+            "shag_max": np.zeros((N, D), np.int32),
+            # buffered MBump max clock per dot (tempo.rs:674-701)
+            "mbump_buf": np.zeros((N, N, D), np.int32),
+            # table executor: votes + pending entries (phase 0 empty,
+            # 1 awaiting clock stability, 2 parked queue head)
+            "vote_front": np.zeros((N, K, N), np.int32),
+            "vote_gaps": np.zeros((N, K, N, G, 2), np.int32),
+            "pend_clock": np.zeros((N, K, PK), np.int32),
+            "pend_src": np.zeros((N, K, PK), np.int32),
+            "pend_seq": np.zeros((N, K, PK), np.int32),
+            "pend_client": np.zeros((N, K, PK), np.int32),
+            "pend_cseq": np.zeros((N, K, PK), np.int32),
+            "pend_kmask": np.zeros((N, K, PK), np.int32),
+            "pend_missing": np.zeros((N, K, PK), np.int32),
+            "pend_phase": np.zeros((N, K, PK), np.int32),
+            # rifl_to_stable_count (executor.rs:318-330): locally stable
+            # key count of the client's in-flight rifl
+            "stable_cnt": np.zeros((N, C), np.int32),
+            "stable_cnt_seq": np.zeros((N, C), np.int32),
+            # buffered StableAtShard per (key, client) with rifl guard
+            "buf_cnt": np.zeros((N, K, C), np.int32),
+            "buf_seq": np.zeros((N, K, C), np.int32),
+            # committed-clock GC (sources span all shards; only my
+            # shard's sources accumulate)
+            "comm_front": np.zeros((N, N), np.int32),
+            "comm_gaps": np.zeros((N, N, G, 2), np.int32),
+            "others_frontier": np.zeros((N, N, N), np.int32),
+            "seen": np.zeros((N, N), bool),
+            "prev_stable": np.zeros((N, N), np.int32),
+            "m_fast": np.zeros((N,), np.int32),
+            "m_slow": np.zeros((N,), np.int32),
+            "m_stable": np.zeros((N,), np.int32),
+            "err": np.zeros((N,), np.int32),
+        }
+
+    # -- device handlers ----------------------------------------------
+
+    def ready(self, ps, msg, me, ctx, dims: EngineDims):
+        """Requeue messages that overtook their prerequisite under
+        reordering (same contract as TempoDev.ready)."""
+        t = msg["mtype"]
+        dsrc, dseq = msg["payload"][0], msg["payload"][1]
+        slot = dot_slot(dseq, dims)
+        free = oh_get(oh_get(ps["seq_in_slot"], dsrc), slot) == 0
+        have = (
+            oh_get(oh_get(ps["seq_in_slot"], dsrc), slot) == dseq
+        )
+        ok = jnp.where(t == self.MCOLLECT, free, True)
+        needs_payload = (
+            (t == self.MCOMMIT)
+            | (t == self.MCONSENSUS)
+            | (t == self.MSHARDAGG)
+            | (t == self.MSHARDCOMMIT)
+        )
+        return jnp.where(needs_payload, have, ok)
+
+    def handle(self, ps, msg, me, now, ctx, dims: EngineDims):
+        def _noop(ps, msg):
+            return ps, empty_outbox(dims)
+
+        branches = [
+            lambda ps, msg: _p_submit(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _p_mcollect(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _p_mcollectack(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _p_mcommit(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _p_mdetached(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _p_mconsensus(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _p_mconsensusack(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _p_mgc(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _p_mdrain(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _p_detach_drain(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _p_mfwdsubmit(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _p_mbump(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _p_mshardcommit(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _p_mshardagg(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _p_stableat(self, ps, msg, me, ctx, dims),
+            _noop,
+        ]
+        idx = jnp.clip(msg["mtype"], 0, self.NUM_TYPES)
+        return jax.lax.switch(idx, branches, ps, msg)
+
+    def periodic(self, ps, fire, me, now, ctx, dims: EngineDims):
+        """GC frontier broadcast (within shard), real-time clock bump,
+        detached-send kick-off — TempoDev.periodic with a shard-aware
+        broadcast base."""
+        base = _shard_base(ctx, me)
+        ob = emit_broadcast(
+            empty_outbox(dims),
+            self.MGC,
+            ps["comm_front"],
+            ctx["n"],
+            me,
+            exclude_me=True,
+            base=base,
+        )
+        ob = dict(ob, valid=ob["valid"] & fire[0])
+
+        min_clock = jnp.maximum(ps["max_commit_clock"], now * 1000)
+        ps = _detached_all_p(self, ps, min_clock, fire[1])
+
+        has = jnp.any(ps["det"][:, :, 0] > 0)
+        ob = emit(
+            ob,
+            dims.N,
+            me,
+            self.DETACH_DRAIN,
+            [0],
+            valid=fire[2] & has,
+        )
+        return ps, ob
+
+
+# ----------------------------------------------------------------------
+# shared helpers
+# ----------------------------------------------------------------------
+
+
+def _shard_base(ctx, me):
+    return oh_get(ctx["shard_of"], me) * ctx["n"]
+
+
+def _shard_mask(ctx, me, dims):
+    """Bool [N]: live processes of my shard."""
+    procs = jnp.arange(dims.N, dtype=I32)
+    s_me = oh_get(ctx["shard_of"], me)
+    return ctx["shard_of"] == s_me  # pad rows carry shard id S (never live)
+
+
+def _cmd_tables(ctx, client, cseq):
+    """(kmask, skey [S, KPC]) of command (client, cseq) from ctx."""
+    T = ctx["cmd_kmask"].shape[1]
+    j = jnp.minimum(cseq, T - 1)
+    kmask = oh_get(oh_get(ctx["cmd_kmask"], client), j)
+    skey = oh_get(oh_get(ctx["cmd_skey"], client), j)  # [S, KPC]
+    return kmask, skey
+
+
+def _popcount(kmask, S: int):
+    return jnp.sum(
+        (kmask[None] >> jnp.arange(S, dtype=I32)) & 1, dtype=I32
+    )
+
+
+def _my_keys(pp, ctx, me, skey):
+    """This shard's keys of the command: [KPC] (-1 pad)."""
+    s_me = oh_get(ctx["shard_of"], me)
+    return oh_get(skey, s_me)
+
+
+def _proposal(pp, ps, keys, min_clock):
+    """key_clocks.proposal (sequential.rs:36-47) over up to KPC keys:
+    clock = max(min_clock, highest key clock + 1); each key votes its
+    vacated range. Returns (ps, clock, vs [KPC], ve [KPC])."""
+    valid = keys >= 0
+    cur = jnp.where(valid, oh_take(ps["clocks"], keys), 0)  # [KPC]
+    clock = jnp.maximum(min_clock, jnp.max(jnp.where(valid, cur, 0)) + 1)
+    vs = jnp.where(valid & (cur < clock), cur + 1, 0)
+    ve = jnp.where(valid & (cur < clock), clock, 0)
+    clocks = ps["clocks"]
+    for d in range(pp.KPC):
+        clocks = oh_set(
+            clocks, jnp.where(valid[d], keys[d], -1), clock
+        )
+    return dict(ps, clocks=clocks), clock, vs, ve
+
+
+def _detached_keys(pp, ps, keys, up_to, enable):
+    """key_clocks.detached over the command's local keys."""
+    for d in range(pp.KPC):
+        ps = _bump(
+            pp, ps, jnp.where(keys[d] >= 0, keys[d], -1), up_to,
+            jnp.asarray(enable, bool) & (keys[d] >= 0),
+        )
+    return ps
+
+
+def _detached_all_p(pp, ps, min_clock, enable):
+    """detached_all (vectorized over keys), as in TempoDev."""
+    clocks = ps["clocks"]
+    det = ps["det"]
+    do = jnp.asarray(enable, bool) & (clocks < min_clock)
+    free = det[:, :, 0] == 0
+    slot = jnp.argmax(free, axis=1)
+    overflow = do & ~jnp.any(free, axis=1)
+    slot_w = jnp.where(do & ~overflow, slot, pp.R)
+    hit = jnp.arange(pp.R, dtype=I32)[None, :] == slot_w[:, None]
+    vals = jnp.stack(
+        [clocks + 1, jnp.broadcast_to(min_clock, clocks.shape)], axis=-1
+    )
+    det = jnp.where(hit[:, :, None], vals[:, None, :], det)
+    return dict(
+        ps,
+        det=det,
+        clocks=jnp.where(do, min_clock, clocks),
+        err=ps["err"] | ERR_CAPACITY * jnp.any(overflow),
+    )
+
+
+def _set_votes_row(arr, dsrc, slot, idx, vals):
+    """arr [Nsrc, D, KPC, NV]: write vals [KPC] at voter column idx."""
+    row = oh_get(oh_get(arr, dsrc), slot)  # [KPC, NV]
+    NV = row.shape[1]
+    hit = jnp.arange(NV, dtype=I32)[None, :] == idx
+    row = jnp.where(hit, vals[:, None], row)
+    return oh_set2(arr, dsrc, slot, row)
+
+
+def _get2(arr, i, j):
+    return oh_get(oh_get(arr, i), j)
+
+
+def _bump_field2(ps, name, dsrc, slot, value):
+    return oh_set2(ps[name], dsrc, slot, value)
+
+
+# ----------------------------------------------------------------------
+# submit / forward / collect
+# ----------------------------------------------------------------------
+
+
+def _p_start(pp, ps, dsrc, dseq, client, cseq, me, ctx, dims, forward):
+    """Shared coordinator start (tempo.rs:267-339 at the target shard;
+    the MForwardSubmit path runs the same flow without re-forwarding,
+    partial.rs:8-35)."""
+    kmask, skey = _cmd_tables(ctx, client, cseq)
+    keys = _my_keys(pp, ctx, me, skey)
+    slot = dot_slot(dseq, dims)
+
+    ps, clock, vs, ve = _proposal(pp, ps, keys, 0)
+    # reset this dot's coordinator aggregation state
+    for name in ("ack_cnt", "max_clock", "max_cnt", "slow_acks"):
+        ps = dict(ps, **{name: oh_set2(ps[name], dsrc, slot, 0)})
+    ps = dict(
+        ps,
+        votes_n=oh_set2(ps["votes_n"], dsrc, slot, 1),
+        votes_by=_set_votes_row3(ps["votes_by"], dsrc, slot, 0, me),
+        votes_s=_set_votes_row(ps["votes_s"], dsrc, slot, 0, vs),
+        votes_e=_set_votes_row(ps["votes_e"], dsrc, slot, 0, ve),
+    )
+    base = _shard_base(ctx, me)
+    ob = emit_broadcast(
+        empty_outbox(dims),
+        pp.MCOLLECT,
+        [dsrc, dseq, client, cseq, clock],
+        ctx["n"],
+        base=base,
+    )
+    if forward:
+        # own dot: reset the shard aggregation + forward to the closest
+        # process of every other touched shard
+        ps = dict(
+            ps,
+            shag_cnt=oh_set(ps["shag_cnt"], slot, 0),
+            shag_max=oh_set(ps["shag_max"], slot, 0),
+        )
+        s_me = oh_get(ctx["shard_of"], me)
+        for s in range(pp.S):
+            touched = ((kmask >> s) & 1) == 1
+            ob = emit(
+                ob,
+                dims.N + s,
+                oh_get(oh_get(ctx["closest"], me), jnp.int32(s)),
+                pp.MFWDSUBMIT,
+                [dsrc, dseq, client, cseq],
+                valid=touched & (s != s_me),
+            )
+    return ps, ob
+
+
+def _set_votes_row3(arr, dsrc, slot, idx, val):
+    """arr [Nsrc, D, NV]: write scalar val at voter column idx."""
+    row = _get2(arr, dsrc, slot)
+    NV = row.shape[0]
+    hit = jnp.arange(NV, dtype=I32) == idx
+    return oh_set2(arr, dsrc, slot, jnp.where(hit, val, row))
+
+
+def _p_submit(pp, ps, msg, me, ctx, dims):
+    client, cseq = msg["payload"][0], msg["payload"][1]
+    dseq = ps["own_seq"] + 1
+    ps = dict(
+        ps,
+        own_seq=dseq,
+        err=ps["err"] | ERR_SEQ * (dseq >= SEQ_BOUND),
+    )
+    return _p_start(
+        pp, ps, me, dseq, client, cseq, me, ctx, dims, forward=True
+    )
+
+
+def _p_mfwdsubmit(pp, ps, msg, me, ctx, dims):
+    dsrc, dseq, client, cseq = (
+        msg["payload"][0],
+        msg["payload"][1],
+        msg["payload"][2],
+        msg["payload"][3],
+    )
+    return _p_start(
+        pp, ps, dsrc, dseq, client, cseq, me, ctx, dims, forward=False
+    )
+
+
+def _p_mcollect(pp, ps, msg, me, ctx, dims):
+    """tempo.rs:341-459 with the dot source decoupled from the message
+    sender (the shard coordinator)."""
+    coord = msg["src"]
+    dsrc, dseq, client, cseq, rclock = (
+        msg["payload"][0],
+        msg["payload"][1],
+        msg["payload"][2],
+        msg["payload"][3],
+        msg["payload"][4],
+    )
+    slot = dot_slot(dseq, dims)
+    dirty = _get2(ps["seq_in_slot"], dsrc, slot) != 0
+    ps = dict(
+        ps,
+        err=ps["err"] | ERR_DOT * dirty,
+        seq_in_slot=oh_set2(ps["seq_in_slot"], dsrc, slot, dseq),
+        client_of=oh_set2(ps["client_of"], dsrc, slot, client),
+        cseq_of=oh_set2(ps["cseq_of"], dsrc, slot, cseq),
+    )
+    in_q = oh_get(oh_get(ctx["fast_quorum"], coord), me)
+    from_self = coord == me
+
+    kmask, skey = _cmd_tables(ctx, client, cseq)
+    keys = _my_keys(pp, ctx, me, skey)
+
+    # quorum member: proposal with the remote clock as floor (the
+    # self-collect keeps the original clock, no votes)
+    ps2, pclock, vs, ve = _proposal(pp, ps, keys, rclock)
+    propose = in_q & ~from_self
+    ps = jax.tree_util.tree_map(
+        lambda a, b: jnp.where(propose, a, b), ps2, ps
+    )
+    clock = jnp.where(from_self, rclock, pclock)
+    vs = jnp.where(propose, vs, 0)
+    ve = jnp.where(propose, ve, 0)
+
+    # apply a buffered MBump (tempo.rs:371-373: after the proposal)
+    bump_to = _get2(ps["mbump_buf"], dsrc, slot)
+    ps = _detached_keys(pp, ps, keys, bump_to, in_q & (bump_to > 0))
+    ps = dict(
+        ps, mbump_buf=oh_set2(ps["mbump_buf"], dsrc, slot, 0)
+    )
+
+    pay = jnp.zeros((dims.P,), I32)
+    pay = pay.at[0].set(dsrc).at[1].set(dseq).at[2].set(clock)
+    pay = jax.lax.dynamic_update_slice(
+        pay, jnp.stack([vs, ve], axis=1).reshape(-1), (3,)
+    )
+    ob = emit(
+        empty_outbox(dims), 0, coord, pp.MCOLLECTACK, pay, valid=in_q
+    )
+    # MBump the other shards' closest processes (tempo.rs:1013-1049)
+    s_me = oh_get(ctx["shard_of"], me)
+    for s in range(pp.S):
+        touched = ((kmask >> s) & 1) == 1
+        ob = emit(
+            ob,
+            1 + s,
+            oh_get(oh_get(ctx["closest"], me), jnp.int32(s)),
+            pp.MBUMP,
+            [dsrc, dseq, clock],
+            valid=in_q & touched & (s != s_me),
+        )
+    return ps, ob
+
+
+def _p_mbump(pp, ps, msg, me, ctx, dims):
+    """tempo.rs:674-701: bump the command's local keys, or buffer the
+    max clock until the payload arrives."""
+    dsrc, dseq, clock = (
+        msg["payload"][0],
+        msg["payload"][1],
+        msg["payload"][2],
+    )
+    slot = dot_slot(dseq, dims)
+    have = _get2(ps["seq_in_slot"], dsrc, slot) == dseq
+    client = _get2(ps["client_of"], dsrc, slot)
+    cseq = _get2(ps["cseq_of"], dsrc, slot)
+    _, skey = _cmd_tables(ctx, client, cseq)
+    keys = _my_keys(pp, ctx, me, skey)
+    ps = _detached_keys(pp, ps, keys, clock, have)
+    buffered = jnp.maximum(_get2(ps["mbump_buf"], dsrc, slot), clock)
+    ps = dict(
+        ps,
+        mbump_buf=oh_set2(
+            ps["mbump_buf"], dsrc, slot,
+            jnp.where(have, 0, buffered),
+        ),
+    )
+    return ps, empty_outbox(dims)
+
+# ----------------------------------------------------------------------
+# collect-ack / commit paths
+# ----------------------------------------------------------------------
+
+
+def _p_mcollectack(pp, ps, msg, me, ctx, dims):
+    """tempo.rs:461-554 at the shard coordinator (possibly of a foreign
+    dot)."""
+    src = msg["src"]
+    dsrc, dseq, clock = (
+        msg["payload"][0],
+        msg["payload"][1],
+        msg["payload"][2],
+    )
+    vsve = jax.lax.dynamic_slice(
+        msg["payload"], (3,), (2 * pp.KPC,)
+    ).reshape(pp.KPC, 2)
+    vs, ve = vsve[:, 0], vsve[:, 1]
+    slot = dot_slot(dseq, dims)
+
+    # late/duplicate acks: the exact-count trigger below ignores them
+    nv = _get2(ps["votes_n"], dsrc, slot)
+    has_vote = jnp.any(vs > 0)
+    fits = has_vote & (nv < dims.N)
+    widx = jnp.where(fits, nv, dims.N)
+    ps = dict(
+        ps,
+        votes_by=_set_votes_row3(ps["votes_by"], dsrc, slot, widx, src),
+        votes_s=_set_votes_row(ps["votes_s"], dsrc, slot, widx, vs),
+        votes_e=_set_votes_row(ps["votes_e"], dsrc, slot, widx, ve),
+        votes_n=oh_set2(
+            ps["votes_n"], dsrc, slot, nv + fits.astype(I32)
+        ),
+        err=ps["err"] | ERR_CAPACITY * (has_vote & ~fits),
+    )
+
+    old_max = _get2(ps["max_clock"], dsrc, slot)
+    new_max = jnp.maximum(old_max, clock)
+    new_cnt = jnp.where(
+        clock > old_max,
+        1,
+        _get2(ps["max_cnt"], dsrc, slot) + (clock == old_max),
+    )
+    cnt = _get2(ps["ack_cnt"], dsrc, slot) + 1
+    ps = dict(
+        ps,
+        max_clock=oh_set2(ps["max_clock"], dsrc, slot, new_max),
+        max_cnt=oh_set2(ps["max_cnt"], dsrc, slot, new_cnt),
+        ack_cnt=oh_set2(ps["ack_cnt"], dsrc, slot, cnt),
+    )
+
+    # bump own keys to the running max (tempo.rs:497-514)
+    client = _get2(ps["client_of"], dsrc, slot)
+    cseq = _get2(ps["cseq_of"], dsrc, slot)
+    kmask, skey = _cmd_tables(ctx, client, cseq)
+    keys = _my_keys(pp, ctx, me, skey)
+    ps = _detached_keys(pp, ps, keys, new_max, src != me)
+
+    all_acks = cnt == ctx["fq_size"]
+    fast = all_acks & (new_cnt >= ctx["f"])
+    slow = all_acks & ~fast
+    ps = dict(
+        ps,
+        m_fast=ps["m_fast"] + fast.astype(I32),
+        m_slow=ps["m_slow"] + slow.astype(I32),
+    )
+
+    ob = _p_commit_actions(
+        pp, ps, me, dsrc, dseq, new_max, client, cseq, kmask, ctx, dims,
+        fast,
+    )
+    base = _shard_base(ctx, me)
+    obc = emit_broadcast(
+        empty_outbox(dims),
+        pp.MCONSENSUS,
+        [dsrc, dseq, new_max],
+        ctx["n"],
+        base=base,
+    )
+    procs = jnp.arange(dims.F, dtype=I32) + base
+    wq = oh_take(
+        oh_get(ctx["write_quorum"], me),
+        jnp.clip(procs, 0, dims.N - 1),
+    )
+    obc = dict(obc, valid=obc["valid"] & slow & wq)
+    ob = jax.tree_util.tree_map(
+        lambda a, b: jnp.where(
+            fast.reshape((-1,) + (1,) * (a.ndim - 1)) if a.ndim > 1 else fast,
+            a,
+            b,
+        ),
+        ob,
+        obc,
+    )
+    return ps, ob
+
+
+def _p_commit_actions(
+    pp, ps, me, dsrc, dseq, clock, client, cseq, kmask, ctx, dims, valid
+):
+    """partial.rs:37-101: single-shard commands broadcast MCommit in
+    this shard; multi-shard commands send MShardCommit to the dot owner
+    and keep the votes parked for the MShardAggregatedCommit."""
+    nsh = _popcount(kmask, pp.S)
+    single = nsh == 1
+    ob_commit = _p_commit_broadcast(
+        pp, ps, me, dsrc, dseq, clock, client, cseq, ctx, dims,
+        jnp.asarray(valid, bool) & single,
+    )
+    ob_shard = emit(
+        empty_outbox(dims),
+        0,
+        dsrc,
+        pp.MSHARDCOMMIT,
+        [dsrc, dseq, clock],
+        valid=jnp.asarray(valid, bool) & ~single,
+    )
+    return jax.tree_util.tree_map(
+        lambda a, b: jnp.where(
+            single.reshape((-1,) + (1,) * (a.ndim - 1))
+            if a.ndim > 1
+            else single,
+            a,
+            b,
+        ),
+        ob_commit,
+        ob_shard,
+    )
+
+
+def _p_commit_broadcast(
+    pp, ps, me, dsrc, dseq, clock, client, cseq, ctx, dims, valid
+):
+    """MCommit carrying this shard's aggregated votes."""
+    slot = dot_slot(dseq, dims)
+    N, P = dims.N, dims.P
+    pay = jnp.zeros((P,), I32)
+    pay = (
+        pay.at[0].set(dsrc).at[1].set(dseq).at[2].set(clock)
+        .at[3].set(client).at[4].set(cseq)
+        .at[5].set(_get2(ps["votes_n"], dsrc, slot))
+    )
+    by = _get2(ps["votes_by"], dsrc, slot)          # [NV]
+    vs = _get2(ps["votes_s"], dsrc, slot)           # [KPC, NV]
+    ve = _get2(ps["votes_e"], dsrc, slot)
+    pay = jax.lax.dynamic_update_slice(pay, by, (6,))
+    pay = jax.lax.dynamic_update_slice(
+        pay,
+        jnp.stack([vs, ve], axis=2).reshape(-1),    # KPC*NV*(s,e)
+        (6 + N,),
+    )
+    base = _shard_base(ctx, me)
+    ob = emit_broadcast(
+        empty_outbox(dims), pp.MCOMMIT, pay, ctx["n"], base=base
+    )
+    return dict(ob, valid=ob["valid"] & jnp.asarray(valid, bool))
+
+
+def _p_mshardcommit(pp, ps, msg, me, ctx, dims):
+    """partial.rs:103-142 at the dot owner: aggregate per-shard commit
+    clocks; when every touched shard reported, send the aggregated
+    clock back to the participants (the shard coordinators)."""
+    dsrc, dseq, clock = (
+        msg["payload"][0],
+        msg["payload"][1],
+        msg["payload"][2],
+    )
+    slot = dot_slot(dseq, dims)
+    ps = dict(ps, err=ps["err"] | ERR_PROTO * (dsrc != me))
+    smax = jnp.maximum(oh_get(ps["shag_max"], slot), clock)
+    scnt = oh_get(ps["shag_cnt"], slot) + 1
+    ps = dict(
+        ps,
+        shag_max=oh_set(ps["shag_max"], slot, smax),
+        shag_cnt=oh_set(ps["shag_cnt"], slot, scnt),
+    )
+    client = _get2(ps["client_of"], me, slot)
+    cseq = _get2(ps["cseq_of"], me, slot)
+    kmask, _ = _cmd_tables(ctx, client, cseq)
+    nsh = _popcount(kmask, pp.S)
+    done = scnt == nsh
+    # participants: me plus the closest process of every other touched
+    # shard — exactly who received the MForwardSubmit
+    ob = emit(
+        empty_outbox(dims),
+        0,
+        me,
+        pp.MSHARDAGG,
+        [dsrc, dseq, smax],
+        valid=done,
+    )
+    s_me = oh_get(ctx["shard_of"], me)
+    for s in range(pp.S):
+        touched = ((kmask >> s) & 1) == 1
+        ob = emit(
+            ob,
+            1 + s,
+            oh_get(oh_get(ctx["closest"], me), jnp.int32(s)),
+            pp.MSHARDAGG,
+            [dsrc, dseq, smax],
+            valid=done & touched & (s != s_me),
+        )
+    return ps, ob
+
+
+def _p_mshardagg(pp, ps, msg, me, ctx, dims):
+    """partial.rs:144-167 at each shard coordinator: broadcast the
+    final-clock MCommit inside this shard with the locally-held
+    votes."""
+    dsrc, dseq, clock = (
+        msg["payload"][0],
+        msg["payload"][1],
+        msg["payload"][2],
+    )
+    slot = dot_slot(dseq, dims)
+    client = _get2(ps["client_of"], dsrc, slot)
+    cseq = _get2(ps["cseq_of"], dsrc, slot)
+    ob = _p_commit_broadcast(
+        pp, ps, me, dsrc, dseq, clock, client, cseq, ctx, dims, True
+    )
+    return ps, ob
+
+# ----------------------------------------------------------------------
+# commit receiver + table executor
+# ----------------------------------------------------------------------
+
+
+def _stable_clock_p(pp, ps, key, ctx, dims, me):
+    """Threshold-ranked frontier over this shard's voters
+    (table/mod.rs:243-263), rank computed over the shard's process
+    rows."""
+    fronts = oh_get(ps["vote_front"], key)  # [N]
+    procs = jnp.arange(dims.N, dtype=I32)
+    mine = _shard_mask(ctx, me, dims)
+    masked = jnp.where(mine, fronts, INF)
+    rank = jnp.sum(
+        (masked[None, :] < masked[:, None])
+        | (
+            (masked[None, :] == masked[:, None])
+            & (procs[None, :] < procs[:, None])
+        ),
+        axis=1,
+    )
+    # the (n - threshold)-th smallest among this shard's voters: padded
+    # and foreign rows sit at INF, so they always rank above the n live
+    # shard rows and the index lands inside them
+    k = ctx["n"] - ctx["threshold"]
+    return jnp.sum(jnp.where(rank == k, masked, 0))
+
+
+def _vote_add_p(pp, ps, key, voter, start, end, enable):
+    front = _get2(ps["vote_front"], key, voter)
+    gaps = _get2(ps["vote_gaps"], key, voter)
+    front, gaps, overflow = iset_add_range(front, gaps, start, end, enable)
+    return dict(
+        ps,
+        vote_front=oh_set2(ps["vote_front"], key, voter, front),
+        vote_gaps=oh_set2(ps["vote_gaps"], key, voter, gaps),
+        err=ps["err"] | ERR_CAPACITY * overflow,
+    )
+
+
+def _pend_insert_p(pp, ps, key, clock, dsrc, dseq, client, cseq, kmask,
+                   missing, enable):
+    """One per-key pending entry (phase 1: awaiting clock stability)."""
+    slots = oh_get(ps["pend_clock"], key)
+    free = slots == 0
+    idx = jnp.argmax(free)
+    overflow = jnp.asarray(enable, bool) & ~jnp.any(free)
+    widx = jnp.where(
+        jnp.asarray(enable, bool) & ~overflow, idx, pp.PK
+    )
+    return dict(
+        ps,
+        pend_clock=oh_set2(ps["pend_clock"], key, widx, clock),
+        pend_src=oh_set2(ps["pend_src"], key, widx, dsrc),
+        pend_seq=oh_set2(ps["pend_seq"], key, widx, dseq),
+        pend_client=oh_set2(ps["pend_client"], key, widx, client),
+        pend_cseq=oh_set2(ps["pend_cseq"], key, widx, cseq),
+        pend_kmask=oh_set2(ps["pend_kmask"], key, widx, kmask),
+        pend_missing=oh_set2(ps["pend_missing"], key, widx, missing),
+        pend_phase=oh_set2(ps["pend_phase"], key, widx, 1),
+        err=ps["err"] | ERR_CAPACITY * overflow,
+    )
+
+
+def _p_mcommit(pp, ps, msg, me, ctx, dims):
+    """tempo.rs:556-654: feed the votes table per local key, insert the
+    per-key pending entries, record the commit for GC (own-shard dots
+    only — foreign dots free their slot immediately, the gc_single
+    path), then kick one drain per key."""
+    dsrc = msg["payload"][0]
+    dseq = msg["payload"][1]
+    clock = msg["payload"][2]
+    client = msg["payload"][3]
+    cseq = msg["payload"][4]
+    nv = msg["payload"][5]
+    slot = dot_slot(dseq, dims)
+    have = _get2(ps["seq_in_slot"], dsrc, slot) == dseq
+    ps = dict(ps, err=ps["err"] | ERR_PROTO * ~have)
+
+    kmask, skey = _cmd_tables(ctx, client, cseq)
+    keys = _my_keys(pp, ctx, me, skey)
+    nsh = _popcount(kmask, pp.S)
+
+    bump_mode = ctx["clock_bump_mode"]
+    ps = dict(
+        ps,
+        max_commit_clock=jnp.where(
+            bump_mode,
+            jnp.maximum(ps["max_commit_clock"], clock),
+            ps["max_commit_clock"],
+        ),
+    )
+    ps = _detached_keys(pp, ps, keys, clock, ~bump_mode)
+
+    # attached votes: payload rows [6..6+N) voters, then (s, e) pairs
+    # per (kpc, voter)
+    N = dims.N
+    idxs = 6 + jnp.arange(N, dtype=I32)
+    bys = oh_take(msg["payload"], idxs)
+    enable_v = jnp.arange(N, dtype=I32) < nv
+    bys = jnp.where(enable_v, bys, N)
+    for d in range(pp.KPC):
+        key_d = keys[d]
+        s_idx = 6 + N + 2 * (d * N + jnp.arange(N, dtype=I32))
+        starts = oh_take(msg["payload"], s_idx)
+        ends = oh_take(msg["payload"], s_idx + 1)
+        # voters are distinct: route ranges to per-voter lanes with
+        # one-hot sums, then one vmapped interval-set union
+        oh_by = bys[:, None] == jnp.arange(N, dtype=I32)[None, :]
+        per_s = jnp.sum(jnp.where(oh_by, starts[:, None], 0), axis=0)
+        per_e = jnp.sum(jnp.where(oh_by, ends[:, None], 0), axis=0)
+        per_en = (
+            jnp.any(oh_by & enable_v[:, None], axis=0)
+            & (per_s > 0)
+            & (key_d >= 0)
+        )
+        fronts, gaps, ovf = jax.vmap(iset_add_range)(
+            oh_get(ps["vote_front"], key_d),
+            oh_get(ps["vote_gaps"], key_d),
+            per_s,
+            per_e,
+            per_en,
+        )
+        ps = dict(
+            ps,
+            vote_front=oh_set(ps["vote_front"], key_d, fronts),
+            vote_gaps=oh_set(ps["vote_gaps"], key_d, gaps),
+            err=ps["err"] | ERR_CAPACITY * jnp.any(ovf),
+        )
+        ps = _pend_insert_p(
+            pp, ps, key_d, clock, dsrc, dseq, client, cseq, kmask, nsh,
+            key_d >= 0,
+        )
+
+    # GC: only dots of this shard feed the committed clock
+    # (tempo.rs:463-469); foreign dots free their window slot now
+    my_dot = oh_get(ctx["shard_of"], dsrc) == oh_get(ctx["shard_of"], me)
+    cf, cg, overflow = iset_add(
+        oh_get(ps["comm_front"], dsrc),
+        oh_get(ps["comm_gaps"], dsrc),
+        dseq,
+        enable=my_dot,
+    )
+    ps = dict(
+        ps,
+        comm_front=oh_set(ps["comm_front"], dsrc, cf),
+        comm_gaps=oh_set(ps["comm_gaps"], dsrc, cg),
+        err=ps["err"] | ERR_CAPACITY * overflow,
+        seq_in_slot=oh_set2(
+            ps["seq_in_slot"], dsrc, slot,
+            jnp.where(my_dot, dseq, 0),
+        ),
+    )
+
+    # one zero-delay drain per local key (same-instant, prio)
+    ob = empty_outbox(dims)
+    for d in range(pp.KPC):
+        ob = emit(
+            ob, d, me, pp.MDRAIN, [keys[d]], valid=keys[d] >= 0
+        )
+    return ps, ob
+
+
+def _p_mdetached(pp, ps, msg, me, ctx, dims):
+    """tempo.rs:703-716: union the sender's detached ranges, drain."""
+    voter = msg["src"]
+    key = msg["payload"][0]
+    nr = msg["payload"][1]
+    for i in range(pp.detached_per_msg(dims)):
+        s = msg["payload"][2 + 2 * i]
+        e = msg["payload"][2 + 2 * i + 1]
+        ps = _vote_add_p(pp, ps, key, voter, s, e, i < nr)
+    return _p_drain(pp, ps, key, me, ctx, dims, empty_outbox(dims))
+
+
+def _p_mconsensus(pp, ps, msg, me, ctx, dims):
+    """tempo.rs:718-773 (initial ballot always wins)."""
+    dsrc, dseq, clock = (
+        msg["payload"][0],
+        msg["payload"][1],
+        msg["payload"][2],
+    )
+    slot = dot_slot(dseq, dims)
+    has_cmd = _get2(ps["seq_in_slot"], dsrc, slot) == dseq
+    client = _get2(ps["client_of"], dsrc, slot)
+    cseq = _get2(ps["cseq_of"], dsrc, slot)
+    _, skey = _cmd_tables(ctx, client, cseq)
+    keys = _my_keys(pp, ctx, me, skey)
+    ps = _detached_keys(pp, ps, keys, clock, has_cmd)
+    ob = emit(
+        empty_outbox(dims),
+        0,
+        msg["src"],
+        pp.MCONSENSUSACK,
+        [dsrc, dseq],
+    )
+    return ps, ob
+
+
+def _p_mconsensusack(pp, ps, msg, me, ctx, dims):
+    """tempo.rs:775-812: f+1 accepts choose the slow-path clock."""
+    dsrc, dseq = msg["payload"][0], msg["payload"][1]
+    slot = dot_slot(dseq, dims)
+    cnt = _get2(ps["slow_acks"], dsrc, slot) + 1
+    chosen = cnt == ctx["wq_size"]
+    ps = dict(
+        ps, slow_acks=oh_set2(ps["slow_acks"], dsrc, slot, cnt)
+    )
+    client = _get2(ps["client_of"], dsrc, slot)
+    cseq = _get2(ps["cseq_of"], dsrc, slot)
+    kmask, _ = _cmd_tables(ctx, client, cseq)
+    ob = _p_commit_actions(
+        pp, ps, me, dsrc, dseq,
+        _get2(ps["max_clock"], dsrc, slot),
+        client, cseq, kmask, ctx, dims, chosen,
+    )
+    return ps, ob
+
+
+def _p_mgc(pp, ps, msg, me, ctx, dims):
+    """Committed-clock GC within this shard (tempo.rs:897-970)."""
+    N = dims.N
+    s = msg["src"]
+    frontier = msg["payload"][:N]
+    of = oh_set(
+        ps["others_frontier"],
+        s,
+        jnp.maximum(oh_get(ps["others_frontier"], s), frontier),
+    )
+    seen = oh_set(ps["seen"], s, True)
+    mine = _shard_mask(ctx, me, dims)
+    procs = jnp.arange(N, dtype=I32)
+    others = mine & (procs != me)
+    ready = jnp.all(seen | ~others)
+    min_others = jnp.min(jnp.where(others[:, None], of, INF), axis=0)
+    stable = jnp.minimum(ps["comm_front"], min_others)
+    stable = jnp.where(ready & mine, stable, 0)
+    delta = jnp.maximum(stable - ps["prev_stable"], 0)
+    prev_stable = jnp.maximum(ps["prev_stable"], stable)
+    freed = (ps["seq_in_slot"] > 0) & (
+        ps["seq_in_slot"] <= prev_stable[:, None]
+    )
+    ps = dict(
+        ps,
+        others_frontier=of,
+        seen=seen,
+        prev_stable=prev_stable,
+        m_stable=ps["m_stable"] + jnp.sum(delta),
+        seq_in_slot=jnp.where(freed, 0, ps["seq_in_slot"]),
+    )
+    return ps, empty_outbox(dims)
+
+
+def _p_mdrain(pp, ps, msg, me, ctx, dims):
+    return _p_drain(
+        pp, ps, msg["payload"][0], me, ctx, dims, empty_outbox(dims)
+    )
+
+
+def _p_detach_drain(pp, ps, msg, me, ctx, dims):
+    """One key's detached ranges to this shard's processes, chained
+    (TempoDev._detach_drain with a shard-aware broadcast)."""
+    det = ps["det"]
+    has = det[:, :, 0] > 0
+    key_has = jnp.any(has, axis=1)
+    key = jnp.argmax(key_has)
+    any_key = jnp.any(key_has)
+
+    row = oh_get(det, key)
+    occ = row[:, 0] > 0
+    order = cumsum_i32(occ)
+    per_msg = pp.detached_per_msg(dims)
+    take = occ & (order <= per_msg)
+    nr = jnp.sum(take)
+
+    pay = jnp.zeros((dims.P,), I32)
+    pay = pay.at[0].set(key)
+    pay = pay.at[1].set(nr)
+    lo = jnp.where(take, 2 + 2 * (order - 1), dims.P)
+    pay = oh_pack_pairs(pay, lo, row[:, 0], row[:, 1])
+
+    det = oh_set(det, key, jnp.where(take[:, None], 0, row))
+    ps = dict(ps, det=det)
+
+    base = _shard_base(ctx, me)
+    ob = emit_broadcast(
+        empty_outbox(dims), pp.MDETACHED, pay, ctx["n"], base=base
+    )
+    ob = dict(ob, valid=ob["valid"] & any_key)
+    more = jnp.any(det[:, :, 0] > 0)
+    ob = emit(
+        ob, dims.N, me, pp.DETACH_DRAIN, [0], valid=any_key & more
+    )
+    return ps, ob
+
+# ----------------------------------------------------------------------
+# the per-key pending queue (executor.rs:171-360)
+#
+# Invariant mirrored from the reference: at most one entry per key (the
+# parked queue head, phase 2) has been *processed* — contributed to
+# rifl_to_stable_count and (when the count completed) sent its
+# StableAtShard fan-out. Everything behind it waits raw in phase 1;
+# the drain promotes entries in (clock, dot) order, which is stability
+# order because the stable clock only grows.
+# ----------------------------------------------------------------------
+
+
+def _p_execute(pp, ps, key, idx, client, me, ctx, dims, ob, enable):
+    """Execute the entry: emit the per-key result partial to the client
+    when this process is the client's connected process of this shard
+    (run/prelude.rs:35-40 registration), free the slot, chain."""
+    do = jnp.asarray(enable, bool)
+    s_me = oh_get(ctx["shard_of"], me)
+    connected = oh_get(oh_get(ctx["client_attach_s"], client), s_me) == me
+    ob = emit(
+        ob,
+        0,
+        dims.N + client,
+        pp.TO_CLIENT,
+        [0],
+        valid=do & connected,
+    )
+    widx = jnp.where(do, idx, pp.PK)
+    ps = dict(
+        ps,
+        pend_clock=oh_set2(ps["pend_clock"], key, widx, 0),
+        pend_phase=oh_set2(ps["pend_phase"], key, widx, 0),
+    )
+    return ps, ob
+
+
+def _p_drain(pp, ps, key, me, ctx, dims, ob):
+    """Promote/execute this key's lowest-order ready entry — the array
+    form of stable_ops + _send_stable_or_execute +
+    _execute_single_or_mark_stable (executor.rs:234-360)."""
+    stable = _stable_clock_p(pp, ps, key, ctx, dims, me)
+    clocks = oh_get(ps["pend_clock"], key)      # [PK]
+    phase = oh_get(ps["pend_phase"], key)
+    srcs = oh_get(ps["pend_src"], key)
+    seqs = oh_get(ps["pend_seq"], key)
+    eligible = ((phase == 1) & (clocks > 0) & (clocks <= stable)) | (
+        phase == 2
+    )
+    any_el = jnp.any(eligible)
+    cmin = jnp.min(jnp.where(eligible, clocks, INF))
+    tie = eligible & (clocks == cmin)
+    packed = srcs * SEQ_BOUND + seqs
+    idx = jnp.argmin(jnp.where(tie, packed, INF))
+    head_parked = oh_get(phase, idx) == 2
+    proceed = any_el & ~head_parked & (key >= 0)
+
+    client = oh_get(oh_get(ps["pend_client"], key), idx)
+    cseq = oh_get(oh_get(ps["pend_cseq"], key), idx)
+    kmask = oh_get(oh_get(ps["pend_kmask"], key), idx)
+    missing0 = oh_get(oh_get(ps["pend_missing"], key), idx)
+    _, skey = _cmd_tables(ctx, client, cseq)
+    keys_me = _my_keys(pp, ctx, me, skey)
+    nloc = jnp.sum((keys_me >= 0).astype(I32))
+    nsh = _popcount(kmask, pp.S)
+    single = (nsh == 1) & (nloc == 1)
+
+    # rifl_to_stable_count (executor.rs:318-330): only counted for
+    # multi-local-key commands; the count completing marks the rifl
+    prev = jnp.where(
+        oh_get(ps["stable_cnt_seq"], client) == cseq,
+        oh_get(ps["stable_cnt"], client),
+        0,
+    )
+    cnt = prev + 1
+    counted = proceed & ~single & (nloc > 1)
+    do_mark = jnp.where(nloc > 1, cnt == nloc, True) & proceed & ~single
+    cw = jnp.where(counted, client, dims.C)
+    ps = dict(
+        ps,
+        stable_cnt=oh_set(
+            ps["stable_cnt"], cw, jnp.where(do_mark, 0, cnt)
+        ),
+        stable_cnt_seq=oh_set(ps["stable_cnt_seq"], cw, cseq),
+    )
+
+    # apply + clear buffered StableAtShard counts for this rifl
+    bmatch = _get2(ps["buf_seq"], key, client) == cseq
+    bcnt = jnp.where(bmatch, _get2(ps["buf_cnt"], key, client), 0)
+    bw = jnp.where(proceed & ~single, key, pp.K)
+    ps = dict(
+        ps, buf_cnt=oh_set2(ps["buf_cnt"], bw, client, 0)
+    )
+    missing = missing0 - do_mark.astype(I32) - bcnt
+
+    # StableAtShard fan-out to every other key of the command: local
+    # keys inline (zero-delay self-message), remote keys through the
+    # closest process of their shard (executor.rs:332-344)
+    s_me = oh_get(ctx["shard_of"], me)
+    slot_i = 2
+    for s in range(pp.S):
+        for d in range(pp.KPC):
+            kk = oh_get(oh_get(skey, jnp.int32(s)), jnp.int32(d))
+            is_local = jnp.int32(s) == s_me
+            dst = jnp.where(
+                is_local,
+                me,
+                oh_get(oh_get(ctx["closest"], me), jnp.int32(s)),
+            )
+            ob = emit(
+                ob,
+                slot_i,
+                dst,
+                pp.STABLEAT,
+                [kk, client, cseq],
+                valid=do_mark & (kk >= 0) & (kk != key),
+            )
+            slot_i += 1
+
+    execute = proceed & (single | (missing <= 0))
+    park = proceed & ~execute
+    widx = jnp.where(park, idx, pp.PK)
+    ps = dict(
+        ps,
+        pend_phase=oh_set2(ps["pend_phase"], key, widx, 2),
+        pend_missing=oh_set2(ps["pend_missing"], key, widx, missing),
+    )
+    ps, ob = _p_execute(pp, ps, key, idx, client, me, ctx, dims, ob, execute)
+    more = jnp.sum(eligible.astype(I32)) > 1
+    ob = emit(ob, 1, me, pp.MDRAIN, [key], valid=execute & more)
+    return ps, ob
+
+
+def _p_stableat(pp, ps, msg, me, ctx, dims):
+    """StableAtShard arrival (executor.rs:191-214): decrement the
+    parked head when it is this rifl, else buffer the count."""
+    key, client, cseq = (
+        msg["payload"][0],
+        msg["payload"][1],
+        msg["payload"][2],
+    )
+    clocks = oh_get(ps["pend_clock"], key)
+    phase = oh_get(ps["pend_phase"], key)
+    parked = (phase == 2) & (clocks > 0)
+    any_parked = jnp.any(parked)
+    cmin = jnp.min(jnp.where(parked, clocks, INF))
+    tie = parked & (clocks == cmin)
+    packed = (
+        oh_get(ps["pend_src"], key) * SEQ_BOUND
+        + oh_get(ps["pend_seq"], key)
+    )
+    idx = jnp.argmin(jnp.where(tie, packed, INF))
+    match = (
+        any_parked
+        & (oh_get(oh_get(ps["pend_client"], key), idx) == client)
+        & (oh_get(oh_get(ps["pend_cseq"], key), idx) == cseq)
+    )
+
+    missing = oh_get(oh_get(ps["pend_missing"], key), idx) - 1
+    widx = jnp.where(match, idx, pp.PK)
+    ps = dict(
+        ps,
+        pend_missing=oh_set2(ps["pend_missing"], key, widx, missing),
+    )
+    execute = match & (missing <= 0)
+    ob = empty_outbox(dims)
+    ps, ob = _p_execute(pp, ps, key, idx, client, me, ctx, dims, ob, execute)
+    ob = emit(ob, 1, me, pp.MDRAIN, [key], valid=execute)
+
+    # no parked head for this rifl yet: buffer (executor.rs:211-214)
+    buffer = ~match & (key >= 0)
+    old = jnp.where(
+        _get2(ps["buf_seq"], key, client) == cseq,
+        _get2(ps["buf_cnt"], key, client),
+        0,
+    )
+    bw = jnp.where(buffer, key, pp.K)
+    ps = dict(
+        ps,
+        buf_cnt=oh_set2(ps["buf_cnt"], bw, client, old + 1),
+        buf_seq=oh_set2(ps["buf_seq"], bw, client, cseq),
+    )
+    return ps, ob

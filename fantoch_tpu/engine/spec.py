@@ -71,16 +71,31 @@ def make_lane(
     (runner.rs:520-524) — for race-hunting runs. Randomized delays void
     the conservative-lookahead bound, so reorder lanes run serialized
     (global-time stepping), and tie order is engine-defined: assert
-    protocol invariants against these lanes, not oracle equality."""
+    protocol invariants against these lanes, not oracle equality.
+
+    ``config.shard_count > 1`` builds a partial-replication lane: one
+    process per (shard, region) — the oracle Runner's multi-shard
+    layout (sim/runner.py:81-103) — with per-shard client attachment
+    and precomputed per-command shard/key tables (the device reads a
+    command's keys from ctx by (client, seq) instead of carrying them
+    in payloads)."""
     n = config.n
-    assert len(process_regions) == n <= dims.N
+    S = config.shard_count
+    assert len(process_regions) == n
+    assert S * n <= dims.N
     N, C = dims.N, dims.C
+    total = S * n  # live process rows; row = shard * n + region index
+
+    def row_region(row: int) -> str:
+        return process_regions[row % n]
 
     # process↔process delays: half the ping latency (runner.rs:575-595)
     delay_pp = np.zeros((N, N), np.int32)
-    for i, a in enumerate(process_regions):
-        for j, b in enumerate(process_regions):
-            delay_pp[i, j] = planet.ping_latency(a, b) // 2
+    for i in range(total):
+        for j in range(total):
+            delay_pp[i, j] = (
+                planet.ping_latency(row_region(i), row_region(j)) // 2
+            )
 
     # conservative-lookahead matrix: lookahead[q, p] = minimum time any
     # chain of messages starting at q can take to reach p (all-pairs
@@ -93,21 +108,23 @@ def make_lane(
     # the pool's prio/pop mechanism, so they never gate p's progress.
     # Padded rows stay at INF.
     lookahead = np.full((N, N), INF, np.int64)
-    sp = delay_pp[:n, :n].astype(np.int64)
-    for k in range(n):
+    sp = delay_pp[:total, :total].astype(np.int64)
+    for k in range(total):
         sp = np.minimum(sp, sp[:, k, None] + sp[None, k, :])
-    lookahead[:n, :n] = sp
-    np.fill_diagonal(lookahead[:n, :n], INF)
+    lookahead[:total, :total] = sp
+    np.fill_diagonal(lookahead[:total, :total], INF)
     # the strict bound plus the global-minimum escape hatch are only
     # tie-safe when distinct processes can never exchange same-instant
     # messages; with a zero inter-process delay (colocated process
-    # regions) fall back to serialized global-time stepping — such
-    # schedules are inherently tied, so the exact-match contract (which
-    # only covers tie-free schedules) is unaffected, only speed is
-    offdiag = delay_pp[:n, :n][~np.eye(n, dtype=bool)]
-    if (n > 1 and offdiag.min() < 1) or reorder:
-        lookahead[:n, :n] = 0
-        np.fill_diagonal(lookahead[:n, :n], INF)
+    # regions — always the case for multi-shard lanes, whose co-region
+    # cross-shard processes sit at distance ~0) fall back to serialized
+    # global-time stepping — such schedules are inherently tied, so the
+    # exact-match contract (which only covers tie-free schedules) is
+    # unaffected, only speed is
+    offdiag = delay_pp[:total, :total][~np.eye(total, dtype=bool)]
+    if (total > 1 and offdiag.min() < 1) or reorder:
+        lookahead[:total, :total] = 0
+        np.fill_diagonal(lookahead[:total, :total], INF)
 
     sorted_idx = _sorted_indices(planet, process_regions)
 
@@ -116,6 +133,7 @@ def make_lane(
     region_rows = list(dict.fromkeys(client_regions))
     assert len(region_rows) <= dims.RR
     client_attach = np.zeros((C,), np.int32)
+    client_attach_s = np.zeros((C, S), np.int32)
     client_region_row = np.full((C,), dims.RR, np.int32)
     client_delay = np.zeros((C, N), np.int32)
     cmd_budget = np.zeros((C,), np.int32)
@@ -126,10 +144,15 @@ def make_lane(
         for _ in range(clients_per_region):
             assert c < C, "raise EngineDims.C"
             client_attach[c] = closest
+            # per-shard connected process (closest_process_per_shard,
+            # util.rs:188-230): shards share the region layout, so the
+            # closest row index repeats per shard block
+            for s in range(S):
+                client_attach_s[c, s] = s * n + closest
             client_region_row[c] = region_rows.index(region)
-            for p in range(n):
+            for p in range(total):
                 client_delay[c, p] = (
-                    planet.ping_latency(region, process_regions[p]) // 2
+                    planet.ping_latency(region, row_region(p)) // 2
                 )
             cmd_budget[c] = commands_per_client
             c += 1
@@ -159,11 +182,13 @@ def make_lane(
 
     ctx: Dict[str, np.ndarray] = {
         "n": np.int32(n),
+        "rows": np.int32(total),
         "f": np.int32(config.f),
         "delay_pp": delay_pp,
         "lookahead": np.minimum(lookahead, INF).astype(np.int32),
         "client_delay": client_delay,
         "client_attach": client_attach,
+        "client_attach_s": client_attach_s,
         "client_region_row": client_region_row,
         "cmd_budget": cmd_budget,
         "conflict_rate": np.int32(conflict_rate),
@@ -177,6 +202,16 @@ def make_lane(
         "periodic_intervals": intervals,
         "extra_time": np.int32(extra_time_ms),
     }
+    if S > 1 or getattr(protocol, "KPC", 1) > 1:
+        assert getattr(protocol, "S", 1) == S, (
+            "protocol shards must match config.shard_count"
+        )
+        ctx.update(
+            _partial_tables(
+                protocol, planet, config, dims, ctx,
+                commands_per_client, process_regions, row_region, total,
+            )
+        )
     ctx.update(protocol.lane_ctx(config, dims, sorted_idx))
     return LaneSpec(
         ctx=ctx,
@@ -184,6 +219,111 @@ def make_lane(
         region_rows=region_rows,
         process_regions=list(process_regions),
     )
+
+
+def _partial_tables(
+    protocol, planet: Planet, config: Config, dims: EngineDims, ctx,
+    commands_per_client: int, process_regions, row_region, total: int,
+):
+    """Precomputed per-command shard/key tables for partial-replication
+    (or multi-key) lanes.
+
+    A command is fully determined by (client, seq): ``KPC`` key draws
+    from the same counter-based stream the single-shard engine uses
+    (``gen_key``; host replay = client/key_gen.py DeviceStream), each
+    mapped to its shard by ``key_hash(str(key)) % shard_count`` —
+    identical to the oracle workload's routing (client/workload.py:
+    106-107) — then grouped: ``cmd_skey[c, j, s, :]`` = the command's
+    distinct keys on shard s (-1 pad), ``cmd_kmask`` the touched-shard
+    bitmask, ``cmd_parts`` the total distinct keys (= expected client
+    result parts), ``cmd_target`` the first key's shard (the submit
+    target, client/workload.py:84)."""
+    import jax.numpy as jnp
+
+    from ..core.util import key_hash
+    from .core import KEYGEN_CTX_FIELDS, key_table_fn
+
+    n, S = config.n, config.shard_count
+    C, N = dims.C, dims.N
+    T = commands_per_client
+    KPC = getattr(protocol, "KPC", 1)
+
+    keyctx = {k: jnp.asarray(ctx[k]) for k in KEYGEN_CTX_FIELDS}
+    # the workload redraws duplicates until it has KPC *unique* keys
+    # (workload.rs:156-186 / client/workload.py _gen_unique_keys), so
+    # each command consumes a variable number of stream draws; walk the
+    # stream exactly like the oracle does, growing the table on demand
+    n_draws = T * KPC * 4 + 1
+    draws = np.asarray(key_table_fn(C, n_draws)(keyctx))
+
+    kmask = np.zeros((C, T + 1), np.int32)
+    skey = np.full((C, T + 1, S, KPC), -1, np.int32)
+    parts = np.ones((C, T + 1), np.int32)
+    target = np.zeros((C, T + 1), np.int32)
+    shard_cache: Dict[int, int] = {}
+    for c in range(C):
+        i = 1  # draw counter, 1-based like the engine's key stream
+        for j in range(1, T + 1):
+            keys: List[int] = []
+            redraws = 0
+            while len(keys) < KPC:
+                if i >= draws.shape[1]:
+                    n_draws *= 2
+                    draws = np.asarray(key_table_fn(C, n_draws)(keyctx))
+                k = int(draws[c, i])
+                i += 1
+                if k in keys:
+                    redraws += 1
+                    assert redraws < 10_000, (
+                        "workload cannot produce unique keys (pool too "
+                        "small for keys_per_command at this conflict "
+                        "rate) — the oracle would spin here too"
+                    )
+                    continue
+                keys.append(k)
+            mask, tgt = 0, None
+            per_shard: Dict[int, List[int]] = {}
+            for k in keys:
+                s = shard_cache.get(k)
+                if s is None:
+                    s = key_hash(str(k)) % S
+                    shard_cache[k] = s
+                if tgt is None:
+                    tgt = s
+                mask |= 1 << s
+                per_shard.setdefault(s, []).append(k)
+            kmask[c, j] = mask
+            parts[c, j] = len(keys)
+            target[c, j] = tgt
+            for s, ks in per_shard.items():
+                for d, k in enumerate(ks):
+                    skey[c, j, s, d] = k
+
+    # per-row shard id + closest process of every shard (the discovery
+    # view each process routes cross-shard messages through,
+    # util.rs:188-230; ties break by process id). Pad rows carry the
+    # invalid shard id S so no shard-membership mask ever includes them.
+    shard_of = np.full((N,), S, np.int32)
+    closest = np.zeros((N, S), np.int32)
+    for p in range(total):
+        shard_of[p] = p // n
+        order = {
+            r: i for i, (_l, r) in enumerate(planet.sorted(row_region(p)))
+        }
+        i_star = min(
+            range(n), key=lambda i: (order[process_regions[i]], i)
+        )
+        for s in range(S):
+            closest[p, s] = s * n + i_star
+
+    return {
+        "cmd_kmask": kmask,
+        "cmd_skey": skey,
+        "cmd_parts": parts,
+        "cmd_target": target,
+        "shard_of": shard_of,
+        "closest": closest,
+    }
 
 
 def stack_lanes(specs: Sequence[LaneSpec]) -> Dict[str, np.ndarray]:
