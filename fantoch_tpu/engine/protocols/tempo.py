@@ -73,11 +73,17 @@ class TempoDev(DevIdentity):
         pending_per_key: int = 32,
         detached_slots: int = 16,
         gap_slots: int = 8,
+        skip_capable: bool = False,
     ):
         self.K = keys
         self.PK = pending_per_key
         self.R = detached_slots
         self.G = gap_slots
+        # trace-time gate for the skip_fast_ack paths (tempo.rs:91-93,
+        # 442-455): lanes select per-config via ctx["skip_fast_ack"],
+        # but tracing the extra commit-broadcast work at all costs
+        # kernels, so sweeps without the knob compile it out entirely
+        self.skip_capable = skip_capable
 
     @classmethod
     def for_load(cls, keys: int, clients: int) -> "TempoDev":
@@ -132,6 +138,11 @@ class TempoDev(DevIdentity):
             "threshold": np.int32(threshold),
             "clock_bump_mode": np.bool_(
                 config.tempo_clock_bump_interval_ms is not None
+            ),
+            # tempo.rs:91-93: the optimization only applies when the
+            # fast quorum is a pair (coordinator + one member)
+            "skip_fast_ack": np.bool_(
+                config.skip_fast_ack and fq_size == 2
             ),
         }
 
@@ -451,6 +462,12 @@ def _submit(tempo, ps, msg, me, ctx, dims):
 
     cur = oh_get(ps["clocks"], key)
     clock = cur + 1  # max(0, highest key clock + 1), single key
+    if tempo.skip_capable:
+        # skip_fast_ack lanes ship the coordinator's votes inside the
+        # MCollect (tempo.rs:330-335) instead of holding them locally
+        own_vote = jnp.where(ctx["skip_fast_ack"], 0, 1)
+    else:
+        own_vote = 1
     ps = dict(
         ps,
         # (source, sequence) packing in the drain scan requires seq < bound
@@ -461,7 +478,7 @@ def _submit(tempo, ps, msg, me, ctx, dims):
         max_clock=oh_set(ps["max_clock"], slot, 0),
         max_cnt=oh_set(ps["max_cnt"], slot, 0),
         slow_acks=oh_set(ps["slow_acks"], slot, 0),
-        votes_n=oh_set(ps["votes_n"], slot, 1),
+        votes_n=oh_set(ps["votes_n"], slot, own_vote),
         votes_by=oh_set2(ps["votes_by"], slot, 0, me),
         votes_s=oh_set2(ps["votes_s"], slot, 0, cur + 1),
         votes_e=oh_set2(ps["votes_e"], slot, 0, clock),
@@ -469,7 +486,7 @@ def _submit(tempo, ps, msg, me, ctx, dims):
     ob = emit_broadcast(
         empty_outbox(dims),
         TempoDev.MCOLLECT,
-        [seq, key, clock, client],
+        [seq, key, clock, client, cur + 1, clock],
         ctx["n"],
     )
     return ps, ob
@@ -508,14 +525,45 @@ def _mcollect(tempo, ps, msg, me, ctx, dims):
     ack_clock = jnp.where(from_self, rclock, clock)
     vs = jnp.where(propose, cur + 1, 0)
     ve = jnp.where(propose, clock, 0)
+    if tempo.skip_capable:
+        # tempo.rs:442-455: with a pair fast quorum, the non-coordinator
+        # member commits directly — its proposal plus the coordinator's
+        # shipped votes are the whole quorum's votes — and no ack flows
+        skipv = ctx["skip_fast_ack"] & in_q & ~from_self
+        vs_c, ve_c = msg["payload"][4], msg["payload"][5]
+        pay = jnp.zeros((dims.P,), I32)
+        pay = (
+            pay.at[0].set(s).at[1].set(seq).at[2].set(clock)
+            .at[3].set(key).at[4].set(client).at[5].set(2)
+            .at[6].set(s).at[7].set(vs_c).at[8].set(ve_c)
+            .at[9].set(me).at[10].set(vs).at[11].set(ve)
+        )
+        obc = emit_broadcast(
+            empty_outbox(dims), TempoDev.MCOMMIT, pay, ctx["n"]
+        )
+        obc = dict(obc, valid=obc["valid"] & skipv)
+    else:
+        skipv = False
     ob = emit(
         empty_outbox(dims),
         0,
         s,
         TempoDev.MCOLLECTACK,
         [seq, ack_clock, vs, ve],
-        valid=in_q,
+        valid=in_q & ~jnp.asarray(skipv, bool),
     )
+    if tempo.skip_capable:
+        ob = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(
+                skipv.reshape((-1,) + (1,) * (a.ndim - 1))
+                if a.ndim > 1
+                else skipv,
+                a,
+                b,
+            ),
+            obc,
+            ob,
+        )
     return ps, ob
 
 

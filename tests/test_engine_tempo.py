@@ -144,3 +144,67 @@ def test_engine_tempo_concurrent_invariants():
         _issued, hist = oracle_lat[region]
         assert res.issued(region) == commands * cpr
         assert abs(res.latency_mean(region) - hist.mean()) <= 0.1 * hist.mean()
+
+
+def test_engine_tempo_skip_fast_ack_matches_oracle():
+    """skip_fast_ack (tempo.rs:91-93, 330-335, 442-455): with a pair
+    fast quorum the non-coordinator member commits directly from the
+    MCollect, skipping the ack round. Device twin must match the host
+    oracle exactly — and beat the normal path's latency."""
+    n, f, conflict, commands, cpr = 3, 1, 100, 20, 1
+    regions = Planet.new().regions()[:n]
+
+    def both(skip):
+        config = Config(
+            n=n, f=f, gc_interval_ms=100,
+            tempo_detached_send_interval_ms=100,
+            skip_fast_ack=skip,
+        )
+        lat, fast, slow, stable = run_oracle(
+            config, regions, conflict, commands, cpr
+        )
+        planet = Planet.new()
+        clients = cpr * n
+        tempo = TempoDev(keys=1 + clients, skip_capable=skip)
+        total = commands * clients
+        dims = EngineDims.for_protocol(
+            tempo,
+            n=n,
+            clients=clients,
+            payload=tempo.payload_width(n),
+            total_commands=total,
+            dot_slots=total + 1,
+            regions=n,
+        )
+        spec = make_lane(
+            tempo,
+            planet,
+            config,
+            conflict_rate=conflict,
+            pool_size=1,
+            commands_per_client=commands,
+            clients_per_region=cpr,
+            process_regions=regions,
+            client_regions=regions,
+            dims=dims,
+        )
+        res = run_lanes(tempo, dims, [spec])[0]
+        assert not res.err, res.err_cause
+        return lat, fast, slow, stable, res
+
+    lat, fast, slow, stable, res = both(skip=True)
+    total = commands * cpr * n
+    # the skip path records no fast/slow classification — neither side
+    # counts, but GC still accounts for every commit
+    assert fast == slow == 0
+    assert int(res.protocol_metrics["fast_path"].sum()) == 0
+    assert int(res.protocol_metrics["slow_path"].sum()) == 0
+    assert int(res.protocol_metrics["stable"].sum()) == stable == n * total
+    for region in regions:
+        _issued, hist = lat[region]
+        assert res.latency_mean(region) == hist.mean(), region
+
+    # sanity: skipping the ack round can only help latency
+    lat_off, _, _, _, res_off = both(skip=False)
+    for region in regions:
+        assert res.latency_mean(region) <= res_off.latency_mean(region)
