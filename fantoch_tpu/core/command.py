@@ -63,6 +63,18 @@ class Command:
                 return True
         return False
 
+    def merge(self, other: "Command") -> None:
+        """Fold ``other``'s ops into this command (command.rs:199-209).
+
+        Used by client-side batching: the merged command keeps this
+        command's rifl and is submitted once; the batcher remembers the
+        member rifls and fans the single result back out.
+        """
+        for shard_id, ops in other.shard_to_ops.items():
+            current = self.shard_to_ops.setdefault(shard_id, {})
+            for key, kops in ops.items():
+                current.setdefault(key, []).extend(kops)
+
     def execute(self, shard_id: ShardId, store: KVStore) -> "CommandResult":
         """Execute all of this command's ops on ``shard_id`` against the
         store (command.rs:142-157)."""

@@ -61,6 +61,24 @@ class Executor(ABC):
     def cleanup(self, time: SysTime) -> None:
         pass
 
+    @classmethod
+    def pool(cls, process_id: ProcessId, shard_id: ShardId, config: Config,
+             count: int):
+        """``count`` pool members for key-hash routing (``MessageKey``,
+        executor/mod.rs:148-167). Key-hash pools need per-key
+        independence, so the default rejects count > 1 unless the class
+        declares ``KEY_HASH_ROUTED``; executors with cross-key state
+        override to share it between members (the reference shares via
+        ``SharedMap``). (The graph executor is ``parallel()`` in the
+        reference only through its executor-0-runs-the-graph request
+        protocol, executor/graph/mod.rs:54-67, which this runtime does
+        not implement.)"""
+        assert count == 1 or getattr(cls, "KEY_HASH_ROUTED", False), (
+            f"{cls.__name__} does not support key-hash executor pools"
+            " in this runtime"
+        )
+        return [cls(process_id, shard_id, config) for _ in range(count)]
+
     def monitor_pending(self, time: SysTime) -> None:
         pass
 

@@ -123,7 +123,9 @@ class _VotesTable:
 class TableExecutor(Executor):
     """executor.rs:19-380."""
 
-    def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config):
+    def __init__(self, process_id: ProcessId, shard_id: ShardId,
+                 config: Config, *,
+                 shared_stable_counts: Optional[Dict[Rifl, int]] = None):
         super().__init__(process_id, shard_id, config)
         _, _, self.stability_threshold = config.tempo_quorum_sizes()
         self.execute_at_commit = config.execute_at_commit
@@ -131,7 +133,13 @@ class TableExecutor(Executor):
         self.tables: Dict[Key, _VotesTable] = {}
         # key -> (pending deque, buffered stable-at-shard counts)
         self.pending: Dict[Key, Tuple[Deque[_Pending], Dict[Rifl, int]]] = {}
-        self.rifl_to_stable_count: Dict[Rifl, int] = {}
+        # cross-key stability counts; pool members share one map (the
+        # reference shares between executor workers via SharedMap,
+        # executor.rs:318-330) so multi-key rifls whose keys hash to
+        # different members still complete their counts
+        self.rifl_to_stable_count: Dict[Rifl, int] = (
+            shared_stable_counts if shared_stable_counts is not None else {}
+        )
 
     # -- Executor interface --------------------------------------------
 
@@ -158,13 +166,20 @@ class TableExecutor(Executor):
         else:
             raise TypeError(f"unexpected execution info {info!r}")
 
-    # NOT safe behind this runtime's key-hash executor pools: a
-    # multi-key command's stability count (rifl_to_stable_count,
-    # executor.rs:318-330) must see every key of the rifl, which the
-    # reference provides through state shared between executor workers;
-    # per-instance pools would deadlock such commands. parallel() stays
-    # true for the reference's own shared-state scheme.
-    KEY_HASH_ROUTED = False
+    # safe behind key-hash executor pools *when constructed via
+    # ``pool``*: the cross-key stability count (rifl_to_stable_count,
+    # executor.rs:318-330) is shared between pool members exactly like
+    # the reference shares it between executor workers via SharedMap;
+    # per-key tables/queues are member-local.
+    KEY_HASH_ROUTED = True
+
+    @classmethod
+    def pool(cls, process_id, shard_id, config, count):
+        shared: Dict[Rifl, int] = {}
+        return [
+            cls(process_id, shard_id, config, shared_stable_counts=shared)
+            for _ in range(count)
+        ]
 
     @staticmethod
     def parallel() -> bool:

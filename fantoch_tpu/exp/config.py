@@ -22,7 +22,9 @@ class ProtocolConfig:
     addresses: Dict[int, Tuple[str, int]]
     peer_shards: Dict[int, int] = field(default_factory=dict)
     shard_count: int = 1
+    workers: int = 1
     executors: int = 1
+    multiplexing: int = 1
     delay_ms: int = 0
     gc_interval_ms: int = 100
     detached_interval_ms: int = 100
@@ -47,7 +49,9 @@ class ProtocolConfig:
                 f"{pid}={host}:{port}"
                 for pid, (host, port) in sorted(self.addresses.items())
             ),
+            "--workers", str(self.workers),
             "--executors", str(self.executors),
+            "--multiplexing", str(self.multiplexing),
             "--gc-interval", str(self.gc_interval_ms),
             "--detached-interval", str(self.detached_interval_ms),
         ]

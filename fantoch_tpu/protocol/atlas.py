@@ -105,16 +105,22 @@ class MShardAggregatedCommit(Message):
 
 @dataclass
 class MCommitDot(Message):
+    WORKER = "gc"
+
     dot: Dot
 
 
 @dataclass
 class MGarbageCollection(Message):
+    WORKER = "gc"
+
     committed: Dict[ProcessId, int]
 
 
 @dataclass
 class MStable(Message):
+    WORKER = "gc"
+
     stable: List[Tuple[ProcessId, int, int]]
 
 
@@ -218,7 +224,11 @@ class Atlas(Protocol):
 
     @staticmethod
     def parallel() -> bool:
-        return False  # SequentialKeyDeps (the reference's AtlasSequential)
+        # the reference ships AtlasLocked (RwLock-per-key KeyDeps) to make
+        # W worker threads safe on shared state; this runtime's workers
+        # are cooperative asyncio tasks, so every handle() is atomic and
+        # the Locked capability holds with no locks
+        return True
 
     @staticmethod
     def leaderless() -> bool:

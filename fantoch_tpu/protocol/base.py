@@ -72,7 +72,23 @@ Action = Union[ToSend, ToForward]
 @dataclass
 class Message:
     """Base class for protocol messages; concrete protocols define
-    dataclass subclasses (one per reference message variant)."""
+    dataclass subclasses (one per reference message variant).
+
+    ``WORKER`` is the ``MessageIndex`` analog (protocol/mod.rs:182-194
+    routed through lib.rs:44-76's reserved indexes) used by the run
+    layer to pick one of W protocol workers:
+
+    - ``"dot"``: shift past the two reserved workers by the message's
+      dot sequence (``worker_dot_index_shift``) — the default; dotless
+      messages fall back to the GC worker;
+    - ``"slot"``: shift by ``self.slot`` (FPaxos commanders);
+    - ``"gc"``: reserved worker 0 (``GC_WORKER_INDEX``);
+    - ``"leader"``: reserved worker 0 (``LEADER_WORKER_INDEX``);
+    - ``"aux"``: reserved worker 1 (Tempo's clock-bump role, FPaxos's
+      acceptor role).
+    """
+
+    WORKER = "dot"
 
 
 class BaseProcess:
@@ -296,6 +312,14 @@ class Protocol(ABC):
         """(event, interval_ms) pairs to schedule at start (the second
         element of the reference's ``Protocol::new`` return)."""
         return []
+
+    @staticmethod
+    def event_worker(event) -> str:
+        """Worker kind (``Message.WORKER`` vocabulary) a periodic event
+        routes to under workers > 1 — the ``PeriodicEventIndex`` analog.
+        Defaults to the GC worker; protocols with other periodic roles
+        (Tempo's clock bump, FPaxos's acceptor GC) override."""
+        return "gc"
 
     @abstractmethod
     def discover(

@@ -49,16 +49,22 @@ class MCommit(Message):
 
 @dataclass
 class MCommitDot(Message):
+    WORKER = "gc"
+
     dot: Dot
 
 
 @dataclass
 class MGarbageCollection(Message):
+    WORKER = "gc"
+
     committed: Dict[ProcessId, int]
 
 
 @dataclass
 class MStable(Message):
+    WORKER = "gc"
+
     stable: List[Tuple[ProcessId, int, int]]
 
 

@@ -79,6 +79,8 @@ class MRetryAck(Message):
 
 @dataclass
 class MGarbageCollection(Message):
+    WORKER = "gc"
+
     executed: List[Dot]
 
 
@@ -223,7 +225,10 @@ class Caesar(Protocol):
 
     @staticmethod
     def parallel() -> bool:
-        return False
+        # the reference's only Caesar variant is CaesarLocked
+        # (LockedCommandsInfo); cooperative workers give the same
+        # per-message atomicity with no locks
+        return True
 
     @staticmethod
     def leaderless() -> bool:

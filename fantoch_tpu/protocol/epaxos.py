@@ -152,7 +152,8 @@ class EPaxos(Protocol):
 
     @staticmethod
     def parallel() -> bool:
-        return False  # SequentialKeyDeps (the reference's EPaxosSequential)
+        # EPaxosLocked equivalent under cooperative workers (see Atlas)
+        return True
 
     @staticmethod
     def leaderless() -> bool:

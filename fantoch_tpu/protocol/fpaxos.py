@@ -35,11 +35,15 @@ from .synod import (
 # messages (fpaxos.rs:382-408)
 @dataclass
 class MForwardSubmit(Message):
+    WORKER = "leader"  # fpaxos.rs:383-453 routing
+
     cmd: Command
 
 
 @dataclass
 class MSpawnCommander(Message):
+    WORKER = "slot"
+
     ballot: int
     slot: int
     cmd: Command
@@ -47,6 +51,8 @@ class MSpawnCommander(Message):
 
 @dataclass
 class MAccept(Message):
+    WORKER = "aux"  # ACCEPTOR_WORKER_INDEX
+
     ballot: int
     slot: int
     cmd: Command
@@ -54,18 +60,24 @@ class MAccept(Message):
 
 @dataclass
 class MAccepted(Message):
+    WORKER = "slot"  # back to the spawned commander
+
     ballot: int
     slot: int
 
 
 @dataclass
 class MChosen(Message):
+    WORKER = "aux"
+
     slot: int
     cmd: Command
 
 
 @dataclass
 class MGarbageCollection(Message):
+    WORKER = "aux"  # the acceptor holds the slots to gc
+
     committed: int
 
 
@@ -133,6 +145,11 @@ class FPaxos(Protocol):
                 msg=MGarbageCollection(self.gc_track.committed()),
             )
         )
+
+    @staticmethod
+    def event_worker(event) -> str:
+        # the acceptor worker holds the slots to gc (fpaxos.rs routing)
+        return "aux"
 
     @staticmethod
     def parallel() -> bool:

@@ -81,11 +81,15 @@ class MCommit(Message):
 
 @dataclass
 class MCommitClock(Message):
+    WORKER = "aux"  # CLOCK_BUMP_WORKER_INDEX (tempo.rs:1226-1243)
+
     clock: int
 
 
 @dataclass
 class MDetached(Message):
+    WORKER = "aux"  # CLOCK_BUMP_WORKER_INDEX (tempo.rs:1243-1245)
+
     detached: Votes
 
 
@@ -128,16 +132,22 @@ class MShardAggregatedCommit(Message):
 
 @dataclass
 class MCommitDot(Message):
+    WORKER = "gc"  # tempo.rs:1256-1262
+
     dot: Dot
 
 
 @dataclass
 class MGarbageCollection(Message):
+    WORKER = "gc"
+
     committed: Dict[ProcessId, int]
 
 
 @dataclass
 class MStable(Message):
+    WORKER = "gc"  # self-forwarded by the GC worker; stays there
+
     stable: List[Tuple[ProcessId, int, int]]
 
 
@@ -303,6 +313,12 @@ class Tempo(Protocol):
     @staticmethod
     def leaderless() -> bool:
         return True
+
+    @staticmethod
+    def event_worker(event) -> str:
+        """tempo.rs:1271-1276: clock-bump and send-detached run on the
+        reserved clock-bump worker; GC on the GC worker."""
+        return "gc" if event == GARBAGE_COLLECTION else "aux"
 
     def metrics(self):
         return self.bp.metrics

@@ -7,22 +7,23 @@ workloads (mod.rs:122-260). Multi-shard commands register with every
 shard's connection and aggregate per-key partials client-side
 (task/client/pending.rs); single-shard results arrive whole.
 
-Batching: commands from clients sharing a connection can merge up to
-``batch_max_size`` with ``batch_max_delay_ms`` slack (batcher.rs:15-100,
-unbatcher.rs:11-106). Merged commands keep their own rifls; the server
-executes them as independent submissions, so unbatching is just
-result routing — the semantic the reference's unbatcher implements.
+Batching (``batch_max_size`` > 1): commands from clients sharing this
+client group merge into one submission, up to ``batch_max_size``
+commands or ``batch_max_delay_ms`` of slack, whichever first
+(batcher.rs:15-100, batch.rs:17-74). The merged command keeps the
+first member's rifl; the batcher remembers every member rifl and fans
+the single result back out on completion (unbatcher.rs:11-106).
 """
 
 from __future__ import annotations
 
 import asyncio
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from ..client.client import Client, ClientData
 from ..client.workload import Workload
-from ..core.command import CommandResultBuilder
+from ..core.command import Command, CommandResult, CommandResultBuilder
 from ..core.ids import ClientId, ProcessId, ShardId
 from ..core.timing import RunTime
 from .prelude import ClientHi
@@ -34,6 +35,9 @@ class ClientHandle:
     """Results of a finished client group."""
 
     data: Dict[ClientId, ClientData]
+    # wire submissions actually sent; < total commands when batching
+    # merged some (the batching test's observable)
+    submits: int = 0
 
     def latencies_us(self) -> List[int]:
         out: List[int] = []
@@ -51,10 +55,17 @@ async def client(
     open_loop_interval_ms: Optional[int] = None,
     compress: bool = False,
     connect_retries: int = 100,
+    batch_max_size: int = 1,
+    batch_max_delay_ms: float = 5.0,
+    command_timeout_s: Optional[float] = None,
 ) -> ClientHandle:
     """Run ``len(client_ids)`` closed-loop clients (or open-loop with
     ``open_loop_interval_ms``) against an already-running cluster;
-    returns when every client finished its workload."""
+    returns when every client finished its workload.
+
+    ``command_timeout_s`` bounds the wait for any single command's
+    result; on expiry the run fails loudly (TimeoutError) instead of
+    hanging forever on a lost result."""
     time = RunTime()
     conns: Dict[ShardId, Connection] = {}
     for shard, (host, port) in shard_addresses.items():
@@ -76,20 +87,48 @@ async def client(
         c.connect(dict(shard_processes))
         clients[cid] = c
 
-    # route results back to the issuing client
+    # route results back to the issuing client. ``waiters`` is keyed by
+    # member rifl; ``batch_members`` maps a submitted (possibly merged)
+    # command's rifl to every member rifl it carries.
     waiters: Dict[object, asyncio.Future] = {}
     partials: Dict[object, CommandResultBuilder] = {}
+    batch_members: Dict[object, List[object]] = {}
+    stats = {"submits": 0}
+    multi_shard = len(conns) > 1
+
+    def _resolve(batch_rifl, result: CommandResult) -> None:
+        """Fan one wire result out to every member rifl's waiter
+        (unbatcher.rs:96-106 semantics)."""
+        for member in batch_members.pop(batch_rifl, [batch_rifl]):
+            fut = waiters.pop(member, None)
+            if fut is not None and not fut.done():
+                fut.set_result(CommandResult(member, result.results))
+
+    def _fail(batch_rifl, reason: str) -> None:
+        for member in batch_members.pop(batch_rifl, [batch_rifl]):
+            fut = waiters.pop(member, None)
+            if fut is not None and not fut.done():
+                fut.set_exception(
+                    RuntimeError(f"command {member} failed: {reason}")
+                )
 
     async def dispatcher(conn: Connection) -> None:
         while True:
             msg = await conn.recv()
             if msg is None:
+                # server side closed mid-run: results in flight are
+                # lost for good, so fail every pending waiter loudly
+                # rather than letting clients wait forever
+                for fut in list(waiters.values()):
+                    if not fut.done():
+                        fut.set_exception(
+                            ConnectionError("server connection closed")
+                        )
+                waiters.clear()
                 return
             tag = msg[0]
             if tag == "result":
-                fut = waiters.pop(msg[1].rifl, None)
-                if fut is not None and not fut.done():
-                    fut.set_result(msg[1])
+                _resolve(msg[1].rifl, msg[1])
             elif tag == "partial":
                 er = msg[1]
                 builder = partials.get(er.rifl)
@@ -98,14 +137,87 @@ async def client(
                 builder.add_partial(er.key, er.partial_results)
                 if builder.ready():
                     del partials[er.rifl]
-                    fut = waiters.pop(er.rifl, None)
-                    if fut is not None and not fut.done():
-                        fut.set_result(builder.build())
+                    _resolve(er.rifl, builder.build())
+            elif tag == "error":
+                _fail(msg[1], msg[2])
 
     dispatchers = [
         asyncio.create_task(dispatcher(conn)) for conn in conns.values()
     ]
-    multi_shard = len(conns) > 1
+
+    async def _submit(target_shard, cmd: Command, members) -> None:
+        stats["submits"] += 1
+        if members != [cmd.rifl]:
+            batch_members[cmd.rifl] = members
+        if multi_shard:
+            partials[cmd.rifl] = CommandResultBuilder(
+                cmd.rifl, cmd.total_key_count()
+            )
+            for shard, conn in conns.items():
+                await conn.send(("register", cmd))
+        else:
+            await conns[target_shard].send(("register", cmd))
+        await conns[target_shard].send(("submit", cmd))
+
+    batch_q: asyncio.Queue = asyncio.Queue()
+
+    async def batcher_loop() -> None:
+        """Hold the open batch until it reaches ``batch_max_size`` or
+        its deadline expires, whichever first (batcher.rs:29-91)."""
+        loop = asyncio.get_running_loop()
+        while True:
+            target_shard, cmd = await batch_q.get()
+            # the merged command must not alias the member's op maps —
+            # the member Command lives on in the client's pending set
+            merged = Command(
+                cmd.rifl,
+                {
+                    s: {k: list(v) for k, v in ops.items()}
+                    for s, ops in cmd.shard_to_ops.items()
+                },
+            )
+            members = [cmd.rifl]
+            # per-shard target votes; the batch targets the most-voted
+            # shard (batch.rs:62-74)
+            targets = {target_shard: 1}
+            deadline = loop.time() + batch_max_delay_ms / 1000
+            while len(members) < batch_max_size:
+                timeout = deadline - loop.time()
+                if timeout <= 0:
+                    break
+                try:
+                    nxt_shard, nxt_cmd = await asyncio.wait_for(
+                        batch_q.get(), timeout
+                    )
+                except asyncio.TimeoutError:
+                    break
+                merged.merge(nxt_cmd)
+                members.append(nxt_cmd.rifl)
+                targets[nxt_shard] = targets.get(nxt_shard, 0) + 1
+            target = max(targets.items(), key=lambda kv: (kv[1], kv[0]))[0]
+            await _submit(target, merged, members)
+
+    batching = batch_max_size > 1
+    batcher = asyncio.create_task(batcher_loop()) if batching else None
+    batcher_exc: List[BaseException] = []
+    if batcher is not None:
+        # a dead batcher would strand every future command unsubmitted
+        # with its waiter unresolved; fail all pending waiters loudly
+        # (and every later submission, via batcher_exc) instead of
+        # hanging the run
+        def _batcher_died(t: asyncio.Task) -> None:
+            if t.cancelled() or t.exception() is None:
+                return
+            exc = t.exception()
+            batcher_exc.append(exc)
+            for fut in list(waiters.values()):
+                if not fut.done():
+                    fut.set_exception(
+                        RuntimeError(f"batcher died: {exc!r}")
+                    )
+            waiters.clear()
+
+        batcher.add_done_callback(_batcher_died)
 
     async def run_one(c: Client) -> None:
         loop = asyncio.get_running_loop()
@@ -113,7 +225,7 @@ async def client(
 
         async def record(fut: asyncio.Future) -> None:
             # latency is measured at completion time, not at drain time
-            result = await fut
+            result = await asyncio.wait_for(fut, command_timeout_s)
             c.cmd_recv(result.rifl, time)
 
         while True:
@@ -123,15 +235,14 @@ async def client(
             target_shard, cmd = nxt
             fut = loop.create_future()
             waiters[cmd.rifl] = fut
-            if multi_shard:
-                partials[cmd.rifl] = CommandResultBuilder(
-                    cmd.rifl, cmd.total_key_count()
-                )
-                for shard, conn in conns.items():
-                    await conn.send(("register", cmd))
+            if batching:
+                if batcher_exc:
+                    raise RuntimeError(
+                        f"batcher died: {batcher_exc[0]!r}"
+                    )
+                await batch_q.put((target_shard, cmd))
             else:
-                await conns[target_shard].send(("register", cmd))
-            await conns[target_shard].send(("submit", cmd))
+                await _submit(target_shard, cmd, [cmd.rifl])
             if open_loop_interval_ms is None:
                 await record(fut)
             else:
@@ -140,9 +251,17 @@ async def client(
         for task in inflight:
             await task
 
-    await asyncio.gather(*(run_one(c) for c in clients.values()))
-    for task in dispatchers:
-        task.cancel()
-    for conn in conns.values():
-        await conn.close()
-    return ClientHandle({cid: c.data for cid, c in clients.items()})
+    try:
+        await asyncio.gather(*(run_one(c) for c in clients.values()))
+    finally:
+        # loud-failure paths (command timeout, batcher death, server
+        # close) must not leak dispatcher/batcher tasks or sockets
+        for task in dispatchers:
+            task.cancel()
+        if batcher is not None:
+            batcher.cancel()
+        for conn in conns.values():
+            await conn.close()
+    return ClientHandle(
+        {cid: c.data for cid, c in clients.items()}, stats["submits"]
+    )

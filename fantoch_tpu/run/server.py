@@ -30,7 +30,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Type
 
 from ..core.command import Command, CommandResultBuilder
 from ..core.config import Config
-from ..core.ids import ProcessId, Rifl, ShardId
+from ..core.ids import DotGen, ProcessId, Rifl, ShardId
 from ..core.timing import RunTime
 from ..core.trace import trace, tracer
 from ..core.util import key_hash
@@ -81,21 +81,10 @@ def _executor_pool(
     executors: int,
 ) -> List[Executor]:
     executor_cls = protocol_cls.EXECUTOR  # type: ignore[attr-defined]
-    if executors > 1:
-        # key-hash pools require per-key independence; configs asking
-        # for a pool of any other executor are rejected at boot. (The
-        # graph executor is ``parallel()`` in the reference only
-        # through its executor-0-runs-the-graph request protocol,
-        # executor/graph/mod.rs:54-67, which this runtime does not
-        # implement; the table executor's cross-key stability counting
-        # needs state shared between pool members.)
-        assert getattr(executor_cls, "KEY_HASH_ROUTED", False), (
-            f"{executor_cls.__name__} does not support key-hash executor"
-            " pools in this runtime"
-        )
-    return [
-        executor_cls(process_id, shard_id, config) for _ in range(executors)
-    ]
+    # pool construction (and the per-key-independence gate) lives on
+    # the executor class: executors with cross-key state override
+    # ``pool`` to share it between members (executor/base.py)
+    return executor_cls.pool(process_id, shard_id, config, executors)
 
 
 def _route_info(info: Any, executors: int) -> int:
@@ -105,6 +94,43 @@ def _route_info(info: Any, executors: int) -> int:
     if key is None or executors == 1:
         return _GC_EXECUTOR
     return key_hash(key) % executors
+
+
+# the reference's reserved worker indexes (lib.rs:44-76): worker 0 is
+# the GC (and leader) worker, worker 1 the aux role (Tempo clock bump,
+# FPaxos acceptor); dot/slot-indexed messages shift past both
+GC_WORKER = 0
+AUX_WORKER = 1
+WORKERS_RESERVED = 2
+
+
+def _route_msg(msg: Any, workers: int) -> int:
+    """``MessageIndex`` routing (protocol/mod.rs:182-194): pick one of
+    W protocol workers by the message's ``WORKER`` kind."""
+    if workers == 1:
+        return 0
+    kind = getattr(msg, "WORKER", "dot")
+    if kind in ("gc", "leader"):
+        return GC_WORKER
+    if kind == "aux":
+        return AUX_WORKER % workers
+    if kind == "slot":
+        return _shift_index(int(msg.slot), workers)
+    dot = getattr(msg, "dot", None)
+    if dot is None:
+        return GC_WORKER
+    return _shift_index(int(dot.sequence), workers)
+
+
+def _shift_index(value: int, workers: int) -> int:
+    """``worker_index_shift`` (lib.rs:63-76): land past the reserved
+    workers when there are more than the reserved two."""
+    if workers > WORKERS_RESERVED:
+        return WORKERS_RESERVED + value % (workers - WORKERS_RESERVED)
+    return value % workers
+
+
+_EVENT_WORKER = {"gc": GC_WORKER, "leader": GC_WORKER, "aux": AUX_WORKER}
 
 
 async def process(
@@ -120,7 +146,9 @@ async def process(
     listen: Tuple[str, int] = None,
     client_listen: Tuple[str, int] = None,
     sorted_processes: Optional[Sequence[Tuple[ProcessId, ShardId]]] = None,
+    workers: int = 1,
     executors: int = 1,
+    multiplexing: int = 1,
     delay_ms: int = 0,
     compress: bool = False,
     metrics_file: Optional[str] = None,
@@ -141,6 +169,14 @@ async def process(
     from ..core.trace import init_tracing
 
     init_tracing()  # $FANTOCH_TRACE; idempotent, keeps explicit setups
+    # run/mod.rs:180-183: worker parallelism needs a protocol whose
+    # state tolerates it. Python workers are cooperative asyncio tasks
+    # in one thread — every handle() runs to completion unpreempted, so
+    # sharing one protocol instance gives exactly the per-message
+    # atomicity the reference's Atomic/Locked variants provide.
+    assert workers == 1 or protocol_cls.parallel(), (
+        f"{protocol_cls.__name__} does not support workers > 1"
+    )
     protocol = protocol_cls(process_id, shard_id, config)
     pool = _executor_pool(
         protocol_cls, process_id, shard_id, config, executors
@@ -159,6 +195,8 @@ async def process(
             listen=listen,
             client_listen=client_listen,
             sorted_processes=sorted_processes,
+            workers=workers,
+            multiplexing=multiplexing,
             delay_ms=delay_ms,
             compress=compress,
             metrics_file=metrics_file,
@@ -200,6 +238,8 @@ class _Runtime:
         listen,
         client_listen,
         sorted_processes,
+        workers,
+        multiplexing,
         delay_ms,
         compress,
         metrics_file,
@@ -223,6 +263,7 @@ class _Runtime:
         self.listen = listen
         self.client_listen = client_listen
         self.sorted_processes = sorted_processes
+        self.multiplexing = max(1, multiplexing)
         self.delay_ms = delay_ms
         self.compress = compress
         self.metrics_file = metrics_file
@@ -230,16 +271,29 @@ class _Runtime:
         self.execution_log = execution_log
         self.connect_retries = connect_retries
 
-        # the worker loop's single select queue (the reference's
-        # process_task selects over 4 channels; one queue keeps their
-        # arrival order total)
-        self.work: "asyncio.Queue[Tuple]" = asyncio.Queue()
+        # one select queue per protocol worker (the reference's W
+        # process_task loops, each selecting over 4 channels; a queue
+        # per worker keeps per-worker arrival order total). Messages
+        # route by MessageIndex (_route_msg); submits by a server-side
+        # dot generator (the AtomicDotGen analog, run/mod.rs:285-291)
+        # so a dot's whole lifetime stays on one worker.
+        self.workers = workers
+        self.works: List["asyncio.Queue[Tuple]"] = [
+            asyncio.Queue() for _ in range(workers)
+        ]
+        self.dot_gen = DotGen(self.process_id)
         self.exec_queues: List["asyncio.Queue[Tuple]"] = [
             asyncio.Queue() for _ in pool
         ]
         # outgoing peer connections (sends ride these; receives ride the
         # connections peers opened to us)
-        self.out: Dict[ProcessId, Connection] = {}
+        # outgoing connections per peer: ``multiplexing`` parallel TCP
+        # connections (run/mod.rs:113, task/server/mod.rs:226-310);
+        # sends spread round-robin like the reference's random writer
+        # pick, so cross-connection ordering is NOT guaranteed — the
+        # protocols' buffered-commit paths tolerate that by design
+        self.out: Dict[ProcessId, List[Connection]] = {}
+        self._out_rr: Dict[ProcessId, int] = {}
         self.client_conns: Dict[int, Connection] = {}
         self.client_pending: Dict[int, AggregatePending] = {}
         # rifl → client-connection id that registered it
@@ -257,6 +311,10 @@ class _Runtime:
         # process has a register for it.
         self.partial_buf: Dict[Rifl, List[Tuple[float, Any]]] = {}
         self.partial_buf_ttl_s = 10.0
+        # rifl -> eviction time for partials the sweeper dropped; a
+        # late register finds its rifl here and fails explicitly
+        # instead of waiting forever for partials that are gone
+        self.partial_evicted: Dict[Rifl, float] = {}
         self.tasks: List[asyncio.Task] = []
         self.exec_log_fh = None
         self._conn_seq = 0
@@ -296,21 +354,37 @@ class _Runtime:
             )
 
     async def _connect_to_all(self) -> None:
-        """Open one outgoing connection per peer, say hi
-        (task/server/mod.rs:40-224; incoming connections carry the
-        peer's sends to us)."""
+        """Open ``multiplexing`` outgoing connections per peer, each
+        with its own hi handshake (task/server/mod.rs:40-310; incoming
+        connections carry the peer's sends to us)."""
         for peer, (host, port) in self.peer_addresses.items():
-            for attempt in range(self.connect_retries):
-                try:
-                    reader, writer = await asyncio.open_connection(host, port)
-                    break
-                except ConnectionError:
-                    await asyncio.sleep(0.05)
-            else:
-                raise ConnectionError(f"cannot reach peer {peer}")
-            conn = Connection(reader, writer, compress=self.compress)
-            await conn.send(ProcessHi(self.process_id, self.shard_id))
-            self.out[peer] = conn
+            conns = []
+            for _m in range(self.multiplexing):
+                for attempt in range(self.connect_retries):
+                    try:
+                        reader, writer = await asyncio.open_connection(
+                            host, port
+                        )
+                        break
+                    except ConnectionError:
+                        await asyncio.sleep(0.05)
+                else:
+                    raise ConnectionError(f"cannot reach peer {peer}")
+                conn = Connection(reader, writer, compress=self.compress)
+                await conn.send(ProcessHi(self.process_id, self.shard_id))
+                conns.append(conn)
+            self.out[peer] = conns
+            self._out_rr[peer] = 0
+
+    def _pick_out(self, peer: ProcessId) -> Connection:
+        """Round-robin over the peer's multiplexed connections (the
+        reference picks uniformly at random, process.rs:309-319;
+        round-robin keeps tests deterministic with the same
+        no-cross-connection-ordering contract)."""
+        conns = self.out[peer]
+        i = self._out_rr[peer]
+        self._out_rr[peer] = (i + 1) % len(conns)
+        return conns[i]
 
     async def _accept_peer(self, reader, writer) -> None:
         conn = Connection(
@@ -320,19 +394,17 @@ class _Runtime:
         if not isinstance(hi, ProcessHi):
             await conn.close()
             return
-        self.tasks.append(
-            asyncio.create_task(
-                self._peer_reader(hi.process_id, hi.shard_id, conn),
-                name=f"reader-{self.process_id}<-{hi.process_id}",
-            )
+        self._spawn(
+            self._peer_reader(hi.process_id, hi.shard_id, conn),
+            f"reader-{self.process_id}<-{hi.process_id}",
         )
 
     async def _ping_round(self) -> None:
         """One RTT measurement per peer (ping.rs:13-100); used for
         RTT-sorted discovery when ``sorted_processes`` is not given."""
-        for peer, conn in self.out.items():
+        for peer, conns in self.out.items():
             t0 = _time.monotonic()
-            await conn.send(("ping", t0))
+            await conns[0].send(("ping", t0))
             # pongs come back on the incoming connection; readers fill
             # self._rtt. Give them a moment without blocking the boot on
             # a slow peer.
@@ -361,47 +433,48 @@ class _Runtime:
             self.process_id, self.shard_id, sorted_ps,
         )
 
+    def _spawn(self, coro, name: str) -> asyncio.Task:
+        """Supervised spawn: an exception in any task (protocol.handle,
+        executor.handle, a reader...) stops the whole replica loudly via
+        ``stop_event`` instead of leaving it up but silently stuck with
+        clients hanging — mirroring the reference runtime's fail-fast
+        behavior when a task dies."""
+        task = asyncio.create_task(coro, name=name)
+
+        def _done(t: asyncio.Task) -> None:
+            if t.cancelled():
+                return
+            exc = t.exception()
+            if exc is not None:
+                log.error(
+                    "process %s task %r died: %r",
+                    self.process_id, name, exc, exc_info=exc,
+                )
+                self.handle.stop_event.set()
+
+        task.add_done_callback(_done)
+        self.tasks.append(task)
+        return task
+
     def _start_tasks(self) -> None:
-        t = self.tasks.append
-        t(asyncio.create_task(self._worker_loop(), name="worker"))
+        for w in range(self.workers):
+            self._spawn(self._worker_loop(w), f"worker-{w}")
         for i in range(len(self.pool)):
-            t(
-                asyncio.create_task(
-                    self._executor_loop(i), name=f"executor-{i}"
-                )
-            )
+            self._spawn(self._executor_loop(i), f"executor-{i}")
         for event, interval in self.protocol.periodic_events():
-            t(
-                asyncio.create_task(
-                    self._periodic_loop(event, interval),
-                    name=f"periodic-{event}",
-                )
+            self._spawn(
+                self._periodic_loop(event, interval), f"periodic-{event}"
             )
-        t(
-            asyncio.create_task(
-                self._executed_notification_loop(),
-                name="executed-notification",
-            )
+        self._spawn(
+            self._executed_notification_loop(), "executed-notification"
         )
         cleanup = self.config.executor_cleanup_interval_ms
         if cleanup:
-            t(
-                asyncio.create_task(
-                    self._executor_cleanup_loop(cleanup), name="cleanup"
-                )
-            )
+            self._spawn(self._executor_cleanup_loop(cleanup), "cleanup")
         if self.metrics_file:
-            t(
-                asyncio.create_task(
-                    self._metrics_logger_loop(), name="metrics-logger"
-                )
-            )
+            self._spawn(self._metrics_logger_loop(), "metrics-logger")
         if self.config.shard_count > 1:
-            t(
-                asyncio.create_task(
-                    self._partial_buf_sweeper(), name="partial-sweeper"
-                )
-            )
+            self._spawn(self._partial_buf_sweeper(), "partial-sweeper")
 
     # -- readers -------------------------------------------------------
 
@@ -413,7 +486,9 @@ class _Runtime:
             tag = msg[0]
             if tag == "msg":
                 _, from_id, from_shard, pmsg = msg
-                await self.work.put(("msg", from_id, from_shard, pmsg))
+                await self.works[_route_msg(pmsg, self.workers)].put(
+                    ("msg", from_id, from_shard, pmsg)
+                )
             elif tag == "exec":
                 _, from_shard, info = msg
                 await self.exec_queues[
@@ -423,17 +498,15 @@ class _Runtime:
                 # a ping can arrive while our own connect_to_all is
                 # still retrying; answer from a side task so the reader
                 # never stalls protocol traffic behind the wait
-                self.tasks.append(
-                    asyncio.create_task(self._pong(peer, msg[1]))
-                )
+                self._spawn(self._pong(peer, msg[1]), f"pong-{peer}")
             elif tag == "pong":
                 self._rtt[peer] = _time.monotonic() - msg[1]
 
     async def _pong(self, peer, nonce) -> None:
         for _ in range(200):
             out = self.out.get(peer)
-            if out is not None:
-                await out.send(("pong", nonce))
+            if out:
+                await out[0].send(("pong", nonce))
                 return
             await asyncio.sleep(0.01)
 
@@ -451,11 +524,8 @@ class _Runtime:
         self.client_pending[conn_id] = AggregatePending(
             self.process_id, self.shard_id
         )
-        self.tasks.append(
-            asyncio.create_task(
-                self._client_reader(conn_id, conn),
-                name=f"client-conn-{conn_id}",
-            )
+        self._spawn(
+            self._client_reader(conn_id, conn), f"client-conn-{conn_id}"
         )
 
     async def _client_reader(self, conn_id: int, conn: Connection) -> None:
@@ -479,26 +549,54 @@ class _Runtime:
                     # not touch this shard produce no partials here.
                     expected = cmd.key_count(self.shard_id)
                     if expected:
+                        if self.partial_evicted.pop(cmd.rifl, None):
+                            # partials already swept: the client would
+                            # wait forever — fail the rifl explicitly
+                            log.error(
+                                "register for %s after partials were "
+                                "evicted (register delayed > %ss)",
+                                cmd.rifl, self.partial_buf_ttl_s,
+                            )
+                            await self._send_client(
+                                conn_id,
+                                conn,
+                                ("error", cmd.rifl, "partials evicted"),
+                            )
+                            continue
                         self.rifl_shard_conn[cmd.rifl] = [conn_id, expected]
                         for _, er in self.partial_buf.pop(cmd.rifl, []):
                             await self._to_client(er)
             elif tag == "submit":
-                await self.work.put(("submit", msg[1]))
+                cmd = msg[1]
+                if self.workers > 1 and self.protocol.leaderless():
+                    # pre-assign the dot so the submit routes to the
+                    # worker that will own the dot's whole lifetime
+                    # (the client-side AtomicDotGen analog,
+                    # run/mod.rs:285-291)
+                    dot = self.dot_gen.next_id()
+                    w = _shift_index(int(dot.sequence), self.workers)
+                elif self.workers > 1:
+                    dot, w = None, GC_WORKER  # leader worker
+                else:
+                    dot, w = None, 0
+                await self.works[w].put(("submit", dot, cmd))
 
     # -- the protocol worker -------------------------------------------
 
-    async def _worker_loop(self) -> None:
+    async def _worker_loop(self, worker: int) -> None:
+        queue = self.works[worker]
         while True:
-            item = await self.work.get()
+            item = await queue.get()
             tag = item[0]
             if tag == "msg":
                 _, from_id, from_shard, pmsg = item
                 trace(
-                    log, "p%s <- p%s: %s", self.process_id, from_id, pmsg
+                    log, "p%s/w%s <- p%s: %s",
+                    self.process_id, worker, from_id, pmsg,
                 )
                 self.protocol.handle(from_id, from_shard, pmsg, self.time)
             elif tag == "submit":
-                self.protocol.submit(None, item[1], self.time)
+                self.protocol.submit(item[1], item[2], self.time)
             elif tag == "periodic":
                 self.protocol.handle_event(item[1], self.time)
             elif tag == "executed":
@@ -510,15 +608,16 @@ class _Runtime:
         connections with one serialization, ToForward re-enters the work
         queue, execution info routes to the executor pool by key."""
         actions = self.protocol.to_processes()
+        touched: set = set()
         for info in self.protocol.to_executors():
             await self.exec_queues[_route_info(info, len(self.pool))].put(
                 ("info", info)
             )
         for action in actions:
             if isinstance(action, ToForward):
-                await self.work.put(
-                    ("msg", self.process_id, self.shard_id, action.msg)
-                )
+                await self.works[
+                    _route_msg(action.msg, self.workers)
+                ].put(("msg", self.process_id, self.shard_id, action.msg))
                 continue
             assert isinstance(action, ToSend)
             targets = sorted(action.target)
@@ -530,11 +629,11 @@ class _Runtime:
                         if len(targets) > 1
                         else action.msg
                     )
-                    await self.work.put(
-                        ("msg", self.process_id, self.shard_id, msg)
-                    )
+                    await self.works[
+                        _route_msg(msg, self.workers)
+                    ].put(("msg", self.process_id, self.shard_id, msg))
                 else:
-                    conn = self.out[to]
+                    conn = self._pick_out(to)
                     if wire is None:
                         wire = conn.serialize(
                             (
@@ -545,9 +644,11 @@ class _Runtime:
                             )
                         )
                     conn.send_bytes_nowait(wire)
-        for to in {t for a in actions if isinstance(a, ToSend)
-                   for t in a.target if t != self.process_id}:
-            await self.out[to].writer.drain()
+                    touched.add(conn)
+        # drain only the connections this batch actually wrote (with
+        # multiplexing, round-robin touches a subset per batch)
+        for conn in touched:
+            await conn.writer.drain()
 
     # -- executors -----------------------------------------------------
 
@@ -579,11 +680,20 @@ class _Runtime:
                     ].put(("info", info))
                 else:
                     target = self.protocol.bp.closest_process(to_shard)
-                    await self.out[target].send(
+                    await self._pick_out(target).send(
                         ("exec", self.shard_id, info)
                     )
             for er in results:
                 await self._to_client(er)
+
+    async def _send_client(self, conn_id: int, conn, payload) -> None:
+        """Client-facing send: a client that died mid-run must not take
+        the replica down (the supervised-task fail-fast is for internal
+        bugs), so a reset connection just gets dropped."""
+        try:
+            await conn.send(payload)
+        except ConnectionError:
+            self.client_conns.pop(conn_id, None)
 
     async def _to_client(self, executor_result) -> None:
         rifl = executor_result.rifl
@@ -597,7 +707,9 @@ class _Runtime:
                 self.rifl_conn.pop(rifl, None)
                 conn = self.client_conns.get(conn_id)
                 if conn is not None:
-                    await conn.send(("result", cmd_result))
+                    await self._send_client(
+                        conn_id, conn, ("result", cmd_result)
+                    )
         else:
             entry = self.rifl_shard_conn.get(rifl)
             if entry is None:
@@ -611,14 +723,19 @@ class _Runtime:
                 del self.rifl_shard_conn[rifl]
             conn = self.client_conns.get(conn_id)
             if conn is not None:
-                await conn.send(("partial", executor_result))
+                await self._send_client(
+                    conn_id, conn, ("partial", executor_result)
+                )
 
     # -- periodic tasks ------------------------------------------------
 
     async def _periodic_loop(self, event, interval_ms: int) -> None:
+        w = _EVENT_WORKER.get(
+            self.protocol.event_worker(event), GC_WORKER
+        ) % self.workers
         while True:
             await asyncio.sleep(interval_ms / 1000)
-            await self.work.put(("periodic", event))
+            await self.works[w].put(("periodic", event))
 
     async def _executed_notification_loop(self) -> None:
         interval = self.config.executor_executed_notification_interval_ms
@@ -627,7 +744,9 @@ class _Runtime:
             for executor in self.pool:
                 executed = executor.executed(self.time)
                 if executed is not None:
-                    await self.work.put(("executed", executed))
+                    # executed notifications feed protocol GC: the GC
+                    # worker's role (executor.rs:281-330 ticks)
+                    await self.works[GC_WORKER].put(("executed", executed))
 
     async def _executor_cleanup_loop(self, interval_ms: int) -> None:
         while True:
@@ -638,7 +757,8 @@ class _Runtime:
     async def _partial_buf_sweeper(self) -> None:
         while True:
             await asyncio.sleep(self.partial_buf_ttl_s / 2)
-            cutoff = _time.monotonic() - self.partial_buf_ttl_s
+            now = _time.monotonic()
+            cutoff = now - self.partial_buf_ttl_s
             stale = [
                 rifl
                 for rifl, entries in self.partial_buf.items()
@@ -646,6 +766,13 @@ class _Runtime:
             ]
             for rifl in stale:
                 del self.partial_buf[rifl]
+                self.partial_evicted[rifl] = now
+            # evictions nothing ever claimed age out too, so the
+            # record itself cannot leak
+            dead = now - 10 * self.partial_buf_ttl_s
+            self.partial_evicted = {
+                r: t for r, t in self.partial_evicted.items() if t >= dead
+            }
 
     async def _metrics_logger_loop(self) -> None:
         """metrics_logger.rs: periodic (worker, metrics) snapshots."""
@@ -677,7 +804,8 @@ class _Runtime:
                 await task
             except (asyncio.CancelledError, Exception):
                 pass
-        for conn in list(self.out.values()) + list(
+        out_conns = [c for conns in self.out.values() for c in conns]
+        for conn in out_conns + list(
             self.client_conns.values()
         ):
             try:

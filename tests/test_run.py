@@ -36,7 +36,8 @@ def _bind() -> socket.socket:
     return s
 
 
-async def _boot_cluster(protocol_cls, config, delay_ms=1):
+async def _boot_cluster(protocol_cls, config, delay_ms=1, workers=1,
+                        executors=1, multiplexing=1):
     """Start config.n × config.shard_count replicas on pre-bound
     localhost ports; returns (handles, client_addresses)."""
     ids = [
@@ -83,21 +84,26 @@ async def _boot_cluster(protocol_cls, config, delay_ms=1):
                 client_sock=client_socks[pid],
                 sorted_processes=sorted_ps,
                 delay_ms=delay_ms,
-                executors=1,
+                workers=workers,
+                executors=executors,
+                multiplexing=multiplexing,
             )
         )
     await asyncio.gather(*(h.started.wait() for h in handles))
     return handles, client_addr, shards
 
 
-async def _run_cluster(protocol_cls, config, keys_per_command=2):
+async def _run_cluster(protocol_cls, config, keys_per_command=2,
+                       workers=1):
     config = config.with_(
         executor_monitor_execution_order=True,
         gc_interval_ms=25,
         executor_executed_notification_interval_ms=25,
         executor_cleanup_interval_ms=5,
     )
-    handles, client_addr, shards = await _boot_cluster(protocol_cls, config)
+    handles, client_addr, shards = await _boot_cluster(
+        protocol_cls, config, workers=workers
+    )
     workload = Workload(
         shard_count=config.shard_count,
         key_gen=ConflictPool(conflict_rate=50, pool_size=1),
@@ -299,6 +305,175 @@ def test_run_basic_executor_pool():
             assert keys0 and keys1, (
                 "both executors should own keys with a 4-key pool"
             )
+        for h in handles:
+            await h.stop()
+
+    asyncio.run(main())
+
+
+def test_run_client_batching():
+    """Client-side batching (batcher.rs:15-100): four concurrent
+    closed-loop clients sharing a connection merge commands up to
+    batch_max_size, so the wire carries strictly fewer submits than
+    commands, while every member rifl still completes with its own
+    latency sample (unbatcher.rs:96-106 fan-out)."""
+
+    async def main():
+        config = Config(
+            n=3, f=1,
+            gc_interval_ms=25,
+            tempo_detached_send_interval_ms=25,
+            executor_executed_notification_interval_ms=25,
+        )
+        handles, client_addr, _ = await _boot_cluster(Tempo, config)
+        workload = Workload(
+            shard_count=1,
+            key_gen=ConflictPool(conflict_rate=50, pool_size=1),
+            keys_per_command=1,
+            commands_per_client=COMMANDS,
+            payload_size=1,
+        )
+        h0 = handles[0]
+        cids = [1, 2, 3, 4]
+        res = await run_client(
+            cids,
+            {0: client_addr[h0.process_id]},
+            {0: h0.process_id},
+            workload,
+            batch_max_size=len(cids),
+            batch_max_delay_ms=20,
+            command_timeout_s=30,
+        )
+        assert all(
+            len(d.latency_data()) == COMMANDS for d in res.data.values()
+        )
+        total = COMMANDS * len(cids)
+        assert 0 < res.submits < total, (
+            f"batching never merged: {res.submits} submits / {total} cmds"
+        )
+        for h in handles:
+            await h.stop()
+
+    asyncio.run(main())
+
+
+def test_run_tempo_workers():
+    """Worker axis (run/mod.rs:575-849 runs workers=2-4): protocol
+    messages route to one of W cooperative workers by MessageIndex —
+    dot messages shift past the two reserved workers, GC stays on
+    worker 0, clock-bump traffic on worker 1 — with submits pre-dotted
+    by the server-side dot generator so a dot's lifetime stays on one
+    worker. Full-stack invariants must hold unchanged."""
+    _run(
+        Tempo,
+        Config(n=3, f=1, tempo_detached_send_interval_ms=25),
+        workers=3,
+    )
+
+
+def test_run_atlas_workers():
+    _run(Atlas, Config(n=3, f=1), workers=2)
+
+
+def test_run_fpaxos_workers():
+    """Leader-based routing: submits and forwards pin to the leader
+    worker, accepts/chosen to the acceptor worker, commanders shift by
+    slot (fpaxos.rs:383-453)."""
+    _run(FPaxos, Config(n=3, f=1, leader=1), workers=4)
+
+
+def test_run_tempo_table_executor_pool():
+    """Table-executor pool (workers × executors like the reference's
+    2-4 × 1-3 shapes): multi-key commands split keys across pool
+    members; the shared stability-count map (the reference's SharedMap,
+    executor.rs:318-330) lets rifls complete across members."""
+
+    async def main():
+        config = Config(
+            n=3, f=1,
+            executor_monitor_execution_order=True,
+            gc_interval_ms=25,
+            tempo_detached_send_interval_ms=25,
+            executor_executed_notification_interval_ms=25,
+        )
+        handles, client_addr, _ = await _boot_cluster(
+            Tempo, config, workers=2, executors=2
+        )
+        workload = Workload(
+            shard_count=1,
+            key_gen=ConflictPool(conflict_rate=50, pool_size=4),
+            keys_per_command=2,
+            commands_per_client=COMMANDS,
+            payload_size=1,
+        )
+        h0 = handles[0]
+        res = await run_client(
+            [1, 2],
+            {0: client_addr[h0.process_id]},
+            {0: h0.process_id},
+            workload,
+            command_timeout_s=30,
+        )
+        assert all(
+            len(d.latency_data()) == COMMANDS for d in res.data.values()
+        )
+        for h in handles:
+            monitors = h.monitors()
+            assert len(monitors) == 2
+            keys0 = set(monitors[0].keys())
+            keys1 = set(monitors[1].keys())
+            assert keys0.isdisjoint(keys1)
+            # multi-key commands spread over the pool: the shared
+            # count map must have drained (every rifl completed)
+            assert not h.executors[0].rifl_to_stable_count
+            assert (
+                h.executors[0].rifl_to_stable_count
+                is h.executors[1].rifl_to_stable_count
+            )
+        for h in handles:
+            await h.stop()
+
+    asyncio.run(main())
+
+
+def test_run_tempo_multiplexing():
+    """Connection multiplexing (run/mod.rs:113, task/server/mod.rs:
+    226-310): three TCP connections per peer with sends spread
+    round-robin; cross-connection ordering is not guaranteed (the
+    reference picks writers at random) and the protocols' buffered
+    paths absorb it — full-stack invariants hold unchanged."""
+
+    async def main():
+        config = Config(
+            n=3, f=1,
+            executor_monitor_execution_order=True,
+            gc_interval_ms=25,
+            tempo_detached_send_interval_ms=25,
+            executor_executed_notification_interval_ms=25,
+        )
+        handles, client_addr, _ = await _boot_cluster(
+            Tempo, config, multiplexing=3
+        )
+        workload = Workload(
+            shard_count=1,
+            key_gen=ConflictPool(conflict_rate=50, pool_size=1),
+            keys_per_command=2,
+            commands_per_client=COMMANDS,
+            payload_size=1,
+        )
+        h0 = handles[0]
+        res = await run_client(
+            [1, 2],
+            {0: client_addr[h0.process_id]},
+            {0: h0.process_id},
+            workload,
+            command_timeout_s=30,
+        )
+        assert all(
+            len(d.latency_data()) == COMMANDS for d in res.data.values()
+        )
+        monitors = {h.process_id: h.monitors()[0] for h in handles}
+        check_monitors(monitors)
         for h in handles:
             await h.stop()
 
