@@ -28,6 +28,70 @@ from .core.planet import Planet
 
 ENGINE_PROTOCOLS = ("basic", "fpaxos", "tempo", "atlas", "epaxos", "caesar")
 
+# subcommands that run device computations; everything else is
+# host-only and gets the CPU backend outright so a dead device
+# backend can never hang it
+DEVICE_COMMANDS = ("sweep",)
+
+
+def _force_cpu() -> None:
+    """Force the CPU backend (fantoch_tpu.platform holds the
+    site-hook-safe recipe shared with bench/graft smoke runs)."""
+    from .platform import force_cpu
+
+    force_cpu()
+
+
+def _probe_backend(timeout_s: float) -> bool:
+    """Check device-backend liveness in a throwaway subprocess.
+
+    Backend init happens inside a C extension and can block for many
+    minutes when the tunnel is down, so an in-process attempt cannot be
+    cancelled — a subprocess with a hard timeout can.
+    """
+    import subprocess
+
+    check = (
+        "import jax; ds = jax.devices(); "
+        "assert any(d.platform != 'cpu' for d in ds), 'cpu only'"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", check],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def _apply_platform(platform: str, cmd: str) -> None:
+    import os
+
+    if platform == "cpu" or cmd not in DEVICE_COMMANDS:
+        # host-only subcommands never touch a device: no probe, no
+        # fail-fast, whatever --platform says
+        _force_cpu()
+        return
+    timeout_s = float(os.environ.get("FANTOCH_PROBE_TIMEOUT", "60"))
+    print(
+        f"probing device backend (timeout {timeout_s:.0f}s)...",
+        file=sys.stderr,
+    )
+    if _probe_backend(timeout_s):
+        return
+    if platform == "tpu":
+        raise SystemExit(
+            "device backend unreachable (probe timed out after "
+            f"{timeout_s:.0f}s); retry later or pass --platform cpu"
+        )
+    print(
+        "device backend unreachable; falling back to --platform cpu",
+        file=sys.stderr,
+    )
+    _force_cpu()
+
 
 def _ints(s: str) -> List[int]:
     return [int(x) for x in s.split(",") if x != ""]
@@ -47,28 +111,12 @@ def _build_config(name: str, n: int, f: int, args) -> Config:
 
 
 def _engine_protocol(name: str, clients: int):
-    from .engine.protocols import (
-        AtlasDev,
-        BasicDev,
-        CaesarDev,
-        EPaxosDev,
-        FPaxosDev,
-        TempoDev,
-    )
+    from .engine.protocols import dev_protocol
 
-    if name == "tempo":
-        return TempoDev.for_load(keys=1 + clients, clients=clients)
-    if name == "basic":
-        return BasicDev
-    if name == "fpaxos":
-        return FPaxosDev
-    if name == "atlas":
-        return AtlasDev(keys=1 + clients)
-    if name == "epaxos":
-        return EPaxosDev(keys=1 + clients)
-    if name == "caesar":
-        return CaesarDev(keys=1 + clients)
-    raise SystemExit(f"unknown protocol {name!r}")
+    try:
+        return dev_protocol(name, clients)
+    except ValueError as e:
+        raise SystemExit(str(e))
 
 
 def _oracle_protocol(name: str):
@@ -310,6 +358,33 @@ def cmd_plot(args) -> None:
     print(json.dumps({"plotted": len(series), "out": args.out}))
 
 
+def cmd_expplot(args) -> None:
+    """Experiment-dir plot families (fantoch_plot lib.rs:500-626
+    throughput-vs-latency; lib.rs:1619-1974 dstat/process tables)."""
+    from .plot import (
+        dstat_table,
+        experiment_points,
+        process_metrics_table,
+        throughput_latency_plot,
+    )
+
+    out = {}
+    if args.out:
+        series = experiment_points(args.dirs)
+        throughput_latency_plot(series, args.out, title=args.title)
+        out["plot"] = args.out
+        out["series"] = {k: len(v) for k, v in series.items()}
+    if args.tables:
+        with open(args.tables, "w") as fh:
+            fh.write("## dstat\n\n")
+            fh.write(dstat_table(args.dirs))
+            fh.write("\n\n## process metrics\n\n")
+            fh.write(process_metrics_table(args.dirs))
+            fh.write("\n")
+        out["tables"] = args.tables
+    print(json.dumps(out))
+
+
 def _kv_pairs(s: str, parse=str):
     """"2=a,3=b" -> {2: parse("a"), 3: parse("b")}."""
     out = {}
@@ -364,7 +439,9 @@ def cmd_proc(args) -> None:
             listen=("0.0.0.0", args.port),
             client_listen=("0.0.0.0", args.client_port),
             sorted_processes=sorted_ps,
+            workers=args.workers,
             executors=args.executors,
+            multiplexing=args.multiplexing,
             delay_ms=args.delay,
             metrics_file=args.metrics_file,
             metrics_interval_ms=args.metrics_interval,
@@ -415,6 +492,9 @@ def cmd_client(args) -> None:
             shard_processes,
             workload,
             open_loop_interval_ms=args.open_loop_interval,
+            batch_max_size=args.batch_max_size,
+            batch_max_delay_ms=args.batch_max_delay,
+            command_timeout_s=args.command_timeout,
         )
     )
     out = {
@@ -444,6 +524,14 @@ def main(argv=None) -> None:
 
     init_tracing()
     parser = argparse.ArgumentParser(prog="fantoch_tpu")
+    parser.add_argument(
+        "--platform",
+        default="auto",
+        choices=["auto", "cpu", "tpu"],
+        help="device backend: cpu forces the host backend; tpu requires "
+        "a live device (fail-fast probe); auto probes for device "
+        "subcommands and falls back to cpu",
+    )
     sub = parser.add_subparsers(dest="cmd", required=True)
 
     sim = sub.add_parser("sim", help="one oracle DES run (exact)")
@@ -489,7 +577,10 @@ def main(argv=None) -> None:
                     help="peer shard ids: 2=0,3=1 (default all 0)")
     pr.add_argument("--sorted", default=None,
                     help="discovery order: id:shard,id:shard,...")
+    pr.add_argument("--workers", type=int, default=1)
     pr.add_argument("--executors", type=int, default=1)
+    pr.add_argument("--multiplexing", type=int, default=1,
+                    help="TCP connections per peer")
     pr.add_argument("--delay", type=int, default=0,
                     help="artificial per-connection delay (ms)")
     pr.add_argument("--metrics-file", default=None)
@@ -516,6 +607,12 @@ def main(argv=None) -> None:
     cl.add_argument("--payload-size", type=int, default=0)
     cl.add_argument("--shard-count", type=int, default=1)
     cl.add_argument("--open-loop-interval", type=int, default=None)
+    cl.add_argument("--batch-max-size", type=int, default=1,
+                    help="merge up to this many commands per submit")
+    cl.add_argument("--batch-max-delay", type=float, default=5.0,
+                    help="max batching slack (ms)")
+    cl.add_argument("--command-timeout", type=float, default=None,
+                    help="fail loudly if a result takes longer (s)")
     cl.add_argument("--output", default=None)
     cl.set_defaults(fn=cmd_client)
 
@@ -529,7 +626,19 @@ def main(argv=None) -> None:
     pl.add_argument("--max-series", type=int, default=8)
     pl.set_defaults(fn=cmd_plot)
 
+    ep = sub.add_parser(
+        "expplot", help="plots/tables from experiment directories"
+    )
+    ep.add_argument("--dirs", nargs="+", required=True)
+    ep.add_argument("--out", default=None,
+                    help="throughput-vs-latency PNG path")
+    ep.add_argument("--tables", default=None,
+                    help="dstat + process-metrics markdown path")
+    ep.add_argument("--title", default=None)
+    ep.set_defaults(fn=cmd_expplot)
+
     args = parser.parse_args(argv)
+    _apply_platform(args.platform, args.cmd)
     args.fn(args)
 
 

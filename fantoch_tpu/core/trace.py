@@ -43,10 +43,14 @@ def init_tracing(
     instead of stderr. Idempotent; returns the package root logger."""
     global _initialized
     explicit = level is not None or log_file is not None
-    level = level or os.environ.get("FANTOCH_TRACE", "off")
-    # an env-driven (implicit) init never downgrades an explicit setup
-    if explicit or not _initialized:
+    # the level only changes when passed as an argument (or on first
+    # init, from the env); a file-only re-init keeps the prior level
+    # instead of downgrading it to $FANTOCH_TRACE/off
+    if level is not None:
         _root.setLevel(_LEVELS.get(level.lower(), logging.INFO))
+    elif not _initialized:
+        env = os.environ.get("FANTOCH_TRACE", "off")
+        _root.setLevel(_LEVELS.get(env.lower(), logging.INFO))
     if explicit or not _initialized:
         # an explicit re-init replaces the handlers (e.g. switching to a
         # log file after an implicit boot-time init)

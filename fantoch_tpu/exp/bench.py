@@ -161,15 +161,19 @@ def bench_experiment(
         for shard in range(exp.shard_count)
         for pid in process_ids(shard, exp.n)
     ]
-    ports = _free_ports(2 * len(ids))
-    port_of = {pid: ports[2 * i] for i, (pid, _) in enumerate(ids)}
-    cport_of = {pid: ports[2 * i + 1] for i, (pid, _) in enumerate(ids)}
-
     servers: List[subprocess.Popen] = []
     client_procs: List[subprocess.Popen] = []
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_REPO)
     dstat0 = _proc_snapshot()
-    try:
+
+    def _start_servers():
+        """Spawn all servers on freshly probed ports; returns the port
+        maps once every started marker has been seen."""
+        ports = _free_ports(2 * len(ids))
+        port_of = {pid: ports[2 * i] for i, (pid, _) in enumerate(ids)}
+        cport_of = {
+            pid: ports[2 * i + 1] for i, (pid, _) in enumerate(ids)
+        }
         for pid, shard in ids:
             mine = process_ids(shard, exp.n)
             idx = mine.index(pid)
@@ -218,6 +222,31 @@ def bench_experiment(
             [f"process {pid} started" for pid, _ in ids],
             time.monotonic() + start_timeout_s,
         )
+        return port_of, cport_of
+
+    try:
+        # _free_ports only shrinks the reuse window: a concurrent
+        # process can still steal a probed port before the server
+        # binds it, so a bind failure retries the whole server start
+        # on fresh ports instead of failing the experiment
+        for attempt in range(3):
+            try:
+                port_of, cport_of = _start_servers()
+                break
+            except RuntimeError as e:
+                for proc in servers:
+                    if proc.poll() is None:
+                        proc.send_signal(signal.SIGTERM)
+                for proc in servers:
+                    try:
+                        proc.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+                servers.clear()
+                if "address already in use" not in str(e).lower():
+                    raise
+                if attempt == 2:
+                    raise
         for proc in servers:
             _drain(proc)
 

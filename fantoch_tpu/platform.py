@@ -1,0 +1,41 @@
+"""JAX platform selection under the axon site hook.
+
+The deployment environment pre-imports jax and presets JAX_PLATFORMS to
+the tunneled device backend, so a plain env-var override is too late —
+but XLA backends initialize lazily, so flipping the jax config before
+the first computation still wins (the same trick as tests/conftest.py).
+Every entry point that needs to force the CPU backend (CLI, bench
+smoke runs, the standalone graft check) goes through here so the
+recipe lives in one place.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def force_cpu(devices: int = 8) -> None:
+    """Force the CPU backend at jax-config level (and export the env
+    var for subprocesses). Cheap when jax is not yet imported."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if "jax" not in sys.modules:
+        # env var wins for everything imported from here on; skipping
+        # the import keeps host-only paths free of jax startup cost
+        return
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", devices)
+    except Exception:
+        pass  # backend already initialized; keep its device count
+
+
+def force_cpu_from_env(devices: int = 8) -> bool:
+    """Apply :func:`force_cpu` when the caller's environment asks for
+    the CPU backend (JAX_PLATFORMS=cpu); returns whether it did."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        force_cpu(devices)
+        return True
+    return False

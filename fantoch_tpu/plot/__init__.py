@@ -9,12 +9,22 @@ families: latency bars, CDFs, throughput-vs-latency).
 """
 
 from .db import load_results, save_results
+from .experiment import (
+    dstat_table,
+    experiment_points,
+    process_metrics_table,
+    throughput_latency_plot,
+)
 from .latency import cdf_plot, conflict_latency_plot, latency_bar_plot
 
 __all__ = [
     "cdf_plot",
     "conflict_latency_plot",
+    "dstat_table",
+    "experiment_points",
     "latency_bar_plot",
     "load_results",
+    "process_metrics_table",
     "save_results",
+    "throughput_latency_plot",
 ]

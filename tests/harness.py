@@ -9,15 +9,22 @@ and its checks:
   for FPaxos).
 
 Message reordering is enabled (delay ×U(0,10)) like the reference.
+
+Scale matches the reference's sim_test — 10 clients per process × 100
+commands (mod.rs:660) — reduced under the ``CI`` env var exactly like
+the reference reduces its own load there (mod.rs:88-113).
 """
+
+import os
 
 from fantoch_tpu.client import ConflictPool, Workload
 from fantoch_tpu.core import Config, Planet
 from fantoch_tpu.protocol.base import ProtocolMetricsKind
 from fantoch_tpu.sim import Runner
 
-COMMANDS_PER_CLIENT = 20
-CLIENTS_PER_PROCESS = 3
+_CI = bool(os.environ.get("CI"))
+COMMANDS_PER_CLIENT = 20 if _CI else 100
+CLIENTS_PER_PROCESS = 3 if _CI else 10
 KEY_GEN = ConflictPool(conflict_rate=50, pool_size=1)
 
 

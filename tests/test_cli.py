@@ -1,0 +1,113 @@
+"""Smoke tests for the CLI surface (fantoch_ps/src/bin analogs).
+
+proc/client are exercised end-to-end by test_exp.py; here the
+remaining subcommands — sim, sweep, bote, plot — run in-process with
+``--platform cpu`` so the suite passes with no device present
+(the reference's binaries are likewise runnable anywhere).
+"""
+
+import json
+
+import pytest
+
+from fantoch_tpu.cli import main
+
+
+def _run(capsys, *argv):
+    main(list(argv))
+    return capsys.readouterr().out
+
+
+def test_cli_sim(capsys):
+    out = _run(
+        capsys,
+        "--platform", "cpu",
+        "sim",
+        "--protocol", "basic",
+        "--n", "3",
+        "--f", "1",
+        "--commands", "5",
+        "--conflict", "0",
+    )
+    data = json.loads(out)
+    assert data["protocol"] == "basic"
+    assert len(data["regions"]) == 3
+    for stats in data["regions"].values():
+        assert stats["issued"] == 5
+        assert stats["mean_ms"] > 0
+
+
+def test_cli_sweep_and_plot(capsys, tmp_path):
+    results = str(tmp_path / "sweep.jsonl")
+    out = _run(
+        capsys,
+        "--platform", "cpu",
+        "sweep",
+        "--protocol", "fpaxos",
+        "--n", "3",
+        "--fs", "1",
+        "--conflicts", "0,100",
+        "--subsets", "2",
+        "--commands", "5",
+        "--out", results,
+    )
+    data = json.loads(out)
+    assert data["points"] == 4
+    assert data["errors"] == 0
+
+    png = str(tmp_path / "out.png")
+    out = _run(
+        capsys,
+        "--platform", "cpu",
+        "plot",
+        "--results", results,
+        "--kind", "cdf",
+        "--match", "conflict=0",
+        "--out", png,
+    )
+    data = json.loads(out)
+    assert data["plotted"] == 2
+    assert (tmp_path / "out.png").stat().st_size > 0
+
+
+def test_cli_bote(capsys):
+    out = _run(
+        capsys,
+        "--platform", "cpu",
+        "bote",
+        "--min-n", "3",
+        "--max-n", "3",
+        "--top", "1",
+    )
+    data = json.loads(out)
+    assert "3" in data or 3 in data
+
+
+def test_cli_platform_tpu_fail_fast(monkeypatch):
+    """--platform tpu exits with a clear message when the probe fails."""
+    import fantoch_tpu.cli as cli
+
+    monkeypatch.setattr(cli, "_probe_backend", lambda t: False)
+    with pytest.raises(SystemExit) as exc:
+        main(["--platform", "tpu", "sweep", "--protocol", "basic"])
+    assert "unreachable" in str(exc.value)
+
+
+def test_cli_platform_auto_host_only_never_probes(capsys, monkeypatch):
+    """Host-only subcommands never touch the device backend."""
+    import fantoch_tpu.cli as cli
+
+    def boom(t):  # pragma: no cover - must not be called
+        raise AssertionError("probe ran for a host-only subcommand")
+
+    monkeypatch.setattr(cli, "_probe_backend", boom)
+    out = _run(
+        capsys,
+        "sim",
+        "--protocol", "basic",
+        "--n", "3",
+        "--f", "0",
+        "--commands", "2",
+        "--conflict", "0",
+    )
+    assert json.loads(out)["slow_path"] == 0

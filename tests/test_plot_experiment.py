@@ -1,0 +1,95 @@
+"""Experiment-dir plot families: throughput-vs-latency + tables.
+
+Synthesizes two experiment directories in the exact on-disk shape
+``fantoch_tpu.exp.bench_experiment`` produces (exp_config.json,
+client_*.json latency series, .metrics_process_* pickles, dstat.json)
+and renders every family the reference's fantoch_plot ships for them
+(lib.rs:500-626 throughput; lib.rs:1619-1974 tables).
+"""
+
+import json
+import os
+import pickle
+
+from fantoch_tpu.core.metrics import Metrics
+from fantoch_tpu.plot import (
+    dstat_table,
+    experiment_points,
+    process_metrics_table,
+    throughput_latency_plot,
+)
+from fantoch_tpu.protocol.base import ProtocolMetricsKind
+
+
+def _fake_experiment(root, protocol, clients, lat_ms):
+    run_dir = os.path.join(root, f"{protocol}_c{clients}")
+    os.makedirs(run_dir)
+    with open(os.path.join(run_dir, "exp_config.json"), "w") as fh:
+        json.dump(
+            {
+                "protocol": protocol,
+                "n": 3,
+                "f": 1,
+                "shard_count": 1,
+                "clients": clients,
+                "commands_per_client": 4,
+                "conflict": 50,
+            },
+            fh,
+        )
+    lat_us = lat_ms * 1000
+    with open(os.path.join(run_dir, "client_1.json"), "w") as fh:
+        json.dump(
+            {str(cid): [lat_us] * 4 for cid in range(1, clients + 1)}, fh
+        )
+    for pid in (1, 2, 3):
+        pm = Metrics()
+        pm.aggregate(ProtocolMetricsKind.FAST_PATH, clients * 4)
+        pm.aggregate(ProtocolMetricsKind.STABLE, clients * 4)
+        with open(
+            os.path.join(run_dir, f".metrics_process_{pid}"), "wb"
+        ) as fh:
+            pickle.dump(
+                {"process_id": pid, "shard_id": 0, "protocol": pm,
+                 "executors": []},
+                fh,
+            )
+    with open(os.path.join(run_dir, "dstat.json"), "w") as fh:
+        json.dump(
+            {
+                "start": {"time": 0.0, "cpu_jiffies": 1000.0,
+                          "memavailable": 800_000.0},
+                "end": {"time": 2.5, "cpu_jiffies": 1600.0,
+                        "memavailable": 750_000.0},
+            },
+            fh,
+        )
+    return run_dir
+
+
+def test_throughput_latency_and_tables(tmp_path):
+    dirs = [
+        _fake_experiment(str(tmp_path), "tempo", 2, lat_ms=40),
+        _fake_experiment(str(tmp_path), "tempo", 8, lat_ms=60),
+        _fake_experiment(str(tmp_path), "atlas", 2, lat_ms=55),
+    ]
+    series = experiment_points(dirs)
+    assert set(series) == {"tempo", "atlas"}
+    assert len(series["tempo"]) == 2
+    # closed loop: throughput = issued / mean client run time;
+    # 2 clients × 4 cmds at 40 ms each → 8 / 0.16 s = 50 ops/s
+    tp, lat = series["tempo"][0]
+    assert lat == 40.0
+    assert abs(tp - 50.0) < 1e-6
+    # more clients, higher latency ⇒ curve bends right and up
+    tp2, lat2 = series["tempo"][1]
+    assert tp2 > tp and lat2 > lat
+
+    png = str(tmp_path / "tp.png")
+    throughput_latency_plot(series, png, title="tp vs lat")
+    assert os.path.getsize(png) > 0
+
+    table = dstat_table(dirs)
+    assert "cpu (jiffies)" in table and "| 600 |" in table
+    ptable = process_metrics_table(dirs)
+    assert "| tempo n=3 f=1 | 1 | 8 | 0 | 8 |" in ptable

@@ -40,3 +40,11 @@ def test_sim_real_time_tempo_3_1():
 
 def test_sim_real_time_tempo_5_1():
     assert sim_test(Tempo, tempo_config(5, 1, clock_bump_interval_ms=50)) == 0
+
+
+@pytest.mark.parametrize("seed", [1, 2, 4])
+def test_sim_tempo_3_1_reorder_seeds(seed):
+    """Reference-scale reorder runs across distinct seeds (the
+    reference reruns its randomized sim_test on every CI invocation;
+    fixed seeds keep ours deterministic while varying the schedules)."""
+    assert sim_test(Tempo, tempo_config(3, 1), seed=seed) == 0

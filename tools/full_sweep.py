@@ -26,36 +26,15 @@ from fantoch_tpu.core import Config, Planet  # noqa: E402
 
 
 def build_protocol(name, n, clients):
-    from fantoch_tpu.engine.protocols import (
-        AtlasDev,
-        BasicDev,
-        CaesarDev,
-        EPaxosDev,
-        FPaxosDev,
-        TempoDev,
-    )
+    from fantoch_tpu.engine.protocols import dev_protocol
 
-    keys = 1 + clients
-    if name == "tempo":
-        return TempoDev.for_load(keys=keys, clients=clients)
-    return {
-        "basic": lambda: BasicDev,
-        "fpaxos": lambda: FPaxosDev,
-        "atlas": lambda: AtlasDev(keys=keys),
-        "epaxos": lambda: EPaxosDev(keys=keys),
-        "caesar": lambda: CaesarDev(keys=keys),
-    }[name]()
+    return dev_protocol(name, clients)
 
 
 def config_for(name, n, f):
-    kw = dict(n=n, f=f, gc_interval_ms=100)
-    if name == "tempo":
-        kw["tempo_detached_send_interval_ms"] = 100
-    if name == "fpaxos":
-        kw["leader"] = 1
-    if name == "caesar":
-        kw["caesar_wait_condition"] = True
-    return Config(**kw)
+    from fantoch_tpu.engine.protocols import dev_config_kwargs
+
+    return Config(**dev_config_kwargs(name, n, f))
 
 
 def main() -> None:

@@ -29,29 +29,26 @@ from fantoch_tpu.engine.spec import make_lane  # noqa: E402
 from fantoch_tpu.parallel.sweep import run_sweep  # noqa: E402
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--n", type=int, default=9)
-    ap.add_argument("--commands", type=int, default=100_000,
-                    help="total commands per lane")
-    ap.add_argument("--clients-per-region", type=int, default=4)
-    ap.add_argument("--zipf-coefficient", type=float, default=0.7)
-    ap.add_argument("--zipf-keys", type=int, default=128)
-    ap.add_argument("--dot-slots", type=int, default=2048)
-    ap.add_argument("--pool", type=int, default=4096,
-                    help="message-pool capacity (ERR_POOL if exceeded)")
-    ap.add_argument("--quick", action="store_true",
-                    help="1/10th of the commands (CI-sized)")
-    args = ap.parse_args()
-
+def run_stress(
+    n: int = 9,
+    commands: int = 100_000,
+    clients_per_region: int = 4,
+    zipf_coefficient: float = 0.7,
+    zipf_keys: int = 128,
+    dot_slots: int = 2048,
+    pool: int = 4096,
+    segment_steps: int = 4096,
+) -> dict:
+    """One stress lane; returns the report dict after asserting a clean
+    run (err == 0, every command completed). Callable from pytest
+    (tests/test_stress.py runs a CPU-sized shape whose per-source dot
+    window still recycles several times)."""
     planet = Planet.new()
-    n = args.n
     regions = planet.regions()[:n]
-    clients = n * args.clients_per_region
-    total = args.commands // (10 if args.quick else 1)
-    per_client = max(1, total // clients)
+    clients = n * clients_per_region
+    per_client = max(1, commands // clients)
 
-    dev = TempoDev.for_load(keys=args.zipf_keys, clients=clients)
+    dev = TempoDev.for_load(keys=zipf_keys, clients=clients)
     dims = EngineDims.for_protocol(
         dev,
         n=n,
@@ -59,8 +56,8 @@ def main() -> None:
         payload=dev.payload_width(n),
         # recycled windows, sized for GC lag not lifetime totals — the
         # whole point of the stress; overflow is loud (ERR_*/requeues)
-        dot_slots=args.dot_slots,
-        pool=args.pool,
+        dot_slots=dot_slots,
+        pool=pool,
         regions=n,
         hist_buckets=2048,
     )
@@ -72,24 +69,24 @@ def main() -> None:
         planet,
         config,
         conflict_rate=0,  # zipf generator decides contention instead
-        zipf=(args.zipf_coefficient, args.zipf_keys),
+        zipf=(zipf_coefficient, zipf_keys),
         commands_per_client=per_client,
-        clients_per_region=args.clients_per_region,
+        clients_per_region=clients_per_region,
         process_regions=regions,
         client_regions=regions,
         dims=dims,
     )
 
     t0 = time.perf_counter()
-    res = run_sweep(dev, dims, [spec], segment_steps=4096)[0]
+    res = run_sweep(dev, dims, [spec], segment_steps=segment_steps)[0]
     elapsed = time.perf_counter() - t0
     report = {
         "n": n,
         "clients": clients,
         "commands": per_client * clients,
-        "zipf": [args.zipf_coefficient, args.zipf_keys],
-        "dot_slots": args.dot_slots,
-        "pool": args.pool,
+        "zipf": [zipf_coefficient, zipf_keys],
+        "dot_slots": dot_slots,
+        "pool": pool,
         "completed": res.completed,
         "steps": res.steps,
         "pool_peak": res.pool_peak,
@@ -104,6 +101,32 @@ def main() -> None:
     print(json.dumps(report))
     assert res.err == 0, res.err_cause
     assert res.completed == per_client * clients
+    return report
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=9)
+    ap.add_argument("--commands", type=int, default=100_000,
+                    help="total commands per lane")
+    ap.add_argument("--clients-per-region", type=int, default=4)
+    ap.add_argument("--zipf-coefficient", type=float, default=0.7)
+    ap.add_argument("--zipf-keys", type=int, default=128)
+    ap.add_argument("--dot-slots", type=int, default=2048)
+    ap.add_argument("--pool", type=int, default=4096,
+                    help="message-pool capacity (ERR_POOL if exceeded)")
+    ap.add_argument("--quick", action="store_true",
+                    help="1/10th of the commands (CI-sized)")
+    args = ap.parse_args()
+    run_stress(
+        n=args.n,
+        commands=args.commands // (10 if args.quick else 1),
+        clients_per_region=args.clients_per_region,
+        zipf_coefficient=args.zipf_coefficient,
+        zipf_keys=args.zipf_keys,
+        dot_slots=args.dot_slots,
+        pool=args.pool,
+    )
 
 
 if __name__ == "__main__":
