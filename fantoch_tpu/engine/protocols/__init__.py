@@ -11,11 +11,13 @@ from .basic import BasicDev
 from .caesar import CaesarDev
 from .fpaxos import FPaxosDev
 from .graphdep import AtlasDev, EPaxosDev
+from .graphdep_partial import AtlasPartialDev
 from .tempo import TempoDev
 from .tempo_partial import TempoPartialDev
 
 __all__ = [
     "AtlasDev",
+    "AtlasPartialDev",
     "BasicDev",
     "CaesarDev",
     "EPaxosDev",

@@ -1,0 +1,1118 @@
+"""Device Atlas with partial replication and multi-key commands.
+
+The partial-mode twin of :class:`AtlasDev` — the dependency-protocol
+core (fantoch_ps/src/protocol/atlas.rs, host oracle protocol/atlas.py)
+plus the reference's shard coordination and the graph executor's
+cross-shard dependency protocol:
+
+- ``MForwardSubmit`` hands the dot to the closest process of every
+  other touched shard (partial.rs:8-35); each shard runs its own
+  collect round over *its* keys' dependencies;
+- per-shard dep sets aggregate at the dot owner — ``MShardCommit``
+  carries a shard's decided deps, the owner unions them and sends
+  ``MShardAggregatedCommit`` back (partial.rs:37-167, atlas.py
+  _handle_mshard_commit: the aggregation is a set union); every shard
+  coordinator then broadcasts the final ``MCommit`` inside its shard;
+- the graph executor requests vertices owned by remote shards
+  (executor-to-executor ``Request``/``RequestReply``,
+  executor/graph/mod.rs:279-408): a committed-but-blocked dependency
+  whose command does not touch this shard is fetched from the closest
+  process of the dot owner's shard; the responder answers with the
+  vertex (command identity + its aggregated deps) or an
+  executed marker, buffering unknown dots until the periodic cleanup
+  tick re-checks them (task/server/executor.rs:281-330);
+- clients aggregate per-key result partials — the engine core's
+  ``cmd_parts`` completion counting; a vertex executes all of this
+  shard's keys at once (graph/mod.rs _execute).
+
+Dependencies are (source, sequence, shard-bitmask) triples: the mask
+is the dep command's touched shards, which decides replicated-here
+(request needed?) exactly like the reference's ``Dependency.shards``
+(deps/keys/mod.rs:19-35). Commands are otherwise ctx-determined by
+(client, cseq) via the lane's ``cmd_skey``/``cmd_kmask`` tables
+(engine/spec.py), so messages carry identity, not key lists.
+
+Single-shard single-key lanes should use :class:`AtlasDev`; this class
+exists for ``shard_count > 1`` / ``keys_per_cmd > 1`` lanes and matches
+the oracle on tie-free schedules (tests/test_engine_partial.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import (
+    I32, compact_order, emit, emit_broadcast, empty_outbox, oh_get,
+    oh_set, oh_set2, oh_take,
+)
+from ..dims import (
+    ERR_CAPACITY, ERR_DOT, ERR_PROTO, ERR_SEQ, INF, SEQ_BOUND, EngineDims,
+    dot_slot,
+)
+from ..iset import iset_add, iset_contains, iset_contains_gathered
+from .graphdep import AtlasDev
+from .tempo_partial import (
+    _cmd_tables,
+    _get2,
+    _my_keys,
+    _p_mgc,
+    _popcount,
+    _shard_base,
+    _shard_mask,
+)
+
+
+class AtlasPartialDev(AtlasDev):
+    SUBMIT = 0
+    MCOLLECT = 1
+    MCOLLECTACK = 2
+    MCOMMIT = 3
+    MCONSENSUS = 4
+    MCONSENSUSACK = 5
+    MGC = 6
+    MDRAIN = 7
+    MFWDSUBMIT = 8
+    MSHARDCOMMIT = 9
+    MSHARDAGG = 10
+    GREQ = 11
+    GREPLY = 12
+    GREPLYEXEC = 13
+    NUM_TYPES = 14
+    TO_CLIENT = 15
+
+    PERIODIC_ROWS = 2  # [garbage collection, executor cleanup]
+
+    # buffered cross-shard requests awaiting a local commit
+    B = 8
+
+    def __init__(
+        self,
+        keys: int,
+        shards: int = 2,
+        keys_per_cmd: int = 2,
+        gap_slots: int = 8,
+    ):
+        super().__init__(keys, gap_slots)
+        self.S = shards
+        self.KPC = keys_per_cmd
+
+    # -- host-side builders -------------------------------------------
+
+    def q_shard(self, n: int) -> int:
+        """Per-shard dep-slot bound: each of the n reporters contributes
+        up to KPC latest deps plus the coordinator's KPC."""
+        return self.KPC * (n + 1)
+
+    def q_union(self, n: int) -> int:
+        """Aggregated (cross-shard union) dep bound."""
+        return self.S * self.q_shard(n)
+
+    def payload_width(self, n: int) -> int:
+        # MCommit/GReply: [dsrc, dseq, client, cseq, nd] + 3 * QS
+        # MGC: the committed frontier over all S*n sources
+        return max(5 + 3 * self.q_union(n), self.S * n, 8)
+
+    def fanout(self, n: int) -> int:
+        N = self.S * n
+        # shard broadcast + forwards; cleanup replies ride slots
+        # N+1..N+B on the periodic outbox; drain needs KPC client
+        # partials + one request + the chain slot
+        return max(N + self.B + 2, N + self.S + 2, self.KPC + 3)
+
+    def periodic_intervals(self, config, dims: EngineDims):
+        gc = config.gc_interval_ms
+        cl = config.executor_cleanup_interval_ms
+        return [gc if gc is not None else INF, cl if cl else INF]
+
+    def lane_ctx(self, config, dims: EngineDims, sorted_idx: np.ndarray):
+        N, n, S = dims.N, config.n, config.shard_count
+        fq_size, wq_size = self._quorum_sizes(config)
+        fq = np.zeros((N, N), bool)
+        wq = np.zeros((N, N), bool)
+        for s in range(S):
+            for p in range(n):
+                row = s * n + p
+                for member in sorted_idx[p][:fq_size]:
+                    fq[row, s * n + member] = True
+                for member in sorted_idx[p][:wq_size]:
+                    wq[row, s * n + member] = True
+        ack_self = self._ack_self()
+        return {
+            "fast_quorum": fq,
+            "write_quorum": wq,
+            "expected_acks": np.int32(fq_size if ack_self else fq_size - 1),
+            "fp_mode": np.int32(self._fp_mode()),
+            "ack_self": np.bool_(ack_self),
+        }
+
+    def init_state(self, dims: EngineDims, ctx_np) -> Dict[str, np.ndarray]:
+        N, D, G = dims.N, dims.D, self.G
+        n = int(ctx_np["n"])
+        K, KPC, B = self.K, self.KPC, self.B
+        Q, QS = self.q_shard(n), self.q_union(n)
+        return {
+            # conflict index: latest dep per key, with its command's
+            # shard mask (Dependency.shards)
+            "latest_src": np.zeros((N, K), np.int32),
+            "latest_seq": np.zeros((N, K), np.int32),
+            "latest_km": np.zeros((N, K), np.int32),
+            # per-dot payload pointers (dot → (client, cseq))
+            "seq_in_slot": np.zeros((N, N, D), np.int32),
+            "client_of": np.zeros((N, N, D), np.int32),
+            "cseq_of": np.zeros((N, N, D), np.int32),
+            # coordinator per (dot source, slot): forwarded shard
+            # coordinators track foreign dots
+            "own_seq": np.zeros((N,), np.int32),
+            "ack_cnt": np.zeros((N, N, D), np.int32),
+            "slow_acks": np.zeros((N, N, D), np.int32),
+            "qd_src": np.zeros((N, N, D, Q), np.int32),
+            "qd_seq": np.zeros((N, N, D, Q), np.int32),
+            "qd_km": np.zeros((N, N, D, Q), np.int32),
+            "qd_cnt": np.zeros((N, N, D, Q), np.int32),
+            # shard-union aggregation at the dot owner (own dots)
+            "sh_cnt": np.zeros((N, D), np.int32),
+            "sh_src": np.zeros((N, D, QS), np.int32),
+            "sh_seq": np.zeros((N, D, QS), np.int32),
+            "sh_km": np.zeros((N, D, QS), np.int32),
+            # graph-executor vertex store (aggregated deps)
+            "vx_committed": np.zeros((N, N, D), bool),
+            "vx_seq": np.zeros((N, N, D), np.int32),
+            "vx_client": np.zeros((N, N, D), np.int32),
+            "vx_cseq": np.zeros((N, N, D), np.int32),
+            "vx_nd": np.zeros((N, N, D), np.int32),
+            "vx_dep_src": np.zeros((N, N, D, QS), np.int32),
+            "vx_dep_seq": np.zeros((N, N, D, QS), np.int32),
+            "vx_dep_km": np.zeros((N, N, D, QS), np.int32),
+            # cross-shard request bookkeeping: per-dot requested marker
+            # + buffered incoming requests (requester row, dsrc, dseq)
+            "req_seq": np.zeros((N, N, D), np.int32),
+            "breq_from": np.full((N, B), -1, np.int32),
+            "breq_src": np.zeros((N, B), np.int32),
+            "breq_seq": np.zeros((N, B), np.int32),
+            # executed clock per source
+            "exec_front": np.zeros((N, N), np.int32),
+            "exec_gaps": np.zeros((N, N, G, 2), np.int32),
+            # committed-clock GC (own-shard sources only)
+            "comm_front": np.zeros((N, N), np.int32),
+            "comm_gaps": np.zeros((N, N, G, 2), np.int32),
+            "others_frontier": np.zeros((N, N, N), np.int32),
+            "seen": np.zeros((N, N), bool),
+            "prev_stable": np.zeros((N, N), np.int32),
+            "m_fast": np.zeros((N,), np.int32),
+            "m_slow": np.zeros((N,), np.int32),
+            "m_stable": np.zeros((N,), np.int32),
+            "err": np.zeros((N,), np.int32),
+        }
+
+    # -- device handlers ----------------------------------------------
+
+    def ready(self, ps, msg, me, ctx, dims: EngineDims):
+        t = msg["mtype"]
+        dsrc, dseq = msg["payload"][0], msg["payload"][1]
+        slot = dot_slot(dseq, dims)
+        free = (
+            (_get2(ps["seq_in_slot"], dsrc, slot) == 0)
+            & (_get2(ps["vx_seq"], dsrc, slot) == 0)
+        )
+        have = _get2(ps["seq_in_slot"], dsrc, slot) == dseq
+        ok = jnp.where(t == self.MCOLLECT, free, True)
+        needs_payload = (
+            (t == self.MCOMMIT)
+            | (t == self.MSHARDCOMMIT)
+            | (t == self.MSHARDAGG)
+        )
+        return jnp.where(needs_payload, have, ok)
+
+    def handle(self, ps, msg, me, now, ctx, dims: EngineDims):
+        def _noop(ps, msg):
+            return ps, empty_outbox(dims)
+
+        branches = [
+            lambda ps, msg: _g_submit(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _g_mcollect(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _g_mcollectack(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _g_mcommit(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _g_mconsensus(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _g_mconsensusack(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _g_mgc(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _g_mdrain(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _g_mfwdsubmit(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _g_mshardcommit(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _g_mshardagg(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _g_request(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _g_reply(self, ps, msg, me, ctx, dims),
+            lambda ps, msg: _g_replyexec(self, ps, msg, me, ctx, dims),
+            _noop,
+        ]
+        idx = jnp.clip(msg["mtype"], 0, self.NUM_TYPES)
+        return jax.lax.switch(idx, branches, ps, msg)
+
+    def periodic(self, ps, fire, me, now, ctx, dims: EngineDims):
+        """Row 0: GC frontier broadcast within this shard. Row 1: the
+        executor cleanup tick — answer buffered cross-shard requests
+        whose dots have since committed or executed locally
+        (task/server/executor.rs:281-330; GraphExecutor.cleanup)."""
+        base = _shard_base(ctx, me)
+        ob = emit_broadcast(
+            empty_outbox(dims),
+            self.MGC,
+            ps["comm_front"],
+            ctx["n"],
+            me,
+            exclude_me=True,
+            base=base,
+        )
+        ob = dict(ob, valid=ob["valid"] & fire[0])
+        ps, ob = _g_cleanup(self, ps, me, ctx, dims, ob, fire[1])
+        return ps, ob
+
+# ----------------------------------------------------------------------
+# dep-set helpers: (src, seq, kmask) triples in fixed-width tables
+# ----------------------------------------------------------------------
+
+
+def _dep_row_add(src_row, seq_row, km_row, cnt_row, dsrc, dseq, dkm,
+                 enable):
+    """Merge one dep into a table row (QuorumDeps.add, quorum.rs:24-34):
+    bump its report count when present, else take a free slot. Returns
+    (src, seq, km, cnt, overflow)."""
+    Q = src_row.shape[0]
+    do = jnp.asarray(enable, bool) & (dseq > 0)
+    match = (seq_row == dseq) & (src_row == dsrc)
+    found = jnp.any(match)
+    midx = jnp.argmax(match)
+    free = seq_row == 0
+    fidx = jnp.argmax(free)
+    overflow = do & ~found & ~jnp.any(free)
+    widx = jnp.where(do & ~overflow, jnp.where(found, midx, fidx), Q)
+    hit = jnp.arange(Q, dtype=I32) == widx
+    src_row = jnp.where(hit, dsrc, src_row)
+    seq_row = jnp.where(hit, dseq, seq_row)
+    km_row = jnp.where(hit, dkm, km_row)
+    cnt_row = jnp.where(hit, jnp.where(found, cnt_row + 1, 1), cnt_row)
+    return src_row, seq_row, km_row, cnt_row, overflow
+
+
+def _pack_deps(pay, lo_base, src_row, seq_row, km_row, present, limit):
+    """Pack present dep triples contiguously into the payload starting
+    at ``lo_base``; returns (payload, count)."""
+    order, nd = compact_order(present, limit)
+    P = pay.shape[0]
+    lo = jnp.where(order < limit, lo_base + 3 * order, P)
+    iota = jnp.arange(P, dtype=I32)
+    oh0 = lo[:, None] == iota[None, :]
+    oh1 = (lo + 1)[:, None] == iota[None, :]
+    oh2 = (lo + 2)[:, None] == iota[None, :]
+    pay = pay + jnp.sum(
+        jnp.where(oh0, src_row[:, None], 0)
+        + jnp.where(oh1, seq_row[:, None], 0)
+        + jnp.where(oh2, km_row[:, None], 0),
+        axis=0,
+        dtype=I32,
+    )
+    return pay, nd
+
+
+def _take_deps(payload, lo_base, count, slots):
+    """Read up to ``slots`` dep triples from the payload; entries at or
+    past ``count`` zero out."""
+    idxs = lo_base + 3 * jnp.arange(slots, dtype=I32)
+    en = jnp.arange(slots, dtype=I32) < count
+    dsrc = jnp.where(en, oh_take(payload, idxs), 0)
+    dseq = jnp.where(en, oh_take(payload, idxs + 1), 0)
+    dkm = jnp.where(en, oh_take(payload, idxs + 2), 0)
+    return dsrc, dseq, dkm
+
+
+# ----------------------------------------------------------------------
+# submit / forward / collect
+# ----------------------------------------------------------------------
+
+
+def _g_own_deps(pp, ps, keys):
+    """This shard's latest dep per command key, deduplicated — the
+    coordinator/member side of key_deps.add_cmd (sequential.rs:62-86)
+    before the latest pointers move. Returns [KPC] triples."""
+    valid = keys >= 0
+    dsrc = jnp.where(valid, oh_take(ps["latest_src"], keys), 0)
+    dseq = jnp.where(valid, oh_take(ps["latest_seq"], keys), 0)
+    dkm = jnp.where(valid, oh_take(ps["latest_km"], keys), 0)
+    # drop duplicates (two keys sharing one latest dot) and empties
+    keep = dseq > 0
+    for i in range(1, pp.KPC):
+        for j in range(i):
+            dup = (dsrc[i] == dsrc[j]) & (dseq[i] == dseq[j])
+            keep = keep.at[i].set(keep[i] & ~dup)
+    return (
+        jnp.where(keep, dsrc, 0),
+        jnp.where(keep, dseq, 0),
+        jnp.where(keep, dkm, 0),
+    )
+
+
+def _g_bump_latest(pp, ps, keys, dsrc, dseq, kmask, enable):
+    """Point every command key's latest dep at this dot."""
+    latest_src, latest_seq, latest_km = (
+        ps["latest_src"], ps["latest_seq"], ps["latest_km"],
+    )
+    for d in range(pp.KPC):
+        k = jnp.where(
+            jnp.asarray(enable, bool) & (keys[d] >= 0), keys[d], -1
+        )
+        latest_src = oh_set(latest_src, k, dsrc)
+        latest_seq = oh_set(latest_seq, k, dseq)
+        latest_km = oh_set(latest_km, k, kmask)
+    return dict(
+        ps,
+        latest_src=latest_src,
+        latest_seq=latest_seq,
+        latest_km=latest_km,
+    )
+
+
+def _g_start(pp, ps, dsrc, dseq, client, cseq, me, ctx, dims, forward):
+    """Shared coordinator start (atlas.rs:210-248 at the target shard;
+    MForwardSubmit runs the same flow without re-forwarding)."""
+    kmask, skey = _cmd_tables(ctx, client, cseq)
+    keys = _my_keys(pp, ctx, me, skey)
+    slot = dot_slot(dseq, dims)
+    n = ctx["n"]
+
+    d_src, d_seq, d_km = _g_own_deps(pp, ps, keys)
+    ps = _g_bump_latest(pp, ps, keys, dsrc, dseq, kmask, True)
+
+    for name in ("ack_cnt", "slow_acks"):
+        ps = dict(ps, **{name: oh_set2(ps[name], dsrc, slot, 0)})
+    zero_q = jnp.zeros_like(_get2(ps["qd_src"], dsrc, slot))
+    ps = dict(
+        ps,
+        qd_src=oh_set2(ps["qd_src"], dsrc, slot, zero_q),
+        qd_seq=oh_set2(ps["qd_seq"], dsrc, slot, zero_q),
+        qd_km=oh_set2(ps["qd_km"], dsrc, slot, zero_q),
+        qd_cnt=oh_set2(ps["qd_cnt"], dsrc, slot, zero_q),
+    )
+
+    pay = jnp.zeros((dims.P,), I32)
+    pay = (
+        pay.at[0].set(dsrc).at[1].set(dseq)
+        .at[2].set(client).at[3].set(cseq)
+    )
+    pay, nd = _pack_deps(pay, 5, d_src, d_seq, d_km, d_seq > 0, pp.KPC)
+    pay = pay.at[4].set(nd)
+    base = _shard_base(ctx, me)
+    ob = emit_broadcast(
+        empty_outbox(dims), pp.MCOLLECT, pay, n, base=base
+    )
+    if forward:
+        ps = dict(
+            ps,
+            sh_cnt=oh_set(ps["sh_cnt"], slot, 0),
+            sh_src=oh_set(
+                ps["sh_src"], slot, jnp.zeros_like(ps["sh_src"][0])
+            ),
+            sh_seq=oh_set(
+                ps["sh_seq"], slot, jnp.zeros_like(ps["sh_seq"][0])
+            ),
+            sh_km=oh_set(
+                ps["sh_km"], slot, jnp.zeros_like(ps["sh_km"][0])
+            ),
+        )
+        s_me = oh_get(ctx["shard_of"], me)
+        for s in range(pp.S):
+            touched = ((kmask >> s) & 1) == 1
+            ob = emit(
+                ob,
+                dims.N + s,
+                oh_get(oh_get(ctx["closest"], me), jnp.int32(s)),
+                pp.MFWDSUBMIT,
+                [dsrc, dseq, client, cseq],
+                valid=touched & (s != s_me),
+            )
+    return ps, ob
+
+
+def _g_submit(pp, ps, msg, me, ctx, dims):
+    client, cseq = msg["payload"][0], msg["payload"][1]
+    dseq = ps["own_seq"] + 1
+    ps = dict(
+        ps,
+        own_seq=dseq,
+        err=ps["err"] | ERR_SEQ * (dseq >= SEQ_BOUND),
+    )
+    return _g_start(
+        pp, ps, me, dseq, client, cseq, me, ctx, dims, forward=True
+    )
+
+
+def _g_mfwdsubmit(pp, ps, msg, me, ctx, dims):
+    dsrc, dseq, client, cseq = (
+        msg["payload"][0],
+        msg["payload"][1],
+        msg["payload"][2],
+        msg["payload"][3],
+    )
+    return _g_start(
+        pp, ps, dsrc, dseq, client, cseq, me, ctx, dims, forward=False
+    )
+
+
+def _g_mcollect(pp, ps, msg, me, ctx, dims):
+    """atlas.rs:250-323 with the dot source decoupled from the sender
+    (the shard coordinator)."""
+    coord = msg["src"]
+    dsrc, dseq, client, cseq, cnd = (
+        msg["payload"][0],
+        msg["payload"][1],
+        msg["payload"][2],
+        msg["payload"][3],
+        msg["payload"][4],
+    )
+    slot = dot_slot(dseq, dims)
+    dirty = (
+        (_get2(ps["seq_in_slot"], dsrc, slot) != 0)
+        | (_get2(ps["vx_seq"], dsrc, slot) != 0)
+    )
+    ps = dict(
+        ps,
+        err=ps["err"] | ERR_DOT * dirty,
+        seq_in_slot=oh_set2(ps["seq_in_slot"], dsrc, slot, dseq),
+        client_of=oh_set2(ps["client_of"], dsrc, slot, client),
+        cseq_of=oh_set2(ps["cseq_of"], dsrc, slot, cseq),
+    )
+    in_q = oh_get(oh_get(ctx["fast_quorum"], coord), me)
+    from_self = coord == me
+
+    kmask, skey = _cmd_tables(ctx, client, cseq)
+    keys = _my_keys(pp, ctx, me, skey)
+    c_src, c_seq, c_km = _take_deps(msg["payload"], 5, cnd, pp.KPC)
+
+    # member: own latest per key union the coordinator's (add_cmd with
+    # past deps, sequential.rs:62-86); the self-collect acks the
+    # coordinator's own deps unchanged
+    member = in_q & ~from_self
+    o_src, o_seq, o_km = _g_own_deps(pp, ps, keys)
+    ps2 = _g_bump_latest(pp, ps, keys, dsrc, dseq, kmask, True)
+    ps = jax.tree_util.tree_map(
+        lambda a, b: jnp.where(member, a, b), ps2, ps
+    )
+    # drop coordinator entries duplicating the member's own
+    keep = c_seq > 0
+    for i in range(pp.KPC):
+        for j in range(pp.KPC):
+            dup = (c_src[i] == o_src[j]) & (c_seq[i] == o_seq[j]) & (
+                o_seq[j] > 0
+            )
+            keep = keep.at[i].set(keep[i] & ~dup)
+    a_src = jnp.concatenate([o_src, jnp.where(keep, c_src, 0)])
+    a_seq = jnp.concatenate([o_seq, jnp.where(keep, c_seq, 0)])
+    a_km = jnp.concatenate([o_km, jnp.where(keep, c_km, 0)])
+    # the self-ack reports exactly the coordinator's deps
+    self_src = jnp.concatenate([c_src, jnp.zeros_like(c_src)])
+    self_seq = jnp.concatenate([c_seq, jnp.zeros_like(c_seq)])
+    self_km = jnp.concatenate([c_km, jnp.zeros_like(c_km)])
+    a_src = jnp.where(member, a_src, self_src)
+    a_seq = jnp.where(member, a_seq, self_seq)
+    a_km = jnp.where(member, a_km, self_km)
+
+    pay = jnp.zeros((dims.P,), I32)
+    pay = pay.at[0].set(dsrc).at[1].set(dseq)
+    pay, nd = _pack_deps(
+        pay, 3, a_src, a_seq, a_km, a_seq > 0, 2 * pp.KPC
+    )
+    pay = pay.at[2].set(nd)
+    ack = in_q & (ctx["ack_self"] | ~from_self)
+    ob = emit(
+        empty_outbox(dims), 0, coord, pp.MCOLLECTACK, pay, valid=ack
+    )
+    return ps, ob
+
+# ----------------------------------------------------------------------
+# collect-ack / commit paths
+# ----------------------------------------------------------------------
+
+
+def _g_mcollectack(pp, ps, msg, me, ctx, dims):
+    """atlas.rs:325-391 at the shard coordinator (possibly of a foreign
+    dot): aggregate dep reports, run the fast-path predicate on the
+    last expected ack."""
+    dsrc, dseq, nd = (
+        msg["payload"][0],
+        msg["payload"][1],
+        msg["payload"][2],
+    )
+    slot = dot_slot(dseq, dims)
+    r_src, r_seq, r_km = _take_deps(msg["payload"], 3, nd, 2 * pp.KPC)
+
+    src_row = _get2(ps["qd_src"], dsrc, slot)
+    seq_row = _get2(ps["qd_seq"], dsrc, slot)
+    km_row = _get2(ps["qd_km"], dsrc, slot)
+    cnt_row = _get2(ps["qd_cnt"], dsrc, slot)
+    overflow = jnp.asarray(False)
+    for i in range(2 * pp.KPC):
+        src_row, seq_row, km_row, cnt_row, ovf = _dep_row_add(
+            src_row, seq_row, km_row, cnt_row,
+            r_src[i], r_seq[i], r_km[i], True,
+        )
+        overflow = overflow | ovf
+    cnt = _get2(ps["ack_cnt"], dsrc, slot) + 1
+    ps = dict(
+        ps,
+        qd_src=oh_set2(ps["qd_src"], dsrc, slot, src_row),
+        qd_seq=oh_set2(ps["qd_seq"], dsrc, slot, seq_row),
+        qd_km=oh_set2(ps["qd_km"], dsrc, slot, km_row),
+        qd_cnt=oh_set2(ps["qd_cnt"], dsrc, slot, cnt_row),
+        ack_cnt=oh_set2(ps["ack_cnt"], dsrc, slot, cnt),
+        err=ps["err"] | ERR_CAPACITY * overflow,
+    )
+
+    all_acks = cnt == ctx["expected_acks"]
+    present = seq_row > 0
+    threshold = jnp.where(
+        ctx["fp_mode"] == 0, ctx["f"], ctx["expected_acks"]
+    )
+    fp_ok = jnp.all(~present | (cnt_row >= threshold))
+    fast = all_acks & fp_ok
+    slow = all_acks & ~fast
+    ps = dict(
+        ps,
+        m_fast=ps["m_fast"] + fast.astype(I32),
+        m_slow=ps["m_slow"] + slow.astype(I32),
+    )
+
+    client = _get2(ps["client_of"], dsrc, slot)
+    cseq = _get2(ps["cseq_of"], dsrc, slot)
+    kmask, _ = _cmd_tables(ctx, client, cseq)
+    ob = _g_commit_actions(
+        pp, ps, me, dsrc, dseq, client, cseq, kmask, ctx, dims, fast
+    )
+    base = _shard_base(ctx, me)
+    obc = emit_broadcast(
+        empty_outbox(dims),
+        pp.MCONSENSUS,
+        [dsrc, dseq],
+        ctx["n"],
+        base=base,
+    )
+    procs = jnp.arange(dims.F, dtype=I32) + base
+    wq = oh_take(
+        oh_get(ctx["write_quorum"], me),
+        jnp.clip(procs, 0, dims.N - 1),
+    )
+    obc = dict(obc, valid=obc["valid"] & slow & wq)
+    ob = jax.tree_util.tree_map(
+        lambda a, b: jnp.where(
+            fast.reshape((-1,) + (1,) * (a.ndim - 1)) if a.ndim > 1 else fast,
+            a,
+            b,
+        ),
+        ob,
+        obc,
+    )
+    return ps, ob
+
+
+def _g_commit_actions(
+    pp, ps, me, dsrc, dseq, client, cseq, kmask, ctx, dims, valid
+):
+    """partial.rs:37-101: single-shard commands broadcast MCommit with
+    this shard's dep union; multi-shard commands send the union to the
+    dot owner as an MShardCommit."""
+    nsh = _popcount(kmask, pp.S)
+    single = nsh == 1
+    slot = dot_slot(dseq, dims)
+    src_row = _get2(ps["qd_src"], dsrc, slot)
+    seq_row = _get2(ps["qd_seq"], dsrc, slot)
+    km_row = _get2(ps["qd_km"], dsrc, slot)
+    Q = src_row.shape[0]
+
+    ob_commit = _g_commit_broadcast(
+        pp, ps, me, dsrc, dseq, client, cseq,
+        src_row, seq_row, km_row, ctx, dims,
+        jnp.asarray(valid, bool) & single,
+    )
+    pay = jnp.zeros((dims.P,), I32)
+    pay = pay.at[0].set(dsrc).at[1].set(dseq)
+    pay, nd = _pack_deps(pay, 3, src_row, seq_row, km_row, seq_row > 0, Q)
+    pay = pay.at[2].set(nd)
+    ob_shard = emit(
+        empty_outbox(dims),
+        0,
+        dsrc,
+        pp.MSHARDCOMMIT,
+        pay,
+        valid=jnp.asarray(valid, bool) & ~single,
+    )
+    return jax.tree_util.tree_map(
+        lambda a, b: jnp.where(
+            single.reshape((-1,) + (1,) * (a.ndim - 1))
+            if a.ndim > 1
+            else single,
+            a,
+            b,
+        ),
+        ob_commit,
+        ob_shard,
+    )
+
+
+def _g_commit_broadcast(
+    pp, ps, me, dsrc, dseq, client, cseq, src_row, seq_row, km_row,
+    ctx, dims, valid,
+):
+    Q = src_row.shape[0]
+    pay = jnp.zeros((dims.P,), I32)
+    pay = (
+        pay.at[0].set(dsrc).at[1].set(dseq)
+        .at[2].set(client).at[3].set(cseq)
+    )
+    pay, nd = _pack_deps(pay, 5, src_row, seq_row, km_row, seq_row > 0, Q)
+    pay = pay.at[4].set(nd)
+    base = _shard_base(ctx, me)
+    ob = emit_broadcast(
+        empty_outbox(dims), pp.MCOMMIT, pay, ctx["n"], base=base
+    )
+    return dict(ob, valid=ob["valid"] & jnp.asarray(valid, bool))
+
+
+def _g_mshardcommit(pp, ps, msg, me, ctx, dims):
+    """partial.rs:103-142 at the dot owner: union each shard's deps;
+    when every touched shard reported, send the union back to the
+    participants."""
+    dsrc, dseq, nd = (
+        msg["payload"][0],
+        msg["payload"][1],
+        msg["payload"][2],
+    )
+    slot = dot_slot(dseq, dims)
+    ps = dict(ps, err=ps["err"] | ERR_PROTO * (dsrc != me))
+    n = int(dims.N // pp.S)
+    Q = pp.q_shard(n)
+    r_src, r_seq, r_km = _take_deps(msg["payload"], 3, nd, Q)
+
+    src_row = oh_get(ps["sh_src"], slot)
+    seq_row = oh_get(ps["sh_seq"], slot)
+    km_row = oh_get(ps["sh_km"], slot)
+    cnt_row = jnp.zeros_like(src_row)  # counts unused for the union
+    overflow = jnp.asarray(False)
+    for i in range(Q):
+        src_row, seq_row, km_row, cnt_row, ovf = _dep_row_add(
+            src_row, seq_row, km_row, cnt_row,
+            r_src[i], r_seq[i], r_km[i], True,
+        )
+        overflow = overflow | ovf
+    scnt = oh_get(ps["sh_cnt"], slot) + 1
+    ps = dict(
+        ps,
+        sh_src=oh_set(ps["sh_src"], slot, src_row),
+        sh_seq=oh_set(ps["sh_seq"], slot, seq_row),
+        sh_km=oh_set(ps["sh_km"], slot, km_row),
+        sh_cnt=oh_set(ps["sh_cnt"], slot, scnt),
+        err=ps["err"] | ERR_CAPACITY * overflow,
+    )
+
+    client = _get2(ps["client_of"], me, slot)
+    cseq = _get2(ps["cseq_of"], me, slot)
+    kmask, _ = _cmd_tables(ctx, client, cseq)
+    done = scnt == _popcount(kmask, pp.S)
+    pay = jnp.zeros((dims.P,), I32)
+    pay = pay.at[0].set(dsrc).at[1].set(dseq)
+    pay, und = _pack_deps(
+        pay, 3, src_row, seq_row, km_row, seq_row > 0, src_row.shape[0]
+    )
+    pay = pay.at[2].set(und)
+    ob = emit(empty_outbox(dims), 0, me, pp.MSHARDAGG, pay, valid=done)
+    s_me = oh_get(ctx["shard_of"], me)
+    for s in range(pp.S):
+        touched = ((kmask >> s) & 1) == 1
+        ob = emit(
+            ob,
+            1 + s,
+            oh_get(oh_get(ctx["closest"], me), jnp.int32(s)),
+            pp.MSHARDAGG,
+            pay,
+            valid=done & touched & (s != s_me),
+        )
+    return ps, ob
+
+
+def _g_mshardagg(pp, ps, msg, me, ctx, dims):
+    """partial.rs:144-167 at each shard coordinator: broadcast the
+    final MCommit inside this shard with the aggregated union."""
+    dsrc, dseq, nd = (
+        msg["payload"][0],
+        msg["payload"][1],
+        msg["payload"][2],
+    )
+    slot = dot_slot(dseq, dims)
+    client = _get2(ps["client_of"], dsrc, slot)
+    cseq = _get2(ps["cseq_of"], dsrc, slot)
+    n = int(dims.N // pp.S)
+    QS = pp.q_union(n)
+    r_src, r_seq, r_km = _take_deps(msg["payload"], 3, nd, QS)
+    ob = _g_commit_broadcast(
+        pp, ps, me, dsrc, dseq, client, cseq,
+        r_src, r_seq, r_km, ctx, dims, True,
+    )
+    return ps, ob
+
+
+def _g_mcommit(pp, ps, msg, me, ctx, dims):
+    """atlas.rs:393-464: install the vertex with the aggregated deps,
+    record the commit for GC (own-shard dots only), drain the graph."""
+    dsrc = msg["payload"][0]
+    dseq = msg["payload"][1]
+    client = msg["payload"][2]
+    cseq = msg["payload"][3]
+    nd = msg["payload"][4]
+    slot = dot_slot(dseq, dims)
+    n = int(dims.N // pp.S)
+    QS = pp.q_union(n)
+
+    have = _get2(ps["seq_in_slot"], dsrc, slot) == dseq
+    already = _get2(ps["vx_seq"], dsrc, slot) == dseq
+    do = have & ~already
+    ps = dict(ps, err=ps["err"] | ERR_PROTO * ~have)
+
+    d_src, d_seq, d_km = _take_deps(msg["payload"], 5, nd, QS)
+    wsrc = jnp.where(do, dsrc, dims.N)
+    ps = dict(
+        ps,
+        vx_committed=oh_set2(ps["vx_committed"], wsrc, slot, True),
+        vx_seq=oh_set2(ps["vx_seq"], wsrc, slot, dseq),
+        vx_client=oh_set2(ps["vx_client"], wsrc, slot, client),
+        vx_cseq=oh_set2(ps["vx_cseq"], wsrc, slot, cseq),
+        vx_nd=oh_set2(ps["vx_nd"], wsrc, slot, nd),
+        vx_dep_src=oh_set2(ps["vx_dep_src"], wsrc, slot, d_src),
+        vx_dep_seq=oh_set2(ps["vx_dep_seq"], wsrc, slot, d_seq),
+        vx_dep_km=oh_set2(ps["vx_dep_km"], wsrc, slot, d_km),
+    )
+
+    my_dot = oh_get(ctx["shard_of"], dsrc) == oh_get(ctx["shard_of"], me)
+    cf, cg, overflow = iset_add(
+        oh_get(ps["comm_front"], dsrc),
+        oh_get(ps["comm_gaps"], dsrc),
+        dseq,
+        enable=do & my_dot,
+    )
+    ps = dict(
+        ps,
+        comm_front=oh_set(ps["comm_front"], dsrc, cf),
+        comm_gaps=oh_set(ps["comm_gaps"], dsrc, cg),
+        err=ps["err"] | ERR_CAPACITY * overflow,
+        # foreign dots free their payload slot now (gc_single); the
+        # vertex itself lives until executed
+        seq_in_slot=oh_set2(
+            ps["seq_in_slot"], dsrc, slot,
+            jnp.where(my_dot, dseq, 0),
+        ),
+    )
+    return _g_drain(pp, ps, me, ctx, dims, empty_outbox(dims))
+
+
+def _g_mconsensus(pp, ps, msg, me, ctx, dims):
+    dsrc, dseq = msg["payload"][0], msg["payload"][1]
+    ob = emit(
+        empty_outbox(dims),
+        0,
+        msg["src"],
+        pp.MCONSENSUSACK,
+        [dsrc, dseq],
+    )
+    return ps, ob
+
+
+def _g_mconsensusack(pp, ps, msg, me, ctx, dims):
+    dsrc, dseq = msg["payload"][0], msg["payload"][1]
+    slot = dot_slot(dseq, dims)
+    cnt = _get2(ps["slow_acks"], dsrc, slot) + 1
+    chosen = cnt == ctx["f"] + 1
+    ps = dict(
+        ps, slow_acks=oh_set2(ps["slow_acks"], dsrc, slot, cnt)
+    )
+    client = _get2(ps["client_of"], dsrc, slot)
+    cseq = _get2(ps["cseq_of"], dsrc, slot)
+    kmask, _ = _cmd_tables(ctx, client, cseq)
+    ob = _g_commit_actions(
+        pp, ps, me, dsrc, dseq, client, cseq, kmask, ctx, dims, chosen
+    )
+    return ps, ob
+
+# ----------------------------------------------------------------------
+# graph-executor drain: relaxation + cross-shard requests
+# ----------------------------------------------------------------------
+
+
+def _g_drain(pp, ps, me, ctx, dims, ob):
+    """Execute one dot whose transitive dep closure is committed
+    (graphdep._drain's relaxation), then fetch one missing
+    foreign-shard dependency if any blocked vertex needs it
+    (executor/graph/mod.rs:279-367's Request path)."""
+    N, D = dims.N, dims.D
+    dep_src = ps["vx_dep_src"]  # [N, D, QS]
+    dep_seq = ps["vx_dep_seq"]
+    dep_km = ps["vx_dep_km"]
+    dslot = dot_slot(dep_seq, dims)
+
+    absent = dep_seq == 0
+    dep_executed = iset_contains_gathered(
+        ps["exec_front"], ps["exec_gaps"], dep_src, dep_seq
+    )
+    dep_cell_valid = ps["vx_seq"][dep_src, dslot] == dep_seq
+    dep_pass_static = absent | dep_executed
+
+    def body(carry):
+        ok, _changed = carry
+        dep_ok = ok[dep_src, dslot] & dep_cell_valid
+        new_ok = ok & jnp.all(dep_pass_static | dep_ok, axis=2)
+        return new_ok, jnp.any(new_ok != ok)
+
+    ok0 = ps["vx_committed"]
+    ok, _ = jax.lax.while_loop(
+        lambda c: c[1], body, (ok0, jnp.asarray(True))
+    )
+
+    num_ok = jnp.sum(ok)
+    ready = ok & jnp.all(dep_pass_static, axis=2)
+    sel = jnp.where(jnp.any(ready), ready, ok)
+    srcs = jnp.arange(N, dtype=I32)[:, None]
+    packed = srcs * SEQ_BOUND + ps["vx_seq"]
+    flat_idx = jnp.argmin(jnp.where(sel, packed, INF))
+    esrc, eslot = flat_idx // D, flat_idx % D
+    eseq = _get2(ps["vx_seq"], esrc, eslot)
+    client = _get2(ps["vx_client"], esrc, eslot)
+    cseq = _get2(ps["vx_cseq"], esrc, eslot)
+
+    do = num_ok > 0
+    front, gaps, overflow = iset_add(
+        oh_get(ps["exec_front"], esrc), oh_get(ps["exec_gaps"], esrc),
+        eseq, do,
+    )
+    ps = dict(
+        ps,
+        exec_front=oh_set(ps["exec_front"], esrc, front),
+        exec_gaps=oh_set(ps["exec_gaps"], esrc, gaps),
+        vx_committed=oh_set2(
+            ps["vx_committed"], jnp.where(do, esrc, N), eslot, False
+        ),
+        vx_seq=oh_set2(ps["vx_seq"], jnp.where(do, esrc, N), eslot, 0),
+        err=ps["err"] | ERR_CAPACITY * overflow,
+    )
+    # execute: one result partial per local key (graph/mod.rs _execute)
+    _, skey = _cmd_tables(ctx, client, cseq)
+    keys = _my_keys(pp, ctx, me, skey)
+    s_me = oh_get(ctx["shard_of"], me)
+    connected = oh_get(oh_get(ctx["client_attach_s"], client), s_me) == me
+    for d in range(pp.KPC):
+        ob = emit(
+            ob,
+            d,
+            dims.N + client,
+            pp.TO_CLIENT,
+            [0],
+            valid=do & connected & (keys[d] >= 0),
+        )
+
+    # one request for a blocked foreign dependency: committed vertices
+    # with a dep that is neither executed nor locally present, whose
+    # command never touches this shard — fetch it from the closest
+    # process of the dot owner's shard (mod.rs:279-367). One per drain;
+    # the chain re-issues until all are requested.
+    still = ps["vx_committed"]
+    touches_me = ((dep_km >> s_me) & 1) == 1
+    req_done = ps["req_seq"][dep_src, dslot] == dep_seq
+    missing = (
+        still[:, :, None]
+        & ~dep_pass_static
+        & ~dep_cell_valid
+        & ~touches_me
+        & ~req_done
+        & (dep_seq > 0)
+    )
+    any_missing = jnp.any(missing)
+    m_packed = dep_src * SEQ_BOUND + dep_seq
+    m_flat = jnp.argmin(jnp.where(missing, m_packed, INF))
+    mi = m_flat // (D * missing.shape[2])
+    rest = m_flat % (D * missing.shape[2])
+    mj, mq = rest // missing.shape[2], rest % missing.shape[2]
+    r_src = dep_src[mi, mj, mq]
+    r_seq = dep_seq[mi, mj, mq]
+    r_shard = oh_get(ctx["shard_of"], r_src)
+    ps = dict(
+        ps,
+        req_seq=oh_set2(
+            ps["req_seq"],
+            jnp.where(any_missing, r_src, N),
+            dot_slot(r_seq, dims),
+            r_seq,
+        ),
+    )
+    ob = emit(
+        ob,
+        pp.KPC,
+        oh_get(oh_get(ctx["closest"], me), r_shard),
+        pp.GREQ,
+        [r_src, r_seq],
+        valid=any_missing,
+    )
+    more = (do & (num_ok > 1)) | (any_missing & (jnp.sum(missing) > 1))
+    ob = emit(ob, pp.KPC + 1, me, pp.MDRAIN, [0], valid=more)
+    return ps, ob
+
+
+def _g_mdrain(pp, ps, msg, me, ctx, dims):
+    return _g_drain(pp, ps, me, ctx, dims, empty_outbox(dims))
+
+
+def _g_request(pp, ps, msg, me, ctx, dims):
+    """mod.rs:372-393 at the responder: answer with the vertex or an
+    executed marker; buffer unknown dots for the cleanup tick."""
+    dsrc, dseq = msg["payload"][0], msg["payload"][1]
+    from_shard = oh_get(ctx["shard_of"], msg["src"])
+    slot = dot_slot(dseq, dims)
+    ps, ob, answered = _g_answer(
+        pp, ps, me, ctx, dims, empty_outbox(dims), 0, from_shard,
+        dsrc, dseq, True,
+    )
+    # buffer unanswered requests (dedup like the oracle's per-shard set)
+    dup = jnp.any(
+        (ps["breq_from"] == from_shard)
+        & (ps["breq_src"] == dsrc)
+        & (ps["breq_seq"] == dseq)
+    )
+    free = ps["breq_from"] < 0
+    fidx = jnp.argmax(free)
+    store = ~answered & ~dup
+    overflow = store & ~jnp.any(free)
+    widx = jnp.where(store & ~overflow, fidx, pp.B)
+    ps = dict(
+        ps,
+        breq_from=oh_set(ps["breq_from"], widx, from_shard),
+        breq_src=oh_set(ps["breq_src"], widx, dsrc),
+        breq_seq=oh_set(ps["breq_seq"], widx, dseq),
+        err=ps["err"] | ERR_CAPACITY * overflow,
+    )
+    return ps, ob
+
+
+def _g_answer(pp, ps, me, ctx, dims, ob, slot_i, from_shard, dsrc, dseq,
+              enable):
+    """Emit a GREPLY (pending vertex) or GREPLYEXEC (already executed)
+    for one requested dot; returns (ps, ob, answered)."""
+    slot = dot_slot(dseq, dims)
+    pending = (
+        (_get2(ps["vx_seq"], dsrc, slot) == dseq)
+        & _get2(ps["vx_committed"], dsrc, slot)
+    )
+    executed = iset_contains(
+        oh_get(ps["exec_front"], dsrc),
+        oh_get(ps["exec_gaps"], dsrc),
+        dseq,
+    )
+    en = jnp.asarray(enable, bool) & (dseq > 0)
+    dst = oh_get(oh_get(ctx["closest"], me), from_shard)
+
+    pay = jnp.zeros((dims.P,), I32)
+    pay = (
+        pay.at[0].set(dsrc).at[1].set(dseq)
+        .at[2].set(_get2(ps["vx_client"], dsrc, slot))
+        .at[3].set(_get2(ps["vx_cseq"], dsrc, slot))
+        .at[4].set(_get2(ps["vx_nd"], dsrc, slot))
+    )
+    QS = ps["vx_dep_src"].shape[-1]
+    d_src = _get2(ps["vx_dep_src"], dsrc, slot)
+    d_seq = _get2(ps["vx_dep_seq"], dsrc, slot)
+    d_km = _get2(ps["vx_dep_km"], dsrc, slot)
+    pay, _nd = _pack_deps(pay, 5, d_src, d_seq, d_km, d_seq > 0, QS)
+    pay_exec = jnp.zeros((dims.P,), I32).at[0].set(dsrc).at[1].set(dseq)
+
+    ob = emit(
+        ob,
+        slot_i,
+        dst,
+        jnp.where(pending, pp.GREPLY, pp.GREPLYEXEC),
+        jnp.where(pending, pay, pay_exec),
+        valid=en & (pending | executed),
+    )
+    return ps, ob, en & (pending | executed)
+
+
+def _g_reply(pp, ps, msg, me, ctx, dims):
+    """mod.rs:395-398 at the requester: install the remote vertex with
+    its deps and drain (transitively missing deps re-request through
+    the drain chain)."""
+    dsrc = msg["payload"][0]
+    dseq = msg["payload"][1]
+    client = msg["payload"][2]
+    cseq = msg["payload"][3]
+    nd = msg["payload"][4]
+    slot = dot_slot(dseq, dims)
+    n = int(dims.N // pp.S)
+    QS = pp.q_union(n)
+    cell = _get2(ps["vx_seq"], dsrc, slot)
+    already = cell == dseq
+    # a live different-sequence vertex in this dot slot is a window
+    # collision — surface it like MCOLLECT's dirty check (ERR_DOT)
+    # instead of silently clobbering the vertex
+    dirty = (cell != 0) & ~already
+    do = ~already & ~dirty
+    ps = dict(ps, err=ps["err"] | ERR_DOT * dirty)
+    d_src, d_seq, d_km = _take_deps(msg["payload"], 5, nd, QS)
+    wsrc = jnp.where(do, dsrc, dims.N)
+    ps = dict(
+        ps,
+        vx_committed=oh_set2(ps["vx_committed"], wsrc, slot, True),
+        vx_seq=oh_set2(ps["vx_seq"], wsrc, slot, dseq),
+        vx_client=oh_set2(ps["vx_client"], wsrc, slot, client),
+        vx_cseq=oh_set2(ps["vx_cseq"], wsrc, slot, cseq),
+        vx_nd=oh_set2(ps["vx_nd"], wsrc, slot, nd),
+        vx_dep_src=oh_set2(ps["vx_dep_src"], wsrc, slot, d_src),
+        vx_dep_seq=oh_set2(ps["vx_dep_seq"], wsrc, slot, d_seq),
+        vx_dep_km=oh_set2(ps["vx_dep_km"], wsrc, slot, d_km),
+    )
+    return _g_drain(pp, ps, me, ctx, dims, empty_outbox(dims))
+
+
+def _g_replyexec(pp, ps, msg, me, ctx, dims):
+    """mod.rs:399-407: mark the remote dot executed and drain."""
+    dsrc, dseq = msg["payload"][0], msg["payload"][1]
+    front, gaps, overflow = iset_add(
+        oh_get(ps["exec_front"], dsrc),
+        oh_get(ps["exec_gaps"], dsrc),
+        dseq,
+    )
+    ps = dict(
+        ps,
+        exec_front=oh_set(ps["exec_front"], dsrc, front),
+        exec_gaps=oh_set(ps["exec_gaps"], dsrc, gaps),
+        err=ps["err"] | ERR_CAPACITY * overflow,
+    )
+    return _g_drain(pp, ps, me, ctx, dims, empty_outbox(dims))
+
+
+def _g_cleanup(pp, ps, me, ctx, dims, ob, fire):
+    """The executor cleanup tick: re-check buffered requests and answer
+    the ones whose dots have since committed or executed here."""
+    for b in range(pp.B):
+        from_shard = ps["breq_from"][b]
+        dsrc = ps["breq_src"][b]
+        dseq = ps["breq_seq"][b]
+        en = jnp.asarray(fire, bool) & (from_shard >= 0)
+        ps, ob, answered = _g_answer(
+            pp, ps, me, ctx, dims, ob, dims.N + 1 + b, from_shard,
+            dsrc, dseq, en,
+        )
+        clear = jnp.where(answered, b, pp.B)
+        ps = dict(
+            ps, breq_from=oh_set(ps["breq_from"], clear, -1)
+        )
+    return ps, ob
+
+
+def _g_mgc(pp, ps, msg, me, ctx, dims):
+    """Committed-clock GC within this shard — identical state shape to
+    Tempo's, so the one shard-scoped handler serves both twins."""
+    return _p_mgc(pp, ps, msg, me, ctx, dims)

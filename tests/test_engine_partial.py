@@ -22,8 +22,8 @@ import pytest
 from fantoch_tpu.client import DeviceStream, Workload
 from fantoch_tpu.core import Config, Planet
 from fantoch_tpu.engine import EngineDims, make_lane, run_lanes
-from fantoch_tpu.engine.protocols import TempoPartialDev
-from fantoch_tpu.protocol import Tempo
+from fantoch_tpu.engine.protocols import AtlasPartialDev, TempoPartialDev
+from fantoch_tpu.protocol import Atlas, Tempo
 from fantoch_tpu.protocol.base import ProtocolMetricsKind
 from fantoch_tpu.sim import Runner
 
@@ -31,20 +31,22 @@ COMMANDS = 10
 CPR = 1
 
 
-def partial_config(n, f, shards):
-    return Config(
+def partial_config(n, f, shards, tempo=True):
+    kw = dict(
         n=n,
         f=f,
         shard_count=shards,
         gc_interval_ms=100,
-        tempo_detached_send_interval_ms=100,
         executor_executed_notification_interval_ms=100,
         executor_cleanup_interval_ms=100,
     )
+    if tempo:
+        kw["tempo_detached_send_interval_ms"] = 100
+    return Config(**kw)
 
 
 def run_oracle(config, regions, conflict, pool, kpc, commands=COMMANDS,
-               cpr=CPR):
+               cpr=CPR, oracle_cls=Tempo):
     planet = Planet.new()
     wl = Workload(
         shard_count=config.shard_count,
@@ -54,7 +56,7 @@ def run_oracle(config, regions, conflict, pool, kpc, commands=COMMANDS,
         payload_size=0,
     )
     runner = Runner(
-        Tempo, planet, config, wl, cpr, regions, list(regions)
+        oracle_cls, planet, config, wl, cpr, regions, list(regions)
     )
     metrics, _, lat = runner.run(extra_sim_time_ms=1500)
     fast = slow = stable = 0
@@ -66,11 +68,11 @@ def run_oracle(config, regions, conflict, pool, kpc, commands=COMMANDS,
 
 
 def run_engine(config, regions, conflict, pool, kpc, commands=COMMANDS,
-               cpr=CPR):
+               cpr=CPR, dev_cls=TempoPartialDev):
     planet = Planet.new()
     n, S = config.n, config.shard_count
     clients = cpr * len(regions)
-    dev = TempoPartialDev(
+    dev = dev_cls(
         keys=pool + clients + 1, shards=S, keys_per_cmd=kpc
     )
     total_rows = S * n
@@ -81,7 +83,7 @@ def run_engine(config, regions, conflict, pool, kpc, commands=COMMANDS,
         M=total * 4 * total_rows + 64,
         D=total + 1,
         F=dev.fanout(n),
-        R=3,
+        R=dev.PERIODIC_ROWS,
         P=dev.payload_width(n),
         H=2048,
         RR=len(regions),
@@ -130,6 +132,45 @@ def test_engine_partial_matches_oracle(n, f, shards, conflict, pool, kpc):
     assert total <= dev_fast + dev_slow <= total * shards
     assert dev_fast + dev_slow == fast + slow
     # stability accounting: n processes GC each dot at its shard
+    assert int(res.protocol_metrics["stable"].sum()) == stable == n * total
+
+    for region in regions:
+        _issued, hist = oracle_lat[region]
+        dev_mean = res.latency_mean(region)
+        assert dev_mean == hist.mean(), (
+            region, dev_mean, hist.mean()
+        )
+
+
+@pytest.mark.parametrize(
+    "n,f,shards,conflict,pool,kpc",
+    [
+        (3, 1, 2, 100, 4, 2),  # shared pool: cross-shard deps + requests
+        (3, 1, 3, 50, 4, 2),   # 3 shards, mixed private/pool stream
+    ],
+)
+def test_engine_atlas_partial_matches_oracle(n, f, shards, conflict,
+                                             pool, kpc):
+    """Atlas partial replication: shard-union dep aggregation plus the
+    graph executor's cross-shard Request/RequestReply protocol
+    (executor/graph/mod.rs:279-408)."""
+    config = partial_config(n, f, shards, tempo=False)
+    regions = Planet.new().regions()[:n]
+    oracle_lat, fast, slow, stable = run_oracle(
+        config, regions, conflict, pool, kpc, oracle_cls=Atlas
+    )
+    _dev, res = run_engine(
+        config, regions, conflict, pool, kpc, dev_cls=AtlasPartialDev
+    )
+    assert not res.err, res.err_cause
+    total = COMMANDS * CPR * n
+
+    for region in regions:
+        assert res.issued(region) == CPR * COMMANDS
+    dev_fast = int(res.protocol_metrics["fast_path"].sum())
+    dev_slow = int(res.protocol_metrics["slow_path"].sum())
+    assert total <= dev_fast + dev_slow <= total * shards
+    assert dev_fast + dev_slow == fast + slow
     assert int(res.protocol_metrics["stable"].sum()) == stable == n * total
 
     for region in regions:
