@@ -27,6 +27,8 @@ from .core.config import Config
 from .core.planet import Planet
 
 ENGINE_PROTOCOLS = ("basic", "fpaxos", "tempo", "atlas", "epaxos", "caesar")
+# host-oracle-only variants (sim/proc): the tempo_atomic binary analog
+ORACLE_PROTOCOLS = ENGINE_PROTOCOLS + ("tempo_atomic",)
 
 # subcommands that run device computations; everything else is
 # host-only and gets the CPU backend outright so a dead device
@@ -99,7 +101,7 @@ def _ints(s: str) -> List[int]:
 
 def _build_config(name: str, n: int, f: int, args) -> Config:
     kw = dict(n=n, f=f, gc_interval_ms=args.gc_interval)
-    if name == "tempo":
+    if name.startswith("tempo"):
         kw["tempo_detached_send_interval_ms"] = args.detached_interval
         if args.clock_bump_interval:
             kw["tempo_clock_bump_interval_ms"] = args.clock_bump_interval
@@ -126,6 +128,7 @@ def _oracle_protocol(name: str):
         "basic": p.Basic,
         "fpaxos": p.FPaxos,
         "tempo": p.Tempo,
+        "tempo_atomic": p.TempoAtomic,
         "atlas": p.Atlas,
         "epaxos": p.EPaxos,
         "caesar": p.Caesar,
@@ -133,7 +136,11 @@ def _oracle_protocol(name: str):
 
 
 def _add_common(sp, sweep: bool):
-    sp.add_argument("--protocol", required=True, choices=ENGINE_PROTOCOLS)
+    sp.add_argument(
+        "--protocol",
+        required=True,
+        choices=ENGINE_PROTOCOLS if sweep else ORACLE_PROTOCOLS,
+    )
     sp.add_argument("--n", type=int, default=3)
     sp.add_argument(
         "--regions",
@@ -563,7 +570,7 @@ def main(argv=None) -> None:
     pr = sub.add_parser(
         "proc", help="run one replica server over TCP (run layer)"
     )
-    pr.add_argument("--protocol", required=True, choices=ENGINE_PROTOCOLS)
+    pr.add_argument("--protocol", required=True, choices=ORACLE_PROTOCOLS)
     pr.add_argument("--id", type=int, required=True)
     pr.add_argument("--shard-id", type=int, default=0)
     pr.add_argument("--n", type=int, required=True)

@@ -21,4 +21,4 @@ from .basic import Basic
 from .caesar import Caesar
 from .epaxos import EPaxos
 from .fpaxos import FPaxos
-from .tempo import Tempo
+from .tempo import Tempo, TempoAtomic

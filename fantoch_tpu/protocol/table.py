@@ -137,8 +137,80 @@ class SequentialKeyClocks:
             self.clocks[key] = up_to
 
 
-# canonical name used by the protocol (the reference's atomic/locked
-# variants only matter for its multi-threaded runtime)
+class NativeAtomicKeyClocks:
+    """The ``AtomicKeyClocks`` variant (common/table/clocks/keys/
+    atomic.rs:13-90), backed by the native C++ sharded CAS map
+    (fantoch_tpu/native/keyclocks.cpp). Same observable semantics as
+    :class:`SequentialKeyClocks` single-threaded; the clock bumps are
+    lock-free CAS loops with the GIL released, and key interning takes
+    a short lock on first sighting, so the structure stays safe if the
+    runtime ever moves workers onto OS threads. The native key table
+    is fixed-capacity (``$FANTOCH_NATIVE_KEYS``, default 65,536
+    distinct keys); exhaustion raises instead of degrading."""
+
+    def __init__(self, process_id: ProcessId, shard_id: ShardId,
+                 capacity: Optional[int] = None):
+        import os
+        import threading
+
+        from ..native.keyclocks import AtomicKeyClocks
+
+        if capacity is None:
+            capacity = int(
+                os.environ.get("FANTOCH_NATIVE_KEYS", str(1 << 16))
+            )
+        self.process_id = process_id
+        self.shard_id = shard_id
+        self._kc = AtomicKeyClocks(capacity)
+        self._ids: Dict[Key, int] = {}
+        self._names: List[Key] = []
+        self._intern_lock = threading.Lock()
+
+    def _id(self, key: Key) -> int:
+        i = self._ids.get(key)  # dict reads are GIL-atomic
+        if i is None:
+            with self._intern_lock:
+                i = self._ids.get(key)
+                if i is None:
+                    i = len(self._names)
+                    self._names.append(key)
+                    self._ids[key] = i
+        return i
+
+    def init_clocks(self, cmd: Command) -> None:
+        for key in cmd.keys(self.shard_id):
+            self._id(key)
+
+    def _add(self, votes: Votes, triples) -> None:
+        for kid, start, end in triples:
+            votes.add(
+                self._names[kid], VoteRange(self.process_id, start, end)
+            )
+
+    def proposal(self, cmd: Command, min_clock: int) -> Tuple[int, Votes]:
+        ids = [self._id(k) for k in cmd.keys(self.shard_id)]
+        clock, triples = self._kc.proposal(ids, min_clock)
+        votes = Votes()
+        self._add(votes, triples)
+        return clock, votes
+
+    def detached(self, cmd: Command, up_to: int, votes: Votes) -> None:
+        ids = [self._id(k) for k in cmd.keys(self.shard_id)]
+        if ids:
+            self._add(votes, self._kc.detached(ids, up_to))
+
+    def detached_all(self, up_to: int, votes: Votes) -> None:
+        ids = list(range(len(self._names)))
+        if ids:
+            self._add(votes, self._kc.detached(ids, up_to))
+
+    @staticmethod
+    def parallel() -> bool:
+        return True
+
+
+# canonical name used by the protocol; TempoAtomic swaps in the native
+# variant (the reference selects per-binary, bin/tempo_atomic.rs)
 KeyClocks = SequentialKeyClocks
 
 

@@ -203,6 +203,7 @@ class Tempo(Protocol):
     PARTIAL_REPLICATION = True
 
     EXECUTOR = TableExecutor
+    KEY_CLOCKS = KeyClocks
 
     def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config):
         super().__init__(process_id, shard_id, config)
@@ -210,7 +211,7 @@ class Tempo(Protocol):
         self.bp = BaseProcess(
             process_id, shard_id, config, fast_quorum_size, write_quorum_size
         )
-        self.key_clocks = KeyClocks(process_id, shard_id)
+        self.key_clocks = self.KEY_CLOCKS(process_id, shard_id)
         n, f = config.n, config.f
         self.cmds: CommandsInfo[_TempoInfo] = CommandsInfo(
             lambda: _TempoInfo(process_id, n, f, fast_quorum_size)
@@ -607,3 +608,14 @@ class Tempo(Protocol):
 
     def _gc_running(self) -> bool:
         return self.bp.config.gc_interval_ms is not None
+
+
+class TempoAtomic(Tempo):
+    """Tempo over the native lock-free AtomicKeyClocks — the
+    ``tempo_atomic`` binary's variant (fantoch_ps/src/bin/
+    tempo_atomic.rs; clock state in common/table/clocks/keys/
+    atomic.rs:13-90). Byte-identical behavior to :class:`Tempo` under
+    one worker; under thread-parallel workers the per-key CAS bumps
+    interleave safely without the GIL."""
+
+    from .table import NativeAtomicKeyClocks as KEY_CLOCKS  # noqa: N814

@@ -12,11 +12,17 @@ registration (task/server/client.rs:80-244), a periodic metrics logger
 (metrics_logger.rs) and an execution-info logger replayable by
 ``tools/executor_replay.py`` (execution_logger.rs:11-60).
 
-One protocol worker per process: the host protocols are the reference's
-*Sequential* state variants, for which the reference enforces
-``workers == 1`` (run/mod.rs:180-183). Executor pools are key-hash
-routed (executor/mod.rs:148-167) and allowed only for executors
-declaring ``KEY_HASH_ROUTED`` per-key independence.
+W protocol workers per process (run/mod.rs:180-198): messages route by
+``Message.WORKER`` (the MessageIndex analog — dot/slot messages shift
+past the two reserved workers, GC/leader traffic pins to worker 0,
+clock-bump/acceptor roles to worker 1), submits are pre-dotted by a
+server-side generator so a dot's lifetime stays on one worker, and the
+cooperative scheduler gives every ``handle()`` the per-message
+atomicity the reference's Atomic/Locked variants provide. Executor
+pools are key-hash routed (executor/mod.rs:148-167); pool construction
+lives on the executor class so cross-key state can be shared between
+members (executor/base.py). Peers get ``multiplexing`` parallel TCP
+connections with round-robin sends (task/server/mod.rs:226-310).
 """
 
 from __future__ import annotations

@@ -179,3 +179,50 @@ def test_engine_atlas_partial_matches_oracle(n, f, shards, conflict,
         assert dev_mean == hist.mean(), (
             region, dev_mean, hist.mean()
         )
+
+
+def test_engine_tempo_partial_reorder_invariants():
+    """Message reordering (delay ×U(0,10)) over the multi-shard engine:
+    exactness is out of scope on randomized schedules, but the
+    readiness gates (MCollect window, commit-overtakes-collect,
+    buffered MBump, StableAtShard buffering) must absorb every
+    overtake: the lane completes cleanly with full GC."""
+    n, shards, conflict, pool, kpc = 3, 2, 100, 4, 2
+    config = partial_config(n, 1, shards)
+    regions = Planet.new().regions()[:n]
+    planet = Planet.new()
+    clients = CPR * n
+    dev = TempoPartialDev(
+        keys=pool + clients + 1, shards=shards, keys_per_cmd=kpc
+    )
+    total = COMMANDS * clients
+    dims = EngineDims(
+        N=shards * n,
+        C=clients,
+        M=total * 4 * shards * n + 64,
+        D=total + 1,
+        F=dev.fanout(n),
+        R=dev.PERIODIC_ROWS,
+        P=dev.payload_width(n),
+        H=2048,
+        RR=n,
+    )
+    spec = make_lane(
+        dev,
+        planet,
+        config,
+        conflict_rate=conflict,
+        pool_size=pool,
+        commands_per_client=COMMANDS,
+        clients_per_region=CPR,
+        process_regions=regions,
+        client_regions=regions,
+        dims=dims,
+        extra_time_ms=30_000,
+        seed=5,
+        reorder=True,
+    )
+    res = run_lanes(dev, dims, [spec])[0]
+    assert not res.err, res.err_cause
+    assert res.completed == total
+    assert int(res.protocol_metrics["stable"].sum()) == n * total

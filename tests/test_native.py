@@ -104,3 +104,20 @@ def test_stress_gap_free_votes(threads):
         threads, ops_per_thread=2000, key_count=100, keys_per_op=2
     )
     assert ok, "votes not gap-free/duplicate-free"
+
+
+def test_tempo_atomic_matches_sequential_sim():
+    """TempoAtomic (native AtomicKeyClocks, the tempo_atomic binary's
+    variant) behaves byte-identically to sequential Tempo in the
+    deterministic sim — same slow-path count, monitors checked by the
+    harness invariants."""
+    from harness import sim_test
+
+    from fantoch_tpu.core import Config
+    from fantoch_tpu.protocol import Tempo, TempoAtomic
+
+    config = Config(n=3, f=1, tempo_detached_send_interval_ms=100)
+    kw = dict(commands_per_client=10, clients_per_process=2)
+    assert sim_test(TempoAtomic, config, **kw) == sim_test(
+        Tempo, config, **kw
+    )

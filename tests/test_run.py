@@ -478,3 +478,19 @@ def test_run_tempo_multiplexing():
             await h.stop()
 
     asyncio.run(main())
+
+
+def test_run_tempo_atomic_workers():
+    """The native atomic key clocks under the worker axis — the
+    reference's TempoAtomic shape (workers share clock state through
+    the C++ CAS map; common/table/clocks/keys/atomic.rs:13-90)."""
+    from fantoch_tpu.native.keyclocks import available
+    from fantoch_tpu.protocol import TempoAtomic
+
+    if not available():
+        pytest.skip("native toolchain unavailable")
+    _run(
+        TempoAtomic,
+        Config(n=3, f=1, tempo_detached_send_interval_ms=25),
+        workers=3,
+    )
