@@ -139,6 +139,12 @@ def main() -> None:
         points = sum(len(c) for c in chunks)
         total_points += points
         per_proto[name] = round(points / dt, 2)
+        print(
+            f"timed {name}: {points} points in {dt:.1f}s "
+            f"({per_proto[name]}/s)",
+            file=sys.stderr,
+            flush=True,
+        )
     elapsed = time.perf_counter() - t0
 
     points_per_sec = total_points / elapsed

@@ -11,10 +11,12 @@ Capability parity with ``fantoch_ps/src/protocol/common/table/``:
 - ``QuorumClocks``: max clock + occurrence count over a fast quorum
   (clocks/quorum.rs:7-60).
 
-The reference's ``AtomicKeyClocks``/``LockedKeyClocks`` exist only to allow
-multiple intra-process worker threads to bump clocks concurrently; the TPU
-engine gets its concurrency from batching whole configurations instead, so
-the sequential (semantically identical) variant is the canonical one here.
+The reference's ``AtomicKeyClocks``/``LockedKeyClocks`` exist to allow
+multiple intra-process workers to bump clocks concurrently; the TPU
+engine gets its concurrency from batching whole configurations, so the
+sequential variant is the default — and :class:`NativeAtomicKeyClocks`
+(below) is the AtomicKeyClocks twin over the native C++ CAS map, which
+``TempoAtomic`` swaps in for the run layer's worker axis.
 """
 
 from __future__ import annotations

@@ -8,14 +8,17 @@ periodic-event tasks, a metrics logger and an execution logger; clients
 connect to the closest process per shard and drive closed- or open-loop
 workloads.
 
-Where the reference runs W parallel protocol workers over lock-free
-Atomic/Locked state (run/mod.rs:180-183 asserts ``workers > 1 ⇒
-P::parallel()``), the host protocols here are the *Sequential* variants,
-so the runtime enforces the same rule the reference does for them: one
-protocol worker per process. Executor pools are key-hash routed
-(executor/mod.rs:148-167) and allowed only for executors declaring
-``KEY_HASH_ROUTED`` per-key independence (the basic executor); others
-run as a single instance.
+Like the reference, W parallel protocol workers are supported for
+``parallel()`` protocols (run/mod.rs:180-198): messages route by the
+MessageIndex analog (``Message.WORKER`` — dot/slot shift past the two
+reserved workers, GC/leader on worker 0, clock-bump/acceptor on
+worker 1), submits are pre-dotted server-side, and cooperative
+scheduling gives each ``handle()`` the atomicity the reference's
+Atomic/Locked variants provide (``TempoAtomic`` additionally backs its
+clocks with the native lock-free CAS map). Executor pools are key-hash
+routed (executor/mod.rs:148-167), with cross-key state shared between
+pool members where needed (the table executor's stability counts);
+peers get ``multiplexing`` parallel TCP connections.
 """
 
 from .client import ClientHandle, client

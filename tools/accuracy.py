@@ -213,6 +213,87 @@ def main() -> None:
         title="Atlas vs Tempo n=5, conflict 100% — latency CDF",
     )
 
+    # -- partial replication: multi-shard Tempo + Atlas ----------------
+    # exact device-vs-oracle agreement on multi-shard/multi-key
+    # DeviceStream workloads (the engine-partial diff tests' shape),
+    # so a device run certifies the shard paths on the actual chip
+    from fantoch_tpu.engine.protocols import (
+        AtlasPartialDev,
+        TempoPartialDev,
+    )
+    from fantoch_tpu.protocol.base import ProtocolMetricsKind
+
+    planet = Planet.new()
+    n, shards, kpc, pool = 3, 2, 2, 4
+    p_regions = planet.regions()[:n]
+    p_cmds = 10 if quick else 20
+    worst_p = 0.0
+    for name, dev_cls, oracle_cls in (
+        ("tempo", TempoPartialDev, Tempo),
+        ("atlas", AtlasPartialDev, Atlas),
+    ):
+        clients = cpr * n
+        dev = dev_cls(keys=pool + clients + 1, shards=shards,
+                      keys_per_cmd=kpc)
+        total = p_cmds * clients
+        dims = EngineDims(
+            N=shards * n,
+            C=clients,
+            M=total * 4 * shards * n + 64,
+            D=total + 1,
+            F=dev.fanout(n),
+            R=dev.PERIODIC_ROWS,
+            P=dev.payload_width(n),
+            H=2048,
+            RR=n,
+        )
+        kw = dict(
+            n=n, f=1, shard_count=shards, gc_interval_ms=100,
+            executor_executed_notification_interval_ms=100,
+            executor_cleanup_interval_ms=100,
+        )
+        if name == "tempo":
+            kw["tempo_detached_send_interval_ms"] = 100
+        config = Config(**kw)
+        spec = make_lane(
+            dev, planet, config, conflict_rate=100, pool_size=pool,
+            commands_per_client=p_cmds, clients_per_region=cpr,
+            process_regions=p_regions, client_regions=p_regions,
+            dims=dims,
+        )
+        res = run_sweep(dev, dims, [spec])[0]
+        assert not res.err, (name, res.err_cause)
+        wl = Workload(
+            shard_count=shards,
+            key_gen=DeviceStream(conflict_rate=100, pool_size=pool),
+            keys_per_command=kpc,
+            commands_per_client=p_cmds,
+            payload_size=0,
+        )
+        runner = Runner(
+            oracle_cls, planet, config, wl, cpr, p_regions,
+            list(p_regions),
+        )
+        metrics, _, lat = runner.run(extra_sim_time_ms=1500)
+        stable = sum(
+            pm.get_aggregated(ProtocolMetricsKind.STABLE) or 0
+            for pm, _em in metrics.values()
+        )
+        assert int(res.protocol_metrics["stable"].sum()) == stable
+        for r in p_regions:
+            om = lat[r][1].mean()
+            rel = abs(res.latency_mean(r) - om) / om
+            worst_p = max(worst_p, rel)
+        rows.append(
+            (
+                {"protocol": f"{name}_partial", "n": n, "f": 1,
+                 "conflict": 100, "shards": shards},
+                res,
+            )
+        )
+    report["partial_worst_rel_err"] = worst_p
+    assert worst_p <= TOLERANCE, f"partial {worst_p:.3%} > 2%"
+
     save_results(plots / "accuracy_results.jsonl", rows)
     report["tolerance"] = TOLERANCE
     report["commands_per_client"] = commands
