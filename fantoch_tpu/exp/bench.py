@@ -113,6 +113,32 @@ def _drain(proc: subprocess.Popen) -> None:
     threading.Thread(target=loop, daemon=True).start()
 
 
+class _DstatSampler:
+    """Periodic /proc sampling around a run — the dstat analog the
+    reference starts before every experiment (bench.rs:780-870); the
+    series feeds the heatmap plot family (fantoch_plot lib.rs heatmaps
+    render per-machine utilization over time)."""
+
+    def __init__(self, interval_s: float = 1.0):
+        import threading
+
+        self.interval_s = interval_s
+        self.samples: List[Dict[str, float]] = [_proc_snapshot()]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.samples.append(_proc_snapshot())
+
+    def finish(self) -> List[Dict[str, float]]:
+        self._stop.set()
+        self._thread.join(timeout=2)
+        self.samples.append(_proc_snapshot())
+        return self.samples
+
+
 def _proc_snapshot() -> Dict[str, float]:
     """Minimal dstat analog: cpu + memory counters from /proc."""
     out: Dict[str, float] = {"time": time.time()}
@@ -147,10 +173,19 @@ def bench_experiment(
     client latency JSON, the experiment config and dstat-style
     snapshots.
     """
+    # extras that change behavior must land in the directory name or
+    # two variants of one base config overwrite each other; full key
+    # names and zero values included (gc_interval_ms=0 is a different
+    # experiment than the default)
+    extra_tag = "".join(
+        f"_{k}={v}" for k, v in sorted(exp.extra.items())
+        if isinstance(v, (int, float)) and not isinstance(v, bool)
+    )
     run_dir = os.path.join(
         output_dir,
         f"{exp.protocol}_n{exp.n}_f{exp.f}_s{exp.shard_count}"
-        f"_c{exp.clients}_k{exp.commands_per_client}_r{exp.conflict}",
+        f"_c{exp.clients}_k{exp.commands_per_client}_r{exp.conflict}"
+        f"{extra_tag}",
     )
     os.makedirs(run_dir, exist_ok=True)
 
@@ -164,7 +199,7 @@ def bench_experiment(
     servers: List[subprocess.Popen] = []
     client_procs: List[subprocess.Popen] = []
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_REPO)
-    dstat0 = _proc_snapshot()
+    dstat = _DstatSampler()
 
     def _start_servers():
         """Spawn all servers on freshly probed ports; returns the port
@@ -277,6 +312,10 @@ def bench_experiment(
                 commands=exp.commands_per_client,
                 conflict=exp.conflict,
                 keys_per_command=exp.extra.get("keys_per_command", 1),
+                batch_max_size=exp.extra.get("batch_max_size", 1),
+                batch_max_delay_ms=exp.extra.get(
+                    "batch_max_delay_ms", 5.0
+                ),
                 shard_count=exp.shard_count,
                 output=os.path.join(run_dir, f"client_{cid}.json"),
             )
@@ -309,8 +348,17 @@ def bench_experiment(
             except subprocess.TimeoutExpired:
                 proc.kill()
 
+    samples = dstat.finish()
     with open(os.path.join(run_dir, "dstat.json"), "w") as fh:
-        json.dump({"start": dstat0, "end": _proc_snapshot()}, fh)
+        json.dump(
+            {
+                "start": samples[0],
+                "end": samples[-1],
+                "series": samples,
+                "interval_s": dstat.interval_s,
+            },
+            fh,
+        )
     with open(os.path.join(run_dir, "exp_config.json"), "w") as fh:
         json.dump(asdict(exp), fh, indent=2)
     return run_dir

@@ -91,6 +91,8 @@ class ClientConfig:
     shard_count: int = 1
     zipf: Optional[Tuple[float, int]] = None
     open_loop_interval_ms: Optional[int] = None
+    batch_max_size: int = 1
+    batch_max_delay_ms: float = 5.0
     output: Optional[str] = None
 
     def to_args(self) -> List[str]:
@@ -107,6 +109,8 @@ class ClientConfig:
             ),
             "--ids", f"{self.ids[0]}-{self.ids[1]}",
             "--commands", str(self.commands),
+            "--batch-max-size", str(self.batch_max_size),
+            "--batch-max-delay", str(self.batch_max_delay_ms),
             "--keys-per-command", str(self.keys_per_command),
             "--payload-size", str(self.payload_size),
             "--shard-count", str(self.shard_count),

@@ -10,6 +10,9 @@ families: latency bars, CDFs, throughput-vs-latency).
 
 from .db import load_results, save_results
 from .experiment import (
+    batching_plot,
+    batching_points,
+    dstat_heatmap,
     dstat_table,
     experiment_points,
     process_metrics_table,
@@ -18,8 +21,11 @@ from .experiment import (
 from .latency import cdf_plot, conflict_latency_plot, latency_bar_plot
 
 __all__ = [
+    "batching_plot",
+    "batching_points",
     "cdf_plot",
     "conflict_latency_plot",
+    "dstat_heatmap",
     "dstat_table",
     "experiment_points",
     "latency_bar_plot",

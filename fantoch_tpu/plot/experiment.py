@@ -39,20 +39,10 @@ def experiment_points(
     for run_dir in run_dirs:
         exp = load_experiment(run_dir)
         cfg = exp["config"]
-        lats_us: List[int] = []
-        client_times_us: List[int] = []
-        for _cid, lats in exp["clients"].items():
-            if not lats:
-                continue
-            lats_us.extend(lats)
-            client_times_us.append(sum(lats))
-        if not lats_us or not client_times_us:
+        rates = _run_rates(exp)
+        if rates is None:
             continue
-        mean_ms = (sum(lats_us) / len(lats_us)) / 1000.0
-        mean_run_s = (
-            sum(client_times_us) / len(client_times_us) / 1_000_000.0
-        )
-        throughput = len(lats_us) / max(mean_run_s, 1e-9)
+        throughput, mean_ms = rates
         series.setdefault(cfg["protocol"], []).append(
             (cfg["clients"], throughput, mean_ms)
         )
@@ -60,6 +50,25 @@ def experiment_points(
         proto: [(tp, lat) for _c, tp, lat in sorted(points)]
         for proto, points in series.items()
     }
+
+
+def _run_rates(exp) -> "Optional[Tuple[float, float]]":
+    """(throughput ops/s, mean latency ms) of one experiment run —
+    the closed-loop reduction shared by the throughput-latency and
+    batching figures."""
+    lats_us: List[int] = []
+    client_times_us: List[int] = []
+    for lats in exp["clients"].values():
+        if lats:
+            lats_us.extend(lats)
+            client_times_us.append(sum(lats))
+    if not lats_us:
+        return None
+    mean_ms = (sum(lats_us) / len(lats_us)) / 1000.0
+    mean_run_s = (
+        sum(client_times_us) / len(client_times_us) / 1_000_000.0
+    )
+    return len(lats_us) / max(mean_run_s, 1e-9), mean_ms
 
 
 def throughput_latency_plot(
@@ -149,3 +158,123 @@ def process_metrics_table(run_dirs: Sequence[str]) -> str:
         "|---|---|---|---|---|\n"
     )
     return header + "\n".join(f"| {' | '.join(r)} |" for r in rows)
+
+
+def dstat_heatmap(run_dirs: Sequence[str], path: str,
+                  title: Optional[str] = None):
+    """CPU-utilization heatmap over (experiment, time) from the dstat
+    sample series — the reference's per-machine utilization heatmaps
+    (fantoch_plot lib.rs heatmap family)."""
+    import json
+
+    import numpy as np
+
+    rows = []
+    labels = []
+    for run_dir in run_dirs:
+        exp = load_experiment(run_dir)
+        cfg = exp["config"]
+        p = os.path.join(run_dir, "dstat.json")
+        if not os.path.exists(p):
+            continue
+        with open(p) as fh:
+            snap = json.load(fh)
+        series = snap.get("series")
+        if not series or len(series) < 2:
+            continue
+        # per-interval cpu jiffies burned, normalized by interval length
+        rates = []
+        for a, b in zip(series, series[1:]):
+            dt = max(b.get("time", 0) - a.get("time", 0), 1e-9)
+            rates.append(
+                (b.get("cpu_jiffies", 0) - a.get("cpu_jiffies", 0)) / dt
+            )
+        rows.append(rates)
+        labels.append(
+            f"{cfg['protocol']} c={cfg['clients']}"
+            + (
+                f" b={cfg['extra']['batch_max_size']}"
+                if cfg.get("extra", {}).get("batch_max_size", 1) > 1
+                else ""
+            )
+        )
+    if not rows:
+        raise ValueError("no dstat series found in the given run dirs")
+    width = max(len(r) for r in rows)
+    grid = np.full((len(rows), width), np.nan)
+    for i, r in enumerate(rows):
+        grid[i, : len(r)] = r
+    fig, ax = plt.subplots(
+        figsize=(1.2 + 0.45 * width, 1.0 + 0.4 * len(rows))
+    )
+    im = ax.imshow(grid, aspect="auto", cmap="viridis")
+    ax.set_yticks(range(len(labels)))
+    ax.set_yticklabels(labels, fontsize=7)
+    # rows are sequences of sampling intervals (dstat.json interval_s;
+    # the rates are already normalized per second)
+    ax.set_xlabel("dstat sample")
+    fig.colorbar(im, ax=ax, label="cpu jiffies/s")
+    if title:
+        ax.set_title(title)
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+
+
+def batching_points(
+    run_dirs: Sequence[str],
+) -> Dict[str, List[Tuple[int, float, float]]]:
+    """(batch_max_size, throughput ops/s, mean latency ms) per
+    experiment, grouped by protocol — the input of the reference's
+    batching figures (fantoch_plot lib.rs batching family)."""
+    out: Dict[str, List[Tuple[int, float, float]]] = {}
+    for run_dir in run_dirs:
+        exp = load_experiment(run_dir)
+        cfg = exp["config"]
+        batch = cfg.get("extra", {}).get("batch_max_size", 1)
+        rates = _run_rates(exp)
+        if rates is None:
+            continue
+        throughput, mean_ms = rates
+        # key by everything except batch size so mixed sweeps never
+        # fold a client-count effect into the batching axis
+        label = (
+            f"{cfg['protocol']} n={cfg['n']} c={cfg['clients']} "
+            f"r={cfg['conflict']}"
+        )
+        out.setdefault(label, []).append((batch, throughput, mean_ms))
+    return {k: sorted(v) for k, v in out.items()}
+
+
+def batching_plot(
+    series: Dict[str, List[Tuple[int, float, float]]],
+    path: str,
+    title: Optional[str] = None,
+):
+    """Throughput and latency vs batch_max_size, one line pair per
+    protocol (fantoch_plot's batching family)."""
+    fig, ax = plt.subplots(figsize=(5.2, 3.4))
+    ax2 = ax.twinx()
+    for label, points in series.items():
+        xs = [b for b, _, _ in points]
+        ax.plot(
+            xs, [tp for _, tp, _ in points],
+            marker="o", markersize=4, label=f"{label} (tput)",
+        )
+        ax2.plot(
+            xs, [lat for _, _, lat in points],
+            marker="s", markersize=4, linestyle="--",
+            label=f"{label} (lat)",
+        )
+    ax.set_xlabel("batch max size")
+    ax.set_ylabel("throughput (ops/s)")
+    ax2.set_ylabel("latency (ms)")
+    if title:
+        ax.set_title(title)
+    ax.grid(alpha=0.3)
+    lines, labels_ = ax.get_legend_handles_labels()
+    lines2, labels2 = ax2.get_legend_handles_labels()
+    ax.legend(lines + lines2, labels_ + labels2, fontsize=7)
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    plt.close(fig)

@@ -21,8 +21,8 @@ from fantoch_tpu.plot import (
 from fantoch_tpu.protocol.base import ProtocolMetricsKind
 
 
-def _fake_experiment(root, protocol, clients, lat_ms):
-    run_dir = os.path.join(root, f"{protocol}_c{clients}")
+def _fake_experiment(root, protocol, clients, lat_ms, batch=1):
+    run_dir = os.path.join(root, f"{protocol}_c{clients}_b{batch}")
     os.makedirs(run_dir)
     with open(os.path.join(run_dir, "exp_config.json"), "w") as fh:
         json.dump(
@@ -34,6 +34,7 @@ def _fake_experiment(root, protocol, clients, lat_ms):
                 "clients": clients,
                 "commands_per_client": 4,
                 "conflict": 50,
+                "extra": {"batch_max_size": batch},
             },
             fh,
         )
@@ -54,14 +55,18 @@ def _fake_experiment(root, protocol, clients, lat_ms):
                  "executors": []},
                 fh,
             )
+    series = [
+        {"time": float(t), "cpu_jiffies": 1000.0 + 240.0 * t,
+         "memavailable": 800_000.0 - 20_000.0 * t}
+        for t in range(4)
+    ]
+    series[0]["time"] = 0.0
+    series[-1]["time"] = 2.5
+    series[-1]["cpu_jiffies"] = 1600.0
+    series[-1]["memavailable"] = 750_000.0
     with open(os.path.join(run_dir, "dstat.json"), "w") as fh:
         json.dump(
-            {
-                "start": {"time": 0.0, "cpu_jiffies": 1000.0,
-                          "memavailable": 800_000.0},
-                "end": {"time": 2.5, "cpu_jiffies": 1600.0,
-                        "memavailable": 750_000.0},
-            },
+            {"start": series[0], "end": series[-1], "series": series},
             fh,
         )
     return run_dir
@@ -93,3 +98,30 @@ def test_throughput_latency_and_tables(tmp_path):
     assert "cpu (jiffies)" in table and "| 600 |" in table
     ptable = process_metrics_table(dirs)
     assert "| tempo n=3 f=1 | 1 | 8 | 0 | 8 |" in ptable
+
+
+def test_heatmap_and_batching_families(tmp_path):
+    from fantoch_tpu.plot import (
+        batching_plot,
+        batching_points,
+        dstat_heatmap,
+    )
+
+    dirs = [
+        _fake_experiment(str(tmp_path), "tempo", 4, lat_ms=50, batch=1),
+        _fake_experiment(str(tmp_path), "tempo", 4, lat_ms=35, batch=4),
+    ]
+    png = str(tmp_path / "heat.png")
+    dstat_heatmap(dirs, png, title="cpu utilization")
+    assert os.path.getsize(png) > 0
+
+    series = batching_points(dirs)
+    (label,) = series  # one (protocol, clients, conflict) group
+    assert label == "tempo n=3 c=4 r=50"
+    assert [b for b, _, _ in series[label]] == [1, 4]
+    # batching amortizes the round trip: lower latency, higher tput
+    (_, tp1, lat1), (_, tp4, lat4) = series[label]
+    assert lat4 < lat1 and tp4 > tp1
+    png2 = str(tmp_path / "batch.png")
+    batching_plot(series, png2, title="batching")
+    assert os.path.getsize(png2) > 0
