@@ -96,6 +96,9 @@ def engine_results(name, configs, commands, cpr, regions):
         total_commands=total,
         dot_slots=total + 1,
         regions=len(regions),
+        # f=2 tails can pass 512 ms; keep percentiles out of the
+        # saturating last bucket (VERDICT r2 weak #8)
+        hist_buckets=2048,
     )
     specs = [
         make_lane(
