@@ -244,22 +244,45 @@ def cmd_sweep(args) -> None:
             )
         ]
     clients = args.n * args.clients_per_region
-    dev = _engine_protocol(args.protocol, clients)
     total = args.commands * clients
-    dims = EngineDims.for_protocol(
-        dev,
-        n=args.n,
-        clients=clients,
-        payload=dev.payload_width(args.n),
-        total_commands=None if args.dot_slots else total,
-        dot_slots=args.dot_slots or total + 1,
-        regions=args.n,
-    )
+    if args.shards > 1:
+        from .engine.protocols import partial_dev_protocol
+
+        try:
+            dev = partial_dev_protocol(
+                args.protocol,
+                clients,
+                args.shards,
+                keys_per_cmd=args.keys_per_command,
+                pool_size=args.pool_size,
+            )
+        except ValueError as e:
+            raise SystemExit(str(e))
+        dims = EngineDims.for_partial(
+            dev, args.n, clients, total, dot_slots=args.dot_slots
+        )
+    else:
+        dev = _engine_protocol(args.protocol, clients)
+        dims = EngineDims.for_protocol(
+            dev,
+            n=args.n,
+            clients=clients,
+            payload=dev.payload_width(args.n),
+            total_commands=None if args.dot_slots else total,
+            dot_slots=args.dot_slots or total + 1,
+            regions=args.n,
+        )
     fs = args.fs or [1]
     conflicts = (
         [args.conflict] if args.conflict is not None else args.conflicts
     )
     base = _build_config(args.protocol, args.n, fs[0], args)
+    if args.shards > 1:
+        base = base.with_(
+            shard_count=args.shards,
+            executor_executed_notification_interval_ms=100,
+            executor_cleanup_interval_ms=100,
+        )
     specs = make_sweep_specs(
         dev,
         planet,
@@ -302,6 +325,7 @@ def cmd_sweep(args) -> None:
                         "protocol": args.protocol,
                         "n": spec.config.n,
                         "f": spec.config.f,
+                        "shards": spec.config.shard_count,
                         "conflict": int(spec.ctx["conflict_rate"]),
                         "regions": spec.process_regions,
                     },
@@ -554,6 +578,10 @@ def main(argv=None) -> None:
     sw.add_argument("--subsets", type=int, default=16,
                     help="number of n-region subsets when --regions unset")
     sw.add_argument("--dot-slots", type=int, default=None)
+    sw.add_argument("--shards", type=int, default=1,
+                    help="partial replication: shard count (tempo/atlas)")
+    sw.add_argument("--keys-per-command", type=int, default=2,
+                    help="keys per command when --shards > 1")
     sw.add_argument("--out", default=None, help="results JSONL path")
     sw.set_defaults(fn=cmd_sweep)
 

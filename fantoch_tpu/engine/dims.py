@@ -125,3 +125,26 @@ class EngineDims:
             H=hist_buckets,
             RR=regions,
         )
+
+    @staticmethod
+    def for_partial(protocol, n: int, clients: int,
+                    total_commands: int,
+                    dot_slots: int | None = None,
+                    regions: int | None = None) -> "EngineDims":
+        """Bounds for a partial-replication (multi-shard) lane: the
+        process axis spans every shard's rows and the pool bound covers
+        the cross-shard fan-out (forwards, shard commits, executor
+        requests). One definition serves the CLI, the accuracy tool and
+        the diff tests so the tuned capacity formulas live here."""
+        S = protocol.S
+        return EngineDims(
+            N=S * n,
+            C=clients,
+            M=total_commands * 4 * S * n + 64,
+            D=dot_slots if dot_slots is not None else total_commands + 1,
+            F=protocol.fanout(n),
+            R=protocol.PERIODIC_ROWS,
+            P=protocol.payload_width(n),
+            H=2048,
+            RR=regions if regions is not None else n,
+        )

@@ -26,6 +26,7 @@ __all__ = [
     "TempoPartialDev",
     "dev_protocol",
     "dev_config_kwargs",
+    "partial_dev_protocol",
 ]
 
 
@@ -47,6 +48,26 @@ def dev_protocol(name: str, clients: int, keys: "int | None" = None):
     if name == "caesar":
         return CaesarDev(keys=keys)
     raise ValueError(f"unknown protocol {name!r}")
+
+
+def partial_dev_protocol(name: str, clients: int, shards: int,
+                         keys_per_cmd: int = 2, pool_size: int = 1):
+    """The partial-replication twin switch — only the protocols whose
+    reference implements partial.rs have one (Tempo, Atlas); anything
+    else raises ValueError with the reason."""
+    keys = pool_size + clients + 1
+    if name == "tempo":
+        return TempoPartialDev(
+            keys=keys, shards=shards, keys_per_cmd=keys_per_cmd
+        )
+    if name == "atlas":
+        return AtlasPartialDev(
+            keys=keys, shards=shards, keys_per_cmd=keys_per_cmd
+        )
+    raise ValueError(
+        f"{name} does not support partial replication (only tempo and "
+        "atlas implement the reference's partial.rs paths)"
+    )
 
 
 def dev_config_kwargs(name: str, n: int, f: int, **overrides):

@@ -111,3 +111,36 @@ def test_cli_platform_auto_host_only_never_probes(capsys, monkeypatch):
         "--conflict", "0",
     )
     assert json.loads(out)["slow_path"] == 0
+
+
+def test_cli_sweep_partial_replication(capsys):
+    """--shards routes the sweep through the multi-shard device twins
+    (TempoPartialDev/AtlasPartialDev); unsupported protocols fail with
+    a clear message like the reference's partial.rs coverage."""
+    out = _run(
+        capsys,
+        "--platform", "cpu",
+        "sweep",
+        "--protocol", "tempo",
+        "--n", "3",
+        "--shards", "2",
+        "--fs", "1",
+        "--conflicts", "100",
+        "--pool-size", "4",
+        "--subsets", "1",
+        "--commands", "4",
+    )
+    data = json.loads(out)
+    assert data["points"] == 1 and data["errors"] == 0
+
+    with pytest.raises(SystemExit) as exc:
+        main(
+            [
+                "--platform", "cpu",
+                "sweep",
+                "--protocol", "caesar",
+                "--n", "3",
+                "--shards", "2",
+            ]
+        )
+    assert "partial replication" in str(exc.value)

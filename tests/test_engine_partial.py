@@ -75,18 +75,9 @@ def run_engine(config, regions, conflict, pool, kpc, commands=COMMANDS,
     dev = dev_cls(
         keys=pool + clients + 1, shards=S, keys_per_cmd=kpc
     )
-    total_rows = S * n
     total = commands * clients
-    dims = EngineDims(
-        N=total_rows,
-        C=clients,
-        M=total * 4 * total_rows + 64,
-        D=total + 1,
-        F=dev.fanout(n),
-        R=dev.PERIODIC_ROWS,
-        P=dev.payload_width(n),
-        H=2048,
-        RR=len(regions),
+    dims = EngineDims.for_partial(
+        dev, n, clients, total, regions=len(regions)
     )
     spec = make_lane(
         dev,
@@ -196,17 +187,7 @@ def test_engine_tempo_partial_reorder_invariants():
         keys=pool + clients + 1, shards=shards, keys_per_cmd=kpc
     )
     total = COMMANDS * clients
-    dims = EngineDims(
-        N=shards * n,
-        C=clients,
-        M=total * 4 * shards * n + 64,
-        D=total + 1,
-        F=dev.fanout(n),
-        R=dev.PERIODIC_ROWS,
-        P=dev.payload_width(n),
-        H=2048,
-        RR=n,
-    )
+    dims = EngineDims.for_partial(dev, n, clients, total)
     spec = make_lane(
         dev,
         planet,

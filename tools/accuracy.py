@@ -220,10 +220,7 @@ def main() -> None:
     # exact device-vs-oracle agreement on multi-shard/multi-key
     # DeviceStream workloads (the engine-partial diff tests' shape),
     # so a device run certifies the shard paths on the actual chip
-    from fantoch_tpu.engine.protocols import (
-        AtlasPartialDev,
-        TempoPartialDev,
-    )
+    from fantoch_tpu.engine.protocols import partial_dev_protocol
     from fantoch_tpu.protocol.base import ProtocolMetricsKind
 
     planet = Planet.new()
@@ -231,25 +228,13 @@ def main() -> None:
     p_regions = planet.regions()[:n]
     p_cmds = 10 if quick else 20
     worst_p = 0.0
-    for name, dev_cls, oracle_cls in (
-        ("tempo", TempoPartialDev, Tempo),
-        ("atlas", AtlasPartialDev, Atlas),
-    ):
+    for name, oracle_cls in (("tempo", Tempo), ("atlas", Atlas)):
         clients = cpr * n
-        dev = dev_cls(keys=pool + clients + 1, shards=shards,
-                      keys_per_cmd=kpc)
-        total = p_cmds * clients
-        dims = EngineDims(
-            N=shards * n,
-            C=clients,
-            M=total * 4 * shards * n + 64,
-            D=total + 1,
-            F=dev.fanout(n),
-            R=dev.PERIODIC_ROWS,
-            P=dev.payload_width(n),
-            H=2048,
-            RR=n,
+        dev = partial_dev_protocol(
+            name, clients, shards, keys_per_cmd=kpc, pool_size=pool
         )
+        total = p_cmds * clients
+        dims = EngineDims.for_partial(dev, n, clients, total)
         kw = dict(
             n=n, f=1, shard_count=shards, gc_interval_ms=100,
             executor_executed_notification_interval_ms=100,
