@@ -45,27 +45,12 @@ def _force_cpu() -> None:
 
 
 def _probe_backend(timeout_s: float) -> bool:
-    """Check device-backend liveness in a throwaway subprocess.
+    """Check device-backend liveness (fantoch_tpu.platform holds the
+    throwaway-subprocess probe shared with bench.py)."""
+    from .platform import probe_device_backend
 
-    Backend init happens inside a C extension and can block for many
-    minutes when the tunnel is down, so an in-process attempt cannot be
-    cancelled — a subprocess with a hard timeout can.
-    """
-    import subprocess
-
-    check = (
-        "import jax; ds = jax.devices(); "
-        "assert any(d.platform != 'cpu' for d in ds), 'cpu only'"
-    )
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", check],
-            timeout=timeout_s,
-            capture_output=True,
-        )
-        return proc.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+    status, _ = probe_device_backend(timeout_s)
+    return status == "up"
 
 
 def _apply_platform(platform: str, cmd: str) -> None:

@@ -32,6 +32,59 @@ def force_cpu(devices: int = 8) -> None:
         pass  # backend already initialized; keep its device count
 
 
+def probe_device_backend(timeout_s: float):
+    """Initialize the JAX backend in a THROWAWAY subprocess.
+
+    Returns ``(status, platform)`` where status is:
+
+    * ``"up"`` — a non-cpu backend initialized; platform is its name;
+    * ``"cpu-only"`` — init succeeded but only the CPU fallback
+      answered (no device plugin at all): deterministic, retrying
+      cannot fix it;
+    * ``"down"`` — init failed or timed out (dead tunnel): transient,
+      worth retrying.
+
+    Backend init happens inside a C extension and can block for many
+    minutes when the device tunnel is down, so an in-process attempt
+    cannot be cancelled — a subprocess with a hard timeout can.  The
+    non-cpu assertion matters: with JAX_PLATFORMS unset, a dead tunnel
+    makes ``jax.devices()`` fall back to the CPU backend, which must
+    not be mistaken for a live device.
+    """
+    import subprocess
+
+    check = (
+        "import jax; ds = jax.devices(); "
+        "assert any(d.platform != 'cpu' for d in ds), 'cpu only'; "
+        "print([d.platform for d in ds if d.platform != 'cpu'][0])"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", check],
+            timeout=max(timeout_s, 1.0),
+            capture_output=True,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return ("down", None)
+    if proc.returncode != 0:
+        stderr = proc.stderr or ""
+        # a dead tunnel can make jax fall back to the CPU backend after
+        # logging an unavailability warning, which then fails the same
+        # 'cpu only' assert — that is transient ("down"), not a
+        # deterministic plugin-less install
+        transient = (
+            "Unable to initialize backend" in stderr
+            or "UNAVAILABLE" in stderr
+        )
+        if "cpu only" in stderr and not transient:
+            return ("cpu-only", None)
+        return ("down", None)
+    if not proc.stdout.strip():
+        return ("down", None)
+    return ("up", proc.stdout.strip().splitlines()[-1])
+
+
 def force_cpu_from_env(devices: int = 8) -> bool:
     """Apply :func:`force_cpu` when the caller's environment asks for
     the CPU backend (JAX_PLATFORMS=cpu); returns whether it did."""
