@@ -174,6 +174,17 @@ def main() -> None:
 # of burning the retry ladder or masquerading as infra downtime
 _TRACE_BUG_MARKERS = ("Tracer", "Concretization")
 
+# XLA error statuses that reproduce on every attempt regardless of
+# backend health — retrying or downgrading them would hide a code bug
+_DETERMINISTIC_XLA_STATUSES = (
+    "INVALID_ARGUMENT",
+    "FAILED_PRECONDITION",
+    "UNIMPLEMENTED",
+    "NOT_FOUND",
+    "OUT_OF_RANGE",
+    "ALREADY_EXISTS",
+)
+
 
 def _infra_shaped(e: BaseException) -> bool:
     """True for failures that point at the device backend/tunnel rather
@@ -198,7 +209,13 @@ def _infra_shaped(e: BaseException) -> bool:
     mod = type(e).__module__ or ""
     if mod.startswith(("jax", "jaxlib")):
         name = type(e).__name__
-        return not any(m in name for m in _TRACE_BUG_MARKERS)
+        if any(m in name for m in _TRACE_BUG_MARKERS):
+            return False
+        # deterministic XLA statuses are code bugs too (a bad lane
+        # shape raises INVALID_ARGUMENT on every attempt) — only
+        # status-less worker deaths and availability statuses point
+        # at the tunnel
+        return not any(s in str(e) for s in _DETERMINISTIC_XLA_STATUSES)
     if isinstance(e, RuntimeError):
         msg = str(e).lower()
         return "backend" in msg or "tpu" in msg or "device" in msg

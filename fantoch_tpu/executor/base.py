@@ -69,10 +69,9 @@ class Executor(ABC):
         independence, so the default rejects count > 1 unless the class
         declares ``KEY_HASH_ROUTED``; executors with cross-key state
         override to share it between members (the reference shares via
-        ``SharedMap``). (The graph executor is ``parallel()`` in the
-        reference only through its executor-0-runs-the-graph request
-        protocol, executor/graph/mod.rs:54-67, which this runtime does
-        not implement.)"""
+        ``SharedMap``), e.g. the graph executor's
+        executor-0-runs-the-graph role split over a shared vertex index
+        (executor/graph/mod.rs:54-67, graph.py ``pool``)."""
         assert count == 1 or getattr(cls, "KEY_HASH_ROUTED", False), (
             f"{cls.__name__} does not support key-hash executor pools"
             " in this runtime"

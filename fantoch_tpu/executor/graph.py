@@ -32,11 +32,18 @@ from ..core.timing import SysTime
 from ..protocol.graph_deps import Dependency
 from .base import Executor, ExecutorMetricsKind, ExecutorResult
 
-# GraphExecutionInfo variants (executor.rs:197-232), as dataclasses
+# GraphExecutionInfo variants (executor.rs:197-232), as dataclasses.
+# ``POOL_INDEX = (reserved, index)`` mirrors the reference's
+# MessageIndex impl (executor.rs:234-253): Add/RequestReply go to the
+# main executor 0 (runs the graph), Request/Executed to the secondary
+# executor 1 (answers cross-shard requests) — the run layer's pool
+# routing applies the reference's do_index formula (pool.rs:114-123).
 
 
 @dataclass
 class GraphAdd:
+    POOL_INDEX = (0, 0)
+
     dot: Dot
     cmd: Command
     deps: Set[Dependency]
@@ -44,17 +51,23 @@ class GraphAdd:
 
 @dataclass
 class GraphRequest:
+    POOL_INDEX = (0, 1)
+
     from_shard: ShardId
     dots: Set[Dot]
 
 
 @dataclass
 class GraphRequestReply:
+    POOL_INDEX = (0, 0)
+
     infos: List
 
 
 @dataclass
 class GraphExecuted:
+    POOL_INDEX = (0, 1)
+
     dots: Set[Dot]
 
 
@@ -207,9 +220,19 @@ class _Finder:
 
 
 class GraphExecutor(Executor):
-    """mod.rs:46-689 + executor.rs:19-195, single executor role (the
-    oracle simulator runs one executor per process; the reference's
-    executor 0 / auxiliary split is a worker-routing concern)."""
+    """mod.rs:46-689 + executor.rs:19-195.
+
+    With a single executor (the oracle simulator, and the run layer at
+    executors=1) one instance plays every role.  Behind a run-layer pool
+    (``pool``) the reference's executor-0-runs-the-graph split applies
+    (mod.rs:54-67): member 0 handles ``Add``/``RequestReply`` and runs
+    Tarjan + execution; member 1 answers cross-shard ``Request`` traffic
+    from the **shared** vertex index (the reference shares it between
+    clones via ``Arc<SharedMap>``, index.rs:18-30) and keeps its own
+    executed-clock copy in sync via ``Executed`` notifications
+    (mod.rs:199-213).  Pool members past index 1 receive no graph
+    traffic at all — the reference routes every variant to index 0 or 1
+    (executor.rs:234-253)."""
 
     def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config):
         super().__init__(process_id, shard_id, config)
@@ -222,11 +245,33 @@ class GraphExecutor(Executor):
         self.out_requests: Dict[ShardId, Set[Dot]] = {}
         self.added_to_executed: Set[Dot] = set()
         self.buffered_in_requests: Dict[ShardId, Set[Dot]] = {}
+        # run-layer pool role (executor.rs:53-56 set_executor_index);
+        # role_split stays False at executors=1 where one instance
+        # handles every variant
+        self.executor_index = 0
+        self.role_split = False
+
+    @classmethod
+    def pool(cls, process_id: ProcessId, shard_id: ShardId, config: Config,
+             count: int):
+        members = [cls(process_id, shard_id, config) for _ in range(count)]
+        if count > 1:
+            for i, member in enumerate(members):
+                member.executor_index = i
+                member.role_split = True
+                if i > 0:
+                    # shared vertex store: secondaries answer requests
+                    # from the vertices the main executor indexes
+                    member.vertex_index = members[0].vertex_index
+        return members
 
     # -- Executor interface -------------------------------------------
 
     def handle(self, info, time: SysTime) -> None:
         if isinstance(info, GraphAdd):
+            assert not self.role_split or self.executor_index == 0, (
+                "Add routed to a secondary executor"
+            )
             if self.config.execute_at_commit:
                 self._execute(info.cmd)
             else:
@@ -235,13 +280,22 @@ class GraphExecutor(Executor):
                                  time)
                 self._fetch_actions(time)
         elif isinstance(info, GraphRequest):
+            assert not self.role_split or self.executor_index > 0, (
+                "Request routed to the main executor of a pool"
+            )
             self.metrics_.aggregate(ExecutorMetricsKind.IN_REQUESTS, 1)
             self._process_requests(info.from_shard, info.dots)
             self._fetch_actions(time)
         elif isinstance(info, GraphRequestReply):
+            assert not self.role_split or self.executor_index == 0, (
+                "RequestReply routed to a secondary executor"
+            )
             self._handle_request_reply(info.infos, time)
             self._fetch_actions(time)
         elif isinstance(info, GraphExecuted):
+            # only secondaries need the catch-up (mod.rs:199-213); the
+            # main executor already marked these during SCC save — the
+            # add below is idempotent so the combined role keeps it
             for dot in info.dots:
                 self.executed_clock.setdefault(dot.source, IntervalSet()).add(
                     dot.sequence

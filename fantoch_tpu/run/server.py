@@ -94,10 +94,23 @@ def _executor_pool(
 
 
 def _route_info(info: Any, executors: int) -> int:
-    """Key-hash routing (``MessageKey``, executor/mod.rs:148-167);
-    keyless info goes to the reserved executor 0."""
+    """Executor-pool routing: infos carrying a ``POOL_INDEX`` class
+    attribute use the reference's MessageIndex scheme with the do_index
+    formula (pool.rs:114-123) — the graph executor's Add/RequestReply
+    pin to executor 0 and Request/Executed to executor 1
+    (graph/executor.rs:234-253); keyed infos use key-hash routing
+    (``MessageKey``, executor/mod.rs:148-167); keyless info goes to the
+    reserved executor 0."""
+    if executors == 1:
+        return _GC_EXECUTOR
+    pool_index = getattr(info, "POOL_INDEX", None)
+    if pool_index is not None:
+        reserved, index = pool_index
+        if reserved < executors:
+            return reserved + index % (executors - reserved)
+        return index % executors
     key = getattr(info, "key", None)
-    if key is None or executors == 1:
+    if key is None:
         return _GC_EXECUTOR
     return key_hash(key) % executors
 

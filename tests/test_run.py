@@ -93,8 +93,22 @@ async def _boot_cluster(protocol_cls, config, delay_ms=1, workers=1,
     return handles, client_addr, shards
 
 
+def _merged_monitor(handle):
+    """Merge a handle's per-executor monitors into one (pool members own
+    disjoint key sets: key-hash for table pools, everything-on-0 for
+    graph pools), so cross-replica order checks see whole processes."""
+    from fantoch_tpu.core.kvs import ExecutionOrderMonitor
+
+    merged = ExecutionOrderMonitor()
+    for m in handle.monitors():
+        for k in m.keys():
+            assert k not in merged.order, f"key {k!r} on two pool members"
+            merged.order[k] = list(m.get_order(k))
+    return merged
+
+
 async def _run_cluster(protocol_cls, config, keys_per_command=2,
-                       workers=1):
+                       workers=1, executors=1):
     config = config.with_(
         executor_monitor_execution_order=True,
         gc_interval_ms=25,
@@ -102,7 +116,7 @@ async def _run_cluster(protocol_cls, config, keys_per_command=2,
         executor_cleanup_interval_ms=5,
     )
     handles, client_addr, shards = await _boot_cluster(
-        protocol_cls, config, workers=workers
+        protocol_cls, config, workers=workers, executors=executors
     )
     workload = Workload(
         shard_count=config.shard_count,
@@ -160,9 +174,8 @@ async def _run_cluster(protocol_cls, config, keys_per_command=2,
     }
     monitors = {}
     for h in handles:
-        ms = h.monitors()
-        assert len(ms) == 1
-        monitors[(h.shard_id, h.process_id)] = ms[0]
+        assert len(h.monitors()) == executors
+        monitors[(h.shard_id, h.process_id)] = _merged_monitor(h)
     for h in handles:
         await h.stop()
 
@@ -434,6 +447,65 @@ def test_run_tempo_table_executor_pool():
             await h.stop()
 
     asyncio.run(main())
+
+
+def test_run_atlas_graph_executor_pool():
+    """Graph-executor pool, single shard: the reference's
+    executor-0-runs-the-graph split (graph/mod.rs:54-67) routes every
+    Add to member 0, so all execution (and the monitor) lives there
+    while member 1 idles; full-stack invariants hold unchanged."""
+    _run(Atlas, Config(n=3, f=1), workers=2, executors=2)
+
+    async def check():
+        config = Config(
+            n=3, f=1,
+            executor_monitor_execution_order=True,
+            gc_interval_ms=25,
+            executor_executed_notification_interval_ms=25,
+        )
+        handles, client_addr, _ = await _boot_cluster(
+            Atlas, config, executors=2
+        )
+        workload = Workload(
+            shard_count=1,
+            key_gen=ConflictPool(conflict_rate=50, pool_size=2),
+            keys_per_command=2,
+            commands_per_client=COMMANDS,
+            payload_size=1,
+        )
+        h0 = handles[0]
+        res = await run_client(
+            [1, 2], {0: client_addr[h0.process_id]}, {0: h0.process_id},
+            workload, command_timeout_s=30,
+        )
+        assert all(
+            len(d.latency_data()) == COMMANDS for d in res.data.values()
+        )
+        for h in handles:
+            main, secondary = h.executors
+            assert main.vertex_index is secondary.vertex_index, (
+                "pool members must share the vertex index"
+            )
+            assert not secondary.monitor().keys(), (
+                "secondary executor must never execute commands"
+            )
+        for h in handles:
+            await h.stop()
+
+    asyncio.run(check())
+
+
+def test_run_atlas_partial_graph_executor_pool():
+    """Graph-executor pool under partial replication: cross-shard
+    Request traffic routes to the secondary executor, which answers
+    from the shared vertex index (or its Executed-synced clock copy,
+    mod.rs:199-213,279-408); every command completes and per-shard
+    execution orders agree across replicas."""
+    _run(
+        Atlas,
+        Config(n=3, f=1, shard_count=2),
+        executors=2,
+    )
 
 
 def test_run_tempo_multiplexing():
