@@ -6,18 +6,45 @@ per (protocol, config, clients) it starts server binaries with
 generated CLI args, waits for a started marker in their logs, runs
 client binaries, stops everything and pulls metrics files into an
 experiment directory. The same loop here drives this package's own CLI
-binaries (``python -m fantoch_tpu proc|client``) as subprocesses on a
-Local testbed; the remote testbeds' SSH/cloud plumbing is out of scope
-for a simulation-first framework (documented N/A, like the reference's
-cloud credentials requirement).
+binaries (``python -m fantoch_tpu proc|client``) over a
+:class:`~fantoch_tpu.exp.machine.Machines` container produced by one of
+the testbeds in :mod:`~fantoch_tpu.exp.testbed` — local (this host),
+baremetal (``user@host`` lines over SSH), or aws (pre-provisioned
+instance inventory; provisioning itself is an external step in a
+zero-egress deployment, unlike the reference's in-process tsunami
+launcher).
 """
 
-from .bench import ExperimentConfig, bench_experiment
+from .bench import ExperimentConfig, bench_experiment, load_experiment
 from .config import ClientConfig, ProtocolConfig
+from .machine import LocalMachine, Machine, Machines, SshMachine
+from .testbed import (
+    Nickname,
+    RunMode,
+    aws_setup,
+    baremetal_setup,
+    create_nicknames,
+    create_placement,
+    local_setup,
+    machine_setup,
+)
 
 __all__ = [
     "ClientConfig",
     "ExperimentConfig",
+    "LocalMachine",
+    "Machine",
+    "Machines",
+    "Nickname",
     "ProtocolConfig",
+    "RunMode",
+    "SshMachine",
+    "aws_setup",
+    "baremetal_setup",
     "bench_experiment",
+    "create_nicknames",
+    "create_placement",
+    "load_experiment",
+    "local_setup",
+    "machine_setup",
 ]

@@ -160,6 +160,8 @@ def bench_experiment(
     exp: ExperimentConfig,
     output_dir: str,
     *,
+    machines=None,
+    run_mode=None,
     clients_per_group: Optional[int] = None,
     start_timeout_s: float = 30.0,
     run_timeout_s: float = 300.0,
@@ -167,12 +169,53 @@ def bench_experiment(
 ) -> str:
     """Run one experiment; returns its result directory.
 
-    Spawns ``n × shard_count`` server subprocesses and one client
-    subprocess per shard-0 server (clients spread over servers like the
-    reference's client machines), then collects ``.metrics_*`` pickles,
-    client latency JSON, the experiment config and dstat-style
-    snapshots.
+    Spawns ``n × shard_count`` servers and one client process per
+    region, then collects ``.metrics_*`` pickles, client latency JSON,
+    the experiment config and dstat-style snapshots (and cProfile
+    artifacts under ``run_mode=RunMode.CPROFILE``, lib.rs:26-70).
+
+    ``machines`` picks the testbed (bench.rs:43-187 receives the same
+    container from every testbed): None runs everything on this host
+    (``Testbed::Local``); a :class:`~fantoch_tpu.exp.machine.Machines`
+    from ``testbed.{local,baremetal,aws}_setup`` places each server and
+    client on its machine — SSH machines get the reference's fixed
+    port scheme (config.rs:494-502: ``3000 + pid`` / ``4000 + pid``)
+    and their artifacts pulled over scp after the run.
     """
+    from .machine import LocalMachine
+    from .testbed import RunMode, local_setup
+
+    if run_mode is None:
+        run_mode = RunMode.RELEASE
+    if machines is None:
+        machines = local_setup(
+            [f"region{i + 1}" for i in range(exp.n)], exp.shard_count
+        )
+    all_local = all(type(m) is LocalMachine for m in machines.vms())
+    # region list ordered by region_index so group i talks to region
+    # i's client machine
+    regions_in_order = [
+        region
+        for (region, shard), (_pid, idx) in sorted(
+            machines.placement.items(), key=lambda kv: kv[1][1]
+        )
+        if shard == 0
+    ]
+    # (machine, remote, local, required) copies executed after the run
+    pulls: List[Tuple] = []
+
+    def _base(machine) -> str:
+        return machine.workdir or run_dir
+
+    def _pull(machine, name: str, required: bool = True) -> str:
+        """Machine-side path for artifact ``name``, registering the
+        post-run copy into ``run_dir`` when it lives remotely."""
+        remote = os.path.join(_base(machine), name)
+        if machine.workdir:
+            pulls.append(
+                (machine, remote, os.path.join(run_dir, name), required)
+            )
+        return remote
     # extras that change behavior must land in the directory name or
     # two variants of one base config overwrite each other; full key
     # names and zero values included (gc_interval_ms=0 is a different
@@ -198,17 +241,30 @@ def bench_experiment(
     ]
     servers: List[subprocess.Popen] = []
     client_procs: List[subprocess.Popen] = []
-    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_REPO)
     dstat = _DstatSampler()
 
+    def _env_cwd(machine):
+        """Per-machine spawn environment: the machine-side repo is the
+        working dir and import root."""
+        cwd = machine.workdir or _REPO
+        return {"JAX_PLATFORMS": "cpu", "PYTHONPATH": cwd}, cwd
+
     def _start_servers():
-        """Spawn all servers on freshly probed ports; returns the port
-        maps once every started marker has been seen."""
-        ports = _free_ports(2 * len(ids))
-        port_of = {pid: ports[2 * i] for i, (pid, _) in enumerate(ids)}
-        cport_of = {
-            pid: ports[2 * i + 1] for i, (pid, _) in enumerate(ids)
-        }
+        """Spawn all servers; returns the port maps once every started
+        marker has been seen. All-local testbeds probe free ports;
+        remote testbeds use the reference's fixed scheme
+        (config.rs:494-502) since remote ports cannot be probed."""
+        if all_local:
+            ports = _free_ports(2 * len(ids))
+            port_of = {
+                pid: ports[2 * i] for i, (pid, _) in enumerate(ids)
+            }
+            cport_of = {
+                pid: ports[2 * i + 1] for i, (pid, _) in enumerate(ids)
+            }
+        else:
+            port_of = {pid: 3000 + pid for pid, _ in ids}
+            cport_of = {pid: 4000 + pid for pid, _ in ids}
         for pid, shard in ids:
             mine = process_ids(shard, exp.n)
             idx = mine.index(pid)
@@ -221,6 +277,7 @@ def bench_experiment(
                     if s != shard
                 ]
             )
+            machine = machines.server(pid)
             cfg = ProtocolConfig(
                 protocol=exp.protocol,
                 process_id=pid,
@@ -231,26 +288,25 @@ def bench_experiment(
                 port=port_of[pid],
                 client_port=cport_of[pid],
                 addresses={
-                    q: ("127.0.0.1", port_of[q]) for q, _ in ids if q != pid
+                    q: (machines.server(q).ip(), port_of[q])
+                    for q, _ in ids
+                    if q != pid
                 },
                 peer_shards={q: s for q, s in ids if q != pid},
                 sorted_processes=sorted_ps,
                 gc_interval_ms=exp.extra.get("gc_interval_ms", 50),
-                metrics_file=os.path.join(
-                    run_dir, f".metrics_process_{pid}"
-                ),
+                metrics_file=_pull(machine, f".metrics_process_{pid}"),
                 execution_log=exp.extra.get("execution_log"),
             )
-            servers.append(
-                subprocess.Popen(
-                    [python, "-m", "fantoch_tpu"] + cfg.to_args(),
-                    stdout=subprocess.PIPE,
-                    stderr=subprocess.STDOUT,
-                    text=True,
-                    env=env,
-                    cwd=_REPO,
+            argv = [python, "-m", "fantoch_tpu"] + cfg.to_args()
+            if run_mode is not RunMode.RELEASE:
+                argv = run_mode.wrap(
+                    argv,
+                    # terminated servers may never dump their profile
+                    _pull(machine, f"server_{pid}.prof", required=False),
                 )
-            )
+            srv_env, srv_cwd = _env_cwd(machine)
+            servers.append(machine.popen(argv, env=srv_env, cwd=srv_cwd))
         # wait for every started marker (bench.rs wait_process_started)
         _wait_markers(
             servers,
@@ -299,13 +355,14 @@ def bench_experiment(
         for i, ((pid, shard), size) in enumerate(zip(shard0, sizes)):
             if size == 0:
                 continue
+            client_machine = machines.client(regions_in_order[i])
             shard_processes = {
                 s: process_ids(s, exp.n)[i] for s in range(exp.shard_count)
             }
             ccfg = ClientConfig(
                 ids=(cid, cid + size - 1),
                 addresses={
-                    s: ("127.0.0.1", cport_of[p])
+                    s: (machines.server(p).ip(), cport_of[p])
                     for s, p in shard_processes.items()
                 },
                 shard_processes=shard_processes,
@@ -317,18 +374,17 @@ def bench_experiment(
                     "batch_max_delay_ms", 5.0
                 ),
                 shard_count=exp.shard_count,
-                output=os.path.join(run_dir, f"client_{cid}.json"),
+                output=_pull(client_machine, f"client_{cid}.json"),
             )
-            cid += size
-            client_procs.append(
-                subprocess.Popen(
-                    [python, "-m", "fantoch_tpu"] + ccfg.to_args(),
-                    stdout=subprocess.PIPE,
-                    stderr=subprocess.STDOUT,
-                    text=True,
-                    env=env,
-                    cwd=_REPO,
+            argv = [python, "-m", "fantoch_tpu"] + ccfg.to_args()
+            if run_mode is not RunMode.RELEASE:
+                argv = run_mode.wrap(
+                    argv, _pull(client_machine, f"client_{cid}.prof")
                 )
+            cid += size
+            cli_env, cli_cwd = _env_cwd(client_machine)
+            client_procs.append(
+                client_machine.popen(argv, env=cli_env, cwd=cli_cwd)
             )
         for cp in client_procs:
             out, _ = cp.communicate(timeout=run_timeout_s)
@@ -347,6 +403,15 @@ def bench_experiment(
                 proc.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 proc.kill()
+
+    # pull remote artifacts into the experiment dir (bench.rs
+    # pull_metrics); profiles of terminated servers may not exist
+    for machine, remote, local, required in pulls:
+        try:
+            machine.copy_from(remote, local)
+        except (RuntimeError, OSError):
+            if required:
+                raise
 
     samples = dstat.finish()
     with open(os.path.join(run_dir, "dstat.json"), "w") as fh:

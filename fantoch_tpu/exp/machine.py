@@ -1,0 +1,259 @@
+"""Machine abstraction for experiment testbeds.
+
+Capability parity with ``fantoch_exp/src/machine.rs``: a ``Machine``
+executes commands, spawns long-running processes, and copies files —
+locally (``Machine::Local``) or over SSH (the reference reaches its
+tsunami-provisioned VMs through openssh sessions, machine.rs:30-130).
+``Machines`` is the placement container handed to the experiment loop
+(machine.rs:236-330): region/shard placement, one server machine per
+process, one client machine per region.
+
+The SSH transport shells out to ``ssh``/``scp`` argv (no paramiko in
+the image); tests point ``ssh_binary`` at a local stand-in, which is
+also the seam for any exotic transport.
+"""
+
+from __future__ import annotations
+
+import shlex
+import shutil
+import subprocess
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..core.ids import ProcessId, ShardId
+
+Region = str
+# (region, shard_id) -> (process_id, region_index); region_index is
+# 1-based like the reference's (config.rs Placement)
+Placement = Dict[Tuple[Region, ShardId], Tuple[ProcessId, int]]
+
+
+class Machine:
+    """One experiment host (machine.rs:15-230)."""
+
+    def ip(self) -> str:
+        raise NotImplementedError
+
+    def exec(self, command: str) -> str:
+        """Run ``command`` to completion; returns stdout, raises
+        ``RuntimeError`` on a nonzero exit (machine.rs exec)."""
+        raise NotImplementedError
+
+    #: directory artifacts live in on this machine; None means the
+    #: caller's local paths are directly usable (no pull needed)
+    workdir: Optional[str] = None
+
+    def popen(
+        self,
+        args: Sequence[str],
+        *,
+        env: Optional[Dict[str, str]] = None,
+        cwd: Optional[str] = None,
+    ) -> subprocess.Popen:
+        """Spawn a long-running process with piped stdout+stderr
+        (machine.rs prepare_exec): servers and clients are started
+        through this and watched via their output.  ``env`` entries are
+        overrides on top of the machine's base environment."""
+        raise NotImplementedError
+
+    def copy_to(self, local: str, remote: str) -> None:
+        raise NotImplementedError
+
+    def copy_from(self, remote: str, local: str) -> None:
+        raise NotImplementedError
+
+    def script_exec(self, path: str, args: List[str]) -> str:
+        """machine.rs script_exec: chmod + run an uploaded script."""
+        joined = " ".join(args)
+        return self.exec(f"chmod u+x {path} && ./{path} {joined}")
+
+
+def _popen(argv: Sequence[str], env, cwd) -> subprocess.Popen:
+    return subprocess.Popen(
+        list(argv),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=env,
+        cwd=cwd,
+    )
+
+
+class LocalMachine(Machine):
+    """``Machine::Local`` (machine.rs:18,36-37): this host."""
+
+    def ip(self) -> str:
+        return "127.0.0.1"
+
+    def exec(self, command: str) -> str:
+        proc = subprocess.run(
+            command, shell=True, capture_output=True, text=True
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"local exec failed rc={proc.returncode}: {command!r}: "
+                f"{proc.stderr}"
+            )
+        return proc.stdout
+
+    def popen(self, args, *, env=None, cwd=None) -> subprocess.Popen:
+        import os
+
+        merged = dict(os.environ, **env) if env else None
+        return _popen(args, merged, cwd)
+
+    def _copy(self, src: str, dst: str) -> None:
+        import os
+
+        if os.path.abspath(src) != os.path.abspath(dst):
+            shutil.copy(src, dst)
+
+    def copy_to(self, local: str, remote: str) -> None:
+        self._copy(local, remote)
+
+    def copy_from(self, remote: str, local: str) -> None:
+        self._copy(remote, local)
+
+
+class SshMachine(Machine):
+    """A remote host reached over ssh/scp argv (the reference reaches
+    tsunami VMs through openssh sessions, machine.rs:30-130; baremetal
+    hosts come as ``user@host`` lines, testbed/baremetal.rs:8-9,113-130).
+
+    ``env``/``cwd`` for spawned processes are encoded into the remote
+    command line (``cd`` + ``env``) since ssh does not forward either.
+    """
+
+    def __init__(
+        self,
+        host: str,
+        username: Optional[str] = None,
+        key_path: Optional[str] = None,
+        *,
+        workdir: Optional[str] = None,
+        ssh_binary: str = "ssh",
+        scp_binary: str = "scp",
+    ):
+        self.host = host
+        self.username = username
+        self.key_path = key_path
+        self.workdir = workdir
+        self.ssh_binary = ssh_binary
+        self.scp_binary = scp_binary
+
+    def _dest(self) -> str:
+        return f"{self.username}@{self.host}" if self.username else self.host
+
+    def _ssh_argv(self) -> List[str]:
+        argv = [self.ssh_binary, "-o", "StrictHostKeyChecking=no"]
+        if self.key_path:
+            argv += ["-i", self.key_path]
+        argv.append(self._dest())
+        return argv
+
+    def ip(self) -> str:
+        return self.host
+
+    def exec(self, command: str) -> str:
+        proc = subprocess.run(
+            self._ssh_argv() + [command], capture_output=True, text=True
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"ssh exec failed rc={proc.returncode} on "
+                f"{self._dest()}: {command!r}: {proc.stderr}"
+            )
+        return proc.stdout
+
+    def remote_command(
+        self,
+        args: Sequence[str],
+        env: Optional[Dict[str, str]] = None,
+        cwd: Optional[str] = None,
+    ) -> str:
+        parts = []
+        if cwd:
+            parts.append(f"cd {shlex.quote(cwd)} &&")
+        if env:
+            parts.append(
+                "env "
+                + " ".join(
+                    f"{k}={shlex.quote(str(v))}" for k, v in env.items()
+                )
+            )
+        parts.append(" ".join(shlex.quote(a) for a in args))
+        return " ".join(parts)
+
+    def popen(self, args, *, env=None, cwd=None) -> subprocess.Popen:
+        command = self.remote_command(args, env, cwd)
+        # the ssh process itself runs with OUR environment; the remote
+        # env rides inside the command line
+        return _popen(self._ssh_argv() + [command], None, None)
+
+    def copy_to(self, local: str, remote: str) -> None:
+        self._scp(local, f"{self._dest()}:{remote}")
+
+    def copy_from(self, remote: str, local: str) -> None:
+        self._scp(f"{self._dest()}:{remote}", local)
+
+    def _scp(self, src: str, dst: str) -> None:
+        argv = [self.scp_binary, "-o", "StrictHostKeyChecking=no"]
+        if self.key_path:
+            argv += ["-i", self.key_path]
+        proc = subprocess.run(
+            argv + [src, dst], capture_output=True, text=True
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"scp failed rc={proc.returncode}: {src} -> {dst}: "
+                f"{proc.stderr}"
+            )
+
+
+class Machines:
+    """Placement + per-process server machines + per-region client
+    machines (machine.rs:236-330)."""
+
+    def __init__(
+        self,
+        placement: Placement,
+        servers: Dict[ProcessId, Machine],
+        clients: Dict[Region, Machine],
+    ):
+        assert len(placement) == len(servers), (
+            "placement and servers should have the same cardinality"
+        )
+        self.placement = placement
+        self._servers = servers
+        self._clients = clients
+
+    def server(self, process_id: ProcessId) -> Machine:
+        return self._servers[process_id]
+
+    def servers(self) -> Iterable[Tuple[ProcessId, Machine]]:
+        return self._servers.items()
+
+    def client(self, region: Region) -> Machine:
+        return self._clients[region]
+
+    def clients(self) -> Iterable[Tuple[Region, Machine]]:
+        return self._clients.items()
+
+    def vms(self) -> Iterable[Machine]:
+        yield from self._servers.values()
+        yield from self._clients.values()
+
+    def server_count(self) -> int:
+        return len(self._servers)
+
+    def client_count(self) -> int:
+        return len(self._clients)
+
+    def vm_count(self) -> int:
+        return self.server_count() + self.client_count()
+
+    def process_region(self, process_id: ProcessId) -> Region:
+        for (region, _shard), (pid, _idx) in self.placement.items():
+            if pid == process_id:
+                return region
+        raise KeyError(process_id)

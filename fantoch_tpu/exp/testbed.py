@@ -1,0 +1,248 @@
+"""Experiment testbeds: Local, Baremetal, and AWS.
+
+Capability parity with ``fantoch_exp/src/testbed/``: every testbed
+produces the same :class:`~fantoch_tpu.exp.machine.Machines` container
+(placement + server machine per process + client machine per region)
+that the experiment loop consumes, differing only in where machines
+come from:
+
+* **local** (testbed/local.rs:8-67): every nickname maps to this host;
+* **baremetal** (testbed/baremetal.rs:24-130): ``user@host`` lines from
+  a machines file, one per nickname, reached over SSH with a private
+  key (the reference's ``exp_files/machines`` + ``~/.ssh/id_rsa``);
+* **aws** (testbed/aws.rs): the reference launches spot VMs in-process
+  through tsunami/rusoto; in a zero-egress TPU deployment provisioning
+  is an external step (aws CLI / terraform), so this testbed consumes a
+  region-keyed **inventory** of already-provisioned instances and wires
+  them identically from there.
+
+Also here: ``RunMode`` (lib.rs:26-70) — the reference wraps remote
+binaries in ``flamegraph``/``heaptrack``; the Python analog wraps the
+interpreter in ``cProfile`` with a per-process output file.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+from ..core.ids import ProcessId, ShardId, process_ids
+from .machine import (
+    LocalMachine,
+    Machine,
+    Machines,
+    Placement,
+    Region,
+    SshMachine,
+)
+
+_SERVER_TAG = "server"
+_CLIENT_TAG = "client"
+_SEP = "_"
+
+
+class RunMode(enum.Enum):
+    """lib.rs:26-70. RELEASE runs the plain interpreter; CPROFILE wraps
+    it in ``python -m cProfile -o <file>`` (the flamegraph/heaptrack
+    analog — a per-process profile artifact pulled with the metrics).
+    Profiles are written on clean exit, so they are reliable for
+    clients (which finish their budget) and best-effort for servers
+    (which are terminated)."""
+
+    RELEASE = "release"
+    CPROFILE = "cprofile"
+
+    def wrap(self, argv: Sequence[str], profile_file: str) -> List[str]:
+        argv = list(argv)
+        if self is RunMode.RELEASE:
+            return argv
+        python = argv[0]
+        rest = argv[1:]
+        return [python, "-m", "cProfile", "-o", profile_file] + rest
+
+
+@dataclass
+class Nickname:
+    """testbed/mod.rs:14-59: ``server_<region>_<shard>`` for servers,
+    ``client_<region>`` for clients."""
+
+    region: Region
+    shard_id: Optional[ShardId]
+
+    def to_string(self) -> str:
+        if self.shard_id is not None:
+            return f"{_SERVER_TAG}{_SEP}{self.region}{_SEP}{self.shard_id}"
+        return f"{_CLIENT_TAG}{_SEP}{self.region}"
+
+    @staticmethod
+    def from_string(nickname: str) -> "Nickname":
+        parts = nickname.split(_SEP)
+        if parts[0] == _SERVER_TAG:
+            assert len(parts) == 3
+            return Nickname(parts[1], int(parts[2]))
+        assert parts[0] == _CLIENT_TAG and len(parts) == 2
+        return Nickname(parts[1], None)
+
+
+def create_nicknames(
+    shard_count: int, regions: Sequence[Region]
+) -> List[Nickname]:
+    """testbed/mod.rs:62-79: per region, one server per shard then one
+    client — this order is also the machines-file order for baremetal."""
+    nicknames: List[Nickname] = []
+    for region in regions:
+        for shard_id in range(shard_count):
+            nicknames.append(Nickname(region, shard_id))
+        nicknames.append(Nickname(region, None))
+    return nicknames
+
+
+def create_placement(
+    shard_count: int, regions: Sequence[Region]
+) -> Placement:
+    """testbed/mod.rs:80-128: ``process_id = region_index + shard * n``
+    with 1-based region indexes, so shard s owns the contiguous id
+    block ``s*n+1 ..= (s+1)*n`` (checked against ``process_ids``)."""
+    n = len(regions)
+    placement: Placement = {}
+    for index, region in enumerate(regions):
+        region_index = index + 1
+        for shard_id in range(shard_count):
+            process_id = region_index + shard_id * n
+            placement[(region, shard_id)] = (process_id, region_index)
+    for (_, shard_id), (pid, _) in placement.items():
+        assert pid in process_ids(shard_id, n), (
+            "generated process id should exist in all ids"
+        )
+    return placement
+
+
+def _build_machines(
+    shard_count: int,
+    regions: Sequence[Region],
+    machine_for: Dict[str, Machine],
+) -> Machines:
+    """Common wiring (testbed/{local,baremetal}.rs:35-67,78-110): map
+    each nickname's machine into the servers/clients containers."""
+    placement = create_placement(shard_count, regions)
+    servers: Dict[ProcessId, Machine] = {}
+    clients: Dict[Region, Machine] = {}
+    for nickname in create_nicknames(shard_count, regions):
+        vm = machine_for[nickname.to_string()]
+        if nickname.shard_id is not None:
+            pid, _ = placement[(nickname.region, nickname.shard_id)]
+            assert pid not in servers
+            servers[pid] = vm
+        else:
+            assert nickname.region not in clients
+            clients[nickname.region] = vm
+    assert len(servers) == len(regions) * shard_count, "not enough servers"
+    assert len(clients) == len(regions), "not enough clients"
+    return Machines(placement, servers, clients)
+
+
+def local_setup(regions: Sequence[Region], shard_count: int) -> Machines:
+    """testbed/local.rs:8-67: every machine is this host."""
+    machine_for = {
+        nickname.to_string(): LocalMachine()
+        for nickname in create_nicknames(shard_count, regions)
+    }
+    return _build_machines(shard_count, regions, machine_for)
+
+
+def baremetal_setup(
+    regions: Sequence[Region],
+    shard_count: int,
+    machines_file: str,
+    *,
+    key_path: Optional[str] = "~/.ssh/id_rsa",
+    workdir: Optional[str] = None,
+    ssh_binary: str = "ssh",
+    scp_binary: str = "scp",
+) -> Machines:
+    """testbed/baremetal.rs:24-130: one ``user@host`` line per nickname
+    (nickname order, see :func:`create_nicknames`), reached over SSH."""
+    with open(os.path.expanduser(machines_file)) as fh:
+        lines = [ln.strip() for ln in fh if ln.strip()]
+    nicknames = create_nicknames(shard_count, regions)
+    assert len(lines) >= len(nicknames), (
+        f"not enough machines: need {len(nicknames)}, file has {len(lines)}"
+    )
+
+    def to_machine(line: str) -> SshMachine:
+        username, _, host = line.rpartition("@")
+        return SshMachine(
+            host,
+            username or None,
+            os.path.expanduser(key_path) if key_path else None,
+            workdir=workdir,
+            ssh_binary=ssh_binary,
+            scp_binary=scp_binary,
+        )
+
+    machine_for = {
+        nickname.to_string(): to_machine(line)
+        for nickname, line in zip(nicknames, lines)
+    }
+    return _build_machines(shard_count, regions, machine_for)
+
+
+def aws_setup(
+    regions: Sequence[Region],
+    shard_count: int,
+    inventory_file: str,
+    *,
+    key_path: Optional[str] = None,
+    workdir: Optional[str] = None,
+    ssh_binary: str = "ssh",
+    scp_binary: str = "scp",
+) -> Machines:
+    """testbed/aws.rs analog over pre-provisioned instances.
+
+    The inventory is JSON ``{region: [host, ...]}`` with
+    ``shard_count + 1`` hosts per region (servers in shard order, then
+    the client machine) — the output of whatever provisioning step
+    replaces the reference's in-process tsunami spot-VM launcher.
+    """
+    with open(os.path.expanduser(inventory_file)) as fh:
+        inventory: Dict[str, List[str]] = json.load(fh)
+    machine_for: Dict[str, Machine] = {}
+    for region in regions:
+        hosts = inventory.get(region, [])
+        assert len(hosts) >= shard_count + 1, (
+            f"region {region}: need {shard_count + 1} hosts, "
+            f"inventory has {len(hosts)}"
+        )
+        def to_machine(line: str) -> SshMachine:
+            username, _, host = line.rpartition("@")
+            return SshMachine(
+                host,
+                username or None,
+                os.path.expanduser(key_path) if key_path else None,
+                workdir=workdir,
+                ssh_binary=ssh_binary,
+                scp_binary=scp_binary,
+            )
+
+        for shard_id in range(shard_count):
+            machine_for[
+                Nickname(region, shard_id).to_string()
+            ] = to_machine(hosts[shard_id])
+        machine_for[Nickname(region, None).to_string()] = to_machine(
+            hosts[shard_count]
+        )
+    return _build_machines(shard_count, regions, machine_for)
+
+
+def machine_setup(machine: Machine, repo_dir: str) -> None:
+    """machine.rs fantoch_setup analog: make sure the framework is
+    importable on the machine. The reference clones + ``cargo build``s
+    a branch on every VM; this framework is pure Python, so setup is
+    an import check against the synced repo directory."""
+    machine.exec(
+        f"cd {repo_dir} && "
+        "python -c 'import fantoch_tpu' && echo fantoch_tpu ok"
+    )

@@ -1,16 +1,29 @@
 """Experiment-orchestration tests: the full fantoch_exp-style loop —
-real server and client subprocesses started from generated CLI args on
-the Local testbed, metrics pulled into an experiment dir
-(fantoch_exp/src/bench.rs:43-187).
+real server and client subprocesses started from generated CLI args
+over the testbed machinery (Local directly; Baremetal/SSH through a
+local stand-in transport), metrics pulled into an experiment dir
+(fantoch_exp/src/bench.rs:43-187, machine.rs, testbed/).
 """
 
 from __future__ import annotations
 
+import json
+import os
+import stat
+
 from fantoch_tpu.exp import (
     ClientConfig,
     ExperimentConfig,
+    LocalMachine,
     ProtocolConfig,
+    RunMode,
+    SshMachine,
+    aws_setup,
+    baremetal_setup,
     bench_experiment,
+    create_nicknames,
+    create_placement,
+    local_setup,
 )
 from fantoch_tpu.exp.bench import load_experiment
 from fantoch_tpu.protocol.base import ProtocolMetricsKind
@@ -38,6 +51,112 @@ def test_to_args_roundtrip():
     assert cargs[cargs.index("--ids") + 1] == "1-4"
 
 
+def test_placement_scheme():
+    """testbed/mod.rs:80-128's documented example: shard_count=3 over
+    [A..E] gives (A,0)->1, (A,1)->6, (A,2)->11, (B,0)->2, ..."""
+    placement = create_placement(3, ["A", "B", "C", "D", "E"])
+    assert placement[("A", 0)] == (1, 1)
+    assert placement[("A", 1)] == (6, 1)
+    assert placement[("A", 2)] == (11, 1)
+    assert placement[("B", 0)] == (2, 2)
+    assert placement[("B", 1)] == (7, 2)
+    assert len(placement) == 15
+
+
+def test_nicknames_roundtrip():
+    from fantoch_tpu.exp import Nickname
+
+    nicknames = create_nicknames(2, ["eu", "us"])
+    assert [n.to_string() for n in nicknames] == [
+        "server_eu_0", "server_eu_1", "client_eu",
+        "server_us_0", "server_us_1", "client_us",
+    ]
+    for n in nicknames:
+        back = Nickname.from_string(n.to_string())
+        assert (back.region, back.shard_id) == (n.region, n.shard_id)
+
+
+def test_local_machine_exec_copy(tmp_path):
+    m = LocalMachine()
+    assert m.ip() == "127.0.0.1"
+    assert m.exec("echo hello").strip() == "hello"
+    src = tmp_path / "a.txt"
+    src.write_text("payload")
+    m.copy_to(str(src), str(tmp_path / "b.txt"))
+    assert (tmp_path / "b.txt").read_text() == "payload"
+    # same-path copies are a no-op, not an error
+    m.copy_from(str(src), str(src))
+
+
+def _fake_transport(tmp_path):
+    """A local stand-in for ssh/scp: the ssh binary runs the remote
+    command through /bin/sh, the scp binary strips host: prefixes and
+    copies — so the full SshMachine path (argv construction, env/cwd
+    encoding into the command line, artifact pulling) runs hermetically
+    on this host."""
+    ssh = tmp_path / "fake_ssh"
+    ssh.write_text(
+        "#!/usr/bin/env python\n"
+        "import subprocess, sys\n"
+        "sys.exit(subprocess.call(['/bin/sh', '-c', sys.argv[-1]]))\n"
+    )
+    scp = tmp_path / "fake_scp"
+    scp.write_text(
+        "#!/usr/bin/env python\n"
+        "import shutil, sys\n"
+        "strip = lambda p: p.split(':', 1)[1] if ':' in p and not "
+        "p.startswith('/') else p\n"
+        "shutil.copy(strip(sys.argv[-2]), strip(sys.argv[-1]))\n"
+    )
+    for f in (ssh, scp):
+        f.chmod(f.stat().st_mode | stat.S_IXUSR)
+    return str(ssh), str(scp)
+
+
+def test_ssh_machine_exec_and_copy(tmp_path):
+    ssh, scp = _fake_transport(tmp_path)
+    m = SshMachine(
+        "10.0.0.7", "ubuntu", ssh_binary=ssh, scp_binary=scp
+    )
+    assert m.ip() == "10.0.0.7"
+    assert m.exec("echo remote").strip() == "remote"
+    # env/cwd ride inside the remote command line
+    cmd = m.remote_command(
+        ["printenv", "MARKER"], env={"MARKER": "x y"}, cwd="/tmp"
+    )
+    assert cmd == "cd /tmp && env MARKER='x y' printenv MARKER"
+    src = tmp_path / "metrics.bin"
+    src.write_text("data")
+    m.copy_from(str(src), str(tmp_path / "pulled.bin"))
+    assert (tmp_path / "pulled.bin").read_text() == "data"
+
+
+def test_baremetal_and_aws_setup(tmp_path):
+    machines_file = tmp_path / "machines"
+    machines_file.write_text(
+        "\n".join(f"ubuntu@10.0.0.{i}" for i in range(1, 7)) + "\n"
+    )
+    ms = baremetal_setup(
+        ["eu", "us"], 2, str(machines_file), key_path=None
+    )
+    # nickname order: eu servers (shards 0,1), eu client, us ...
+    assert ms.server(1).ip() == "10.0.0.1"  # (eu, shard 0) -> pid 1
+    assert ms.server(3).ip() == "10.0.0.2"  # (eu, shard 1) -> pid 3
+    assert ms.client("eu").ip() == "10.0.0.3"
+    assert ms.server(2).ip() == "10.0.0.4"
+    assert ms.vm_count() == 6
+    assert all(isinstance(m, SshMachine) for m in ms.vms())
+
+    inventory = tmp_path / "inventory.json"
+    inventory.write_text(json.dumps({
+        "eu": ["ec2-1", "ec2-2", "ec2-3"],
+        "us": ["ec2-4", "ec2-5", "ec2-6"],
+    }))
+    aws = aws_setup(["eu", "us"], 2, str(inventory))
+    assert aws.server(1).ip() == "ec2-1"
+    assert aws.client("us").ip() == "ec2-6"
+
+
 def test_local_experiment_tempo(tmp_path):
     exp = ExperimentConfig(
         protocol="tempo", n=3, f=1, shard_count=1,
@@ -57,3 +176,54 @@ def test_local_experiment_tempo(tmp_path):
         fast += pm.get_aggregated(ProtocolMetricsKind.FAST_PATH) or 0
         slow += pm.get_aggregated(ProtocolMetricsKind.SLOW_PATH) or 0
     assert fast + slow == 15, (fast, slow)
+
+
+def test_local_testbed_experiment_with_profile(tmp_path):
+    """An explicit local testbed + RunMode.CPROFILE: the experiment
+    completes and every client leaves a cProfile artifact (the
+    flamegraph/heaptrack analog, lib.rs:26-70)."""
+    exp = ExperimentConfig(
+        protocol="basic", n=3, f=1, shard_count=1,
+        clients=3, commands_per_client=3, conflict=0,
+    )
+    machines = local_setup(["r1", "r2", "r3"], 1)
+    run_dir = bench_experiment(
+        exp, str(tmp_path), machines=machines, run_mode=RunMode.CPROFILE
+    )
+    loaded = load_experiment(run_dir)
+    total = sum(len(v) for v in loaded["clients"].values())
+    assert total == 3 * 3
+    profs = [f for f in os.listdir(run_dir) if f.endswith(".prof")]
+    assert any(f.startswith("client_") for f in profs), profs
+
+
+def test_baremetal_testbed_experiment_fake_ssh(tmp_path):
+    """The full baremetal path over the local ssh stand-in: machines
+    come from a user@host file, servers get the reference's fixed port
+    scheme (config.rs:494-502), commands ride an ssh command line with
+    env/cwd encoded, and artifacts are pulled with scp into the
+    experiment dir."""
+    ssh, scp = _fake_transport(tmp_path)
+    workdir = tmp_path / "remote_repo"
+    workdir.mkdir()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    os.symlink(
+        os.path.join(repo, "fantoch_tpu"), workdir / "fantoch_tpu"
+    )
+    machines_file = tmp_path / "machines"
+    # every "host" is this machine through the fake transport
+    machines_file.write_text("127.0.0.1\n" * 6)
+    machines = baremetal_setup(
+        ["r1", "r2", "r3"], 1, str(machines_file),
+        key_path=None, workdir=str(workdir),
+        ssh_binary=ssh, scp_binary=scp,
+    )
+    exp = ExperimentConfig(
+        protocol="basic", n=3, f=1, shard_count=1,
+        clients=3, commands_per_client=3, conflict=0,
+    )
+    run_dir = bench_experiment(exp, str(tmp_path / "out"), machines=machines)
+    loaded = load_experiment(run_dir)
+    total = sum(len(v) for v in loaded["clients"].values())
+    assert total == 3 * 3
+    assert sorted(loaded["metrics"]) == [1, 2, 3]
