@@ -62,9 +62,13 @@ def main() -> None:
     # smoke runs (JAX_PLATFORMS=cpu) force the CPU backend even under
     # the axon site hook; driver runs leave the env unset and get the
     # real device
-    from fantoch_tpu.platform import force_cpu_from_env
+    from fantoch_tpu.platform import enable_compile_cache, force_cpu_from_env
 
     force_cpu_from_env()
+    cache_dir = enable_compile_cache()
+    import sys as _sys
+
+    print(f"bench: compile cache at {cache_dir}", file=_sys.stderr)
     planet = Planet.new()
     regions = planet.regions()
     # stride through C(20,5) so subsets are genuinely distinct (the
@@ -211,11 +215,20 @@ def _infra_shaped(e: BaseException) -> bool:
         name = type(e).__name__
         if any(m in name for m in _TRACE_BUG_MARKERS):
             return False
-        # deterministic XLA statuses are code bugs too (a bad lane
-        # shape raises INVALID_ARGUMENT on every attempt) — only
-        # status-less worker deaths and availability statuses point
-        # at the tunnel
-        return not any(s in str(e) for s in _DETERMINISTIC_XLA_STATUSES)
+        msg = str(e)
+        # availability markers win outright: a tunneled-backend failure
+        # often embeds secondary status text (NOT_FOUND inside an
+        # UNAVAILABLE chain) that must not be mistaken for a code bug
+        if "UNAVAILABLE" in msg or "Unable to initialize backend" in msg:
+            return True
+        # deterministic XLA statuses are code bugs (a bad lane shape
+        # raises INVALID_ARGUMENT on every attempt) — but only when the
+        # status is the error's own leading token, not text quoted from
+        # some inner cause
+        head = msg.lstrip()[:64]
+        return not any(
+            head.startswith(s) for s in _DETERMINISTIC_XLA_STATUSES
+        )
     if isinstance(e, RuntimeError):
         msg = str(e).lower()
         return "backend" in msg or "tpu" in msg or "device" in msg

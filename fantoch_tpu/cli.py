@@ -56,6 +56,13 @@ def _probe_backend(timeout_s: float) -> bool:
 def _apply_platform(platform: str, cmd: str) -> None:
     import os
 
+    if cmd in DEVICE_COMMANDS:
+        # device subcommands compile big engine graphs (CaesarDev is
+        # minutes of XLA work): share the persistent compile cache so
+        # each trace is paid once ever, not once per CLI invocation
+        from .platform import enable_compile_cache
+
+        enable_compile_cache()
     if platform == "cpu" or cmd not in DEVICE_COMMANDS:
         # host-only subcommands never touch a device: no probe, no
         # fail-fast, whatever --platform says
@@ -462,12 +469,49 @@ def cmd_proc(args) -> None:
             metrics_file=args.metrics_file,
             metrics_interval_ms=args.metrics_interval,
             execution_log=args.execution_log,
+            connect_retries=args.connect_retries,
         )
         loop = asyncio.get_running_loop()
+
+        # SIGTERM must terminate the process in EVERY state. The
+        # graceful path (stop_event → shutdown) can wedge — e.g. every
+        # replica of a cluster signalled simultaneously, each blocked
+        # on peers that are also dying — so arm a daemon-thread
+        # watchdog that force-exits once the grace period runs out
+        # (a thread, not a task: a wedged event loop never runs tasks).
+        # A second signal force-exits immediately.
+        import os
+        import threading
+
+        grace_s = float(os.environ.get("FANTOCH_SHUTDOWN_GRACE_S", "15"))
+
+        def _force_exit() -> None:
+            print(
+                f"process {args.id}: shutdown grace ({grace_s:.0f}s) "
+                "expired; forcing exit",
+                flush=True,
+            )
+            os._exit(0)
+
+        def _on_signal() -> None:
+            if handle.stop_event.is_set():
+                os._exit(1)
+            handle.stop_event.set()
+            timer = threading.Timer(grace_s, _force_exit)
+            timer.daemon = True
+            timer.start()
+
         for sig in (signal.SIGTERM, signal.SIGINT):
-            loop.add_signal_handler(sig, handle.stop_event.set)
-        await handle.started.wait()
-        print(f"process {args.id} started", flush=True)
+            loop.add_signal_handler(sig, _on_signal)
+        # a SIGTERM that aborts the bootstrap means `started` never
+        # fires — wait on whichever resolves first
+        started = asyncio.create_task(handle.started.wait())
+        await asyncio.wait(
+            {started, handle.task}, return_when=asyncio.FIRST_COMPLETED
+        )
+        started.cancel()
+        if handle.started.is_set():
+            print(f"process {args.id} started", flush=True)
         await handle.task
 
     asyncio.run(main_())
@@ -603,6 +647,8 @@ def main(argv=None) -> None:
                     help="TCP connections per peer")
     pr.add_argument("--delay", type=int, default=0,
                     help="artificial per-connection delay (ms)")
+    pr.add_argument("--connect-retries", type=int, default=100,
+                    help="per-peer connection attempts (50ms apart)")
     pr.add_argument("--metrics-file", default=None)
     pr.add_argument("--metrics-interval", type=int, default=1000)
     pr.add_argument("--execution-log", default=None)

@@ -156,6 +156,62 @@ def _proc_snapshot() -> Dict[str, float]:
     return out
 
 
+def _stop_remote(machine, ports: List[int], patterns: List[str]) -> None:
+    """Kill the experiment's processes ON the machine itself, mirroring
+    the reference's stop_process (fantoch_exp/src/bench.rs:596-634
+    ``lsof -i :port | kill``). Needed because for an SSH machine the
+    local ``Popen`` is only the ssh client — terminating it leaves the
+    remote command running (no tty, so the signal never propagates).
+    Tries lsof (reference parity), fuser, and a pkill fallback on the
+    ``--port N`` argv, since any given host has some subset of the
+    three; escalates to SIGKILL for anything still alive after 1 s."""
+    def esc(pat: str) -> str:
+        """Bracket the first alphanumeric so the pattern can never
+        match the shell that carries it in its own command line."""
+        for i, ch in enumerate(pat):
+            if ch.isalnum():
+                return f"{pat[:i]}[{ch}]{pat[i + 1:]}"
+        return pat
+
+    pats = [
+        esc(f"fantoch_tpu.*--port {p}([^0-9]|$)") for p in ports
+    ] + [esc(p) for p in patterns]
+
+    def round_(sig_kill: bool) -> str:
+        k9 = "-9 " if sig_kill else ""
+        fsig = "-KILL" if sig_kill else "-TERM"
+        cmds = []
+        for p in ports:
+            cmds.append(
+                f"lsof -t -i :{p} -sTCP:LISTEN 2>/dev/null "
+                f"| xargs -r kill {k9}2>/dev/null"
+            )
+            cmds.append(f"fuser -k {fsig} {p}/tcp 2>/dev/null")
+        for pat in pats:
+            cmds.append(f"pkill {fsig} -f -- '{pat}' 2>/dev/null")
+        return "; ".join(cmds)
+
+    probe = "; ".join(
+        [f"lsof -t -i :{p} -sTCP:LISTEN 2>/dev/null" for p in ports]
+        + [f"pgrep -f -- '{pat}' 2>/dev/null" for pat in pats]
+    )
+    try:
+        machine.exec(
+            f"{round_(False)}; "
+            # poll up to 10 s for a graceful exit (a server mid-
+            # shutdown is flushing metrics — SIGKILLing it early would
+            # truncate the artifacts the pull step needs), then
+            # escalate to SIGKILL for whatever is genuinely stuck
+            "i=0; while [ \"$i\" -lt 10 ]; do "
+            f"[ -z \"$({probe}; true)\" ] && break; "
+            "sleep 1; i=$((i+1)); done; "
+            f"if [ -n \"$({probe}; true)\" ]; then "
+            f"{round_(True)}; fi; true"
+        )
+    except (RuntimeError, OSError):
+        pass  # dead transport: nothing more we can do from here
+
+
 def bench_experiment(
     exp: ExperimentConfig,
     output_dir: str,
@@ -192,6 +248,14 @@ def bench_experiment(
             [f"region{i + 1}" for i in range(exp.n)], exp.shard_count
         )
     all_local = all(type(m) is LocalMachine for m in machines.vms())
+    # a remote machine without a workdir would silently run with the
+    # driver's local paths (cwd/PYTHONPATH/artifacts) on the remote
+    # host and the run would "complete" with missing metrics
+    for m in machines.vms():
+        assert type(m) is LocalMachine or m.workdir, (
+            f"machine {m.ip()} is remote but has no workdir; pass "
+            "workdir= to baremetal_setup/aws_setup"
+        )
     # region list ordered by region_index so group i talks to region
     # i's client machine
     regions_in_order = [
@@ -241,6 +305,25 @@ def bench_experiment(
     ]
     servers: List[subprocess.Popen] = []
     client_procs: List[subprocess.Popen] = []
+    # (machine, ports, patterns) for the machine-side cleanup of every
+    # process spawned through a non-local machine (see _stop_remote)
+    remote_kills: Dict[int, Tuple] = {}
+
+    def _register_remote(machine, port=None, pattern=None):
+        if type(machine) is LocalMachine:
+            return
+        _m, ports, pats = remote_kills.setdefault(
+            id(machine), (machine, [], [])
+        )
+        if port is not None and port not in ports:
+            ports.append(port)
+        if pattern is not None and pattern not in pats:
+            pats.append(pattern)
+
+    def _kill_remote():
+        for machine, ports, pats in remote_kills.values():
+            _stop_remote(machine, ports, pats)
+
     dstat = _DstatSampler()
 
     def _env_cwd(machine):
@@ -278,6 +361,8 @@ def bench_experiment(
                 ]
             )
             machine = machines.server(pid)
+            _register_remote(machine, port=port_of[pid])
+            _register_remote(machine, port=cport_of[pid])
             cfg = ProtocolConfig(
                 protocol=exp.protocol,
                 process_id=pid,
@@ -294,6 +379,11 @@ def bench_experiment(
                 },
                 peer_shards={q: s for q, s in ids if q != pid},
                 sorted_processes=sorted_ps,
+                # the intra-machine scalability axis (lib.rs:914-955
+                # refines per cpu count): fan the server across that
+                # many worker/executor tasks
+                workers=int(exp.extra.get("cpus", 1)),
+                executors=int(exp.extra.get("cpus", 1)),
                 gc_interval_ms=exp.extra.get("gc_interval_ms", 50),
                 metrics_file=_pull(machine, f".metrics_process_{pid}"),
                 execution_log=exp.extra.get("execution_log"),
@@ -334,6 +424,11 @@ def bench_experiment(
                     except subprocess.TimeoutExpired:
                         proc.kill()
                 servers.clear()
+                # a squatting leftover (e.g. an orphan from a crashed
+                # earlier run on the fixed port scheme) never frees the
+                # port by itself — clear it on the machine before the
+                # retry rebinds
+                _kill_remote()
                 if "address already in use" not in str(e).lower():
                     raise
                 if attempt == 2:
@@ -382,6 +477,9 @@ def bench_experiment(
                     argv, _pull(client_machine, f"client_{cid}.prof")
                 )
             cid += size
+            # the client's unique --output path identifies it for the
+            # machine-side cleanup (clients have no listen port)
+            _register_remote(client_machine, pattern=ccfg.output)
             cli_env, cli_cwd = _env_cwd(client_machine)
             client_procs.append(
                 client_machine.popen(argv, env=cli_env, cwd=cli_cwd)
@@ -403,6 +501,10 @@ def bench_experiment(
                 proc.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 proc.kill()
+        # for SSH machines the Popens above are only the local ssh
+        # clients — the remote processes survive them; kill those on
+        # the machine itself (bench.rs:596-634 stop_process)
+        _kill_remote()
 
     # pull remote artifacts into the experiment dir (bench.rs
     # pull_metrics); profiles of terminated servers may not exist

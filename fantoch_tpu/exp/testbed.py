@@ -94,6 +94,13 @@ def create_nicknames(
     client — this order is also the machines-file order for baremetal."""
     nicknames: List[Nickname] = []
     for region in regions:
+        # '_' is the nickname separator: a region like "us_east" would
+        # serialize fine but misparse in Nickname.from_string (the
+        # reference has the same implicit constraint; make it explicit)
+        assert _SEP not in region, (
+            f"region name {region!r} must not contain {_SEP!r} "
+            "(the nickname separator)"
+        )
         for shard_id in range(shard_count):
             nicknames.append(Nickname(region, shard_id))
         nicknames.append(Nickname(region, None))

@@ -12,9 +12,13 @@ from .db import load_results, save_results
 from .experiment import (
     batching_plot,
     batching_points,
+    cdf_plot_split,
     dstat_heatmap,
     dstat_table,
     experiment_points,
+    inter_machine_scalability_plot,
+    intra_machine_scalability_plot,
+    intra_machine_scalability_points,
     process_metrics_table,
     throughput_latency_plot,
 )
@@ -24,10 +28,14 @@ __all__ = [
     "batching_plot",
     "batching_points",
     "cdf_plot",
+    "cdf_plot_split",
     "conflict_latency_plot",
     "dstat_heatmap",
     "dstat_table",
     "experiment_points",
+    "inter_machine_scalability_plot",
+    "intra_machine_scalability_plot",
+    "intra_machine_scalability_points",
     "latency_bar_plot",
     "load_results",
     "process_metrics_table",

@@ -221,6 +221,181 @@ def dstat_heatmap(run_dirs: Sequence[str], path: str,
     plt.close(fig)
 
 
+def intra_machine_scalability_points(
+    run_dirs: Sequence[str], n: int,
+) -> Dict[str, List[Tuple[int, float]]]:
+    """(cpus → max throughput K ops/s) per protocol/key-gen label —
+    fantoch_plot's intra_machine_scalability_plot (lib.rs:914-955),
+    which refines a search per cpu count and takes the max throughput
+    over the matching runs (several client counts per cpu setting).
+
+    The cpu axis rides ``exp_config.extra["cpus"]`` — the worker/
+    executor parallelism the run was pinned to (the reference pins the
+    server binary to a taskset of that width)."""
+    series: Dict[str, Dict[int, float]] = {}
+    for run_dir in run_dirs:
+        exp = load_experiment(run_dir)
+        cfg = exp["config"]
+        assert cfg["n"] == n, (
+            f"intra_machine_scalability: run has n={cfg['n']}, want {n}"
+        )
+        cpus = cfg.get("extra", {}).get("cpus")
+        if cpus is None:
+            continue
+        rates = _run_rates(exp)
+        if rates is None:
+            continue
+        throughput, _ = rates
+        label = f"{cfg['protocol']} r={cfg['conflict']}"
+        best = series.setdefault(label, {})
+        best[cpus] = max(best.get(cpus, 0.0), throughput / 1000.0)
+    return {
+        label: sorted(best.items()) for label, best in series.items()
+    }
+
+
+def intra_machine_scalability_plot(
+    series: Dict[str, List[Tuple[int, float]]],
+    path: str,
+    title: Optional[str] = None,
+):
+    """Max throughput vs per-machine cpu count, one line per search —
+    the figure for the series lib.rs:914-955 prints."""
+    fig, ax = plt.subplots(figsize=(4.6, 3.2))
+    for label, points in series.items():
+        ax.plot(
+            [c for c, _ in points], [tp for _, tp in points],
+            marker="o", markersize=4, label=label,
+        )
+    ax.set_xlabel("cpus")
+    ax.set_ylabel("max. throughput (K ops/s)")
+    if title:
+        ax.set_title(title)
+    ax.grid(alpha=0.3)
+    ax.legend(fontsize=8)
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return path
+
+
+def inter_machine_scalability_plot(
+    run_dirs: Sequence[str],
+    n: int,
+    path: str,
+    title: Optional[str] = None,
+):
+    """Grouped bars of max throughput per (shard_count, keys_per_
+    command, conflict/zipf) setting, one bar series per protocol —
+    fantoch_plot's inter_machine_scalability_plot (lib.rs:956-1010):
+    x groups are the workload settings (the reference labels them by
+    zipf coefficient and annotates the shard counts), bars within a
+    group are the protocol variants, y is max throughput in K ops/s."""
+    per_proto: Dict[str, Dict[Tuple, float]] = {}
+    settings: List[Tuple] = []
+    for run_dir in run_dirs:
+        exp = load_experiment(run_dir)
+        cfg = exp["config"]
+        assert cfg["n"] == n, (
+            f"inter_machine_scalability: run has n={cfg['n']}, want {n}"
+        )
+        extra = cfg.get("extra", {})
+        setting = (
+            cfg["shard_count"],
+            extra.get("keys_per_command", 1),
+            cfg["conflict"],
+        )
+        rates = _run_rates(exp)
+        if rates is None:
+            continue
+        throughput, _ = rates
+        if setting not in settings:
+            settings.append(setting)
+        best = per_proto.setdefault(cfg["protocol"], {})
+        best[setting] = max(best.get(setting, 0.0), throughput / 1000.0)
+    settings.sort()
+    if not settings:
+        raise ValueError("no usable runs in the given run dirs")
+
+    fig, ax = plt.subplots(figsize=(5.2, 3.4))
+    combos = sorted(per_proto)
+    group_w = 0.8
+    bar_w = group_w / max(len(combos), 1)
+    xs = list(range(len(settings)))
+    for i, proto in enumerate(combos):
+        offs = (i - len(combos) / 2 + 0.5) * bar_w
+        ys = [per_proto[proto].get(s, 0.0) for s in settings]
+        ax.bar([x + offs for x in xs], ys, width=bar_w, label=proto)
+    ax.set_xticks(xs)
+    ax.set_xticklabels(
+        [f"s={s} k={k}\nr={r}" for s, k, r in settings], fontsize=7.5
+    )
+    ax.set_ylabel("max. throughput (K ops/s)")
+    if title:
+        ax.set_title(title)
+    ax.legend(fontsize=8)
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return path
+
+
+def _client_cdf(exp) -> Optional[Tuple[List[float], List[float]]]:
+    """Pooled client-latency CDF of one experiment run (ms)."""
+    lats_us: List[int] = []
+    for lats in exp["clients"].values():
+        lats_us.extend(lats)
+    if not lats_us:
+        return None
+    lats_ms = sorted(v / 1000.0 for v in lats_us)
+    cum = [(i + 1) / len(lats_ms) for i in range(len(lats_ms))]
+    return lats_ms, cum
+
+
+def cdf_plot_split(
+    top_run_dirs: Sequence[str],
+    bottom_run_dirs: Sequence[str],
+    path: str,
+    title: Optional[str] = None,
+):
+    """Two stacked latency-CDF panels sharing one x-axis —
+    fantoch_plot's cdf_plot_split (lib.rs:466-528), used to contrast
+    two search groups (the paper splits f=1 above f=2) on one scale."""
+    fig, (ax_top, ax_bot) = plt.subplots(
+        2, 1, figsize=(4.6, 4.6), sharex=True,
+        gridspec_kw={"hspace": 0.2},
+    )
+    plotted = 0
+    for ax, dirs in ((ax_top, top_run_dirs), (ax_bot, bottom_run_dirs)):
+        for run_dir in dirs:
+            exp = load_experiment(run_dir)
+            cfg = exp["config"]
+            curve = _client_cdf(exp)
+            if curve is None:
+                continue
+            xs, ys = curve
+            ax.plot(
+                xs, ys,
+                label=f"{cfg['protocol']} f={cfg['f']} "
+                      f"c={cfg['clients']}",
+            )
+            plotted += 1
+        ax.set_ylabel("CDF")
+        ax.set_ylim(0, 1.02)
+        ax.grid(alpha=0.3)
+        ax.legend(fontsize=7)
+    ax_top.tick_params(labelbottom=False)  # hide the shared x on top
+    ax_bot.set_xlabel("latency (ms)")
+    if title:
+        ax_top.set_title(title, fontsize=10)
+    if not plotted:
+        raise ValueError("no client latency series in the given dirs")
+    fig.tight_layout()
+    fig.savefig(path, dpi=160)
+    plt.close(fig)
+    return path
+
+
 def batching_points(
     run_dirs: Sequence[str],
 ) -> Dict[str, List[Tuple[int, float, float]]]:

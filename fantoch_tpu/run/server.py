@@ -344,13 +344,36 @@ class _Runtime:
     async def run(self) -> None:
         if self.execution_log:
             self.exec_log_fh = open(self.execution_log, "ab")
+        # bootstrap races stop_event: when the whole cluster is being
+        # stopped, peers may never come up, so a SIGTERM that lands
+        # mid-connect (or mid-ping) must abort the bootstrap promptly
+        # instead of letting it retry toward peers that are gone
+        boot = asyncio.create_task(self._bootstrap(), name="bootstrap")
+        stop = asyncio.create_task(self.handle.stop_event.wait())
+        try:
+            done, _ = await asyncio.wait(
+                {boot, stop}, return_when=asyncio.FIRST_COMPLETED
+            )
+            if boot in done:
+                boot.result()  # propagate bootstrap failures
+            else:
+                boot.cancel()
+                try:
+                    await boot
+                except (asyncio.CancelledError, Exception):
+                    pass
+                return
+        finally:
+            stop.cancel()
+        await self.handle.stop_event.wait()
+
+    async def _bootstrap(self) -> None:
         await self._start_listeners()
         await self._connect_to_all()
         await self._ping_round()
         self._discover()
         self._start_tasks()
         self.handle.started.set()
-        await self.handle.stop_event.wait()
 
     async def _start_listeners(self) -> None:
         if self.peer_sock is not None:

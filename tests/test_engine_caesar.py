@@ -77,6 +77,9 @@ def run_engine(config, regions, conflict, commands, cpr):
         (3, 1, True, 0, 30, 2),
         (5, 2, True, 100, 10, 1),
         (5, 2, False, 100, 10, 1),
+        # reference sim_test scale (mod.rs:639-705: 100 commands)
+        pytest.param(3, 1, True, 100, 100, 1, marks=pytest.mark.slow),
+        pytest.param(5, 2, True, 100, 100, 1, marks=pytest.mark.slow),
     ],
 )
 def test_engine_caesar_matches_oracle_exactly(

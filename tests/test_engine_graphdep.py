@@ -85,6 +85,15 @@ def run_engine(proto, config, regions, conflict, commands, cpr):
         ("epaxos", 3, 1, 100, 30, 1),
         ("epaxos", 3, 1, 0, 30, 2),
         ("epaxos", 5, 2, 100, 10, 1),
+        # reference sim_test scale (mod.rs:639-705: 100 commands)
+        pytest.param("atlas", 3, 1, 100, 100, 1,
+                     marks=pytest.mark.slow),
+        pytest.param("atlas", 5, 2, 100, 100, 1,
+                     marks=pytest.mark.slow),
+        pytest.param("epaxos", 3, 1, 100, 100, 1,
+                     marks=pytest.mark.slow),
+        pytest.param("epaxos", 5, 2, 100, 100, 1,
+                     marks=pytest.mark.slow),
     ],
 )
 def test_engine_matches_oracle_exactly(proto, n, f, conflict, commands, cpr):

@@ -172,6 +172,53 @@ def test_engine_atlas_partial_matches_oracle(n, f, shards, conflict,
         )
 
 
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "n,f,shards,conflict,dev_cls,oracle_cls",
+    [
+        # the reference's partial run tests reach shard_count 4 with
+        # 100-command loads (fantoch/src/run/mod.rs:575-849 shapes);
+        # n=5 exercises quorums the n=3 quick tier cannot
+        (5, 1, 3, 50, TempoPartialDev, Tempo),
+        (5, 1, 4, 50, TempoPartialDev, Tempo),
+        (3, 1, 4, 50, AtlasPartialDev, Atlas),
+        (5, 1, 3, 50, AtlasPartialDev, Atlas),
+    ],
+)
+def test_engine_partial_reference_scale(n, f, shards, conflict, dev_cls,
+                                        oracle_cls):
+    """Reference-scale device partial replication: 100 commands per
+    client over up to 4 shards. Big schedules are not guaranteed
+    tie-free, so this tier asserts the protocol invariants plus
+    latency-mean closeness; exactness stays the quick tier's job."""
+    commands, pool, kpc = 100, 4, 2
+    tempo = oracle_cls is Tempo
+    config = partial_config(n, f, shards, tempo=tempo)
+    regions = Planet.new().regions()[:n]
+    oracle_lat, fast, slow, _stable = run_oracle(
+        config, regions, conflict, pool, kpc, commands=commands,
+        oracle_cls=oracle_cls,
+    )
+    _dev, res = run_engine(
+        config, regions, conflict, pool, kpc, commands=commands,
+        dev_cls=dev_cls,
+    )
+    assert not res.err, res.err_cause
+    total = commands * CPR * n
+    for region in regions:
+        assert res.issued(region) == CPR * commands
+    dev_fast = int(res.protocol_metrics["fast_path"].sum())
+    dev_slow = int(res.protocol_metrics["slow_path"].sum())
+    assert total <= dev_fast + dev_slow <= total * shards
+    assert dev_fast + dev_slow == fast + slow
+    assert int(res.protocol_metrics["stable"].sum()) == n * total
+    for region in regions:
+        _issued, hist = oracle_lat[region]
+        assert abs(res.latency_mean(region) - hist.mean()) <= (
+            0.1 * hist.mean()
+        )
+
+
 def test_engine_tempo_partial_reorder_invariants():
     """Message reordering (delay ×U(0,10)) over the multi-shard engine:
     exactness is out of scope on randomized schedules, but the

@@ -99,6 +99,9 @@ def run_engine(config, regions, conflict, commands=COMMANDS,
         (3, 1, 0, 30, 2),
         (5, 1, 100, 10, 1),
         (5, 2, 100, 20, 1),
+        # reference sim_test scale (mod.rs:639-705: 100 commands)
+        pytest.param(3, 1, 100, 100, 2, marks=pytest.mark.slow),
+        pytest.param(5, 2, 100, 100, 1, marks=pytest.mark.slow),
     ],
 )
 def test_engine_tempo_matches_oracle_exactly(n, f, conflict, commands, cpr):

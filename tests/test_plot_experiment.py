@@ -21,20 +21,24 @@ from fantoch_tpu.plot import (
 from fantoch_tpu.protocol.base import ProtocolMetricsKind
 
 
-def _fake_experiment(root, protocol, clients, lat_ms, batch=1):
-    run_dir = os.path.join(root, f"{protocol}_c{clients}_b{batch}")
+def _fake_experiment(root, protocol, clients, lat_ms, batch=1, f=1,
+                     shards=1, **extra):
+    tag = "".join(f"_{k}{v}" for k, v in sorted(extra.items()))
+    run_dir = os.path.join(
+        root, f"{protocol}_f{f}_s{shards}_c{clients}_b{batch}{tag}"
+    )
     os.makedirs(run_dir)
     with open(os.path.join(run_dir, "exp_config.json"), "w") as fh:
         json.dump(
             {
                 "protocol": protocol,
                 "n": 3,
-                "f": 1,
-                "shard_count": 1,
+                "f": f,
+                "shard_count": shards,
                 "clients": clients,
                 "commands_per_client": 4,
                 "conflict": 50,
-                "extra": {"batch_max_size": batch},
+                "extra": {"batch_max_size": batch, **extra},
             },
             fh,
         )
@@ -125,3 +129,70 @@ def test_heatmap_and_batching_families(tmp_path):
     png2 = str(tmp_path / "batch.png")
     batching_plot(series, png2, title="batching")
     assert os.path.getsize(png2) > 0
+
+
+def test_intra_machine_scalability(tmp_path):
+    """lib.rs:914-955: per cpu-count searches, max throughput over the
+    matching runs (two client counts per cpu setting here)."""
+    from fantoch_tpu.plot import (
+        intra_machine_scalability_plot,
+        intra_machine_scalability_points,
+    )
+
+    dirs = [
+        _fake_experiment(str(tmp_path), "tempo", 2, lat_ms=40, cpus=1),
+        _fake_experiment(str(tmp_path), "tempo", 8, lat_ms=50, cpus=1),
+        _fake_experiment(str(tmp_path), "tempo", 2, lat_ms=20, cpus=2),
+        _fake_experiment(str(tmp_path), "tempo", 8, lat_ms=25, cpus=2),
+        # runs without a cpus axis are not part of this family
+        _fake_experiment(str(tmp_path), "tempo", 8, lat_ms=25),
+    ]
+    series = intra_machine_scalability_points(dirs, n=3)
+    (label,) = series
+    assert label == "tempo r=50"
+    assert [c for c, _ in series[label]] == [1, 2]
+    # max over client counts at each cpu setting; halved latency
+    # doubles closed-loop throughput
+    (c1, tp1), (c2, tp2) = series[label]
+    assert tp2 == 2 * tp1
+    png = str(tmp_path / "intra.png")
+    intra_machine_scalability_plot(series, png, title="intra")
+    assert os.path.getsize(png) > 0
+
+
+def test_inter_machine_scalability(tmp_path):
+    """lib.rs:956-1010: grouped bars over (shard_count,
+    keys_per_command, conflict) settings, one bar per protocol."""
+    from fantoch_tpu.plot import inter_machine_scalability_plot
+
+    dirs = [
+        _fake_experiment(str(tmp_path), "tempo", 4, lat_ms=40, shards=1,
+                         keys_per_command=1),
+        _fake_experiment(str(tmp_path), "tempo", 4, lat_ms=60, shards=2,
+                         keys_per_command=2),
+        _fake_experiment(str(tmp_path), "atlas", 4, lat_ms=50, shards=1,
+                         keys_per_command=1),
+        _fake_experiment(str(tmp_path), "atlas", 4, lat_ms=80, shards=2,
+                         keys_per_command=2),
+    ]
+    png = str(tmp_path / "inter.png")
+    inter_machine_scalability_plot(dirs, n=3, path=png, title="inter")
+    assert os.path.getsize(png) > 0
+
+
+def test_cdf_split(tmp_path):
+    """lib.rs:466-528: two stacked CDF panels sharing one x-axis
+    (the reference contrasts f=1 against f=2)."""
+    from fantoch_tpu.plot import cdf_plot_split
+
+    top = [
+        _fake_experiment(str(tmp_path), "tempo", 4, lat_ms=40, f=1),
+        _fake_experiment(str(tmp_path), "atlas", 4, lat_ms=50, f=1),
+    ]
+    bottom = [
+        _fake_experiment(str(tmp_path), "tempo", 4, lat_ms=90, f=2),
+        _fake_experiment(str(tmp_path), "atlas", 4, lat_ms=110, f=2),
+    ]
+    png = str(tmp_path / "cdf_split.png")
+    cdf_plot_split(top, bottom, png, title="f=1 vs f=2")
+    assert os.path.getsize(png) > 0

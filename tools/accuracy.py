@@ -119,6 +119,9 @@ def engine_results(name, configs, commands, cpr, regions):
 
 
 def main() -> None:
+    from fantoch_tpu.platform import enable_compile_cache
+
+    enable_compile_cache()
     if "--cpu" in sys.argv:
         # the environment pre-imports jax aimed at the tunneled TPU and
         # overrides JAX_PLATFORMS, so flip the config in-process

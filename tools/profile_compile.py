@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Measure one protocol's engine trace + XLA compile time in isolation.
+
+Round-4 judging measured CaesarDev's bench warmup at 385 s on CPU —
+dominated by XLA compile of the step graph. This tool separates trace
+time (jaxpr construction, proportional to graph size) from compile
+time and reports the jaxpr equation count, so compile-time work can be
+attributed to specific handler subgraphs.
+
+Usage: JAX_PLATFORMS=cpu python tools/profile_compile.py caesar [batch]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    name = sys.argv[1] if len(sys.argv) > 1 else "caesar"
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+
+    from fantoch_tpu.platform import force_cpu_from_env
+
+    force_cpu_from_env()
+    import jax
+
+    from fantoch_tpu.core import Config, Planet
+    from fantoch_tpu.engine import EngineDims, make_lane
+    from fantoch_tpu.engine.core import build_runner
+    from fantoch_tpu.engine.driver import stack_states
+    from fantoch_tpu.engine.protocols import dev_config_kwargs, dev_protocol
+    from fantoch_tpu.engine.spec import stack_lanes
+
+    n = 5
+    clients = n
+    dev = dev_protocol(name, clients)
+    config = Config(**dev_config_kwargs(name, n, 1))
+    planet = Planet.new()
+    regions = planet.regions()[:n]
+    dims = EngineDims.for_protocol(
+        dev, n=n, clients=clients, payload=dev.payload_width(n),
+        dot_slots=64, regions=n, hist_buckets=2048,
+    )
+    spec = make_lane(
+        dev, planet, config, conflict_rate=50, pool_size=1,
+        commands_per_client=5, clients_per_region=1,
+        process_regions=regions, client_regions=regions, dims=dims,
+    )
+    specs = [spec] * batch
+    ctx = stack_lanes(specs)
+    st = stack_states(dev, dims, specs)
+
+    runner = build_runner(dev, dims)
+    t0 = time.perf_counter()
+    lowered = runner.lower(st, ctx)
+    t1 = time.perf_counter()
+    compiled = lowered.compile()
+    t2 = time.perf_counter()
+    jaxpr = jax.make_jaxpr(lambda s, c: runner(s, c))(st, ctx)
+    n_eqns = len(jaxpr.eqns)
+
+    def count(j):
+        total = 0
+        for eq in j.eqns:
+            total += 1
+            for v in eq.params.values():
+                if hasattr(v, "jaxpr"):
+                    total += count(v.jaxpr)
+                elif isinstance(v, (list, tuple)):
+                    for x in v:
+                        if hasattr(x, "jaxpr"):
+                            total += count(x.jaxpr)
+        return total
+
+    deep = count(jaxpr.jaxpr)
+    print(
+        f"{name}: trace {t1 - t0:.1f}s  compile {t2 - t1:.1f}s  "
+        f"top-level eqns {n_eqns}  total eqns {deep}"
+    )
+    del compiled
+
+
+if __name__ == "__main__":
+    main()

@@ -118,6 +118,9 @@ def main() -> None:
     ap.add_argument("--quick", action="store_true",
                     help="1/10th of the commands (CI-sized)")
     args = ap.parse_args()
+    from fantoch_tpu.platform import enable_compile_cache
+
+    enable_compile_cache()
     run_stress(
         n=args.n,
         commands=args.commands // (10 if args.quick else 1),
