@@ -222,13 +222,11 @@ def _infra_shaped(e: BaseException) -> bool:
         if "UNAVAILABLE" in msg or "Unable to initialize backend" in msg:
             return True
         # deterministic XLA statuses are code bugs (a bad lane shape
-        # raises INVALID_ARGUMENT on every attempt) — but only when the
-        # status is the error's own leading token, not text quoted from
-        # some inner cause
-        head = msg.lstrip()[:64]
-        return not any(
-            head.startswith(s) for s in _DETERMINISTIC_XLA_STATUSES
-        )
+        # raises INVALID_ARGUMENT on every attempt); they may be
+        # wrapped ("Error loading program: INVALID_ARGUMENT: ..."), so
+        # match anywhere — the availability precedence above already
+        # protects the tunneled-outage case ADVICE r4 flagged
+        return not any(s in msg for s in _DETERMINISTIC_XLA_STATUSES)
     if isinstance(e, RuntimeError):
         msg = str(e).lower()
         return "backend" in msg or "tpu" in msg or "device" in msg

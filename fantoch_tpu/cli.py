@@ -493,9 +493,16 @@ def cmd_proc(args) -> None:
             )
             os._exit(0)
 
+        signalled = False
+
         def _on_signal() -> None:
-            if handle.stop_event.is_set():
+            # track signals, not stop_event: an internally-initiated
+            # stop (fail-fast task death) must not make the FIRST
+            # external SIGTERM skip the graceful shutdown
+            nonlocal signalled
+            if signalled:
                 os._exit(1)
+            signalled = True
             handle.stop_event.set()
             timer = threading.Timer(grace_s, _force_exit)
             timer.daemon = True

@@ -761,31 +761,61 @@ def _mpropose(dev, ps, msg, me, ctx, dims):
 
 def _agg_union(dev, ps, slot, pay_base, msg, enable):
     """Union the message's dep list into the per-dot aggregate table
-    (QuorumClocks/QuorumRetries dep union)."""
-    nd = msg["payload"][pay_base]
+    (QuorumClocks/QuorumRetries dep union).
 
-    # statically unrolled (payload reads become slices; the union chain
-    # is sequential but fuses)
-    for i in range(dev.DEP):
-        take = jnp.asarray(enable, bool) & (i < nd)
-        dsrc = msg["payload"][pay_base + 1 + 2 * i]
-        dseq = msg["payload"][pay_base + 2 + 2 * i]
-        row_src = oh_get(ps["ag_src"], slot)
-        row_seq = oh_get(ps["ag_seq"], slot)
-        exists = jnp.any(
-            (row_seq == dseq) & (row_src == dsrc) & (row_seq > 0)
-        )
-        free = row_seq == 0
-        fidx = jnp.argmax(free)
-        overflow = take & ~exists & ~jnp.any(free)
-        widx = jnp.where(take & ~exists & ~overflow, fidx, dev.DEP)
-        ps = dict(
-            ps,
-            ag_src=oh_set2(ps["ag_src"], slot, widx, dsrc),
-            ag_seq=oh_set2(ps["ag_seq"], slot, widx, dseq),
-            err=ps["err"] | ERR_CAPACITY * overflow,
-        )
-    return ps
+    One vectorized rank-match instead of a DEP-long unrolled insert
+    chain: with DEP=64 the unroll put ~64 scatter subgraphs into BOTH
+    ack handlers and dominated CaesarDev's XLA compile time (measured
+    385 s on CPU). Entries are deduped against the table AND against
+    earlier same-message entries (triangular compare), so the result is
+    exactly the sequential chain's."""
+    Q = dev.DEP
+    do = jnp.asarray(enable, bool)
+    nd = msg["payload"][pay_base]
+    iota = jnp.arange(Q, dtype=I32)
+    idxs = pay_base + 1 + 2 * iota
+    en = do & (iota < nd)
+    dsrcs = jnp.where(en, oh_take(msg["payload"], idxs), 0)
+    dseqs = jnp.where(en, oh_take(msg["payload"], idxs + 1), 0)
+    row_src = oh_get(ps["ag_src"], slot)  # [Q]
+    row_seq = oh_get(ps["ag_seq"], slot)
+    in_table = jnp.any(
+        (row_seq[None, :] == dseqs[:, None])
+        & (row_src[None, :] == dsrcs[:, None])
+        & (row_seq[None, :] > 0),
+        axis=1,
+    )
+    same = (dseqs[None, :] == dseqs[:, None]) & (
+        dsrcs[None, :] == dsrcs[:, None]
+    )
+    earlier = en[None, :] & (iota[None, :] < iota[:, None])
+    dup_in_msg = jnp.any(same & earlier, axis=1)
+    new = en & ~in_table & ~dup_in_msg
+    # rank the i-th new entry onto the i-th free table slot
+    new_order, n_new = compact_order(new, Q)
+    free = row_seq == 0
+    free_order, n_free = compact_order(free, Q)
+    match = (
+        (new_order[:, None] == free_order[None, :])
+        & new[:, None]
+        & free[None, :]
+    )
+    write = jnp.any(match, axis=0)  # [Q] table slots written
+    w_src = jnp.sum(jnp.where(match, dsrcs[:, None], 0), axis=0, dtype=I32)
+    w_seq = jnp.sum(jnp.where(match, dseqs[:, None], 0), axis=0, dtype=I32)
+    overflow = n_new > n_free
+    return dict(
+        ps,
+        ag_src=oh_set(
+            ps["ag_src"], jnp.where(do, slot, ps["ag_src"].shape[0]),
+            jnp.where(write, w_src, row_src),
+        ),
+        ag_seq=oh_set(
+            ps["ag_seq"], jnp.where(do, slot, ps["ag_seq"].shape[0]),
+            jnp.where(write, w_seq, row_seq),
+        ),
+        err=ps["err"] | ERR_CAPACITY * (do & overflow),
+    )
 
 
 def _agg_broadcast(dev, ps, me, seq, cseq, cpid, mtype, ctx, dims, valid):
@@ -985,25 +1015,39 @@ def _mretry(dev, ps, msg, me, ctx, dims):
     pay = pay.at[1].set(seq)
     pay, nd, overflow = _pack_deps(dev, ps, key, pred_mask, 2, pay, dims)
 
-    o2 = jnp.asarray(False)
-    dep_idxs = 3 + 2 * jnp.arange(dev.DEP, dtype=I32)
-    # statically unrolled; payload updates are one-hot selects
-    for i in range(dev.DEP):
-        take = i < msg["payload"][4]
-        msrc = msg["payload"][5 + 2 * i]
-        mseq = msg["payload"][6 + 2 * i]
-        have_already = jnp.any(
-            (jnp.arange(dev.DEP) < nd)
-            & (oh_take(pay, dep_idxs) == msrc)
-            & (oh_take(pay, dep_idxs + 1) == mseq)
-        )
-        add = take & ~have_already
-        ovf = add & (nd >= dev.DEP)
-        lo = jnp.where(add & ~ovf, 3 + 2 * nd, dims.P)
-        pay = oh_set(pay, lo, msrc)
-        pay = oh_set(pay, lo + 1, mseq)
-        nd = nd + (add & ~ovf).astype(I32)
-        o2 = o2 | ovf
+    # union the MRetry's dep list into the reply, vectorized (the
+    # DEP-long unrolled insert chain here was the other half of
+    # CaesarDev's compile blowup — see _agg_union): dedup each message
+    # entry against my packed predecessors and against earlier message
+    # entries, then append survivors in message order after slot nd
+    Q = dev.DEP
+    iota_q = jnp.arange(Q, dtype=I32)
+    dep_idxs = 3 + 2 * iota_q
+    my_valid = iota_q < nd
+    my_src = oh_take(pay, dep_idxs)
+    my_seq = oh_take(pay, dep_idxs + 1)
+    m_en = iota_q < msg["payload"][4]
+    msrcs = jnp.where(m_en, oh_take(msg["payload"], 5 + 2 * iota_q), 0)
+    mseqs = jnp.where(m_en, oh_take(msg["payload"], 6 + 2 * iota_q), 0)
+    have_already = jnp.any(
+        my_valid[None, :]
+        & (my_src[None, :] == msrcs[:, None])
+        & (my_seq[None, :] == mseqs[:, None]),
+        axis=1,
+    )
+    same = (mseqs[None, :] == mseqs[:, None]) & (
+        msrcs[None, :] == msrcs[:, None]
+    )
+    earlier = m_en[None, :] & (iota_q[None, :] < iota_q[:, None])
+    dup_in_msg = jnp.any(same & earlier, axis=1)
+    add = m_en & ~have_already & ~dup_in_msg
+    add_order, n_add = compact_order(add, Q)
+    lo = jnp.where(
+        add & (nd + add_order < Q), 3 + 2 * (nd + add_order), dims.P
+    )
+    pay = oh_pack_pairs(pay, lo, msrcs, mseqs)
+    o2 = nd + n_add > Q
+    nd = jnp.minimum(nd + n_add, Q)
     pay = pay.at[2].set(nd)
     ps = dict(ps, err=ps["err"] | ERR_CAPACITY * (do & (overflow | o2)))
     ob = emit(

@@ -15,6 +15,7 @@ from __future__ import annotations
 import json
 import os
 import pickle
+import shlex
 import signal
 import socket
 import subprocess
@@ -165,6 +166,8 @@ def _stop_remote(machine, ports: List[int], patterns: List[str]) -> None:
     Tries lsof (reference parity), fuser, and a pkill fallback on the
     ``--port N`` argv, since any given host has some subset of the
     three; escalates to SIGKILL for anything still alive after 1 s."""
+    import re as _re
+
     def esc(pat: str) -> str:
         """Bracket the first alphanumeric so the pattern can never
         match the shell that carries it in its own command line."""
@@ -173,9 +176,12 @@ def _stop_remote(machine, ports: List[int], patterns: List[str]) -> None:
                 return f"{pat[:i]}[{ch}]{pat[i + 1:]}"
         return pat
 
+    # ``patterns`` are literal paths: regex-escape them (dots, pluses)
+    # before the self-match bracketing; shlex.quote at embed time keeps
+    # a path with quotes/spaces from breaking the remote shell line
     pats = [
         esc(f"fantoch_tpu.*--port {p}([^0-9]|$)") for p in ports
-    ] + [esc(p) for p in patterns]
+    ] + [esc(_re.escape(p)) for p in patterns]
 
     def round_(sig_kill: bool) -> str:
         k9 = "-9 " if sig_kill else ""
@@ -188,12 +194,14 @@ def _stop_remote(machine, ports: List[int], patterns: List[str]) -> None:
             )
             cmds.append(f"fuser -k {fsig} {p}/tcp 2>/dev/null")
         for pat in pats:
-            cmds.append(f"pkill {fsig} -f -- '{pat}' 2>/dev/null")
+            cmds.append(
+                f"pkill {fsig} -f -- {shlex.quote(pat)} 2>/dev/null"
+            )
         return "; ".join(cmds)
 
     probe = "; ".join(
         [f"lsof -t -i :{p} -sTCP:LISTEN 2>/dev/null" for p in ports]
-        + [f"pgrep -f -- '{pat}' 2>/dev/null" for pat in pats]
+        + [f"pgrep -f -- {shlex.quote(pat)} 2>/dev/null" for pat in pats]
     )
     try:
         machine.exec(

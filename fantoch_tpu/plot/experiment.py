@@ -389,6 +389,7 @@ def cdf_plot_split(
     if title:
         ax_top.set_title(title, fontsize=10)
     if not plotted:
+        plt.close(fig)  # no figure leak on the error path
         raise ValueError("no client latency series in the given dirs")
     fig.tight_layout()
     fig.savefig(path, dpi=160)

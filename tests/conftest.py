@@ -23,43 +23,46 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 
+# the persistent XLA compile cache turns every re-run of the engine
+# tests from minutes of XLA work into a disk read (same cache the
+# bench/CLI/tools share — fantoch_tpu.platform.enable_compile_cache)
+from fantoch_tpu.platform import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
+
 import subprocess  # noqa: E402
 import time  # noqa: E402
 
 import pytest  # noqa: E402
 
 
-def _is_descendant(pid: int, ancestor: int) -> bool:
-    """Walk /proc ppid links; True when ``ancestor`` is on the chain.
-    Keeps the leak check blind to servers another session on this
-    machine is legitimately running during our test window."""
-    for _ in range(64):
-        if pid == ancestor:
-            return True
-        try:
-            with open(f"/proc/{pid}/stat") as fh:
-                pid = int(fh.read().rsplit(")", 1)[1].split()[1])
-        except (OSError, ValueError, IndexError):
-            return False
-        if pid <= 1:
-            # reparented to init: its real parent is gone — that is
-            # exactly what a leak looks like, so attribute it to us
-            return True
-    return False
+# every process this pytest run spawns (directly or through the exp
+# layer's local/fake-ssh transports) inherits this marker in its
+# environment — the precise ownership test for the leak check, immune
+# to both reparenting (an orphan keeps its environ) and concurrent
+# sessions on the machine (theirs carry a different id or none)
+_RUN_MARKER = f"FANTOCH_TEST_RUN_ID={os.getpid()}-{int(time.time())}"
+os.environ[_RUN_MARKER.split("=")[0]] = _RUN_MARKER.split("=")[1]
+
+
+def _ours(pid: int) -> bool:
+    try:
+        with open(f"/proc/{pid}/environ", "rb") as fh:
+            return _RUN_MARKER.encode() in fh.read().replace(b"\0", b"\n")
+    except OSError:
+        return False
 
 
 def _server_pids() -> set:
-    """PIDs of live ``fantoch_tpu proc`` server processes descended
-    from this pytest run (the bracket keeps the pattern from matching
-    pgrep's own command line)."""
+    """PIDs of live ``fantoch_tpu proc`` servers spawned by THIS pytest
+    run (the bracket keeps the pattern from matching pgrep's own
+    command line; the environ marker keeps it blind to other
+    sessions)."""
     out = subprocess.run(
         ["pgrep", "-f", "[f]antoch_tpu proc"], capture_output=True,
         text=True,
     ).stdout
-    me = os.getpid()
-    return {
-        int(p) for p in out.split() if _is_descendant(int(p), me)
-    }
+    return {int(p) for p in out.split() if _ours(int(p))}
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,148 @@
+"""Property-based Synod safety — the analog of the reference's
+quickcheck suite (fantoch_ps/src/protocol/common/synod/single.rs:740+,
+``a_single_value_is_chosen``, run with QUICKCHECK_TESTS=10000 in its CI).
+
+The model mirrors the reference's: 5 processes (f=2, so phase-1 waits 3
+promises and phase-2 waits 3 accepts), two competing proposers (ids 1
+and 2), and hypothesis-generated action sequences where each action is
+one full proposal attempt through two arbitrary quorums whose messages
+and replies may independently be lost. Whatever the interleaving,
+ballot races, and message loss, at most ONE distinct value may ever be
+chosen — Paxos safety.
+
+Initial acceptor values are distinct primes and the proposal function
+multiplies the phase-1 reported values, so every distinct proposal path
+yields a distinct value and any safety violation is observable.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple
+
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from fantoch_tpu.protocol.synod import S_CHOSEN, Synod
+
+N = 5
+F = 2
+PRIMES = {1: 2, 2: 3, 3: 5, 4: 7, 5: 11}
+
+# a quorum entry: (destination process, msg lost?, reply lost?)
+QuorumEntry = Tuple[int, bool, bool]
+Action = Tuple[int, List[QuorumEntry], List[QuorumEntry]]
+
+
+def _proposal_gen(values: Dict[int, int]) -> int:
+    out = 1
+    for v in values.values():
+        out *= v
+    return out
+
+
+def _quorum(source: int):
+    """Q-1 = 2 distinct non-source destinations with independent
+    msg/reply loss flags (the source is always part of its quorum)."""
+    others = [p for p in range(1, N + 1) if p != source]
+    return st.lists(
+        st.tuples(
+            st.sampled_from(others), st.booleans(), st.booleans()
+        ),
+        min_size=2,
+        max_size=2,
+        unique_by=lambda e: e[0],
+    )
+
+
+def _actions():
+    def action(source):
+        return st.tuples(
+            st.just(source), _quorum(source), _quorum(source)
+        )
+
+    return st.lists(
+        st.sampled_from([1, 2]).flatmap(action), max_size=12
+    )
+
+
+def _handle_in_quorum(source_synod, synods, source, msg, quorum):
+    """Deliver ``msg`` to each quorum member (unless lost) and feed
+    surviving replies back to the proposer; returns the proposer's
+    non-None outputs (one accept / one chosen when a quorum is hit)."""
+    out = []
+    for dest, msg_lost, reply_lost in quorum:
+        if msg_lost:
+            continue
+        reply = synods[dest].handle(source, msg)
+        if reply is None or reply_lost:
+            continue
+        result = source_synod.handle(dest, reply)
+        if result is not None:
+            out.append(result)
+    return out
+
+
+def _run(actions: List[Action]) -> Set[int]:
+    synods = {
+        pid: Synod(pid, N, F, _proposal_gen, PRIMES[pid])
+        for pid in range(1, N + 1)
+    }
+    chosen: Set[int] = set()
+    for source, q1, q2 in actions:
+        synod = synods[source]
+        prepare = synod.new_prepare()
+        # the proposer is part of both its quorums: handle locally first
+        local_promise = synod.handle(source, prepare)
+        assert local_promise is not None
+        synod.handle(source, local_promise)
+        outcome = _handle_in_quorum(synod, synods, source, prepare, q1)
+        if len(outcome) != 1:
+            continue  # phase-1 quorum not reached (losses)
+        accept = outcome[0]
+        local_accepted = synod.handle(source, accept)
+        if local_accepted is not None:
+            synod.handle(source, local_accepted)
+        outcome = _handle_in_quorum(synod, synods, source, accept, q2)
+        if len(outcome) == 1 and outcome[0][0] == S_CHOSEN:
+            chosen.add(outcome[0][1])
+    return chosen
+
+
+@settings(max_examples=500, deadline=None)
+@given(_actions())
+def test_a_single_value_is_chosen(actions):
+    chosen = _run(actions)
+    assert len(chosen) <= 1, (
+        f"safety violation: two values chosen {chosen}"
+    )
+
+
+@pytest.mark.slow
+@settings(max_examples=5000, deadline=None)
+@given(_actions())
+def test_a_single_value_is_chosen_deep(actions):
+    """The reference CI's depth (QUICKCHECK_TESTS=10000; half here,
+    with hypothesis shrinking doing more work per failure)."""
+    chosen = _run(actions)
+    assert len(chosen) <= 1
+
+
+def test_two_proposers_interleaved_deterministic():
+    """A fixed adversarial interleaving as a readable anchor: proposer
+    2 overtakes proposer 1 between its phases — proposer 1's stale
+    accept must be rejected and only one value survives."""
+    chosen = _run(
+        [
+            # p1 completes phase-1 at {3, 4}, then loses its accepts
+            (1, [(3, False, False), (4, False, False)],
+                [(3, True, True), (4, True, True)]),
+            # p2 runs both phases cleanly at {3, 5}
+            (2, [(3, False, False), (5, False, False)],
+                [(3, False, False), (5, False, False)]),
+            # p1 retries end-to-end at {4, 5}
+            (1, [(4, False, False), (5, False, False)],
+                [(4, False, False), (5, False, False)]),
+        ]
+    )
+    assert len(chosen) == 1

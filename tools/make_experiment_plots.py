@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Run the local-testbed experiments behind the committed experiment
+plot PNGs and render every experiment-dir family.
+
+The reference renders its figures from ResultsDB experiment dirs
+(fantoch_plot/src/lib.rs); this tool reproduces the repo's committed
+``plots/*.png`` from real ``bench_experiment`` runs on this host:
+
+* throughput-vs-latency + dstat/process tables (existing families)
+* intra-machine scalability (lib.rs:914-955): cpus ∈ {1, 2} via the
+  worker/executor axis
+* inter-machine scalability (lib.rs:956-1010): shard_count ∈ {1, 2}
+* cdf_split (lib.rs:466-528): conflict 0 (top) vs 100 (bottom)
+
+Usage: JAX_PLATFORMS=cpu python tools/make_experiment_plots.py [outdir]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from fantoch_tpu.exp import ExperimentConfig, bench_experiment  # noqa: E402
+from fantoch_tpu.plot import (  # noqa: E402
+    cdf_plot_split,
+    inter_machine_scalability_plot,
+    intra_machine_scalability_plot,
+    intra_machine_scalability_points,
+)
+
+
+def main() -> None:
+    out = sys.argv[1] if len(sys.argv) > 1 else "plots"
+    exp_root = os.path.join(out, "experiments_scalability")
+    os.makedirs(exp_root, exist_ok=True)
+
+    def run(protocol, clients, conflict=50, shards=1, **extra):
+        exp = ExperimentConfig(
+            protocol=protocol, n=3, f=1, shard_count=shards,
+            clients=clients, commands_per_client=10, conflict=conflict,
+            extra=extra,
+        )
+        print(f"running {protocol} c={clients} s={shards} {extra}...",
+              flush=True)
+        return bench_experiment(exp, exp_root)
+
+    # intra-machine scalability: tempo supports parallel workers
+    intra = [
+        run("tempo", 4, cpus=1),
+        run("tempo", 8, cpus=1),
+        run("tempo", 4, cpus=2),
+        run("tempo", 8, cpus=2),
+    ]
+    series = intra_machine_scalability_points(intra, n=3)
+    intra_machine_scalability_plot(
+        series, os.path.join(out, "intra_machine_scalability.png"),
+        title="intra-machine scalability (workers)",
+    )
+
+    # inter-machine scalability: shard_count x keys_per_command groups
+    inter = [
+        run("tempo", 4, shards=1, keys_per_command=1),
+        run("tempo", 4, shards=2, keys_per_command=2),
+        run("atlas", 4, shards=1, keys_per_command=1),
+        run("atlas", 4, shards=2, keys_per_command=2),
+    ]
+    inter_machine_scalability_plot(
+        inter, n=3, path=os.path.join(out, "inter_machine_scalability.png"),
+        title="inter-machine scalability (shards)",
+    )
+
+    # cdf_split: conflict-free (top) vs all-conflicting (bottom)
+    top = [
+        run("tempo", 4, conflict=0),
+        run("atlas", 4, conflict=0),
+    ]
+    bottom = [
+        run("tempo", 4, conflict=100),
+        run("atlas", 4, conflict=100),
+    ]
+    cdf_plot_split(
+        top, bottom, os.path.join(out, "cdf_split.png"),
+        title="conflict 0 (top) vs 100 (bottom)",
+    )
+    print("done", flush=True)
+
+
+if __name__ == "__main__":
+    main()
