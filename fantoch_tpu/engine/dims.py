@@ -108,6 +108,9 @@ class EngineDims:
         RTT) is used. Overflow is always detected, never silent.
         """
         fanout = getattr(protocol, "MAX_FANOUT", n + 1)
+        # slots a protocol's hoisted post-switch stages need beyond
+        # what any single branch fills (CaesarDev's hoisted scans)
+        extra = getattr(protocol, "EXTRA_SLOTS", 0)
         if pool is None:
             # closed-loop clients keep ≤ ~n messages in flight per command
             # plus periodic GC traffic
@@ -119,7 +122,7 @@ class EngineDims:
             C=clients,
             M=pool,
             D=dot_slots,
-            F=max(fanout, n + 1),
+            F=max(fanout, n + 1) + extra,
             R=getattr(protocol, "PERIODIC_ROWS", 1),
             P=max(payload, 3),
             H=hist_buckets,

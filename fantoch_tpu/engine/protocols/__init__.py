@@ -46,7 +46,7 @@ def dev_protocol(name: str, clients: int, keys: "int | None" = None):
     if name == "epaxos":
         return EPaxosDev(keys=keys)
     if name == "caesar":
-        return CaesarDev(keys=keys)
+        return CaesarDev.for_load(keys=keys, clients=clients)
     raise ValueError(f"unknown protocol {name!r}")
 
 

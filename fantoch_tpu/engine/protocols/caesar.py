@@ -92,9 +92,14 @@ class CaesarDev(DevIdentity):
         # dep unions aggregate several acks' predecessor lists computed at
         # different instants, so they can exceed the key-row population;
         # GC rounds lag executions by up to one interval (oracle event
-        # order), keeping registrations visible longer
-        dep_slots: int = 64,
-        blocker_slots: int = 16,
+        # order), keeping registrations visible longer. DEP multiplies
+        # the payload width and every per-step dep tensor (the executor
+        # scan is the step's dominant cost), so the default is the
+        # smallest bound the test matrix (incl. 100-command reference
+        # scale) runs without ERR_CAPACITY — raise it per-lane for
+        # hotter workloads, overflow is always loud
+        dep_slots: int = 32,
+        blocker_slots: int = 8,
         gap_slots: int = 8,
         exec_buffer: int = 128,
     ):
@@ -104,6 +109,20 @@ class CaesarDev(DevIdentity):
         self.BB = blocker_slots  # blockers per waiting dot
         self.G = gap_slots
         self.EB = exec_buffer    # executed-dot buffers (notify + GC)
+
+    @classmethod
+    def for_load(cls, keys: int, clients: int) -> "CaesarDev":
+        """Capacity bounds scaled to the client count: dep lists grow
+        with the number of concurrently conflicting commands (~a few
+        per client at 100% conflict), so size DEP at ~6x clients with
+        the 32 floor the default shapes need; blockers track higher-
+        clock conflicts, a quarter of that. Overflow stays loud
+        (ERR_CAPACITY), so a hotter workload fails visibly, not
+        silently."""
+        dep = max(32, -(-6 * clients // 8) * 8)
+        return cls(
+            keys=keys, dep_slots=dep, blocker_slots=max(8, dep // 4)
+        )
 
     # -- host-side builders -------------------------------------------
 
@@ -163,9 +182,9 @@ class CaesarDev(DevIdentity):
             "ag_src": np.zeros((N, D, DEP), np.int32),
             "ag_seq": np.zeros((N, D, DEP), np.int32),
             "qr_cnt": np.zeros((N, D), np.int32),
-            # executor clocks (committed / executed per source)
-            "cm_front": np.zeros((N, N), np.int32),
-            "cm_gaps": np.zeros((N, N, G, 2), np.int32),
+            # executor clock (executed per source; commit-ness of live
+            # dots rides their status, and of dead dots this set — see
+            # _exec_scan — so no committed set is needed)
             "ex_front": np.zeros((N, N), np.int32),
             "ex_gaps": np.zeros((N, N, G, 2), np.int32),
             # executed→notification buffer (executor.rs:65-77) and the
@@ -228,9 +247,13 @@ class CaesarDev(DevIdentity):
         )
         return jnp.where(t == CaesarDev.MGC, gc_ok, ok)
 
+    # the hoisted scans (see handle) need 4 outbox slots beyond the
+    # n+1 a branch itself may fill (gc_drain broadcasts + chains)
+    EXTRA_SLOTS = 4
+
     def handle(self, ps, msg, me, now, ctx, dims: EngineDims):
         def _noop(ps, msg):
-            return ps, empty_outbox(dims)
+            return ps, empty_outbox(dims), _off(), _off()
 
         branches = [
             lambda ps, msg: _submit(self, ps, msg, me, ctx, dims),
@@ -246,7 +269,23 @@ class CaesarDev(DevIdentity):
             _noop,
         ]
         idx = jnp.clip(msg["mtype"], 0, CaesarDev.NUM_TYPES)
-        return jax.lax.switch(idx, branches, ps, msg)
+        ps, ob, do_exec, do_wait = jax.lax.switch(idx, branches, ps, msg)
+        # The executor drain and the wait-condition re-evaluation are
+        # by far the heaviest subgraphs (gathering [N, D, BB, DEP]
+        # views of the dep state). Under vmap the switch lowers to a
+        # select that executes EVERY branch each step, so these must
+        # exist ONCE per step — hoisted here behind enable flags the
+        # branches set — not inlined into three branches (which cost
+        # ~3x the per-step work AND ~3x the compile size; measured
+        # 56 ms/step before the hoist).
+        base = dims.N + 1
+        ps, ob = _exec_scan(
+            self, ps, me, ctx, dims, ob, base, base + 1, do_exec
+        )
+        ps, ob = _wait_scan(
+            self, ps, me, ctx, dims, ob, base + 2, base + 3, do_wait
+        )
+        return ps, ob
 
     def periodic(self, ps, fire, me, now, ctx, dims: EngineDims):
         """Row 0: GC — kick the MGC broadcast chain for buffered
@@ -269,6 +308,12 @@ class CaesarDev(DevIdentity):
             valid=fire[0] & (pre_n > 0),
         )
         return ps, ob
+
+
+def _off():
+    """Scan-disable flag (a traced scalar, so every switch branch
+    returns the same aval)."""
+    return jnp.zeros((), bool)
 
 
 # ----------------------------------------------------------------------
@@ -359,7 +404,15 @@ def _pack_deps(dev, ps, key, pred_mask, base, pay, dims):
 def _blocker_verdicts(dev, ps, dims):
     """For every dot's blocker entries: (resolved, reject) masks
     [N, D, BB] (caesar.rs:932-1096 re-evaluated lazily; see module
-    docstring for the monotonicity argument)."""
+    docstring for the monotonicity argument).
+
+    The membership test ("my dot ∈ blocker.deps") goes through a
+    live-dep relation R[q, e, p, d] = "dot (q, e)'s dep list contains
+    the live dot at (p, d)" built with ONE [N, D, DEP]-sized scatter —
+    not by gathering every blocker's whole dep list, which materialized
+    [N, D, BB, DEP] (two ~330k-element gathers per step; this scan runs
+    every step under vmap and dominated CaesarDev's runtime)."""
+    N, D = dims.N, dims.D
     bsrc = ps["bb_src"]                       # [N, D, BB]
     bseq = ps["bb_seq"]
     bslot = dot_slot(bseq, dims)
@@ -368,17 +421,31 @@ def _blocker_verdicts(dev, ps, dims):
     gcd = present & ~valid                    # freed ⇒ executed everywhere
     b_st = ps["status"][bsrc, bslot]
     safe = present & valid & (b_st >= ST_ACCEPT)
-    # my dot ∈ blocker.deps?
-    my_src = jnp.arange(dims.N, dtype=I32)[:, None, None]  # [N, 1, 1]
-    my_seq = ps["pseq"]                                    # [N, D]
-    b_dsrc = ps["dep_src"][bsrc, bslot]       # [N, D, BB, DEP]
-    b_dseq = ps["dep_seq"][bsrc, bslot]
-    member = jnp.any(
-        (b_dseq > 0)
-        & (b_dsrc == my_src[..., None])
-        & (b_dseq == my_seq[..., None, None]),
-        axis=3,
+    # live-dep relation: a dep entry (src, seq) refers to the live dot
+    # at (src, slot) exactly when pseq[src, slot] == seq — the same
+    # equality the direct per-blocker compare used. One [N, D, DEP]
+    # scatter + two small gathers, instead of materializing every
+    # blocker's whole dep list as two [N, D, BB, DEP] gathers (~330k
+    # elements each; this scan runs every step under vmap)
+    dsrc = ps["dep_src"]                      # [N, D, DEP]
+    dseq = ps["dep_seq"]
+    dslot = dot_slot(dseq, dims)
+    dep_live = (dseq > 0) & (ps["pseq"][dsrc, dslot] == dseq)
+    shape = dsrc.shape
+    qq = jnp.broadcast_to(
+        jnp.arange(N, dtype=I32)[:, None, None], shape
     )
+    ee = jnp.broadcast_to(
+        jnp.arange(D, dtype=I32)[None, :, None], shape
+    )
+    rel = jnp.zeros((N, D, N, D), bool)
+    rel = rel.at[
+        qq, ee, jnp.where(dep_live, dsrc, 0), jnp.where(dep_live, dslot, 0)
+    ].max(dep_live)
+    # member[p, d, b] = rel[blocker(p,d,b), (p, d)]
+    pp = jnp.arange(N, dtype=I32)[:, None, None]
+    dd = jnp.arange(D, dtype=I32)[None, :, None]
+    member = rel[bsrc, bslot, pp, dd]
     ign = safe & member
     reject = safe & ~member
     resolved = ~present | gcd | ign
@@ -516,12 +583,25 @@ def _exec_scan(dev, ps, me, ctx, dims, ob, client_slot, chain_slot,
     dseq = ps["dep_seq"]
     dslot = dot_slot(dseq, dims)
     absent = dseq == 0
-    committed = iset_contains_gathered(
-        ps["cm_front"], ps["cm_gaps"], dsrc, dseq
-    )
-    executed = iset_contains_gathered(
+    # Dep commit/execution status with ONE interval-set walk instead of
+    # two (this scan runs every step under vmap and dominated the step
+    # cost — the per-entry gap gathers are the expensive part):
+    # * live dep (slot holds exactly this dot): its local status says
+    #   it all — MCommit sets ST_COMMIT in the same handler call that
+    #   feeds the cm set, execution sets ST_EXECUTED;
+    # * dead dep (slot empty or recycled): the dot was either GC'd
+    #   (⟹ executed HERE ⟹ in the executed set) or never proposed
+    #   here (⟹ not executed, and not committed either — the ready()
+    #   gate holds MCommit until the MPropose landed). So executed-set
+    #   membership decides BOTH bits exactly.
+    pseq_g = ps["pseq"][dsrc, dslot]
+    st_g = ps["status"][dsrc, dslot]
+    live = pseq_g == dseq
+    dead_done = iset_contains_gathered(
         ps["ex_front"], ps["ex_gaps"], dsrc, dseq
     )
+    committed = jnp.where(live, st_g >= ST_COMMIT, dead_done)
+    executed = jnp.where(live, st_g == ST_EXECUTED, dead_done)
     d_cseq = ps["clk_seq"][dsrc, dslot]
     d_cpid = ps["clk_pid"][dsrc, dslot]
     my_cseq = ps["clk_seq"][..., None]
@@ -582,9 +662,16 @@ def _exec_scan(dev, ps, me, ctx, dims, ob, client_slot, chain_slot,
 # ----------------------------------------------------------------------
 
 
-def _gc_count(dev, ps, me, ctx, dims, src, seq, enable):
+def _gc_count(dev, ps, freed, me, ctx, dims, src, seq, enable):
     """BasicGCTrack.add for one dot: at n sightings, free it
-    (caesar.rs _gc_command + bp.stable)."""
+    (caesar.rs _gc_command + bp.stable).
+
+    Runs inside fori_loop bodies, so it touches only SMALL arrays (the
+    [K, S] clock table, the [N, D] counters) and records frees in the
+    ``freed`` [N, D] mask; the caller applies :func:`_apply_freed` ONCE
+    after its loop. Clearing the [N, D, DEP]/[N, D, BB] dep arrays per
+    iteration rewrote ~100 KB x loop-trips every engine step (loop
+    bodies cannot fuse across iterations) and dominated step cost."""
     slot = dot_slot(seq, dims)
     do = jnp.asarray(enable, bool) & (seq > 0)
     valid = oh_get(oh_get(ps["pseq"], src), slot) == seq
@@ -596,7 +683,8 @@ def _gc_count(dev, ps, me, ctx, dims, src, seq, enable):
         err=ps["err"] | ERR_PROTO * (do & ~valid),
         gc_cnt=oh_set2(ps["gc_cnt"], wsrc, slot, cnt),
     )
-    # free: unregister the clock, clear the slot, count stability
+    # free: unregister the clock now (small table); defer the slot
+    # clears to the caller's one masked write
     key = oh_get(oh_get(ps["key_of"], src), slot)
     ps = _kc_remove(
         dev, ps, key,
@@ -605,21 +693,25 @@ def _gc_count(dev, ps, me, ctx, dims, src, seq, enable):
         full,
     )
     fsrc = jnp.where(full, src, dims.N)
-    zero = jnp.zeros((), I32)
-    ps = dict(
+    hit = (
+        jnp.arange(dims.N, dtype=I32)[:, None] == fsrc
+    ) & (jnp.arange(dims.D, dtype=I32)[None, :] == slot)
+    ps = dict(ps, m_stable=ps["m_stable"] + full.astype(I32))
+    return ps, freed | hit
+
+
+def _apply_freed(dev, ps, freed):
+    """Clear every freed dot's lifecycle state in one masked write
+    (the deferred half of :func:`_gc_count`)."""
+    f3 = freed[:, :, None]
+    return dict(
         ps,
-        pseq=oh_set2(ps["pseq"], fsrc, slot, zero),
-        status=oh_set2(ps["status"], fsrc, slot, zero),
-        gc_cnt=oh_set2(ps["gc_cnt"], fsrc, slot, zero),
-        dep_seq=oh_set2(
-            ps["dep_seq"], fsrc, slot, jnp.zeros((dev.DEP,), I32)
-        ),
-        bb_seq=oh_set2(
-            ps["bb_seq"], fsrc, slot, jnp.zeros((dev.BB,), I32)
-        ),
-        m_stable=ps["m_stable"] + full.astype(I32),
+        pseq=jnp.where(freed, 0, ps["pseq"]),
+        status=jnp.where(freed, 0, ps["status"]),
+        gc_cnt=jnp.where(freed, 0, ps["gc_cnt"]),
+        dep_seq=jnp.where(f3, 0, ps["dep_seq"]),
+        bb_seq=jnp.where(f3, 0, ps["bb_seq"]),
     )
-    return ps
 
 
 def _drain_executed_notification(dev, ps, me, ctx, dims, enable):
@@ -630,8 +722,12 @@ def _drain_executed_notification(dev, ps, me, ctx, dims, enable):
     n_dots = jnp.where(do, ps["eb_n"], 0)
 
     # a lax loop, not an unroll: the body embeds _gc_count (a large
-    # subgraph) and EB copies of it explode compile time
-    def body(i, ps):
+    # subgraph) and EB copies of it explode compile time. (A dynamic
+    # while_loop bounded by n_dots measured SLOWER than the fixed fori
+    # here — the batched-while per-iteration select machinery costs
+    # more than the masked no-op iterations save.)
+    def body(i, carry):
+        ps, freed = carry
         take = i < n_dots
         src = ps["eb_src"][i]
         seq = ps["eb_seq"][i]
@@ -645,9 +741,11 @@ def _drain_executed_notification(dev, ps, me, ctx, dims, enable):
             gb_n=gb_n + (take & ~overflow).astype(I32),
             err=ps["err"] | ERR_CAPACITY * overflow,
         )
-        return _gc_count(dev, ps, me, ctx, dims, src, seq, take)
+        return _gc_count(dev, ps, freed, me, ctx, dims, src, seq, take)
 
-    ps = jax.lax.fori_loop(0, dev.EB, body, ps)
+    freed0 = jnp.zeros((dims.N, dims.D), bool)
+    ps, freed = jax.lax.fori_loop(0, dev.EB, body, (ps, freed0))
+    ps = _apply_freed(dev, ps, freed)
     return dict(ps, eb_n=jnp.where(do, 0, ps["eb_n"]))
 
 
@@ -689,7 +787,7 @@ def _submit(dev, ps, msg, me, ctx, dims):
         ctx["n"],
     )
     ob = dict(ob, valid=ob["valid"] & msg["valid"])
-    return ps, ob
+    return ps, ob, _off(), _off()
 
 
 def _mpropose(dev, ps, msg, me, ctx, dims):
@@ -756,7 +854,7 @@ def _mpropose(dev, ps, msg, me, ctx, dims):
         dev, ps, me, s, slot, seq, accept_now, ctx, dims,
         empty_outbox(dims), 0, decided,
     )
-    return ps, ob
+    return ps, ob, _off(), _off()
 
 
 def _agg_union(dev, ps, slot, pay_base, msg, enable):
@@ -890,7 +988,7 @@ def _mproposeack(dev, ps, msg, me, ctx, dims):
     ob = _agg_broadcast(
         dev, ps, me, seq, cseq_f, cpid_f, mtype, ctx, dims, done
     )
-    return ps, ob
+    return ps, ob, _off(), _off()
 
 
 def _store_deps_from_msg(dev, ps, src, slot, msg, base, skip_self, seq,
@@ -962,20 +1060,10 @@ def _mcommit(dev, ps, msg, me, ctx, dims):
         ps,
         status=oh_set2(ps["status"], wsrc, slot, ST_COMMIT),
     )
-    cf, cg, overflow = iset_add(
-        oh_get(ps["cm_front"], dsrc), oh_get(ps["cm_gaps"], dsrc), seq, do
+    # executor + wait re-evaluation run in the hoisted scans (handle)
+    return ps, empty_outbox(dims), jnp.asarray(do, bool), jnp.asarray(
+        do, bool
     )
-    ps = dict(
-        ps,
-        cm_front=oh_set(ps["cm_front"], dsrc, cf),
-        cm_gaps=oh_set(ps["cm_gaps"], dsrc, cg),
-        err=ps["err"] | ERR_CAPACITY * overflow,
-    )
-    # executor + wait re-evaluation, all at this instant
-    ob = empty_outbox(dims)
-    ps, ob = _exec_scan(dev, ps, me, ctx, dims, ob, 0, 1, do)
-    ps, ob = _wait_scan(dev, ps, me, ctx, dims, ob, 2, 3, do)
-    return ps, ob
 
 
 def _mretry(dev, ps, msg, me, ctx, dims):
@@ -1054,8 +1142,8 @@ def _mretry(dev, ps, msg, me, ctx, dims):
         empty_outbox(dims), 0, msg["src"], CaesarDev.MRETRYACK, pay,
         valid=do,
     )
-    ps, ob = _wait_scan(dev, ps, me, ctx, dims, ob, 1, 2, do)
-    return ps, ob
+    # wait re-evaluation runs in the hoisted scan (handle)
+    return ps, ob, _off(), jnp.asarray(do, bool)
 
 
 def _mretryack(dev, ps, msg, me, ctx, dims):
@@ -1084,7 +1172,7 @@ def _mretryack(dev, ps, msg, me, ctx, dims):
         dims,
         chosen,
     )
-    return ps, ob
+    return ps, ob, _off(), _off()
 
 
 def _mgc(dev, ps, msg, me, ctx, dims):
@@ -1094,26 +1182,29 @@ def _mgc(dev, ps, msg, me, ctx, dims):
 
     # a lax loop, not an unroll: gc_per_msg copies of _gc_count's
     # subgraph explode compile time
-    def body(i, ps):
+    def body(i, carry):
+        ps, freed = carry
         take = i < nd
         src = msg["payload"][1 + 2 * i]
         seq = msg["payload"][2 + 2 * i]
-        return _gc_count(dev, ps, me, ctx, dims, src, seq, take)
+        return _gc_count(dev, ps, freed, me, ctx, dims, src, seq, take)
 
-    ps = jax.lax.fori_loop(0, dev.gc_per_msg(dims), body, ps)
-    return ps, empty_outbox(dims)
+    freed0 = jnp.zeros((dims.N, dims.D), bool)
+    ps, freed = jax.lax.fori_loop(
+        0, dev.gc_per_msg(dims), body, (ps, freed0)
+    )
+    ps = _apply_freed(dev, ps, freed)
+    return ps, empty_outbox(dims), _off(), _off()
 
 
 def _wait_drain(dev, ps, msg, me, ctx, dims):
-    return _wait_scan(
-        dev, ps, me, ctx, dims, empty_outbox(dims), 0, 1
-    )
+    # the hoisted wait scan (handle) does the work
+    return ps, empty_outbox(dims), _off(), jnp.ones((), bool)
 
 
 def _exec_drain(dev, ps, msg, me, ctx, dims):
-    return _exec_scan(
-        dev, ps, me, ctx, dims, empty_outbox(dims), 0, 1
-    )
+    # the hoisted executor scan (handle) does the work
+    return ps, empty_outbox(dims), jnp.ones((), bool), _off()
 
 
 def _gc_drain(dev, ps, msg, me, ctx, dims):
@@ -1149,4 +1240,4 @@ def _gc_drain(dev, ps, msg, me, ctx, dims):
     ob = emit(
         ob, dims.N, me, CaesarDev.GC_DRAIN, [0], valid=remaining_gc > 0
     )
-    return ps, ob
+    return ps, ob, _off(), _off()

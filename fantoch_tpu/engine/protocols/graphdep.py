@@ -212,9 +212,13 @@ class _DepDev(DevIdentity):
         ok = jnp.where(t == _DepDev.MCOLLECT, collect_ok, True)
         return jnp.where(t == _DepDev.MCOMMIT, have, ok)
 
+    # the hoisted graph drain (see handle) needs 2 outbox slots beyond
+    # what a branch itself fills
+    EXTRA_SLOTS = 2
+
     def handle(self, ps, msg, me, now, ctx, dims: EngineDims):
         def _noop(ps, msg):
-            return ps, empty_outbox(dims)
+            return ps, empty_outbox(dims), jnp.zeros((), bool)
 
         branches = [
             lambda ps, msg: _submit(self, ps, msg, me, ctx, dims),
@@ -228,7 +232,16 @@ class _DepDev(DevIdentity):
             _noop,
         ]
         idx = jnp.clip(msg["mtype"], 0, _DepDev.NUM_TYPES)
-        return jax.lax.switch(idx, branches, ps, msg)
+        ps, ob, do_drain = jax.lax.switch(idx, branches, ps, msg)
+        # under vmap the switch executes every branch each step, so the
+        # graph drain (relaxation fixed point + per-dep executed-set
+        # walk — the heaviest subgraph here) must exist ONCE per step,
+        # hoisted behind an enable flag, not inlined into two branches
+        base = dims.N + 1
+        ps, ob = _drain(
+            self, ps, me, ctx, dims, ob, base, base + 1, do_drain
+        )
+        return ps, ob
 
     def periodic(self, ps, fire, me, now, ctx, dims: EngineDims):
         """GARBAGE_COLLECTION: broadcast my committed frontier
@@ -453,7 +466,7 @@ def _submit(dev, ps, msg, me, ctx, dims):
         ctx["n"],
     )
     ob = dict(ob, valid=ob["valid"] & msg["valid"])
-    return ps, ob
+    return ps, ob, jnp.zeros((), bool)
 
 
 def _mcollect(dev, ps, msg, me, ctx, dims):
@@ -509,7 +522,7 @@ def _mcollect(dev, ps, msg, me, ctx, dims):
         [seq, d1src, d1seq, d2src, d2seq],
         valid=ack,
     )
-    return ps, ob
+    return ps, ob, jnp.zeros((), bool)
 
 
 def _mcollectack(dev, ps, msg, me, ctx, dims):
@@ -561,7 +574,7 @@ def _mcollectack(dev, ps, msg, me, ctx, dims):
         ob,
         obc,
     )
-    return ps, ob
+    return ps, ob, jnp.zeros((), bool)
 
 
 def _mcommit(dev, ps, msg, me, ctx, dims):
@@ -607,7 +620,8 @@ def _mcommit(dev, ps, msg, me, ctx, dims):
         comm_gaps=oh_set(ps["comm_gaps"], dsrc, cg),
         err=ps["err"] | ERR_CAPACITY * overflow,
     )
-    return _drain(dev, ps, me, ctx, dims, empty_outbox(dims), 0, 1)
+    # the graph drain runs hoisted after the switch (handle)
+    return ps, empty_outbox(dims), jnp.ones((), bool)
 
 
 def _mconsensus(dev, ps, msg, me, ctx, dims):
@@ -621,7 +635,7 @@ def _mconsensus(dev, ps, msg, me, ctx, dims):
         _DepDev.MCONSENSUSACK,
         [dsrc, seq],
     )
-    return ps, ob
+    return ps, ob, jnp.zeros((), bool)
 
 
 def _mconsensusack(dev, ps, msg, me, ctx, dims):
@@ -638,7 +652,7 @@ def _mconsensusack(dev, ps, msg, me, ctx, dims):
     ob = _commit_broadcast(
         dev, ps, me, seq, key, client, ctx, dims, chosen
     )
-    return ps, ob
+    return ps, ob, jnp.zeros((), bool)
 
 
 def _mgc(dev, ps, msg, me, ctx, dims):
@@ -673,8 +687,9 @@ def _mgc(dev, ps, msg, me, ctx, dims):
         m_stable=ps["m_stable"] + jnp.sum(delta),
         seq_in_slot=jnp.where(freed, 0, ps["seq_in_slot"]),
     )
-    return ps, empty_outbox(dims)
+    return ps, empty_outbox(dims), jnp.zeros((), bool)
 
 
 def _mdrain(dev, ps, msg, me, ctx, dims):
-    return _drain(dev, ps, me, ctx, dims, empty_outbox(dims), 0, 1)
+    # the graph drain runs hoisted after the switch (handle)
+    return ps, empty_outbox(dims), jnp.ones((), bool)

@@ -43,7 +43,7 @@ def run_oracle(config, regions, conflict, commands, cpr):
 def run_engine(config, regions, conflict, commands, cpr):
     planet = Planet.new()
     clients = cpr * len(regions)
-    dev = CaesarDev(keys=1 + clients)
+    dev = CaesarDev.for_load(keys=1 + clients, clients=clients)
     total = commands * clients
     dims = EngineDims.for_protocol(
         dev,
@@ -103,6 +103,7 @@ def test_engine_caesar_matches_oracle_exactly(
         assert res.latency_mean(region) == hist.mean(), region
 
 
+@pytest.mark.slow
 def test_engine_caesar_concurrent_invariants():
     """Same-instant concurrency: tie orders may differ; assert protocol
     invariants and closeness of latency means."""

@@ -6,6 +6,8 @@ timing-dependent) — the value is in the harness invariants: identical
 per-key execution order across processes and complete GC.
 """
 
+import pytest
+
 from fantoch_tpu.core import Config
 from fantoch_tpu.protocol import Caesar
 
@@ -28,5 +30,7 @@ def test_sim_caesar_wait_5_2():
     sim_test(Caesar, caesar_config(5, 2, True))
 
 
+@pytest.mark.slow
 def test_sim_caesar_no_wait_5_2():
+    # ~1 min of host DES; the wait_5_2 variant stays in the quick tier
     sim_test(Caesar, caesar_config(5, 2, False))

@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Ablation timing for CaesarDev's per-step cost: monkeypatch each
+suspect subgraph to a no-op, rebuild the runner, and measure the warm
+per-step time delta. The delta IS that piece's per-step cost (every
+switch branch executes every step under vmap, so disabled-by-flag code
+still runs).
+
+Usage: JAX_PLATFORMS=cpu python tools/profile_caesar_ablate.py
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def build_and_time(label):
+    import jax
+
+    from fantoch_tpu.core import Config, Planet
+    from fantoch_tpu.engine import EngineDims, make_lane
+    from fantoch_tpu.engine.core import build_runner
+    from fantoch_tpu.engine.driver import (
+        batch_reorder_flag,
+        stack_states,
+    )
+    from fantoch_tpu.engine.protocols import (
+        dev_config_kwargs,
+        dev_protocol,
+    )
+    from fantoch_tpu.engine.spec import stack_lanes
+
+    n = 5
+    clients = n
+    commands = 5
+    dev = dev_protocol("caesar", clients)
+    config = Config(**dev_config_kwargs("caesar", n, 2))
+    planet = Planet.new()
+    regions = planet.regions()[:n]
+    dims = EngineDims.for_protocol(
+        dev, n=n, clients=clients, payload=dev.payload_width(n),
+        dot_slots=64, regions=n, hist_buckets=2048,
+    )
+    spec = make_lane(
+        dev, planet, config, conflict_rate=50, pool_size=1,
+        commands_per_client=commands, clients_per_region=1,
+        process_regions=regions, client_regions=regions, dims=dims,
+    )
+    specs = [spec]
+    ctx = stack_lanes(specs)
+    st = stack_states(dev, dims, specs)
+    # cap steps: ablated variants diverge (that's fine — the per-step
+    # cost is data-independent, the mix doesn't matter for timing)
+    runner = build_runner(
+        dev, dims, 400, reorder=batch_reorder_flag(specs)
+    )
+    out = runner(st, ctx)  # compile + run
+    jax.block_until_ready(out["steps"])
+    steps = int(out["steps"][0])
+    t0 = time.perf_counter()
+    out = runner(st, ctx)
+    jax.block_until_ready(out["steps"])
+    dt = time.perf_counter() - t0
+    print(
+        f"{label:<28} {dt:6.2f}s  {dt / max(steps, 1) * 1e3:7.2f} ms/step"
+        f"  (steps={steps}, completed={int(out['completed'][0]) if 'completed' in out else '?'})",
+        flush=True,
+    )
+    return dt
+
+
+def main() -> None:
+    from fantoch_tpu.platform import force_cpu_from_env
+
+    force_cpu_from_env()
+
+    import fantoch_tpu.engine.protocols.caesar as C
+
+    base = build_and_time("full")
+
+    saved = {}
+
+    def patch(name, fn):
+        saved[name] = getattr(C, name)
+        setattr(C, name, fn)
+
+    def restore():
+        for k, v in saved.items():
+            setattr(C, k, v)
+        saved.clear()
+
+    # each ablation replaces one subgraph with a cheap stand-in; the
+    # run's RESULTS become wrong — only the timing delta matters
+    import jax.numpy as jnp
+
+    patch("_wait_scan",
+          lambda dev, ps, me, ctx, dims, ob, a, b, enable=True: (ps, ob))
+    build_and_time("- wait_scan")
+    restore()
+
+    patch("_exec_scan",
+          lambda dev, ps, me, ctx, dims, ob, a, b, enable=True: (ps, ob))
+    build_and_time("- exec_scan")
+    restore()
+
+    patch("_drain_executed_notification",
+          lambda dev, ps, me, ctx, dims, enable: ps)
+    build_and_time("- executed_notification")
+    restore()
+
+    patch("_mgc",
+          lambda dev, ps, msg, me, ctx, dims: (
+              ps, C.empty_outbox(dims), C._off(), C._off()))
+    build_and_time("- mgc")
+    restore()
+
+    patch("_agg_union",
+          lambda dev, ps, slot, base, msg, enable: ps)
+    build_and_time("- agg_union")
+    restore()
+
+    patch("_propose_reply",
+          lambda dev, ps, me, wsrc, wslot, wseq, accept, ctx, dims, ob,
+          slot, enable: (ps, ob))
+    build_and_time("- propose_reply")
+    restore()
+
+    patch("_mpropose",
+          lambda dev, ps, msg, me, ctx, dims: (
+              ps, C.empty_outbox(dims), C._off(), C._off()))
+    build_and_time("- mpropose (whole)")
+    restore()
+
+    patch("_gc_drain",
+          lambda dev, ps, msg, me, ctx, dims: (
+              ps, C.empty_outbox(dims), C._off(), C._off()))
+    build_and_time("- gc_drain")
+    restore()
+
+
+if __name__ == "__main__":
+    main()

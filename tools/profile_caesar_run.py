@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Step-count + per-step-cost breakdown for one CaesarDev lane.
+
+The round-5 CPU bench smoke measured caesar at 0.07 points/s vs
+tempo's 5.84 — ~80x. This tool separates the two candidate causes:
+too many engine steps (drain chains) vs too much work per step
+(the wait-condition re-evaluation gathers).
+
+Usage: JAX_PLATFORMS=cpu python tools/profile_caesar_run.py [proto]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    name = sys.argv[1] if len(sys.argv) > 1 else "caesar"
+    from fantoch_tpu.platform import enable_compile_cache, force_cpu_from_env
+
+    force_cpu_from_env()
+    enable_compile_cache()
+
+    from fantoch_tpu.core import Config, Planet
+    from fantoch_tpu.engine import EngineDims, make_lane, run_lanes
+    from fantoch_tpu.engine.protocols import dev_config_kwargs, dev_protocol
+
+    n = 5
+    clients = n
+    commands = 5
+    dev = dev_protocol(name, clients)
+    config = Config(**dev_config_kwargs(name, n, 1 if name != "caesar" else 2))
+    planet = Planet.new()
+    regions = planet.regions()[:n]
+    dims = EngineDims.for_protocol(
+        dev, n=n, clients=clients, payload=dev.payload_width(n),
+        dot_slots=64, regions=n, hist_buckets=2048,
+    )
+    spec = make_lane(
+        dev, planet, config, conflict_rate=50, pool_size=1,
+        commands_per_client=commands, clients_per_region=1,
+        process_regions=regions, client_regions=regions, dims=dims,
+    )
+    t0 = time.perf_counter()
+    res = run_lanes(dev, dims, [spec])[0]
+    dt = time.perf_counter() - t0
+    steps = int(res.steps) if hasattr(res, "steps") else -1
+    print(
+        f"{name}: 1 lane, {commands * clients} cmds -> "
+        f"{dt:.1f}s wall (incl. compile), steps={steps}, "
+        f"completed={res.completed}, err={res.err}"
+    )
+    # run again (compiled): pure runtime
+    t0 = time.perf_counter()
+    res = run_lanes(dev, dims, [spec])[0]
+    dt = time.perf_counter() - t0
+    per_step_us = dt / max(steps, 1) * 1e6
+    print(
+        f"{name}: warm run {dt:.2f}s, {per_step_us:.0f} us/step "
+        f"({steps} steps)"
+    )
+
+
+if __name__ == "__main__":
+    main()
