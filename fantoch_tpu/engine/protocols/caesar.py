@@ -94,12 +94,10 @@ class CaesarDev(DevIdentity):
         # GC rounds lag executions by up to one interval (oracle event
         # order), keeping registrations visible longer. DEP multiplies
         # the payload width and every per-step dep tensor (the executor
-        # scan is the step's dominant cost), so the default is the
-        # smallest bound the test matrix (incl. 100-command reference
-        # scale) runs without ERR_CAPACITY — raise it per-lane for
-        # hotter workloads, overflow is always loud
-        dep_slots: int = 32,
-        blocker_slots: int = 8,
+        # scan is the step's dominant cost) — size through for_load for
+        # real workloads; overflow is always loud
+        dep_slots: int = 64,
+        blocker_slots: int = 16,
         gap_slots: int = 8,
         exec_buffer: int = 128,
     ):
@@ -112,16 +110,17 @@ class CaesarDev(DevIdentity):
 
     @classmethod
     def for_load(cls, keys: int, clients: int) -> "CaesarDev":
-        """Capacity bounds scaled to the client count: dep lists grow
-        with the number of concurrently conflicting commands (~a few
-        per client at 100% conflict), so size DEP at ~6x clients with
-        the 32 floor the default shapes need; blockers track higher-
-        clock conflicts, a quarter of that. Overflow stays loud
-        (ERR_CAPACITY), so a hotter workload fails visibly, not
-        silently."""
-        dep = max(32, -(-6 * clients // 8) * 8)
+        """Capacity bounds scaled to the client count. Dep lists grow
+        with the concurrently conflicting registrations, which at 100%
+        conflict and long command budgets approach the key row (S=32)
+        plus union extras: a 32-slot DEP measured ERR_CAPACITY on the
+        bench's conflict-100 lanes at 50 commands/client, so the floor
+        stays 64 and scales at 8x clients beyond 8 clients; blockers
+        (higher-clock conflicts) track at a quarter. Overflow stays
+        loud (ERR_CAPACITY), never silent."""
+        dep = max(64, 8 * clients)
         return cls(
-            keys=keys, dep_slots=dep, blocker_slots=max(8, dep // 4)
+            keys=keys, dep_slots=dep, blocker_slots=max(16, dep // 4)
         )
 
     # -- host-side builders -------------------------------------------
@@ -277,8 +276,11 @@ class CaesarDev(DevIdentity):
         # exist ONCE per step — hoisted here behind enable flags the
         # branches set — not inlined into three branches (which cost
         # ~3x the per-step work AND ~3x the compile size; measured
-        # 56 ms/step before the hoist).
-        base = dims.N + 1
+        # 56 ms/step before the hoist). The reserved slots are the
+        # LAST EXTRA_SLOTS outbox rows (dims.for_protocol adds them on
+        # top of the branch fanout), so a future wider-fanout branch
+        # can never collide with them by convention drift.
+        base = dims.F - CaesarDev.EXTRA_SLOTS
         ps, ob = _exec_scan(
             self, ps, me, ctx, dims, ob, base, base + 1, do_exec
         )

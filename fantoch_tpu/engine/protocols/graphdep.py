@@ -236,8 +236,10 @@ class _DepDev(DevIdentity):
         # under vmap the switch executes every branch each step, so the
         # graph drain (relaxation fixed point + per-dep executed-set
         # walk — the heaviest subgraph here) must exist ONCE per step,
-        # hoisted behind an enable flag, not inlined into two branches
-        base = dims.N + 1
+        # hoisted behind an enable flag, not inlined into two branches.
+        # Reserved slots are the LAST EXTRA_SLOTS rows (dims adds them
+        # on top of the branch fanout), immune to fanout growth.
+        base = dims.F - _DepDev.EXTRA_SLOTS
         ps, ob = _drain(
             self, ps, me, ctx, dims, ob, base, base + 1, do_drain
         )

@@ -94,8 +94,6 @@ def main() -> None:
 
     # each ablation replaces one subgraph with a cheap stand-in; the
     # run's RESULTS become wrong — only the timing delta matters
-    import jax.numpy as jnp
-
     patch("_wait_scan",
           lambda dev, ps, me, ctx, dims, ob, a, b, enable=True: (ps, ob))
     build_and_time("- wait_scan")
