@@ -86,19 +86,21 @@ class AtlasPartialDev(AtlasDev):
 
     PERIODIC_ROWS = 2  # [garbage collection, executor cleanup]
 
-    # buffered cross-shard requests awaiting a local commit
-    B = 8
-
     def __init__(
         self,
         keys: int,
         shards: int = 2,
         keys_per_cmd: int = 2,
         gap_slots: int = 8,
+        # buffered cross-shard requests awaiting a local commit; grows
+        # with shard count x in-flight commands (reference-scale runs
+        # at 4 shards measured ERR_CAPACITY at 8)
+        req_buffer: int = 16,
     ):
         super().__init__(keys, gap_slots)
         self.S = shards
         self.KPC = keys_per_cmd
+        self.B = req_buffer
 
     # -- host-side builders -------------------------------------------
 
