@@ -153,20 +153,25 @@ def main() -> None:
 
     points_per_sec = total_points / elapsed
     per_chip_target = 10_000 / 60.0 / 8.0  # north-star rate, per chip
+    platform = jax.devices()[0].platform
+    fallback = bool(int(_os.environ.get("FANTOCH_BENCH_CPU_FALLBACK", "0")))
     print(
         json.dumps(
             {
                 "metric": "sweep_points_per_sec",
                 "value": round(points_per_sec, 2),
                 "unit": (
-                    f"all-protocol configs/s (n={N}, f=1-2, "
+                    ("CPU-mesh fallback (TPU unreachable): " if fallback
+                     else "")
+                    + f"all-protocol configs/s (n={N}, f=1-2, "
                     f"{COMMANDS * clients} cmds each, {total_points} "
                     f"points, per-protocol "
                     + ",".join(
                         f"{k}={v}" for k, v in per_proto.items()
                     )
-                    + f", {len(jax.devices())} device(s))"
+                    + f", {len(jax.devices())} {platform} device(s))"
                 ),
+                "platform": platform,
                 "vs_baseline": round(points_per_sec / per_chip_target, 3),
             }
         )
@@ -299,11 +304,61 @@ def _emit_unreachable(reason: str = "unreachable at startup") -> None:
                     f"{_since_birth():.0f}s "
                     "(harness verified on CPU in tests/)"
                 ),
+                "platform": "none",
                 "vs_baseline": 0.0,
             }
         )
     )
     sys.exit(0)
+
+
+# CPU-fallback shape: small enough that a full five-protocol mesh run
+# fits what is left of the driver budget after the probe ladder, big
+# enough to be a real measurement (2 subsets x 2 f x 4 conflicts = 16
+# points per protocol, 80 total)
+_CPU_FALLBACK_ENV = {
+    "FANTOCH_BENCH_SUBSETS": "2",
+    "FANTOCH_BENCH_COMMANDS": "10",
+    "FANTOCH_BENCH_CHUNK": "16",
+}
+
+# below this remaining total budget a CPU fallback run cannot plausibly
+# finish (cold compiles alone can eat minutes) — emit the honest zero
+# instead of starting a run the driver's timeout would kill mid-flight,
+# artifact-less
+_CPU_FALLBACK_MIN_BUDGET_S = 300.0
+
+
+def _cpu_fallback(reason: str = "unreachable at startup") -> None:
+    """Probe ladder exhausted: re-exec as a full CPU-mesh bench run
+    (reduced shape, 8-device host mesh) so the artifact carries a
+    MEASURED value with explicit cpu provenance instead of an
+    honest-zero (VERDICT r5 next-round #1). Falls back to the zero
+    artifact if the CPU run itself already failed once, or when too
+    little of the total budget remains for it to finish."""
+    import sys
+
+    if int(_os.environ.get("FANTOCH_BENCH_CPU_FALLBACK", "0")):
+        _emit_unreachable(f"{reason}; CPU fallback failed too")
+    if TOTAL_BUDGET_S - _since_birth() < _CPU_FALLBACK_MIN_BUDGET_S:
+        _emit_unreachable(
+            f"{reason}; no budget left for a CPU fallback run"
+        )
+    print(
+        f"bench: device backend {reason} after {_since_birth():.0f}s — "
+        "falling back to a CPU-mesh bench run",
+        file=sys.stderr,
+    )
+    _os.environ["FANTOCH_BENCH_CPU_FALLBACK"] = "1"
+    _os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = _os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        _os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    for k, v in _CPU_FALLBACK_ENV.items():
+        _os.environ.setdefault(k, v)
+    _os.execv(sys.executable, [sys.executable] + sys.argv)
 
 
 if __name__ == "__main__":
@@ -330,7 +385,7 @@ if __name__ == "__main__":
                 _remaining(), TOTAL_BUDGET_S - _since_birth()
             )
             if budget < 30:
-                _emit_unreachable()
+                _cpu_fallback()
             status, plat = probe_device_backend(
                 min(PROBE_TIMEOUT_S, budget)
             )
@@ -340,7 +395,7 @@ if __name__ == "__main__":
             if status == "cpu-only":
                 # deterministic: this jax install has no device plugin
                 # at all — retrying can never fix it
-                _emit_unreachable("absent (cpu-only jax install)")
+                _cpu_fallback("absent (cpu-only jax install)")
             wait = PROBE_WAITS_S[
                 min(probe_attempt, len(PROBE_WAITS_S) - 1)
             ]
@@ -349,7 +404,7 @@ if __name__ == "__main__":
                 min(_remaining(), TOTAL_BUDGET_S - _since_birth())
                 < wait + 30
             ):
-                _emit_unreachable()
+                _cpu_fallback()
             print(
                 f"bench: backend probe failed; retry in {wait}s "
                 f"({_remaining():.0f}s of budget left)",
@@ -387,5 +442,15 @@ if __name__ == "__main__":
                 "spent — giving up",
                 file=sys.stderr,
             )
-            _emit_unreachable("crashed mid-run, retry budget spent")
+            _cpu_fallback("crashed mid-run, retry budget spent")
+        if cpu_mode and int(
+            os.environ.get("FANTOCH_BENCH_CPU_FALLBACK", "0")
+        ):
+            # we are the degraded-mode child: the driver must still get
+            # a parsed artifact, so close the ladder with the honest
+            # zero instead of a bare traceback
+            _emit_unreachable(
+                f"unreachable; CPU fallback crashed "
+                f"({type(e).__name__})"
+            )
         raise

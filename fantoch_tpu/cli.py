@@ -220,8 +220,14 @@ def cmd_sim(args) -> None:
 def cmd_sweep(args) -> None:
     import itertools
 
-    from .engine import EngineDims
+    from .engine import EngineDims, parse_fault_specs
     from .parallel.sweep import make_sweep_specs, run_sweep
+
+    fault_plans = None
+    if args.faults:
+        fault_plans = parse_fault_specs(args.faults)
+        if args.shards > 1:
+            raise SystemExit("--faults is single-shard for now")
 
     planet = _planet(args)
     all_regions = planet.regions()
@@ -294,6 +300,7 @@ def cmd_sweep(args) -> None:
             else None
         ),
         pool_size=args.pool_size,
+        faults=fault_plans,
     )
     results = run_sweep(dev, dims, specs)
     errs = sum(1 for r in results if r.err)
@@ -306,24 +313,30 @@ def cmd_sweep(args) -> None:
         ),
         "stalled_lanes": sum(1 for r in results if r.requeues),
     }
+    if fault_plans is not None:
+        summary["fault_lanes"] = sum(
+            1 for r in results if r.faults is not None
+        )
+        summary["unavailable_lanes"] = sum(
+            1 for r in results if r.faults and r.faults.get("unavail")
+        )
+        summary["messages_dropped"] = sum(r.dropped for r in results)
     if args.out:
         from .plot import save_results
 
         rows = []
         for spec, res in zip(specs, results):
-            rows.append(
-                (
-                    {
-                        "protocol": args.protocol,
-                        "n": spec.config.n,
-                        "f": spec.config.f,
-                        "shards": spec.config.shard_count,
-                        "conflict": int(spec.ctx["conflict_rate"]),
-                        "regions": spec.process_regions,
-                    },
-                    res,
-                )
-            )
+            attrs = {
+                "protocol": args.protocol,
+                "n": spec.config.n,
+                "f": spec.config.f,
+                "shards": spec.config.shard_count,
+                "conflict": int(spec.ctx["conflict_rate"]),
+                "regions": spec.process_regions,
+            }
+            if spec.fault_meta is not None:
+                attrs["faults"] = spec.fault_meta
+            rows.append((attrs, res))
         save_results(args.out, rows)
         summary["out"] = args.out
     print(json.dumps(summary))
@@ -618,6 +631,15 @@ def main(argv=None) -> None:
                     help="partial replication: shard count (tempo/atlas)")
     sw.add_argument("--keys-per-command", type=int, default=2,
                     help="keys per command when --shards > 1")
+    sw.add_argument(
+        "--faults",
+        default=None,
+        help="fault-plan spec: JSON object/list or @file; each sweep "
+        'point runs once per plan ({} = fault-free), e.g. '
+        '\'[{}, {"crash": {"1": 200}}, {"windows": [{"src": 0, '
+        '"dst": 1, "t0": 0, "t1": 500, "delay": "inf"}], '
+        '"horizon": 5000}]\' (lossy plans need a horizon)',
+    )
     sw.add_argument("--out", default=None, help="results JSONL path")
     sw.set_defaults(fn=cmd_sweep)
 

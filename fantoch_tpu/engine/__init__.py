@@ -20,6 +20,7 @@ Design (see SURVEY.md §7):
 """
 
 from .dims import EngineDims
+from .faults import FaultPlan, LinkWindow, parse_fault_specs
 from .core import build_runner, init_lane_state
 from .spec import LaneSpec, make_lane, stack_lanes
 from .results import LaneResults, collect_results
@@ -27,11 +28,14 @@ from .driver import run_lanes
 
 __all__ = [
     "EngineDims",
+    "FaultPlan",
+    "LinkWindow",
     "LaneSpec",
     "LaneResults",
     "build_runner",
     "init_lane_state",
     "make_lane",
+    "parse_fault_specs",
     "stack_lanes",
     "collect_results",
     "run_lanes",

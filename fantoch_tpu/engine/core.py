@@ -56,10 +56,12 @@ from .dims import (
     ERR_POOL,
     ERR_STUCK,
     ERR_TRUNCATED,
+    ERR_UNAVAIL,
     INF,
     REQUEUE_LIMIT,
     EngineDims,
 )
+from .faults import NO_FAULTS, FaultFlags, drop_draw
 
 I32 = jnp.int32
 
@@ -426,6 +428,8 @@ def init_lane_state(
         "pair_cnt": np.zeros((N, N), np.int32),
         "steps": np.int32(0),
         "pool_peak": np.int32(int(live.sum())),
+        # messages lost to fault windows/drops (per-lane diagnostic)
+        "fault_dropped": np.int32(0),
         # total readiness-gate bounces: > 0 in a FIFO (non-reorder) lane
         # means an undersized dot window stalled deliveries and latency
         # results deviate from the unbounded-buffer reference — loud in
@@ -444,7 +448,8 @@ def init_lane_state(
 # the step function
 # ----------------------------------------------------------------------
 
-def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False):
+def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False,
+               faults: FaultFlags = NO_FAULTS):
     N, C, M, F, R, P = dims.N, dims.C, dims.M, dims.F, dims.R, dims.P
     pool = st["pool"]                     # [M, POOL_FIELDS + P]
     arrival = pool[:, PA]
@@ -452,6 +457,27 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False):
     pool_ksrc = pool[:, PKS]
     pool_prio = pool[:, PPR] != 0
     procs = jnp.arange(N, dtype=I32)
+
+    # fault choke point 0 (crash-stop): a message addressed to a process
+    # at or past its crash time is lost, and a crashed process's timers
+    # stop — the oracle skips the same events at pop time. Both masks
+    # are idempotent, so re-applying them every step needs no extra
+    # bookkeeping; once purged, a crashed process's earliest event time
+    # is INF and it stops qualifying, emitting, or gating anyone's
+    # lookahead bound (its e_q drops out of the Chandy-Misra condition,
+    # which is exactly the per-window recomputation the conservative
+    # rule needs).
+    if faults.crash:
+        crash_t = ctx["fault_crash_t"]                        # [N]
+        arrival = jnp.where(
+            arrival >= oh_take(crash_t, pool_dst), INF, arrival
+        )
+        next_periodic_in = jnp.where(
+            st["next_periodic"] >= crash_t[:, None], INF,
+            st["next_periodic"],
+        )
+    else:
+        next_periodic_in = st["next_periodic"]
 
     # 1. per-process local event times + conservative lookahead ---------
     # Each process p advances to its own earliest pending event e_p
@@ -466,7 +492,7 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False):
     arr_p = jnp.min(
         jnp.where(dstmask, arrival[None, :], INF), axis=1
     )                                                         # [N]
-    ep = jnp.minimum(arr_p, jnp.min(st["next_periodic"], axis=1))
+    ep = jnp.minimum(arr_p, jnp.min(next_periodic_in, axis=1))
     reach = jnp.where(
         (ep[:, None] >= INF) | (ctx["lookahead"] >= INF),
         INF,
@@ -479,6 +505,11 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False):
     # minimum T are always safe (nothing can arrive before T) — that
     # also guarantees progress whatever the delay matrix.
     active = (ep < INF) & ((ep < bound) | (ep == T))
+    if faults.horizon:
+        # events at or past the fault horizon are never handled (the
+        # oracle stops popping at the same instant); once every pending
+        # event sits past it, now >= horizon and the lane ends
+        active = active & (ep < ctx["fault_horizon"])
 
     # 2. pop at most one message per active process at its local time --
     # periodic timers take the whole step for their process: the oracle
@@ -486,7 +517,7 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False):
     # their self-targeted emissions inline before any same-instant
     # message — so pending messages wait for the next step
     fire = (
-        (st["next_periodic"] == ep[:, None]) & active[:, None]
+        (next_periodic_in == ep[:, None]) & active[:, None]
     )                                                         # [N, R]
     fired_any = jnp.any(fire, axis=1)                         # [N]
 
@@ -555,7 +586,7 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False):
     ps, pout = jax.vmap(periodic_one)(st["ps"], fire, procs, ep)
     next_periodic = jnp.where(
         fire, ep[:, None] + ctx["periodic_intervals"][None, :],
-        st["next_periodic"],
+        next_periodic_in,
     )
 
     def handle_one(ps_slice, m, me, t):
@@ -606,6 +637,8 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False):
     emitter = jnp.repeat(procs, F2)
     E = N * F2
     valid, dst = out["valid"], out["dst"]
+    # each process's last emission row is its readiness-gate requeue
+    is_rq = jnp.zeros((N, F2), bool).at[:, F2 - 1].set(True).reshape(E)
 
     # 5. client rewrite: TO_CLIENT → latency record + next SUBMIT -------
     # reorder perturbation (runner.rs:520-524): every hop's delay scales
@@ -642,7 +675,16 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False):
     # multi-key tables), at the latest part's arrival time. The closed
     # loop guarantees at most one *completion* per client per step.
     iota_c = jnp.arange(C, dtype=I32)
-    oh_done = is_client[:, None] & (c[:, None] == iota_c[None, :])  # [E, C]
+    if faults.horizon:
+        # a result that would reach its client at or past the fault
+        # horizon is never delivered (the oracle never pops it), so it
+        # completes nothing and issues nothing
+        is_client_done = is_client & (t_arr < ctx["fault_horizon"])
+    else:
+        is_client_done = is_client
+    oh_done = (
+        is_client_done[:, None] & (c[:, None] == iota_c[None, :])
+    )  # [E, C]
     arrivals = jnp.sum(oh_done, axis=0, dtype=I32)                  # [C]
     if "cmd_parts" in ctx:
         T_parts = ctx["cmd_parts"].shape[1]
@@ -742,6 +784,44 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False):
         scaled(ctx["delay_pp"][emitter, jnp.clip(dst, 0, N - 1)], 2),
     )
     delay = jnp.where(overridden, out["delay"], delay)
+
+    # fault choke point 1 (wire faults apply to process->process sends
+    # only: client hops model the in-process client stack, requeues are
+    # deferred deliveries, self-messages never cross the network)
+    wired = valid & ~is_client & ~is_rq & ~overridden & (dst != emitter)
+    if faults.windows:
+        # link-degradation windows, by the emitter's local send time;
+        # an effective delay at or past INF is a partition and the
+        # message is lost on the wire (after taking its channel
+        # counter value — the oracle counts before it drops too)
+        wm = (
+            (ctx["fault_win_src"][None, :] == emitter[:, None])
+            & (ctx["fault_win_dst"][None, :] == dst[:, None])
+            & (ctx["fault_win_t0"][None, :] <= ep_e[:, None])
+            & (ep_e[:, None] < ctx["fault_win_t1"][None, :])
+            & wired[:, None]
+        )                                                     # [E, W]
+        w_hit = jnp.any(wm, axis=1)
+        # windows of one (src, dst) pair never overlap (validated at
+        # plan construction), so masked sums select the active window
+        w_mul = jnp.sum(
+            jnp.where(wm, ctx["fault_win_mul"][None, :], 0), axis=1
+        )
+        w_ovr = jnp.sum(
+            jnp.where(wm, ctx["fault_win_ovr"][None, :], 0), axis=1
+        )
+        # multiply with an overflow clamp: mul > INF // delay implies
+        # delay * mul > INF, exactly the oracle's min(base*mult, INF)
+        # (an i32 wraparound would deliver at a negative arrival time)
+        w_mul = jnp.maximum(w_mul, 1)
+        mul_cap = INF // jnp.maximum(delay, 1)
+        eff_mul = jnp.where(w_mul > mul_cap, INF, delay * w_mul)
+        eff = jnp.where(w_ovr >= 0, w_ovr, eff_mul)
+        lost = w_hit & (eff >= INF)
+        delay = jnp.where(w_hit & ~lost, eff, delay)
+    else:
+        lost = jnp.zeros((E,), bool)
+
     valid = valid & (~is_client | issue)
     msg_arrival = base + delay
     prio = ~is_client & (dst == emitter) & ~overridden
@@ -762,7 +842,6 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False):
     # key — they are deliveries deferred, not new emissions — so they
     # keep their place in the per-channel FIFO order and never consume
     # channel counter values
-    is_rq = jnp.zeros((N, F2), bool).at[:, F2 - 1].set(True).reshape(E)
     dst_b = dst.reshape(N, F2)
     chan_b = (
         (valid & ~is_client & ~is_rq).reshape(N, F2)
@@ -792,20 +871,39 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False):
         ohe[:, :, None] & ohd[:, None, :], axis=0, dtype=I32
     )
 
+    # fault choke point 2 (probabilistic wire loss): the verdict is a
+    # pure threefry function of (src, dst, channel emission index), so
+    # the host oracle draws the identical verdict for the identical
+    # message whatever the step interleaving — the same schedule-
+    # independence argument as the tie-break keys. Lost messages KEEP
+    # their channel counter value (pair_cnt above counts pre-loss,
+    # like the oracle) but never land in the pool.
+    if faults.drops:
+        draw = jax.vmap(
+            lambda s, d, k: drop_draw(ctx["fault_drop_key"], s, d, k)
+        )(emitter, jnp.clip(dst, 0, N - 1), kcnt)
+        lost = lost | (wired & (draw < ctx["fault_drop_num"]))
+    if faults.windows or faults.drops:
+        deliver = valid & ~lost
+        n_lost = jnp.sum(valid & lost, dtype=I32)
+    else:
+        deliver = valid
+        n_lost = jnp.zeros((), I32)
+
     # 6. pack the emissions and land them in free pool slots with ONE
     # row scatter (slot choice is arbitrary — ordering lives in the
     # (ksrc, kcnt) keys)
-    rank = cumsum_i32(valid)                                  # [E], 1-based
+    rank = cumsum_i32(deliver)                                # [E], 1-based
     free = arrival == INF
     free_cum = cumsum_i32(free)                               # [M]
     target = searchsorted_left(free_cum, rank)
-    target = jnp.where(valid, target, M)
+    target = jnp.where(deliver, target, M)
     n_free = jnp.sum(free)
-    pool_overflow = jnp.sum(valid) > n_free
+    pool_overflow = jnp.sum(deliver) > n_free
     rq_arr = jnp.zeros((N, F2), I32).at[:, F2 - 1].set(rq_next).reshape(E)
     # diagnostic: peak pool occupancy, for sizing EngineDims.M
     pool_peak = jnp.maximum(
-        st["pool_peak"], M - n_free + jnp.sum(valid, dtype=I32)
+        st["pool_peak"], M - n_free + jnp.sum(deliver, dtype=I32)
     )
     new_rows = jnp.concatenate(
         [
@@ -847,6 +945,11 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False):
         | ERR_STUCK * stuck
         | jnp.bitwise_or.reduce(jnp.asarray(protocol.error(ps), I32))
     )
+    if faults.crash:
+        # statically-known unavailability (crashes exceed what the
+        # protocol tolerates): terminate now, never hang toward
+        # ERR_STUCK/ERR_TRUNCATED
+        err = err | ERR_UNAVAIL * (ctx["fault_unavail"] != 0)
 
     return {
         "pool": new_pool,
@@ -868,6 +971,7 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False):
         "now": T,
         "pair_cnt": pair_cnt,
         "pool_peak": pool_peak,
+        "fault_dropped": st["fault_dropped"] + n_lost,
         "requeues": st["requeues"] + jnp.sum(requeued, dtype=I32),
         "max_completion": max_completion,
         "steps": st["steps"] + 1,
@@ -878,31 +982,39 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False):
     }
 
 
-def _lane_running(dims, st, ctx, max_steps):
+def _lane_running(dims, st, ctx, max_steps, faults: FaultFlags = NO_FAULTS):
     end = jnp.where(
         st["done_time"] >= INF, INF, st["done_time"] + ctx["extra_time"]
     )
     finished = (st["done_time"] < INF) & (st["now"] >= end)
     idle = st["now"] >= INF  # nothing scheduled at all
-    return (
+    running = (
         ~(finished | idle | (st["err"] != 0)) & (st["steps"] < max_steps)
     )
+    if faults.horizon:
+        # fault-plan horizon: the lane ends at a fixed simulated
+        # instant (lossy lanes may never complete their budget)
+        running = running & (st["now"] < ctx["fault_horizon"])
+    return running
 
 
 def build_runner(
     protocol, dims: EngineDims, max_steps: int = 1 << 22,
-    reorder: bool = False,
+    reorder: bool = False, faults: FaultFlags = NO_FAULTS,
 ):
     """Compile the batched sweep runner: (batched state, batched ctx) →
     final batched state. vmap supplies the config-batch axis; the sweep
     driver shards that axis over the TPU mesh. ``reorder`` must match
     the lanes' ``make_lane(reorder=...)`` flag (one compiled runner per
-    setting — mixing both in one batch is not supported)."""
+    setting — mixing both in one batch is not supported). ``faults``
+    is the batch's fault-capability union (engine/faults.py): lanes
+    with and without fault plans share one compiled runner, and an
+    all-False ``faults`` compiles exactly the fault-free graph."""
 
     def run_lane(st, ctx):
         out = jax.lax.while_loop(
-            lambda s: _lane_running(dims, s, ctx, max_steps),
-            lambda s: _lane_step(protocol, dims, s, ctx, reorder),
+            lambda s: _lane_running(dims, s, ctx, max_steps, faults),
+            lambda s: _lane_step(protocol, dims, s, ctx, reorder, faults),
             st,
         )
         # a lane truncated by max_steps must never look like a clean run
@@ -914,7 +1026,7 @@ def build_runner(
 
 def build_segment_runner(
     protocol, dims: EngineDims, max_steps: int = 1 << 22,
-    reorder: bool = False,
+    reorder: bool = False, faults: FaultFlags = NO_FAULTS,
 ):
     """Like :func:`build_runner` but each device call advances every
     still-running lane by at most ``until - steps`` steps and returns,
@@ -932,12 +1044,12 @@ def build_segment_runner(
     def run_lane(st, ctx, until):
         lim = jnp.minimum(until, max_steps)
         out = jax.lax.while_loop(
-            lambda s: _lane_running(dims, s, ctx, max_steps)
+            lambda s: _lane_running(dims, s, ctx, max_steps, faults)
             & (s["steps"] < lim),
-            lambda s: _lane_step(protocol, dims, s, ctx, reorder),
+            lambda s: _lane_step(protocol, dims, s, ctx, reorder, faults),
             st,
         )
-        return out, _lane_running(dims, out, ctx, max_steps)
+        return out, _lane_running(dims, out, ctx, max_steps, faults)
 
     def run_batch(st, ctx, until):
         out, alive = jax.vmap(run_lane, in_axes=(0, 0, None))(
@@ -951,9 +1063,9 @@ def build_segment_runner(
     runner = jax.jit(run_batch)
     alive = jax.jit(
         lambda st, ctx: jnp.any(
-            jax.vmap(lambda s, c: _lane_running(dims, s, c, max_steps))(
-                st, ctx
-            )
+            jax.vmap(
+                lambda s, c: _lane_running(dims, s, c, max_steps, faults)
+            )(st, ctx)
         )
     )
     return runner, alive

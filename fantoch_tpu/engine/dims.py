@@ -33,6 +33,10 @@ ERR_CAPACITY = 16   # fixed-width table/buffer overflow (rows, slots)
 ERR_PROTO = 32      # protocol invariant violated (missing/dup entries)
 ERR_STUCK = 64      # one message requeued > REQUEUE_LIMIT times — a
                     # prerequisite that never arrives (deadlocked lane)
+ERR_UNAVAIL = 128   # fault plan exceeds what the protocol tolerates
+                    # (crashes > f, or survivors < its largest quorum):
+                    # quorum unreachable, the lane terminates instead of
+                    # hanging (engine/faults.py)
 
 # readiness-gate bounces per message before the lane is declared stuck;
 # legitimate waits are bounded by the largest delivery-time gap between
@@ -48,6 +52,7 @@ ERR_NAMES = {
     ERR_CAPACITY: "capacity-overflow",
     ERR_PROTO: "protocol-invariant",
     ERR_STUCK: "requeue-livelock",
+    ERR_UNAVAIL: "quorum-unavailable",
 }
 
 

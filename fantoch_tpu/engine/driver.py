@@ -11,6 +11,7 @@ import numpy as np
 
 from .core import build_runner, init_lane_state
 from .dims import EngineDims
+from .faults import batch_fault_flags
 from .results import LaneResults, collect_results
 from .spec import LaneSpec, stack_lanes
 
@@ -37,7 +38,11 @@ def run_lanes(
     ctx = stack_lanes(specs)
     state = stack_states(protocol, dims, specs)
     runner = build_runner(
-        protocol, dims, max_steps, reorder=batch_reorder_flag(specs)
+        protocol, dims, max_steps,
+        reorder=batch_reorder_flag(specs),
+        # fault-capability union: fault-free and faulty lanes share one
+        # compiled runner (fault-free lanes' ctx arrays are inert)
+        faults=batch_fault_flags(specs),
     )
     final = runner(state, ctx)
     return collect_results(protocol, dims, final, specs)

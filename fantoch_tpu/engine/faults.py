@@ -1,0 +1,463 @@
+"""Per-lane fault plans for the batched device engine.
+
+The reference framework evaluates consensus protocols that are designed
+around tolerating ``f`` replica failures, yet the batched engine only
+ever simulated fault-free runs. A :class:`FaultPlan` is the pure-array
+encoding of one lane's adversity:
+
+* **crash-stop faults** — process ``p`` dies at local time ``t`` and
+  never handles or emits again. Messages addressed to it at or past
+  ``t`` are lost, its timers stop, and — because neither the reference
+  nor this repo models recovery — processes that are going to crash are
+  *suspected from the start*: quorum selection ranks them last (they
+  join no quorum) and the clients attached to them are halted (their
+  command budget is zeroed and they are excused from the termination
+  predicate). Until ``t`` the doomed process still participates as a
+  quorum outsider: it stores payloads, votes, executes and advances the
+  stability frontier, so the surviving lanes measure exactly the
+  "tail latency with a degraded membership" question. Under a leader
+  protocol (``config.leader`` set) a leader crash halts every client —
+  nothing can commit after the leader stops and there is no election;
+* **link-degradation windows** — during ``[t0, t1)`` (by the *send*
+  time at the emitter) the ``(src, dst)`` delay is multiplied or
+  overridden; an override at or past ``INF`` is a partition and the
+  message is lost on the wire;
+* **probabilistic message drops** — each process→process emission is
+  lost with probability ``drop_bp / 10_000``, decided by a threefry
+  draw keyed on ``(src, dst, channel-emission-index)`` so the host
+  oracle and the device draw bit-identical verdicts on identical
+  histories (the same schedule-independence argument as the engine's
+  tie-break keys).
+
+Drops and windows apply to process→process wire hops only: client hops
+(SUBMIT / TO_CLIENT) model the in-process client stack, self-messages
+never cross the network, and readiness-gate requeues are deferred
+deliveries, not new sends. Lost prerequisites can legitimately surface
+as ``ERR_STUCK`` (a commit endlessly requeued behind a dropped collect)
+— that is a measured deadlock, not an engine bug; bound such lanes with
+``horizon_ms``, which ends the simulation at a fixed instant on both
+the device and the oracle (closed-loop clients have no retransmission,
+so a lossy lane may otherwise never complete its budget).
+
+Availability: a plan whose crashes exceed ``f`` — or leave fewer
+survivors than the protocol's largest quorum/threshold
+(``protocol.min_live``) — cannot reach quorum; such lanes terminate
+immediately with ``ERR_UNAVAIL`` instead of hanging.
+
+Fault plans are single-shard for now (partial-replication twins reject
+them loudly).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from .dims import INF
+
+# static window-slot bound shared by every lane of a batch (fixed
+# shapes under jit); plans with more windows fail loudly at build time
+MAX_WINDOWS = 8
+
+# drop probabilities are basis points out of this denominator
+DROP_DENOM = 10_000
+
+
+class FaultFlags(NamedTuple):
+    """Trace-time fault capabilities of a compiled runner (hashable —
+    part of the sweep driver's compile-cache key). A batch mixing
+    fault-free and faulty lanes compiles once with the union of its
+    lanes' flags; fault-free lanes' ctx arrays are inert."""
+
+    crash: bool = False
+    windows: bool = False
+    drops: bool = False
+    horizon: bool = False
+
+    def __or__(self, other: "FaultFlags") -> "FaultFlags":
+        return FaultFlags(*(bool(a or b) for a, b in zip(self, other)))
+
+
+NO_FAULTS = FaultFlags()
+
+
+@dataclass(frozen=True)
+class LinkWindow:
+    """One ``(src, dst)`` degradation interval, by send time."""
+
+    src: int
+    dst: int
+    t0: int
+    t1: int
+    mult: int = 1              # delay multiplier (>= 1)
+    delay: Optional[int] = None  # absolute override; >= INF partitions
+
+    def __post_init__(self):
+        assert self.src != self.dst, "self-links never cross the wire"
+        assert 0 <= self.t0 < self.t1, "empty or negative window"
+        assert self.mult >= 1, "degradation cannot speed a link up"
+        assert self.delay is None or self.delay >= 1, (
+            "override must be >= 1 ms (0-delay links create same-instant "
+            "ties the exact-match contract excludes) or INF to partition"
+        )
+
+    def effective(self, base_delay: int) -> int:
+        if self.delay is not None:
+            return min(self.delay, INF)
+        return min(base_delay * self.mult, INF)
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    """One lane's fault schedule (host-side; see module docstring)."""
+
+    crashes: Mapping[int, int] = field(default_factory=dict)
+    windows: Tuple[LinkWindow, ...] = ()
+    drop_bp: int = 0
+    drop_seed: int = 0
+    horizon_ms: Optional[int] = None
+
+    def __post_init__(self):
+        object.__setattr__(self, "crashes", dict(self.crashes))
+        object.__setattr__(self, "windows", tuple(self.windows))
+        assert len(self.windows) <= MAX_WINDOWS, (
+            f"{len(self.windows)} windows > MAX_WINDOWS={MAX_WINDOWS}"
+        )
+        assert 0 <= self.drop_bp <= DROP_DENOM
+        for row, t in self.crashes.items():
+            assert row >= 0 and t >= 0, f"bad crash ({row}, {t})"
+        # windows of one (src, dst) pair must not overlap: the device
+        # selects the active window with a masked sum, which is only a
+        # selection when at most one window matches an instant
+        by_pair: Dict[Tuple[int, int], List[LinkWindow]] = {}
+        for w in self.windows:
+            by_pair.setdefault((w.src, w.dst), []).append(w)
+        for pair, ws in by_pair.items():
+            ws = sorted(ws, key=lambda w: w.t0)
+            for a, b in zip(ws, ws[1:]):
+                assert a.t1 <= b.t0, f"overlapping windows on {pair}"
+        lossy = self.drop_bp > 0 or any(
+            w.delay is not None and w.delay >= INF for w in self.windows
+        )
+        if lossy:
+            assert self.horizon_ms is not None, (
+                "lossy plans (drops or partition windows) need "
+                "horizon_ms: closed-loop clients have no "
+                "retransmission, so a lost message can stall the lane "
+                "forever (the oracle would loop and the device would "
+                "burn to max_steps)"
+            )
+
+    # -- capability flags ---------------------------------------------
+
+    @property
+    def flags(self) -> FaultFlags:
+        return FaultFlags(
+            crash=bool(self.crashes),
+            windows=bool(self.windows),
+            drops=self.drop_bp > 0,
+            horizon=self.horizon_ms is not None,
+        )
+
+    def is_noop(self) -> bool:
+        return self.flags == NO_FAULTS
+
+    # -- host-side model ----------------------------------------------
+
+    def crash_ms(self, row: int) -> int:
+        return self.crashes.get(row, INF)
+
+    def window_at(self, src: int, dst: int, send_ms: int
+                  ) -> Optional[LinkWindow]:
+        for w in self.windows:
+            if w.src == src and w.dst == dst and w.t0 <= send_ms < w.t1:
+                return w
+        return None
+
+    def wire(self, src: int, dst: int, send_ms: int, base_delay: int,
+             kcnt: int, drop_table: "np.ndarray | None" = None
+             ) -> Tuple[int, bool]:
+        """The oracle's wire model: (effective delay, lost?). Mirrors
+        the device's emission choke point exactly — window by send
+        time, then the threefry drop verdict by channel index."""
+        delay, lost = base_delay, False
+        w = self.window_at(src, dst, send_ms)
+        if w is not None:
+            delay = w.effective(base_delay)
+            if delay >= INF:
+                return delay, True
+        if drop_table is not None:
+            assert kcnt < drop_table.shape[2], (
+                "drop table too small; raise kmax"
+            )
+            lost = bool(drop_table[src, dst, kcnt])
+        return delay, lost
+
+    def drop_table(self, n: int, kmax: int = 1 << 14) -> np.ndarray:
+        """Precomputed ``[n, n, kmax]`` drop verdicts for the host
+        oracle — one batched threefry call instead of one per message.
+        ``table[src, dst, k]`` must equal the device's in-loop draw for
+        channel emission ``k`` (see ``drop_draw``)."""
+        import jax
+        import jax.numpy as jnp
+
+        key = jnp.asarray(self.drop_key())
+        num = self.drop_bp
+
+        def one(s, d, k):
+            return drop_draw(key, s, d, k) < num
+
+        grid = jnp.arange
+        table = jax.jit(
+            jax.vmap(
+                lambda s: jax.vmap(
+                    lambda d: jax.vmap(lambda k: one(s, d, k))(
+                        grid(kmax)
+                    )
+                )(grid(n))
+            )
+        )(grid(n))
+        return np.asarray(table)
+
+    def drop_key(self) -> np.ndarray:
+        import jax.random as jr
+
+        return np.asarray(
+            jr.fold_in(jr.PRNGKey(self.drop_seed), 0xFA17)
+        )
+
+    # -- serialization (CLI --faults spec) ----------------------------
+
+    @staticmethod
+    def from_json(obj: dict) -> "FaultPlan":
+        """``{"crash": {"1": 200}, "windows": [{"src": 0, "dst": 1,
+        "t0": 100, "t1": 400, "mult": 5}], "drop_bp": 50, "seed": 1,
+        "horizon": 5000}`` — window ``"delay": "inf"`` partitions."""
+        windows = []
+        for w in obj.get("windows", ()):
+            delay = w.get("delay")
+            if isinstance(delay, str):
+                assert delay.lower() == "inf", delay
+                delay = INF
+            windows.append(
+                LinkWindow(
+                    src=int(w["src"]), dst=int(w["dst"]),
+                    t0=int(w["t0"]), t1=int(w["t1"]),
+                    mult=int(w.get("mult", 1)), delay=delay,
+                )
+            )
+        return FaultPlan(
+            crashes={
+                int(k): int(v) for k, v in obj.get("crash", {}).items()
+            },
+            windows=tuple(windows),
+            drop_bp=int(obj.get("drop_bp", 0)),
+            drop_seed=int(obj.get("seed", 0)),
+            horizon_ms=obj.get("horizon"),
+        )
+
+    def meta(self, **extra) -> dict:
+        """Compact per-lane metadata surfaced through LaneResults and
+        the sweep results table."""
+        out: dict = {}
+        if self.crashes:
+            out["crash"] = {str(k): int(v) for k, v in
+                            sorted(self.crashes.items())}
+        if self.windows:
+            out["windows"] = [
+                {
+                    "src": w.src, "dst": w.dst, "t0": w.t0, "t1": w.t1,
+                    "mult": w.mult,
+                    **(
+                        {"delay": "inf" if w.delay >= INF else w.delay}
+                        if w.delay is not None else {}
+                    ),
+                }
+                for w in self.windows
+            ]
+        if self.drop_bp:
+            out["drop_bp"] = self.drop_bp
+            out["drop_seed"] = self.drop_seed
+        if self.horizon_ms is not None:
+            out["horizon_ms"] = int(self.horizon_ms)
+        out.update(extra)
+        return out
+
+
+def parse_fault_specs(text: str) -> List[Optional[FaultPlan]]:
+    """Parse a CLI ``--faults`` spec: a JSON object (one plan), a JSON
+    list of objects (one plan per entry; ``{}``/``null`` = fault-free),
+    or ``@path`` to a file holding either. Every sweep grid point is
+    replicated once per returned plan, so one spec mixes fault-free and
+    faulty lanes in a single compiled sweep."""
+    if text.startswith("@"):
+        with open(text[1:]) as fh:
+            text = fh.read()
+    obj = json.loads(text)
+    if isinstance(obj, dict):
+        obj = [obj]
+    out: List[Optional[FaultPlan]] = []
+    for entry in obj:
+        if not entry:
+            out.append(None)
+            continue
+        plan = FaultPlan.from_json(entry)
+        out.append(None if plan.is_noop() else plan)
+    return out
+
+
+# ----------------------------------------------------------------------
+# device-side primitives (shared by engine/core.py and drop_table)
+# ----------------------------------------------------------------------
+
+
+def drop_draw(key, src, dst, kcnt):
+    """The drop verdict's threefry draw in [0, DROP_DENOM) — one pure
+    function of (plan key, src, dst, channel emission index), so any
+    two executions of the same history agree."""
+    import jax.random as jr
+
+    k = jr.fold_in(jr.fold_in(jr.fold_in(key, src), dst), kcnt)
+    return jr.randint(k, (), 0, DROP_DENOM)
+
+
+# ----------------------------------------------------------------------
+# host-side lane construction helpers (used by engine/spec.py, sim/)
+# ----------------------------------------------------------------------
+
+
+def batch_fault_flags(plans_or_specs) -> FaultFlags:
+    """Union of fault capabilities across a batch (compile-once for
+    mixed fault-free/faulty sweeps). Accepts FaultPlans, LaneSpecs, or
+    None entries."""
+    flags = NO_FAULTS
+    for item in plans_or_specs:
+        if item is None:
+            continue
+        f = getattr(item, "fault_flags", None)
+        if f is None:
+            f = item.flags
+        flags = flags | f
+    return flags
+
+
+def min_live(protocol, config) -> int:
+    """Smallest membership the protocol can make progress with: the
+    protocol's own bound when it declares one, else the generic n - f."""
+    fn = getattr(protocol, "min_live", None)
+    if fn is None:
+        return config.n - config.f
+    return int(fn(config))
+
+
+def unavailable(plan: FaultPlan, protocol, config) -> bool:
+    """True when the plan's crashes exceed what the (recovery-free)
+    protocol tolerates: more than f crashes, or fewer survivors than
+    its largest quorum/threshold. A leader crash is NOT unavailability
+    — it halts every client (nothing commits, vacuously clean)."""
+    k = len(plan.crashes)
+    if k == 0:
+        return False
+    if k > config.f:
+        return True
+    doomed = set(plan.crashes)
+    if config.leader is not None and (config.leader - 1) in doomed:
+        return False
+    return config.n - k < min_live(protocol, config)
+
+
+def reorder_doomed_last(sorted_idx: np.ndarray, doomed) -> np.ndarray:
+    """Stable-partition each process's discovery order so processes
+    that are going to crash rank last — quorum selection (first k of
+    each row) then never includes them. The host oracle applies the
+    same reorder to its discovery lists, keeping faulty schedules
+    bit-identical."""
+    doomed = set(doomed)
+    out = sorted_idx.copy()
+    for p in range(out.shape[0]):
+        row = list(sorted_idx[p])
+        out[p] = [q for q in row if q not in doomed] + [
+            q for q in row if q in doomed
+        ]
+    return out
+
+
+def halted_client_mask(plan: FaultPlan, config,
+                       attach_rows: np.ndarray) -> np.ndarray:
+    """Clients halted by the plan: attached to a doomed process, or any
+    client at all under a doomed leader (no election — nothing commits
+    after the leader stops)."""
+    doomed = set(plan.crashes)
+    halted = np.asarray(
+        [int(a) in doomed for a in attach_rows], dtype=bool
+    )
+    if config.leader is not None and (config.leader - 1) in doomed:
+        halted[:] = True
+    return halted
+
+
+def min_link_delays(plan: FaultPlan, delay_pp: np.ndarray,
+                    total: int) -> np.ndarray:
+    """Per-pair lower bound of the wire delay over the whole run —
+    what the conservative-lookahead matrix must be computed from, since
+    a window *override* may undercut the base delay (multipliers only
+    slow links down). Returns a ``[total, total]`` copy."""
+    out = delay_pp[:total, :total].astype(np.int64).copy()
+    for w in plan.windows:
+        if w.src >= total or w.dst >= total:
+            continue
+        eff = w.effective(int(out[w.src, w.dst]))
+        if eff < out[w.src, w.dst]:
+            out[w.src, w.dst] = eff
+    return out
+
+
+def fault_ctx(plan: Optional[FaultPlan], dims) -> Dict[str, np.ndarray]:
+    """The plan's fixed-shape device context arrays. Present in every
+    lane (inert defaults when ``plan`` is None) so batches can mix
+    faulty and fault-free lanes under one compiled runner."""
+    N = dims.N
+    crash_t = np.full((N,), INF, np.int32)
+    win_src = np.full((MAX_WINDOWS,), -1, np.int32)
+    win_dst = np.full((MAX_WINDOWS,), -1, np.int32)
+    win_t0 = np.zeros((MAX_WINDOWS,), np.int32)
+    win_t1 = np.zeros((MAX_WINDOWS,), np.int32)
+    win_mul = np.ones((MAX_WINDOWS,), np.int32)
+    win_ovr = np.full((MAX_WINDOWS,), -1, np.int32)
+    drop_bp = 0
+    horizon = INF
+    if plan is not None:
+        for row, t in plan.crashes.items():
+            assert row < N, f"crash row {row} out of range"
+            crash_t[row] = min(t, INF)
+        for i, w in enumerate(plan.windows):
+            win_src[i] = w.src
+            win_dst[i] = w.dst
+            win_t0[i] = w.t0
+            win_t1[i] = min(w.t1, INF)
+            win_mul[i] = w.mult
+            win_ovr[i] = -1 if w.delay is None else min(w.delay, INF)
+        drop_bp = plan.drop_bp
+        if plan.horizon_ms is not None:
+            horizon = min(plan.horizon_ms, INF)
+    drop_key = (
+        plan.drop_key() if plan is not None and plan.drop_bp
+        else FaultPlan().drop_key()
+    )
+    return {
+        "fault_crash_t": crash_t,
+        "fault_win_src": win_src,
+        "fault_win_dst": win_dst,
+        "fault_win_t0": win_t0,
+        "fault_win_t1": win_t1,
+        "fault_win_mul": win_mul,
+        "fault_win_ovr": win_ovr,
+        "fault_drop_num": np.int32(drop_bp),
+        "fault_drop_key": drop_key,
+        "fault_horizon": np.int32(horizon),
+        # set by make_lane after the availability check
+        "fault_unavail": np.int32(0),
+    }

@@ -139,6 +139,15 @@ class CaesarDev(DevIdentity):
             config.executor_executed_notification_interval_ms,
         ]
 
+    @staticmethod
+    def min_live(config) -> int:
+        """Caesar's quorums are dynamic (the fastest repliers), but a
+        proposal still needs ⌊3n/4⌋+1 replies and a retry ⌊n/2⌋+1 —
+        fewer survivors than that cannot commit (engine/faults.py
+        flags such crash plans ERR_UNAVAIL)."""
+        fq_size, wq_size = config.caesar_quorum_sizes()
+        return max(fq_size, wq_size)
+
     def lane_ctx(self, config, dims: EngineDims, sorted_idx: np.ndarray):
         fq_size, wq_size = config.caesar_quorum_sizes()
         return {

@@ -112,6 +112,14 @@ class _DepDev(DevIdentity):
         gc = config.gc_interval_ms
         return [gc if gc is not None else INF]
 
+    def min_live(self, config) -> int:
+        """Every collect waits on the full fast quorum and the slow
+        path on the write quorum; recovery is not modeled, so fewer
+        survivors than either cannot commit (engine/faults.py flags
+        such crash plans ERR_UNAVAIL)."""
+        fq_size, wq_size = self._quorum_sizes(config)
+        return max(fq_size, wq_size)
+
     def _quorum_sizes(self, config):
         raise NotImplementedError
 

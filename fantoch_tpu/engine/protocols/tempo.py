@@ -26,8 +26,11 @@ Array encoding:
 - commits may complete out of source order (slow vs fast path), so the
   GC committed clock is an interval set per source, not a counter.
 
-Like the oracle, recovery and ``skip_fast_ack`` are not modeled; partial
-replication (MBump/MShardCommit) is host-oracle-only for now.
+Like the oracle, recovery is not modeled; ``skip_fast_ack`` is (the
+``skip_capable`` trace-time gate below). Partial replication
+(MForwardSubmit/MShardCommit aggregation) has its own device twin —
+``tempo_partial.TempoPartialDev`` — which the engine-partial
+differential tests hold to exact host-oracle agreement.
 """
 
 from __future__ import annotations
@@ -119,6 +122,16 @@ class TempoDev(DevIdentity):
             ms(config.tempo_clock_bump_interval_ms),
             ms(config.tempo_detached_send_interval_ms),
         ]
+
+    @staticmethod
+    def min_live(config) -> int:
+        """Smallest membership that still commits and stabilizes
+        (recovery-free): every collect waits on the full fast quorum,
+        consensus on the write quorum, and the executor's stability
+        rank needs `threshold` advancing voters (engine/faults.py uses
+        this to flag crash plans as ERR_UNAVAIL)."""
+        fast, write, threshold = config.tempo_quorum_sizes()
+        return max(fast, write, threshold)
 
     def lane_ctx(self, config, dims: EngineDims, sorted_idx: np.ndarray):
         N = dims.N

@@ -32,6 +32,10 @@ class LaneResults:
     # (EngineDims.D) stalled deliveries — results are correct under
     # backpressure but latencies deviate from the unbounded reference
     requeues: int = 0
+    # fault-plan metadata (engine/faults.py FaultPlan.meta; None for
+    # fault-free lanes) and messages lost to windows/drops
+    faults: "dict | None" = None
+    dropped: int = 0
 
     @property
     def err_cause(self) -> str:
@@ -77,6 +81,12 @@ def collect_results(
                 completed=int(st["clients"]["completed"][lane].sum()),
                 pool_peak=int(st["pool_peak"][lane]),
                 requeues=int(st["requeues"][lane]),
+                faults=spec.fault_meta,
+                dropped=(
+                    int(st["fault_dropped"][lane])
+                    if "fault_dropped" in st
+                    else 0
+                ),
             )
         )
     return out

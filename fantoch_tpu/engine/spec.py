@@ -20,6 +20,16 @@ from ..client.key_gen import zipf_weights
 from ..core.config import Config
 from ..core.planet import Planet
 from .dims import INF, EngineDims
+from .faults import (
+    NO_FAULTS,
+    FaultFlags,
+    FaultPlan,
+    fault_ctx,
+    halted_client_mask,
+    min_link_delays,
+    reorder_doomed_last,
+    unavailable,
+)
 
 
 @dataclass
@@ -30,6 +40,10 @@ class LaneSpec:
     config: Config
     region_rows: List[str]  # row index → client region name
     process_regions: List[str] = field(default_factory=list)
+    # fault-plan capabilities + compact metadata (engine/faults.py);
+    # NO_FAULTS / None for fault-free lanes
+    fault_flags: FaultFlags = NO_FAULTS
+    fault_meta: "dict | None" = None
 
 
 def _sorted_indices(planet: Planet, process_regions: Sequence[str]) -> np.ndarray:
@@ -60,6 +74,7 @@ def make_lane(
     extra_time_ms: int = 1000,
     seed: int = 0,
     reorder: bool = False,
+    faults: "FaultPlan | None" = None,
 ) -> LaneSpec:
     """``zipf=(coefficient, total_keys)`` switches the workload from the
     ConflictPool generator to Zipf sampling over ``total_keys`` keys
@@ -78,13 +93,34 @@ def make_lane(
     layout (sim/runner.py:81-103) — with per-shard client attachment
     and precomputed per-command shard/key tables (the device reads a
     command's keys from ctx by (client, seq) instead of carrying them
-    in payloads)."""
+    in payloads).
+
+    ``faults`` attaches a per-lane :class:`FaultPlan` (engine/faults.py):
+    crash-stop processes, link-degradation/partition windows, and
+    probabilistic drops. Lanes with and without plans can share one
+    batch; the runner must be built with the batch's fault-flag union
+    (``run_lanes``/``run_sweep`` derive it automatically)."""
     n = config.n
     S = config.shard_count
     assert len(process_regions) == n
     assert S * n <= dims.N
     N, C = dims.N, dims.C
     total = S * n  # live process rows; row = shard * n + region index
+
+    if faults is not None and faults.is_noop():
+        faults = None
+    if faults is not None:
+        assert S == 1, "fault plans are single-shard for now"
+        assert all(r < n for r in faults.crashes), (
+            f"crash rows {sorted(faults.crashes)} out of range for n={n}"
+        )
+        assert all(
+            w.src < n and w.dst < n for w in faults.windows
+        ), "window endpoints out of range"
+    # crashes beyond what the protocol tolerates: the lane terminates
+    # immediately with ERR_UNAVAIL (quorum unreachable), so quorum
+    # selection below stays at its fault-free default
+    unavail = faults is not None and unavailable(faults, protocol, config)
 
     def row_region(row: int) -> str:
         return process_regions[row % n]
@@ -108,7 +144,15 @@ def make_lane(
     # the pool's prio/pop mechanism, so they never gate p's progress.
     # Padded rows stay at INF.
     lookahead = np.full((N, N), INF, np.int64)
-    sp = delay_pp[:total, :total].astype(np.int64)
+    if faults is not None and faults.windows:
+        # a window *override* may undercut the base delay, so the
+        # lookahead lower bound is computed over each pair's minimum
+        # effective delay across the whole run (multipliers only slow
+        # links down; partitions only remove messages — both leave the
+        # bound conservative)
+        sp = min_link_delays(faults, delay_pp, total)
+    else:
+        sp = delay_pp[:total, :total].astype(np.int64)
     for k in range(total):
         sp = np.minimum(sp, sp[:, k, None] + sp[None, k, :])
     lookahead[:total, :total] = sp
@@ -127,6 +171,12 @@ def make_lane(
         np.fill_diagonal(lookahead[:total, :total], INF)
 
     sorted_idx = _sorted_indices(planet, process_regions)
+    if faults is not None and faults.crashes and not unavail:
+        # recovery-free crash model: processes that are going to crash
+        # are suspected from the start — rank them last in every
+        # discovery order so quorum selection never includes them (the
+        # oracle reorders its discovery lists identically)
+        sorted_idx = reorder_doomed_last(sorted_idx, faults.crashes)
 
     # clients: clients_per_region per region, attached to the closest
     # process (closest_process_per_shard; single shard in the simulator)
@@ -156,6 +206,17 @@ def make_lane(
                 )
             cmd_budget[c] = commands_per_client
             c += 1
+
+    halted = 0
+    if faults is not None and faults.crashes:
+        # clients attached to a doomed process (or any client under a
+        # doomed leader) are halted: their budget is zeroed so they
+        # never issue and the termination predicate excuses them —
+        # replica death takes its clients with it (no reconnection
+        # protocol, like the reference)
+        mask = halted_client_mask(faults, config, client_attach[:c])
+        cmd_budget[:c][mask] = 0
+        halted = int(mask.sum())
 
     intervals = np.asarray(
         protocol.periodic_intervals(config, dims), np.int32
@@ -202,6 +263,8 @@ def make_lane(
         "periodic_intervals": intervals,
         "extra_time": np.int32(extra_time_ms),
     }
+    ctx.update(fault_ctx(faults, dims))
+    ctx["fault_unavail"] = np.int32(1 if unavail else 0)
     if S > 1 or getattr(protocol, "KPC", 1) > 1:
         assert getattr(protocol, "S", 1) == S, (
             "protocol shards must match config.shard_count"
@@ -218,6 +281,12 @@ def make_lane(
         config=config,
         region_rows=region_rows,
         process_regions=list(process_regions),
+        fault_flags=faults.flags if faults is not None else NO_FAULTS,
+        fault_meta=(
+            faults.meta(halted_clients=halted, unavail=unavail)
+            if faults is not None
+            else None
+        ),
     )
 
 

@@ -36,6 +36,7 @@ from ..engine.core import (
     key_table_fn,
 )
 from ..engine.driver import batch_reorder_flag
+from ..engine.faults import FaultPlan, batch_fault_flags
 from ..engine.spec import stack_lanes
 
 
@@ -53,13 +54,17 @@ def make_sweep_specs(
     extra_time_ms: int = 500,
     zipf=None,
     pool_size: int = 1,
+    faults: "Sequence[FaultPlan | None] | None" = None,
 ) -> List[LaneSpec]:
-    """The sweep grid: one lane per (region set, f, conflict) point."""
+    """The sweep grid: one lane per (region set, f, conflict) point —
+    replicated once per entry of ``faults`` (None = fault-free), so a
+    single compiled sweep mixes fault-free and faulty lanes."""
     base = config_base or Config(n=len(region_sets[0]), f=1,
                                  gc_interval_ms=100)
+    plans: Sequence["FaultPlan | None"] = faults or [None]
     specs = []
-    for i, (regions, f, conflict) in enumerate(
-        itertools.product(region_sets, fs, conflicts)
+    for i, (regions, f, conflict, plan) in enumerate(
+        itertools.product(region_sets, fs, conflicts, plans)
     ):
         config = base.with_(n=len(regions), f=f)
         specs.append(
@@ -76,7 +81,8 @@ def make_sweep_specs(
                 client_regions=list(regions),
                 dims=dims,
                 extra_time_ms=extra_time_ms,
-                seed=i,
+                seed=i // len(plans),  # same workload across a point's plans
+                faults=plan,
             )
         )
     return specs
@@ -94,14 +100,16 @@ def _cached_key_table(C: int, T: int):
 
 @functools.lru_cache(maxsize=None)
 def _cached_runner(protocol, dims: EngineDims, max_steps: int,
-                   reorder: bool):
+                   reorder: bool, faults):
     """One compiled segmented runner per (protocol value, dims,
-    max_steps): ``build_segment_runner`` returns fresh ``jax.jit``
-    closures, so without the cache every ``run_sweep`` call would
-    retrace and recompile. Device protocols have value identity
+    max_steps, fault flags): ``build_segment_runner`` returns fresh
+    ``jax.jit`` closures, so without the cache every ``run_sweep`` call
+    would retrace and recompile. Device protocols have value identity
     (protocols/identity.py), so fresh instances with equal shape bounds
-    share one compiled runner."""
-    return build_segment_runner(protocol, dims, max_steps, reorder)
+    share one compiled runner; a batch mixing fault-free and faulty
+    lanes shares one too (its flags are the union)."""
+    return build_segment_runner(protocol, dims, max_steps, reorder,
+                                faults)
 
 
 def run_sweep(
@@ -163,7 +171,8 @@ def run_sweep(
         lambda a: jax.device_put(a, sharding), tree
     )
     runner, alive = _cached_runner(
-        protocol, dims, max_steps, batch_reorder_flag(padded)
+        protocol, dims, max_steps, batch_reorder_flag(padded),
+        batch_fault_flags(padded),
     )
     state = put(state)
     ctx = put(ctx)
@@ -187,6 +196,7 @@ def run_sweep(
         "clients": {"completed": state["clients"]["completed"]},
         "pool_peak": state["pool_peak"],
         "requeues": state["requeues"],
+        "fault_dropped": state["fault_dropped"],
         "ps": {
             k: v for k, v in state["ps"].items() if k.startswith("m_")
         },

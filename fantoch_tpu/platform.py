@@ -17,10 +17,19 @@ import sys
 
 def force_cpu(devices: int = 8) -> None:
     """Force the CPU backend at jax-config level (and export the env
-    var for subprocesses). Cheap when jax is not yet imported."""
+    vars for this process and subprocesses). Cheap when jax is not yet
+    imported."""
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # the XLA_FLAGS fallback carries the virtual device mesh on jax
+    # versions without the jax_num_cpu_devices option — and it is the
+    # only mechanism that works for a fresh (not-yet-imported) jax
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={devices}"
+        ).strip()
     if "jax" not in sys.modules:
-        # env var wins for everything imported from here on; skipping
+        # env vars win for everything imported from here on; skipping
         # the import keeps host-only paths free of jax startup cost
         return
     import jax
@@ -29,7 +38,7 @@ def force_cpu(devices: int = 8) -> None:
     try:
         jax.config.update("jax_num_cpu_devices", devices)
     except Exception:
-        pass  # backend already initialized; keep its device count
+        pass  # option absent or backend already initialized
 
 
 def enable_compile_cache(cache_dir: str | None = None) -> str:

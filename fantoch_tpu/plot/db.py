@@ -82,6 +82,8 @@ def load_results(
                         completed=r["completed"],
                         pool_peak=r.get("pool_peak", 0),
                         requeues=r.get("requeues", 0),
+                        faults=r.get("faults"),
+                        dropped=r.get("dropped", 0),
                     ),
                 )
             )

@@ -29,6 +29,7 @@ from ..core.metrics import Histogram
 from ..core.planet import Planet
 from ..core.trace import trace, tracer
 from ..core.util import closest_process_per_shard, sort_processes_by_distance
+from ..engine.faults import FaultPlan
 from ..executor.base import Executor
 from ..protocol.base import Protocol, ToForward, ToSend
 from .schedule import KIND_MESSAGE, Schedule
@@ -36,6 +37,9 @@ from .simulation import Simulation
 
 # schedule action kinds
 _log = tracer("sim.runner")
+
+# sentinel crash time for processes that never crash
+_NO_CRASH = 1 << 60
 
 _SUBMIT = 0
 _SEND = 1
@@ -51,6 +55,22 @@ _EXECUTOR_CLEANUP = 7    # periodic executor cleanup tick (multi-shard)
 _CLIENT_SRC_OFFSET = 1 << 20
 
 
+def _action_process(kind: int, action) -> Optional[int]:
+    """The process a scheduled action targets (None for client-bound
+    actions) — the crash-stop skip's dispatch map."""
+    if kind == _SEND:
+        return action[3]
+    if kind in (
+        _SUBMIT,
+        _PERIODIC,
+        _EXECUTED_NOTIFICATION,
+        _EXECUTOR_INFO,
+        _EXECUTOR_CLEANUP,
+    ):
+        return action[1]
+    return None
+
+
 class Runner:
     def __init__(
         self,
@@ -62,9 +82,33 @@ class Runner:
         process_regions: List[str],
         client_regions: List[str],
         seed: int = 0,
+        fault_plan: Optional[FaultPlan] = None,
     ):
         assert len(process_regions) == config.n
         assert config.gc_interval_ms is not None
+
+        # fault-plan mirror (engine/faults.py): the oracle applies the
+        # exact crash/window/drop model the device engine applies, so
+        # the differential tests extend to faulty schedules. Process
+        # rows in the plan are 0-based; oracle pids are 1-based.
+        if fault_plan is not None and fault_plan.is_noop():
+            fault_plan = None
+        self._fault = fault_plan
+        self._crash_ms: Dict[int, int] = {}
+        self._drop_table = None
+        self._horizon: Optional[int] = None
+        doomed_pids: set = set()
+        if fault_plan is not None:
+            assert config.shard_count == 1, (
+                "fault plans are single-shard for now"
+            )
+            self._crash_ms = {
+                row + 1: ms for row, ms in fault_plan.crashes.items()
+            }
+            doomed_pids = set(self._crash_ms)
+            if fault_plan.drop_bp:
+                self._drop_table = fault_plan.drop_table(config.n)
+            self._horizon = fault_plan.horizon_ms
 
         self.planet = planet
         self.simulation = Simulation()
@@ -135,28 +179,48 @@ class Runner:
                 elif sid not in seen_shards:
                     seen_shards.add(sid)
                     filtered.append((pid, sid))
+            if doomed_pids:
+                # recovery-free crash model: doomed processes rank last
+                # in every discovery order so quorum selection never
+                # includes them — identical to the device engine's
+                # sorted-index reorder (engine/faults.py)
+                filtered = [
+                    x for x in filtered if x[0] not in doomed_pids
+                ] + [x for x in filtered if x[0] in doomed_pids]
             connect_ok, closest = process.discover(filtered)
             assert connect_ok
             self._closest[process_id] = closest
             executor = executor_cls(process_id, shard, config)
             self.simulation.register_process(process, executor)
 
+        leader_doomed = (
+            config.leader is not None and config.leader in doomed_pids
+        )
         client_id = 0
+        registered = 0
         for region in client_regions:
             for _ in range(clients_per_process):
                 client_id += 1
-                client = Client(
-                    client_id,
-                    workload,
-                    rng=random.Random(self.rng.randrange(2**63)),
-                )
+                # consume the seed draw even for halted clients so the
+                # surviving clients' streams match a fault-free run
+                client_rng = random.Random(self.rng.randrange(2**63))
                 closest = closest_process_per_shard(
                     region, planet, to_discover
                 )
+                # clients attached to a doomed process — or any client
+                # under a doomed leader — are halted: they never issue
+                # (replica death takes its clients with it; no
+                # reconnection protocol, matching the device engine's
+                # zeroed budgets). Ids keep counting so the surviving
+                # clients' tie-break order matches the device's.
+                if leader_doomed or closest.get(0) in doomed_pids:
+                    continue
+                client = Client(client_id, workload, rng=client_rng)
                 client.connect(closest)
                 self.simulation.register_client(client)
                 self.client_to_region[client_id] = region
-        self.client_count = client_id
+                registered += 1
+        self.client_count = registered
 
         for process_id, event, delay in periodic:
             self._schedule_periodic(process_id, event, delay)
@@ -195,13 +259,34 @@ class Runner:
         clients_done = 0
         final_time: Optional[int] = None
         time = self.simulation.time
+        if self.client_count == 0:
+            # every client halted by the fault plan (e.g. a doomed
+            # leader): run periodics for the grace window, like the
+            # device lane's immediately-done + extra_time coda
+            final_time = extra_sim_time_ms or 0
         while True:
+            if self._horizon is not None:
+                # fault-plan horizon: never handle an event at or past
+                # it (the device masks the same events out of
+                # qualification)
+                nt = self.schedule.peek_millis()
+                if nt is None or nt >= self._horizon:
+                    return
             action = self.schedule.next_action(time)
             assert action is not None, (
                 "there should be a new action since stability is always"
                 " running"
             )
             kind = action[0]
+            if self._crash_ms:
+                target = _action_process(kind, action)
+                if target is not None and time.millis() >= (
+                    self._crash_ms.get(target, _NO_CRASH)
+                ):
+                    # crash-stop: the process handles nothing at or
+                    # past its crash time; its periodic events are
+                    # also not rescheduled (its timers die with it)
+                    continue
             if kind == _PERIODIC:
                 _, process_id, event, delay = action
                 self._handle_periodic(process_id, event, delay)
@@ -452,6 +537,25 @@ class Runner:
         chan = (src_key, self._region_key(to_region))
         chan_seq = self._chan_seq.get(chan, 0) + 1
         self._chan_seq[chan] = chan_seq
+        if (
+            self._fault is not None
+            and from_region[0] == "process"
+            and to_region[0] == "process"
+            and from_region[1] != to_region[1]
+        ):
+            # fault wire model, after the channel counter ticked: lost
+            # messages keep their emission index, exactly like the
+            # device's emission choke point (engine/faults.py)
+            distance, lost = self._fault.wire(
+                from_region[1] - 1,
+                to_region[1] - 1,
+                self.simulation.time.millis(),
+                distance,
+                chan_seq,
+                self._drop_table,
+            )
+            if lost:
+                return
         self.schedule.schedule(
             self.simulation.time,
             distance,

@@ -57,5 +57,14 @@ class Schedule(Generic[A]):
         time.set_millis(entry[0])
         return entry[-1]
 
+    def peek_millis(self) -> Optional[int]:
+        """Arrival time of the next action without popping it — the
+        fault-plan horizon check stops the loop *before* handling any
+        event at or past the horizon, matching the device engine's
+        qualification mask exactly."""
+        if not self._heap:
+            return None
+        return self._heap[0][0]
+
     def __len__(self) -> int:
         return len(self._heap)

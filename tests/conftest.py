@@ -21,7 +21,13 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax has no such option; the XLA_FLAGS export above already
+    # provides the 8-device host platform as long as jax was imported
+    # fresh in this process
+    pass
 
 # the persistent XLA compile cache turns every re-run of the engine
 # tests from minutes of XLA work into a disk read (same cache the

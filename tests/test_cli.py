@@ -70,6 +70,40 @@ def test_cli_sweep_and_plot(capsys, tmp_path):
     assert (tmp_path / "out.png").stat().st_size > 0
 
 
+def test_cli_sweep_faults(capsys, tmp_path):
+    """--faults replicates each sweep point per plan (fault-free +
+    crash + partition in ONE compiled sweep) and surfaces per-lane
+    fault metadata in the summary and the saved results."""
+    results = str(tmp_path / "faults.jsonl")
+    out = _run(
+        capsys,
+        "--platform", "cpu",
+        "sweep",
+        "--protocol", "basic",
+        "--n", "3",
+        "--fs", "1",
+        "--conflicts", "100",
+        "--subsets", "1",
+        "--commands", "5",
+        "--faults",
+        '[{}, {"crash": {"2": 100}}, '
+        '{"windows": [{"src": 0, "dst": 1, "t0": 0, "t1": 300, '
+        '"delay": "inf"}], "horizon": 3000}]',
+        "--out", results,
+    )
+    data = json.loads(out)
+    assert data["points"] == 3
+    assert data["fault_lanes"] == 2
+    assert data["unavailable_lanes"] == 0
+    assert data["errors"] == 0
+
+    rows = [json.loads(line) for line in open(results)]
+    metas = [r["attrs"].get("faults") for r in rows]
+    assert sum(m is None for m in metas) == 1
+    assert any(m and "crash" in m for m in metas)
+    assert any(m and "windows" in m for m in metas)
+
+
 def test_cli_bote(capsys):
     out = _run(
         capsys,

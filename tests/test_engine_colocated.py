@@ -1,0 +1,85 @@
+"""Lookahead-engine regression on a near-zero (co-located) delay matrix.
+
+With inter-process delays below 1 ms the conservative-lookahead bound
+degenerates: distinct processes can exchange same-instant messages, so
+``make_lane`` falls back to serialized global-time stepping (lookahead
+0 + the global-minimum escape hatch; engine/spec.py). This pins the
+two properties that fallback must keep:
+
+* correctness — the lane completes every command cleanly (tie order is
+  engine-defined on such schedules, so protocol invariants, not oracle
+  equality, are the bar);
+* boundedness — the lane finishes within a step budget proportional to
+  the event count (one delivery per destination per step), instead of
+  stalling or spinning. The ~N-fold concurrency loss vs WAN-delay
+  lanes is documented in docs/PERF.md.
+"""
+
+import numpy as np
+
+from fantoch_tpu.core import Config, Planet
+from fantoch_tpu.engine import EngineDims, make_lane, run_lanes
+from fantoch_tpu.engine.dims import INF
+from fantoch_tpu.engine.protocols import BasicDev
+
+REGIONS = ["colo-a", "colo-b", "colo-c"]
+COMMANDS = 10
+CPR = 1
+
+
+def _colocated_planet():
+    return Planet.from_latencies(
+        {r: {q: 0 for q in REGIONS} for r in REGIONS}
+    )
+
+
+def test_colocated_lane_completes_within_step_budget():
+    n = len(REGIONS)
+    planet = _colocated_planet()
+    config = Config(n=n, f=1, gc_interval_ms=100)
+    clients = n * CPR
+    total = COMMANDS * clients
+    dims = EngineDims.for_protocol(
+        BasicDev,
+        n=n,
+        clients=clients,
+        payload=BasicDev.payload_width(n),
+        # the degenerate 0-RTT closed loop queues every remote delivery
+        # at one instant — for_protocol's total_commands bound covers it
+        total_commands=total,
+        dot_slots=total + 1,
+        regions=n,
+    )
+    spec = make_lane(
+        BasicDev,
+        planet,
+        config,
+        conflict_rate=100,
+        pool_size=1,
+        commands_per_client=COMMANDS,
+        clients_per_region=CPR,
+        process_regions=REGIONS,
+        client_regions=REGIONS,
+        dims=dims,
+        extra_time_ms=500,
+    )
+    # the fallback actually engaged: off-diagonal lookahead is 0
+    la = spec.ctx["lookahead"][:n, :n]
+    assert la[~np.eye(n, dtype=bool)].max() == 0
+    assert (np.diag(la) >= INF).all()
+
+    res = run_lanes(BasicDev, dims, [spec])[0]
+    assert res.err == 0, res.err_cause
+    assert res.completed == total
+    for r in REGIONS:
+        assert res.issued(r) == CPR * COMMANDS
+        # co-located everything: the whole run happens at t=0
+        assert res.latency_mean(r) == 0.0
+
+    # step budget: serialized stepping handles >= 1 event per step with
+    # at most one delivery per destination; every command costs
+    # ~2(n-1)+2 messages plus periodic ticks through the extra-time
+    # coda. 20x headroom over that event count — regression fails loud
+    # if the fallback ever starts spinning without consuming events.
+    events = total * (2 * (n - 1) + 2) + 3 * n * 500 // 100
+    assert res.steps <= 20 * events, (res.steps, events)

@@ -1,0 +1,464 @@
+"""Fault-injection & recovery harness tests (engine/faults.py).
+
+Three layers of coverage:
+
+1. **Differential**: with identical fault plans, the device engine and
+   the host oracle produce bit-identical latency/commit outcomes on
+   tie-free faulty schedules — crash-stop plans and link-degradation
+   windows for Tempo, FPaxos and Atlas (graph family), plus
+   deterministic message drops (threefry verdicts shared by both
+   sides) on Basic.
+2. **Crash-fault liveness**: lanes with tolerable crash plans terminate
+   cleanly (err == 0) with every surviving client's budget executed;
+   plans the protocol cannot tolerate terminate immediately with
+   ERR_UNAVAIL — no lane hangs, truncates, or reports ERR_STUCK.
+3. **Mixed sweeps**: fault-free, crash and partition lanes share one
+   compiled sweep with per-lane fault metadata in the results.
+"""
+
+import pytest
+
+from fantoch_tpu.client import ConflictPool, Workload
+from fantoch_tpu.core import Config, Planet
+from fantoch_tpu.engine import (
+    EngineDims,
+    FaultPlan,
+    LinkWindow,
+    make_lane,
+    parse_fault_specs,
+    run_lanes,
+)
+from fantoch_tpu.engine.dims import ERR_STUCK, ERR_TRUNCATED, ERR_UNAVAIL, INF
+from fantoch_tpu.engine.faults import unavailable
+from fantoch_tpu.engine.protocols import (
+    AtlasDev,
+    BasicDev,
+    EPaxosDev,
+    FPaxosDev,
+    TempoDev,
+    dev_config_kwargs,
+    dev_protocol,
+)
+from fantoch_tpu.protocol import Atlas, Basic, FPaxos, Tempo
+from fantoch_tpu.protocol.base import ProtocolMetricsKind
+from fantoch_tpu.sim import Runner
+
+COMMANDS = 15
+CPR = 1
+
+ORACLES = {
+    "tempo": Tempo,
+    "atlas": Atlas,
+    "fpaxos": FPaxos,
+    "basic": Basic,
+}
+
+
+def _config(name, n, f):
+    return Config(**dev_config_kwargs(name, n, f))
+
+
+def _dev(name, clients):
+    if name == "basic":
+        return BasicDev
+    if name == "fpaxos":
+        return FPaxosDev
+    return dev_protocol(name, clients)
+
+
+def run_oracle(name, config, regions, plan, conflict=100,
+               commands=COMMANDS, cpr=CPR, extra=1000):
+    planet = Planet.new()
+    workload = Workload(
+        shard_count=1,
+        key_gen=ConflictPool(conflict_rate=conflict, pool_size=1),
+        keys_per_command=1,
+        commands_per_client=commands,
+        payload_size=0,
+    )
+    runner = Runner(
+        ORACLES[name], planet, config, workload, cpr, regions,
+        list(regions), fault_plan=plan,
+    )
+    metrics, _, latencies = runner.run(extra_sim_time_ms=extra)
+    fast = slow = stable = 0
+    for pm, _em in metrics.values():
+        fast += pm.get_aggregated(ProtocolMetricsKind.FAST_PATH) or 0
+        slow += pm.get_aggregated(ProtocolMetricsKind.SLOW_PATH) or 0
+        stable += pm.get_aggregated(ProtocolMetricsKind.STABLE) or 0
+    return latencies, fast, slow, stable
+
+
+def run_engine(name, config, regions, plan, conflict=100,
+               commands=COMMANDS, cpr=CPR):
+    planet = Planet.new()
+    clients = cpr * len(regions)
+    dev = _dev(name, clients)
+    total = commands * clients
+    dims = EngineDims.for_protocol(
+        dev,
+        n=config.n,
+        clients=clients,
+        payload=dev.payload_width(config.n),
+        total_commands=total,
+        dot_slots=total + 1,
+        regions=len(regions),
+    )
+    spec = make_lane(
+        dev,
+        planet,
+        config,
+        conflict_rate=conflict,
+        pool_size=1,
+        commands_per_client=commands,
+        clients_per_region=cpr,
+        process_regions=regions,
+        client_regions=regions,
+        dims=dims,
+        faults=plan,
+    )
+    return run_lanes(dev, dims, [spec])[0]
+
+
+def assert_latencies_equal(res, oracle_lat, regions):
+    """Every region either has no surviving clients on both sides or a
+    bit-identical latency distribution. The oracle's per-region tuple
+    carries ISSUED commands; completions are the histogram count (they
+    differ when a lossy lane leaves commands in flight)."""
+    for region in regions:
+        dev_done = res.issued(region)
+        if region not in oracle_lat:
+            assert dev_done == 0, region
+            continue
+        _issued, hist = oracle_lat[region]
+        assert dev_done == hist.count(), region
+        if hist.count():
+            assert res.latency_mean(region) == hist.mean(), region
+            assert res.histogram(region).mean() == hist.mean(), region
+
+
+# ----------------------------------------------------------------------
+# plan construction / validation
+# ----------------------------------------------------------------------
+
+
+def test_fault_plan_validation():
+    with pytest.raises(AssertionError):
+        LinkWindow(src=0, dst=0, t0=0, t1=10)  # self link
+    with pytest.raises(AssertionError):
+        LinkWindow(src=0, dst=1, t0=10, t1=10)  # empty window
+    with pytest.raises(AssertionError):
+        LinkWindow(src=0, dst=1, t0=0, t1=10, mult=0)  # speed-up
+    with pytest.raises(AssertionError):
+        LinkWindow(src=0, dst=1, t0=0, t1=10, delay=0)  # zero-delay tie
+    with pytest.raises(AssertionError):  # overlapping windows, one pair
+        FaultPlan(windows=(
+            LinkWindow(src=0, dst=1, t0=0, t1=100),
+            LinkWindow(src=0, dst=1, t0=50, t1=150),
+        ))
+    with pytest.raises(AssertionError):  # drops need a horizon
+        FaultPlan(drop_bp=100)
+    with pytest.raises(AssertionError):  # partitions need a horizon too
+        FaultPlan(windows=(
+            LinkWindow(src=0, dst=1, t0=0, t1=10, delay=INF),
+        ))
+    # adjacent windows + reverse direction are fine
+    FaultPlan(windows=(
+        LinkWindow(src=0, dst=1, t0=0, t1=100),
+        LinkWindow(src=0, dst=1, t0=100, t1=200),
+        LinkWindow(src=1, dst=0, t0=50, t1=150),
+    ))
+
+
+def test_parse_fault_specs():
+    plans = parse_fault_specs(
+        '[{}, {"crash": {"1": 200}}, '
+        '{"windows": [{"src": 0, "dst": 1, "t0": 0, "t1": 500, '
+        '"delay": "inf"}], "horizon": 5000}, '
+        '{"drop_bp": 50, "horizon": 3000}]'
+    )
+    assert plans[0] is None
+    assert plans[1].crashes == {1: 200}
+    assert plans[2].windows[0].delay >= INF
+    assert plans[3].drop_bp == 50 and plans[3].horizon_ms == 3000
+    # metadata round-trips through meta() for the results table
+    meta = plans[2].meta()
+    assert meta["windows"][0]["delay"] == "inf"
+
+
+def test_min_live_and_unavailable():
+    cfg = _config("tempo", 5, 2)
+    dev = TempoDev(keys=4)
+    # 1 crash: survivors 4 >= fast quorum 4 — tolerable
+    assert not unavailable(FaultPlan(crashes={4: 100}), dev, cfg)
+    # 2 crashes = f, but survivors 3 < fast quorum 4 — unavailable
+    assert unavailable(FaultPlan(crashes={3: 0, 4: 0}), dev, cfg)
+    # caesar at n=3 needs all 3 for the fast quorum
+    from fantoch_tpu.engine.protocols import CaesarDev
+
+    assert unavailable(
+        FaultPlan(crashes={2: 0}), CaesarDev(keys=4),
+        _config("caesar", 3, 1),
+    )
+    # fpaxos tolerates a non-leader crash at n=3, f=1
+    assert not unavailable(
+        FaultPlan(crashes={2: 0}), FPaxosDev, _config("fpaxos", 3, 1)
+    )
+
+
+# ----------------------------------------------------------------------
+# differential: device == oracle on tie-free faulty schedules
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("crash_row", [0, 2])
+def test_crash_diff_exact_tempo(crash_row):
+    n, f = 3, 1
+    regions = Planet.new().regions()[:n]
+    config = _config("tempo", n, f)
+    plan = FaultPlan(crashes={crash_row: 150})
+    lat, fast, slow, stable = run_oracle("tempo", config, regions, plan)
+    res = run_engine("tempo", config, regions, plan)
+    assert not res.err, res.err_cause
+    assert int(res.protocol_metrics["fast_path"].sum()) == fast
+    assert int(res.protocol_metrics["slow_path"].sum()) == slow
+    assert int(res.protocol_metrics["stable"].sum()) == stable
+    # surviving clients: everyone not attached to the crashed row
+    surviving = (n - 1) * CPR
+    assert res.completed == surviving * COMMANDS
+    assert_latencies_equal(res, lat, regions)
+
+
+def test_crash_diff_exact_fpaxos():
+    """Crash a write-quorum acceptor: the quorum re-forms from the
+    survivors (doomed-last selection) and every surviving client's
+    budget completes — identical on device and oracle."""
+    n, f = 3, 1
+    regions = Planet.new().regions()[:n]
+    config = _config("fpaxos", n, f)  # leader = 1 (row 0)
+    plan = FaultPlan(crashes={1: 200})
+    lat, _fast, _slow, stable = run_oracle("fpaxos", config, regions, plan)
+    res = run_engine("fpaxos", config, regions, plan)
+    assert not res.err, res.err_cause
+    assert int(res.protocol_metrics["stable"].sum()) == stable
+    assert res.completed == (n - 1) * CPR * COMMANDS
+    assert_latencies_equal(res, lat, regions)
+
+
+def test_crash_diff_exact_atlas():
+    n, f = 3, 1
+    regions = Planet.new().regions()[:n]
+    config = _config("atlas", n, f)
+    plan = FaultPlan(crashes={1: 100})
+    lat, fast, slow, stable = run_oracle("atlas", config, regions, plan)
+    res = run_engine("atlas", config, regions, plan)
+    assert not res.err, res.err_cause
+    assert int(res.protocol_metrics["fast_path"].sum()) == fast
+    assert int(res.protocol_metrics["slow_path"].sum()) == slow
+    assert int(res.protocol_metrics["stable"].sum()) == stable
+    assert res.completed == (n - 1) * CPR * COMMANDS
+    assert_latencies_equal(res, lat, regions)
+
+
+def test_window_diff_exact_tempo():
+    """Link degradation (no loss): a 6x slowdown window on one link,
+    bit-identical on both sides — and strictly worse than fault-free
+    for the region behind the degraded link."""
+    n, f = 3, 1
+    regions = Planet.new().regions()[:n]
+    config = _config("tempo", n, f)
+    plan = FaultPlan(windows=(
+        LinkWindow(src=0, dst=1, t0=50, t1=400, mult=6),
+        LinkWindow(src=1, dst=0, t0=50, t1=400, mult=6),
+    ))
+    lat, fast, slow, stable = run_oracle("tempo", config, regions, plan)
+    res = run_engine("tempo", config, regions, plan)
+    assert not res.err, res.err_cause
+    assert int(res.protocol_metrics["fast_path"].sum()) == fast
+    assert int(res.protocol_metrics["slow_path"].sum()) == slow
+    assert int(res.protocol_metrics["stable"].sum()) == stable
+    assert_latencies_equal(res, lat, regions)
+
+    clean_lat, *_ = run_oracle("tempo", config, regions, None)
+    clean_mean = clean_lat[regions[0]][1].mean()
+    assert res.latency_mean(regions[0]) > clean_mean
+
+
+def test_window_overflow_mult_partitions_like_oracle():
+    """A multiplier whose product with the base delay crosses INF must
+    clamp to INF (= partition) on the device exactly like the oracle's
+    min(base*mult, INF) — not wrap negative in i32 and deliver in the
+    past (base*mult here is ~5e9, past i32 range)."""
+    n, f = 3, 1
+    regions = Planet.new().regions()[:n]
+    config = _config("tempo", n, f)
+    plan = FaultPlan(
+        windows=(
+            LinkWindow(src=0, dst=1, t0=0, t1=800, mult=1 << 29),
+        ),
+        horizon_ms=5000,
+    )
+    lat, *_ = run_oracle("tempo", config, regions, plan)
+    res = run_engine("tempo", config, regions, plan)
+    assert not res.err, res.err_cause
+    assert res.dropped > 0  # the overflowing window actually cut links
+    assert res.completed == sum(h.count() for _i, h in lat.values())
+    assert_latencies_equal(res, lat, regions)
+
+
+def test_drop_diff_exact_basic():
+    """Probabilistic drops: the threefry verdicts are a pure function
+    of (src, dst, channel index), so device and oracle lose the SAME
+    messages and complete the SAME commands by the horizon."""
+    n, f = 3, 1
+    regions = Planet.new().regions()[:n]
+    config = _config("basic", n, f)
+    plan = FaultPlan(drop_bp=400, drop_seed=7, horizon_ms=4000)
+    lat, *_ = run_oracle("basic", config, regions, plan)
+    res = run_engine("basic", config, regions, plan)
+    # the lane must end at the horizon, not by deadlock detection
+    assert not res.err & (ERR_STUCK | ERR_TRUNCATED), res.err_cause
+    assert not res.err, res.err_cause
+    assert res.dropped > 0, "a 4% drop rate lost no messages?"
+    total_oracle = sum(h.count() for _issued, h in lat.values())
+    assert 0 < res.completed < 3 * COMMANDS  # loss actually stalled work
+    assert res.completed == total_oracle
+    assert_latencies_equal(res, lat, regions)
+
+
+# ----------------------------------------------------------------------
+# crash-fault liveness (device-only)
+# ----------------------------------------------------------------------
+
+
+LIVENESS_SHAPES = [
+    # (protocol, n, f, conflict, commands, crash rows)
+    ("tempo", 3, 1, 100, COMMANDS, {2: 200}),
+    ("atlas", 3, 1, 100, COMMANDS, {1: 150}),
+    ("epaxos", 3, 1, 100, COMMANDS, {2: 250}),
+    ("fpaxos", 3, 1, 100, COMMANDS, {2: 200}),
+    ("basic", 3, 1, 100, COMMANDS, {0: 200}),
+    # crash at t=0: the doomed process never participates at all
+    ("tempo", 3, 1, 100, COMMANDS, {1: 0}),
+    pytest.param(
+        "caesar", 5, 1, 0, 10, {4: 200}, marks=pytest.mark.slow
+    ),
+    pytest.param(
+        "tempo", 5, 2, 100, 20, {4: 300}, marks=pytest.mark.slow
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "name,n,f,conflict,commands,crashes", LIVENESS_SHAPES
+)
+def test_crash_liveness(name, n, f, conflict, commands, crashes):
+    """Tolerable crash plans terminate cleanly with every surviving
+    client's budget executed — no hang, no ERR_STUCK, no truncation."""
+    regions = Planet.new().regions()[:n]
+    config = _config(name, n, f)
+    plan = FaultPlan(crashes=crashes)
+    res = run_engine(
+        name, config, regions, plan, conflict=conflict, commands=commands
+    )
+    assert res.err == 0, res.err_cause
+    survivors = (n - len(crashes)) * CPR
+    assert res.completed == survivors * commands
+    assert res.faults["crash"] == {
+        str(k): v for k, v in crashes.items()
+    }
+
+
+def test_fpaxos_leader_crash_halts_all_clients():
+    """No election is modeled: a doomed leader halts every client, and
+    the lane still terminates cleanly instead of hanging."""
+    n, f = 3, 1
+    regions = Planet.new().regions()[:n]
+    config = _config("fpaxos", n, f)  # leader = 1 (row 0)
+    plan = FaultPlan(crashes={0: 100})
+    res = run_engine("fpaxos", config, regions, plan)
+    assert res.err == 0, res.err_cause
+    assert res.completed == 0
+    assert res.faults["halted_clients"] == n * CPR
+
+
+def test_unavailable_lane_terminates_with_err_unavail():
+    """More crashes than the protocol tolerates: the lane flags
+    ERR_UNAVAIL immediately — it must not hang toward ERR_STUCK or
+    ERR_TRUNCATED."""
+    n, f = 3, 1
+    regions = Planet.new().regions()[:n]
+    config = _config("tempo", n, f)
+    plan = FaultPlan(crashes={1: 100, 2: 400})
+    res = run_engine("tempo", config, regions, plan)
+    assert res.err & ERR_UNAVAIL, res.err_cause
+    assert not res.err & (ERR_STUCK | ERR_TRUNCATED), res.err_cause
+    assert res.steps <= 2
+    assert res.faults["unavail"] is True
+    assert res.err_cause == "quorum-unavailable"
+
+
+# ----------------------------------------------------------------------
+# mixed sweep: fault-free + crash + partition under one compiled runner
+# ----------------------------------------------------------------------
+
+
+def test_mixed_fault_sweep():
+    from fantoch_tpu.parallel.sweep import make_sweep_specs, run_sweep
+
+    n, commands = 3, 10
+    planet = Planet.new()
+    regions = planet.regions()[:n]
+    clients = n * CPR
+    dev = dev_protocol("tempo", clients)
+    total = commands * clients
+    dims = EngineDims.for_protocol(
+        dev,
+        n=n,
+        clients=clients,
+        payload=dev.payload_width(n),
+        total_commands=total,
+        dot_slots=total + 1,
+        regions=n,
+    )
+    plans = [
+        None,
+        FaultPlan(crashes={2: 150}),
+        # partition one direction of a link for a while: messages on it
+        # are lost, so some commands may stall — bound with a horizon
+        FaultPlan(
+            windows=(
+                LinkWindow(src=0, dst=1, t0=0, t1=600, delay=INF),
+            ),
+            horizon_ms=5000,
+        ),
+    ]
+    specs = make_sweep_specs(
+        dev,
+        planet,
+        region_sets=[regions],
+        fs=[1],
+        conflicts=[100],
+        commands_per_client=commands,
+        clients_per_region=CPR,
+        dims=dims,
+        config_base=Config(**dev_config_kwargs("tempo", n, 1)),
+        faults=plans,
+    )
+    assert len(specs) == len(plans)
+    results = run_sweep(dev, dims, specs)
+
+    clean, crash, part = results
+    assert clean.faults is None
+    assert clean.err == 0 and clean.completed == total
+
+    assert crash.faults["crash"] == {"2": 150}
+    assert crash.err == 0
+    assert crash.completed == (n - 1) * CPR * commands
+
+    assert part.faults["windows"][0]["delay"] == "inf"
+    assert not part.err & (ERR_STUCK | ERR_TRUNCATED), part.err_cause
+    assert part.dropped > 0  # the partition actually cut messages
+    # identical workload, identical tie keys: the partition lane can
+    # only lose or delay work relative to the clean lane
+    assert part.completed <= clean.completed

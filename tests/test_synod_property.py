@@ -20,8 +20,12 @@ from __future__ import annotations
 from typing import Dict, List, Set, Tuple
 
 import pytest
-from hypothesis import given, settings
-from hypothesis import strategies as st
+
+pytest.importorskip(
+    "hypothesis", reason="property tests need the hypothesis package"
+)
+from hypothesis import given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
 
 from fantoch_tpu.protocol.synod import S_CHOSEN, Synod
 

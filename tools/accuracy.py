@@ -125,10 +125,10 @@ def main() -> None:
     if "--cpu" in sys.argv:
         # the environment pre-imports jax aimed at the tunneled TPU and
         # overrides JAX_PLATFORMS, so flip the config in-process
-        import jax
+        # (fantoch_tpu.platform guards jax-version differences)
+        from fantoch_tpu.platform import force_cpu
 
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", 8)
+        force_cpu()
     quick = "--quick" in sys.argv
     commands, cpr = (30, 1) if quick else (100, 1)
     conflicts = [0, 2, 10, 50, 100]

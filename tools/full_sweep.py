@@ -49,10 +49,9 @@ def main() -> None:
     args = ap.parse_args()
 
     if args.cpu:
-        import jax
+        from fantoch_tpu.platform import force_cpu
 
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", 8)
+        force_cpu()
 
     from fantoch_tpu.engine import EngineDims  # noqa: E402
     from fantoch_tpu.parallel import make_sweep_specs, run_sweep  # noqa: E402
