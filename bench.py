@@ -58,6 +58,48 @@ def _build(name: str, clients: int):
     return dev, Config(**dev_config_kwargs(name, N, 1))
 
 
+# schedule-fuzzing self-check + throughput (mc/fuzz.py): a fixed-seed
+# Tempo point with the mixed jitter/crash/drop lane draw; the monitors
+# must flag nothing on the correct protocol, and the measured
+# schedules/sec lands in the artifact next to the sweep rate with the
+# same platform provenance
+FUZZ_SCHEDULES = int(_os.environ.get("FANTOCH_BENCH_FUZZ_SCHEDULES", "256"))
+
+# minimum remaining total budget for attempting the fuzz self-check (a
+# cold monitored-runner compile is minutes on a CPU mesh; the sweep
+# artifact must never be lost to a driver timeout mid-compile)
+FUZZ_MIN_BUDGET_S = float(
+    _os.environ.get("FANTOCH_BENCH_FUZZ_MIN_BUDGET", "420")
+)
+
+
+def _fuzz_selfcheck() -> float:
+    from fantoch_tpu.mc.fuzz import FuzzSpec, run_fuzz_point
+
+    spec = FuzzSpec(
+        protocol="tempo",
+        n=N,
+        f=1,
+        schedules=FUZZ_SCHEDULES,
+        commands_per_client=10,
+        seed=0xF022,
+    )
+    # warmup compiles the monitored fuzz runner (same batch shape as
+    # the timed run; the sweep timing above already excludes compiles)
+    run_fuzz_point(spec, confirm=False)
+    res = run_fuzz_point(spec, confirm=False)
+    assert res.flagged == 0, (
+        f"fuzz self-check flagged violations on correct Tempo: "
+        f"{res.summary()}"
+    )
+    bad = {
+        k: v for k, v in res.engine_errors.items()
+        if k != "requeue-livelock"  # legitimate under drop lanes
+    }
+    assert not bad, f"fuzz self-check engine errors: {res.engine_errors}"
+    return res.schedules_per_sec
+
+
 def main() -> None:
     # smoke runs (JAX_PLATFORMS=cpu) force the CPU backend even under
     # the axon site hook; driver runs leave the env unset and get the
@@ -151,6 +193,38 @@ def main() -> None:
         )
     elapsed = time.perf_counter() - t0
 
+    # the self-check cold-compiles a monitored fuzz runner (minutes on
+    # a CPU mesh) AFTER the sweep rate is already measured — never let
+    # it widen the no-artifact window the budget machinery closes:
+    # skip it (honest zero) when too little of the total budget remains
+    fuzz_sps, fuzz_note = 0.0, None
+    if TOTAL_BUDGET_S - _since_birth() < FUZZ_MIN_BUDGET_S:
+        fuzz_note = "skipped: insufficient budget for the fuzz compile"
+        print(f"fuzz self-check {fuzz_note}", file=sys.stderr, flush=True)
+    else:
+        try:
+            fuzz_sps = _fuzz_selfcheck()
+            print(
+                f"fuzz self-check: {FUZZ_SCHEDULES} schedules clean, "
+                f"{fuzz_sps:.1f}/s",
+                file=sys.stderr,
+                flush=True,
+            )
+        except Exception as e:  # noqa: BLE001
+            # the headline sweep metric is already measured — a failed
+            # self-check (flagged lane, engine error, compile failure)
+            # must degrade the fuzz field honestly, never lose the
+            # whole artifact
+            import traceback
+
+            traceback.print_exc()
+            fuzz_sps = 0.0
+            fuzz_note = f"failed: {type(e).__name__}: {e}"[:300]
+            print(
+                f"fuzz self-check {fuzz_note}", file=sys.stderr,
+                flush=True,
+            )
+
     points_per_sec = total_points / elapsed
     per_chip_target = 10_000 / 60.0 / 8.0  # north-star rate, per chip
     platform = jax.devices()[0].platform
@@ -173,6 +247,8 @@ def main() -> None:
                 ),
                 "platform": platform,
                 "vs_baseline": round(points_per_sec / per_chip_target, 3),
+                "fuzz_schedules_per_sec": round(fuzz_sps, 2),
+                **({"fuzz_note": fuzz_note} if fuzz_note else {}),
             }
         )
     )
@@ -306,6 +382,7 @@ def _emit_unreachable(reason: str = "unreachable at startup") -> None:
                 ),
                 "platform": "none",
                 "vs_baseline": 0.0,
+                "fuzz_schedules_per_sec": 0.0,
             }
         )
     )
@@ -320,6 +397,7 @@ _CPU_FALLBACK_ENV = {
     "FANTOCH_BENCH_SUBSETS": "2",
     "FANTOCH_BENCH_COMMANDS": "10",
     "FANTOCH_BENCH_CHUNK": "16",
+    "FANTOCH_BENCH_FUZZ_SCHEDULES": "8",
 }
 
 # below this remaining total budget a CPU fallback run cannot plausibly

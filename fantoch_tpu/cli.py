@@ -32,8 +32,9 @@ ORACLE_PROTOCOLS = ENGINE_PROTOCOLS + ("tempo_atomic",)
 
 # subcommands that run device computations; everything else is
 # host-only and gets the CPU backend outright so a dead device
-# backend can never hang it
-DEVICE_COMMANDS = ("sweep",)
+# backend can never hang it ("mc" only fans out on device when
+# fuzzing — artifact replay is host-only and handled in main())
+DEVICE_COMMANDS = ("sweep", "mc")
 
 
 def _force_cpu() -> None:
@@ -114,17 +115,9 @@ def _engine_protocol(name: str, clients: int):
 
 
 def _oracle_protocol(name: str):
-    from . import protocol as p
+    from .protocol import BY_NAME
 
-    return {
-        "basic": p.Basic,
-        "fpaxos": p.FPaxos,
-        "tempo": p.Tempo,
-        "tempo_atomic": p.TempoAtomic,
-        "atlas": p.Atlas,
-        "epaxos": p.EPaxos,
-        "caesar": p.Caesar,
-    }[name]
+    return BY_NAME[name]
 
 
 def _add_common(sp, sweep: bool):
@@ -340,6 +333,120 @@ def cmd_sweep(args) -> None:
         save_results(args.out, rows)
         summary["out"] = args.out
     print(json.dumps(summary))
+
+
+def cmd_mc(args) -> None:
+    """Stochastic model checking (mc/fuzz.py): fan out perturbed
+    schedules with on-device safety monitors over a (protocol x n)
+    grid, host-confirm flagged lanes, shrink confirmed violations to
+    replayable repro artifacts; ``--replay`` re-executes one."""
+    import os
+    import time
+
+    from .mc.fuzz import (
+        FuzzSpec,
+        load_artifact,
+        replay_artifact,
+        run_fuzz_point,
+    )
+
+    if args.replay:
+        out = replay_artifact(load_artifact(args.replay))
+        print(json.dumps(out, indent=2))
+        if not out["reproduced"]:
+            raise SystemExit("artifact did not reproduce its violation")
+        return
+
+    protocols = args.protocols.split(",")
+    # fail before any point burns its budget, not mid-grid
+    unknown = [p for p in protocols if p not in ENGINE_PROTOCOLS]
+    if unknown:
+        raise SystemExit(
+            f"unknown protocol(s) {unknown}; choose from "
+            f"{','.join(ENGINE_PROTOCOLS)}"
+        )
+    if args.inject_bug and protocols != ["tempo"]:
+        raise SystemExit(
+            "--inject-bug is a Tempo-specific self-check; pass "
+            "--protocols tempo"
+        )
+    planet = _planet(args)
+    points = []
+    t0 = time.perf_counter()
+    artifacts = []
+    skipped_points = 0
+    grid = [(proto, n) for proto in protocols for n in args.ns]
+    for proto, n in grid:
+        if args.budget_s and time.perf_counter() - t0 > args.budget_s:
+            # wall-clock budget guard: report what ran, skip the rest
+            skipped_points += 1
+            continue
+        spec = FuzzSpec(
+            protocol=proto,
+            n=n,
+            f=args.f,
+            conflict=args.conflict,
+            pool_size=args.pool_size,
+            clients_per_region=args.clients_per_region,
+            commands_per_client=args.commands,
+            schedules=args.schedules,
+            seed=args.seed,
+            jitter_max=args.jitter_max,
+            crash_share=args.crash_share,
+            drop_share=args.drop_share,
+            aws=bool(args.aws),
+            inject_bug=args.inject_bug,
+        )
+        res = run_fuzz_point(
+            spec,
+            planet=planet,
+            confirm=not args.no_confirm,
+            max_confirmations=args.max_confirm,
+            shrink_budget=args.shrink_budget,
+            strict_missing=args.strict_missing,
+        )
+        point = res.summary()
+        if args.out:
+            os.makedirs(args.out, exist_ok=True)
+            for finding in res.findings:
+                if finding.artifact is None:
+                    continue
+                path = os.path.join(
+                    args.out,
+                    f"repro_{proto}_n{n}_lane{finding.lane}.json",
+                )
+                with open(path, "w") as fh:
+                    json.dump(finding.artifact, fh, indent=2)
+                artifacts.append(path)
+        points.append(point)
+        print(json.dumps(point), file=sys.stderr, flush=True)
+    elapsed = time.perf_counter() - t0
+    total = sum(p["schedules"] for p in points)
+    # device fuzz time only, matching the per-point field of the same
+    # name (wall time additionally includes host confirmation/shrink
+    # replays and is reported separately as elapsed_s)
+    fuzz_s = sum(p["fuzz_elapsed_s"] for p in points)
+    errors: dict = {}
+    for p in points:
+        for k, v in p["engine_errors"].items():
+            errors[k] = errors.get(k, 0) + v
+    print(
+        json.dumps(
+            {
+                "points": len(points),
+                "skipped_points": skipped_points,
+                "schedules": total,
+                "elapsed_s": round(elapsed, 2),
+                "fuzz_elapsed_s": round(fuzz_s, 2),
+                "schedules_per_sec": round(total / max(fuzz_s, 1e-9), 2),
+                "flagged": sum(p["flagged"] for p in points),
+                "confirmed": sum(p["confirmed"] for p in points),
+                "engine_errors": errors,
+                "artifacts": artifacts,
+                "grid": points,
+            }
+        )
+    )
 
 
 def cmd_bote(args) -> None:
@@ -643,6 +750,49 @@ def main(argv=None) -> None:
     sw.add_argument("--out", default=None, help="results JSONL path")
     sw.set_defaults(fn=cmd_sweep)
 
+    mc = sub.add_parser(
+        "mc",
+        help="device-scale schedule fuzzing with safety monitors "
+        "(mc/fuzz.py); --replay re-executes a repro artifact",
+    )
+    mc.add_argument("--protocols", default="tempo,fpaxos,atlas",
+                    help="comma-separated engine protocols to fuzz")
+    mc.add_argument("--ns", type=_ints, default=[3, 5],
+                    help="replica counts (one fuzz point per value)")
+    mc.add_argument("--f", type=int, default=1)
+    mc.add_argument("--conflict", type=int, default=100)
+    mc.add_argument("--pool-size", type=int, default=1)
+    mc.add_argument("--commands", type=int, default=5,
+                    help="commands per client")
+    mc.add_argument("--clients-per-region", type=int, default=1)
+    mc.add_argument("--schedules", type=int, default=512,
+                    help="perturbed schedules per (protocol, n) point")
+    mc.add_argument("--seed", type=int, default=0,
+                    help="root PRNG key (plans + workload)")
+    mc.add_argument("--jitter-max", type=int, default=8,
+                    help="per-message delay multiplier bound")
+    mc.add_argument("--crash-share", type=float, default=0.2)
+    mc.add_argument("--drop-share", type=float, default=0.15)
+    mc.add_argument("--budget-s", type=float, default=None,
+                    help="wall-clock guard: skip grid points past this")
+    mc.add_argument("--max-confirm", type=int, default=8,
+                    help="flagged lanes host-confirmed per point")
+    mc.add_argument("--shrink-budget", type=int, default=150,
+                    help="host-oracle runs per shrink")
+    mc.add_argument("--strict-missing", action="store_true",
+                    help="treat missing-execution as a finding")
+    mc.add_argument("--no-confirm", action="store_true",
+                    help="skip host confirmation (device flags only)")
+    mc.add_argument("--inject-bug", action="store_true",
+                    help="fuzz the deliberately broken Tempo twin "
+                    "(pipeline self-check)")
+    mc.add_argument("--aws", action="store_true")
+    mc.add_argument("--out", default=None,
+                    help="directory for repro artifacts")
+    mc.add_argument("--replay", default=None,
+                    help="re-execute a repro artifact (host oracle)")
+    mc.set_defaults(fn=cmd_mc)
+
     bt = sub.add_parser("bote", help="closed-form latency config search")
     bt.add_argument("--metric", default="f1", choices=["f1", "f1f2"])
     bt.add_argument("--min-mean-improv", type=float, default=0.0)
@@ -733,7 +883,13 @@ def main(argv=None) -> None:
     ep.set_defaults(fn=cmd_expplot)
 
     args = parser.parse_args(argv)
-    _apply_platform(args.platform, args.cmd)
+    # artifact replay is host-only: never probe the device backend
+    cmd = (
+        "mc-replay"
+        if args.cmd == "mc" and getattr(args, "replay", None)
+        else args.cmd
+    )
+    _apply_platform(args.platform, cmd)
     args.fn(args)
 
 

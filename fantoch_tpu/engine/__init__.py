@@ -22,6 +22,14 @@ Design (see SURVEY.md §7):
 from .dims import EngineDims
 from .faults import FaultPlan, LinkWindow, parse_fault_specs
 from .core import build_runner, init_lane_state
+from .monitor import (
+    VIOL_DUP,
+    VIOL_KEYRANGE,
+    VIOL_MISSING,
+    VIOL_ORDER,
+    VIOL_PREMATURE,
+    viol_names,
+)
 from .spec import LaneSpec, make_lane, stack_lanes
 from .results import LaneResults, collect_results
 from .driver import run_lanes
@@ -32,6 +40,11 @@ __all__ = [
     "LinkWindow",
     "LaneSpec",
     "LaneResults",
+    "VIOL_DUP",
+    "VIOL_KEYRANGE",
+    "VIOL_MISSING",
+    "VIOL_ORDER",
+    "VIOL_PREMATURE",
     "build_runner",
     "init_lane_state",
     "make_lane",
@@ -39,4 +52,5 @@ __all__ = [
     "stack_lanes",
     "collect_results",
     "run_lanes",
+    "viol_names",
 ]

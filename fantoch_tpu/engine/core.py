@@ -61,7 +61,8 @@ from .dims import (
     REQUEUE_LIMIT,
     EngineDims,
 )
-from .faults import NO_FAULTS, FaultFlags, drop_draw
+from .faults import NO_FAULTS, FaultFlags, drop_draw, jitter_draw
+from . import monitor
 
 I32 = jnp.int32
 
@@ -341,6 +342,7 @@ def init_lane_state(
     dims: EngineDims,
     ctx_np: Dict[str, np.ndarray],
     first_keys: "np.ndarray | None" = None,
+    monitor_keys: int = 0,
 ):
     """Build one lane's initial state (numpy, host side).
 
@@ -348,6 +350,9 @@ def init_lane_state(
     reference's ``Simulation::start_clients`` (runner.rs:211-220) — and
     arms the periodic timers at t = interval. ``first_keys`` ([C], from
     :func:`first_keys_fn`) skips the per-lane device round trip.
+    ``monitor_keys > 0`` adds the on-device safety-monitor state
+    (engine/monitor.py) with that per-key capacity; it must match the
+    runner's ``monitor_keys``.
     """
     N, C, M, P, R = dims.N, dims.C, dims.M, dims.P, dims.R
     # packed pool image: columns PA..PPR then P payload words (see the
@@ -401,7 +406,9 @@ def init_lane_state(
     live_rows = int(ctx_np.get("rows", ctx_np["n"]))
     next_periodic[live_rows:, :] = INF
 
+    mon = monitor.mon_init(dims, monitor_keys) if monitor_keys else {}
     return {
+        **mon,
         "pool": pool,
         "ps": protocol.init_state(dims, ctx_np),
         "next_periodic": next_periodic,
@@ -449,8 +456,11 @@ def init_lane_state(
 # ----------------------------------------------------------------------
 
 def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False,
-               faults: FaultFlags = NO_FAULTS):
+               faults: FaultFlags = NO_FAULTS, monitor_keys: int = 0):
     N, C, M, F, R, P = dims.N, dims.C, dims.M, dims.F, dims.R, dims.P
+    # safety monitors (engine/monitor.py) ride inside ps through the
+    # handler vmaps; a trace-time no-op when monitor_keys == 0
+    ps_in = monitor.merge_mon(st) if monitor_keys else st["ps"]
     pool = st["pool"]                     # [M, POOL_FIELDS + P]
     arrival = pool[:, PA]
     pool_dst = pool[:, PDST]
@@ -566,7 +576,7 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False,
     if hasattr(protocol, "ready"):
         rdy = jax.vmap(
             lambda p, m, me_: protocol.ready(p, m, me_, ctx, dims)
-        )(st["ps"], msg, procs)
+        )(ps_in, msg, procs)
         rdy = jnp.asarray(rdy, bool)
     else:
         rdy = jnp.ones((N,), bool)
@@ -583,7 +593,7 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False,
     def periodic_one(ps_slice, f, me, t):
         return protocol.periodic(ps_slice, f, me, t, ctx, dims)
 
-    ps, pout = jax.vmap(periodic_one)(st["ps"], fire, procs, ep)
+    ps, pout = jax.vmap(periodic_one)(ps_in, fire, procs, ep)
     next_periodic = jnp.where(
         fire, ep[:, None] + ctx["periodic_intervals"][None, :],
         next_periodic_in,
@@ -593,6 +603,9 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False,
         return protocol.handle(ps_slice, m, me, t, ctx, dims)
 
     ps, outbox = jax.vmap(handle_one)(ps, msg, procs, ep)  # outbox [N,F]
+    if monitor_keys:
+        ps, mon = monitor.strip_mon(ps)
+        viol, viol_step = monitor.step_viol(st, mon["mon_flags"])
 
     # optional debug timeline of handled messages
     hlog, hlog_n = st["hlog"], st["hlog_n"]
@@ -823,7 +836,11 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False,
         lost = jnp.zeros((E,), bool)
 
     valid = valid & (~is_client | issue)
-    msg_arrival = base + delay
+    if not faults.jitter:
+        # computed here (not after choke point 1b) so the jitter-free
+        # trace keeps the exact op order of a jitter-incapable engine —
+        # same serialized HLO, same persistent-compile-cache key
+        msg_arrival = base + delay
     prio = ~is_client & (dst == emitter) & ~overridden
 
     # sequence keys: the schedule-independent tie-break total order
@@ -871,6 +888,27 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False,
         ohe[:, :, None] & ohd[:, None, :], axis=0, dtype=I32
     )
 
+    # fault choke point 1b (schedule jitter): every wire hop's delay is
+    # multiplied by an independent threefry draw in [1, jitter_max],
+    # keyed on (src, dst, channel emission index) — the same schedule-
+    # independence argument as drops, so the host oracle's precomputed
+    # jitter table replays the identical perturbed schedule. This is
+    # the fuzz subsystem's host-replayable alternative to the legacy
+    # per-step ``reorder`` draws. Multipliers >= 1 keep the lane's
+    # base-delay lookahead matrix a valid lower bound.
+    if faults.jitter:
+        jm = jax.vmap(
+            lambda s, d, k: jitter_draw(
+                ctx["fault_jitter_key"], s, d, k, ctx["fault_jitter_num"]
+            )
+        )(emitter, jnp.clip(dst, 0, N - 1), kcnt)
+        j_cap = INF // jnp.maximum(delay, 1)
+        j_eff = jnp.where(jm > j_cap, INF, delay * jm)
+        j_lost = wired & (j_eff >= INF)
+        delay = jnp.where(wired & ~j_lost, j_eff, delay)
+        lost = lost | j_lost
+        msg_arrival = base + delay
+
     # fault choke point 2 (probabilistic wire loss): the verdict is a
     # pure threefry function of (src, dst, channel emission index), so
     # the host oracle draws the identical verdict for the identical
@@ -883,7 +921,7 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False,
             lambda s, d, k: drop_draw(ctx["fault_drop_key"], s, d, k)
         )(emitter, jnp.clip(dst, 0, N - 1), kcnt)
         lost = lost | (wired & (draw < ctx["fault_drop_num"]))
-    if faults.windows or faults.drops:
+    if faults.windows or faults.drops or faults.jitter:
         deliver = valid & ~lost
         n_lost = jnp.sum(valid & lost, dtype=I32)
     else:
@@ -951,7 +989,11 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False,
         # ERR_STUCK/ERR_TRUNCATED
         err = err | ERR_UNAVAIL * (ctx["fault_unavail"] != 0)
 
+    out_mon = (
+        dict(mon, viol=viol, viol_step=viol_step) if monitor_keys else {}
+    )
     return {
+        **out_mon,
         "pool": new_pool,
         "ps": ps,
         "next_periodic": next_periodic,
@@ -998,9 +1040,20 @@ def _lane_running(dims, st, ctx, max_steps, faults: FaultFlags = NO_FAULTS):
     return running
 
 
+def _check_monitorable(protocol, monitor_keys: int) -> None:
+    if monitor_keys:
+        assert getattr(protocol, "MONITORED", False), (
+            f"{type(protocol).__name__ if not isinstance(protocol, type) else protocol.__name__}"
+            " has no monitor hooks (mon_exec at its executor choke "
+            "point); fuzzing it would report every lane as "
+            "missing-execution"
+        )
+
+
 def build_runner(
     protocol, dims: EngineDims, max_steps: int = 1 << 22,
     reorder: bool = False, faults: FaultFlags = NO_FAULTS,
+    monitor_keys: int = 0,
 ):
     """Compile the batched sweep runner: (batched state, batched ctx) →
     final batched state. vmap supplies the config-batch axis; the sweep
@@ -1009,17 +1062,28 @@ def build_runner(
     setting — mixing both in one batch is not supported). ``faults``
     is the batch's fault-capability union (engine/faults.py): lanes
     with and without fault plans share one compiled runner, and an
-    all-False ``faults`` compiles exactly the fault-free graph."""
+    all-False ``faults`` compiles exactly the fault-free graph.
+    ``monitor_keys > 0`` compiles the safety monitors in
+    (engine/monitor.py) and reduces them to a per-lane violation
+    bitmask at lane end; 0 compiles the exact unmonitored graph."""
+    _check_monitorable(protocol, monitor_keys)
 
     def run_lane(st, ctx):
         out = jax.lax.while_loop(
             lambda s: _lane_running(dims, s, ctx, max_steps, faults),
-            lambda s: _lane_step(protocol, dims, s, ctx, reorder, faults),
+            lambda s: _lane_step(
+                protocol, dims, s, ctx, reorder, faults, monitor_keys
+            ),
             st,
         )
         # a lane truncated by max_steps must never look like a clean run
         truncated = (out["steps"] >= max_steps) & (out["done_time"] >= INF)
-        return dict(out, err=out["err"] | ERR_TRUNCATED * truncated)
+        out = dict(out, err=out["err"] | ERR_TRUNCATED * truncated)
+        if monitor_keys:
+            out = monitor.finalize_lane(
+                protocol, dims, out, ctx, faults, running=False
+            )
+        return out
 
     return jax.jit(jax.vmap(run_lane))
 
@@ -1027,6 +1091,7 @@ def build_runner(
 def build_segment_runner(
     protocol, dims: EngineDims, max_steps: int = 1 << 22,
     reorder: bool = False, faults: FaultFlags = NO_FAULTS,
+    monitor_keys: int = 0,
 ):
     """Like :func:`build_runner` but each device call advances every
     still-running lane by at most ``until - steps`` steps and returns,
@@ -1041,15 +1106,27 @@ def build_segment_runner(
     increments until the flag is false, then apply truncation via
     ``finish_segmented``."""
 
+    _check_monitorable(protocol, monitor_keys)
+
     def run_lane(st, ctx, until):
         lim = jnp.minimum(until, max_steps)
         out = jax.lax.while_loop(
             lambda s: _lane_running(dims, s, ctx, max_steps, faults)
             & (s["steps"] < lim),
-            lambda s: _lane_step(protocol, dims, s, ctx, reorder, faults),
+            lambda s: _lane_step(
+                protocol, dims, s, ctx, reorder, faults, monitor_keys
+            ),
             st,
         )
-        return out, _lane_running(dims, out, ctx, max_steps, faults)
+        running = _lane_running(dims, out, ctx, max_steps, faults)
+        if monitor_keys:
+            # idempotent per segment: a finished lane's state is frozen,
+            # so re-running the end-of-lane reduction only re-derives
+            # the same bits; running lanes keep their in-run bits
+            out = monitor.finalize_lane(
+                protocol, dims, out, ctx, faults, running=running
+            )
+        return out, running
 
     def run_batch(st, ctx, until):
         out, alive = jax.vmap(run_lane, in_axes=(0, 0, None))(

@@ -16,8 +16,12 @@ from .results import LaneResults, collect_results
 from .spec import LaneSpec, stack_lanes
 
 
-def stack_states(protocol, dims: EngineDims, specs: Sequence[LaneSpec]):
-    states = [init_lane_state(protocol, dims, s.ctx) for s in specs]
+def stack_states(protocol, dims: EngineDims, specs: Sequence[LaneSpec],
+                 monitor_keys: int = 0):
+    states = [
+        init_lane_state(protocol, dims, s.ctx, monitor_keys=monitor_keys)
+        for s in specs
+    ]
     return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *states)
 
 
@@ -34,15 +38,18 @@ def run_lanes(
     dims: EngineDims,
     specs: Sequence[LaneSpec],
     max_steps: int = 1 << 22,
+    monitor_keys: int = 0,
 ) -> List[LaneResults]:
     ctx = stack_lanes(specs)
-    state = stack_states(protocol, dims, specs)
+    state = stack_states(protocol, dims, specs, monitor_keys)
     runner = build_runner(
         protocol, dims, max_steps,
         reorder=batch_reorder_flag(specs),
         # fault-capability union: fault-free and faulty lanes share one
         # compiled runner (fault-free lanes' ctx arrays are inert)
         faults=batch_fault_flags(specs),
+        # > 0 compiles the safety monitors in (engine/monitor.py)
+        monitor_keys=monitor_keys,
     )
     final = runner(state, ctx)
     return collect_results(protocol, dims, final, specs)

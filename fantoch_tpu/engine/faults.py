@@ -27,7 +27,21 @@ encoding of one lane's adversity:
   draw keyed on ``(src, dst, channel-emission-index)`` so the host
   oracle and the device draw bit-identical verdicts on identical
   histories (the same schedule-independence argument as the engine's
-  tie-break keys).
+  tie-break keys);
+* **schedule jitter** — each process→process emission's delay is
+  multiplied by an independent threefry draw in ``[1, jitter_max]``,
+  keyed on ``(src, dst, channel-emission-index)`` exactly like drops,
+  so the host oracle replays the identical perturbed schedule. Unlike
+  the engine's legacy ``reorder`` perturbation (per-step draws the
+  oracle cannot mirror), jitter schedules are *host-replayable* — the
+  schedule-fuzzing subsystem (``fantoch_tpu/mc/fuzz.py``) is built on
+  it. Multipliers are >= 1, so the conservative-lookahead matrix
+  computed from base delays stays a valid lower bound and jittered
+  lanes keep parallel stepping. Host-side shrinking
+  (``fantoch_tpu/mc/shrink.py``) uses the explicit forms
+  ``jitter_overrides``/``drop_list`` — per-message ``(src, dst,
+  channel-index)`` entries the device does not implement (fuzz repro
+  artifacts replay through the host oracle).
 
 Drops and windows apply to process→process wire hops only: client hops
 (SUBMIT / TO_CLIENT) model the in-process client stack, self-messages
@@ -76,6 +90,7 @@ class FaultFlags(NamedTuple):
     windows: bool = False
     drops: bool = False
     horizon: bool = False
+    jitter: bool = False
 
     def __or__(self, other: "FaultFlags") -> "FaultFlags":
         return FaultFlags(*(bool(a or b) for a, b in zip(self, other)))
@@ -119,14 +134,36 @@ class FaultPlan:
     drop_bp: int = 0
     drop_seed: int = 0
     horizon_ms: Optional[int] = None
+    # seeded schedule jitter: every wire hop's delay × U{1..jitter_max}
+    # keyed on (src, dst, channel emission index); <= 1 disables
+    jitter_max: int = 0
+    jitter_seed: int = 0
+    # host-only explicit perturbations (shrink/replay artifacts): exact
+    # per-message delay multipliers and losses by (src, dst, channel
+    # emission index). The device engine rejects plans that carry them
+    # (make_lane asserts) — repro artifacts replay via the host oracle.
+    jitter_overrides: Mapping[Tuple[int, int, int], int] = field(
+        default_factory=dict
+    )
+    drop_list: Tuple[Tuple[int, int, int], ...] = ()
 
     def __post_init__(self):
         object.__setattr__(self, "crashes", dict(self.crashes))
         object.__setattr__(self, "windows", tuple(self.windows))
+        object.__setattr__(
+            self, "jitter_overrides", dict(self.jitter_overrides)
+        )
+        object.__setattr__(
+            self, "drop_list", tuple(sorted(set(self.drop_list)))
+        )
         assert len(self.windows) <= MAX_WINDOWS, (
             f"{len(self.windows)} windows > MAX_WINDOWS={MAX_WINDOWS}"
         )
         assert 0 <= self.drop_bp <= DROP_DENOM
+        assert self.jitter_max >= 0
+        assert all(
+            m >= 1 for m in self.jitter_overrides.values()
+        ), "jitter overrides only slow messages down (mult >= 1)"
         for row, t in self.crashes.items():
             assert row >= 0 and t >= 0, f"bad crash ({row}, {t})"
         # windows of one (src, dst) pair must not overlap: the device
@@ -139,7 +176,7 @@ class FaultPlan:
             ws = sorted(ws, key=lambda w: w.t0)
             for a, b in zip(ws, ws[1:]):
                 assert a.t1 <= b.t0, f"overlapping windows on {pair}"
-        lossy = self.drop_bp > 0 or any(
+        lossy = self.drop_bp > 0 or bool(self.drop_list) or any(
             w.delay is not None and w.delay >= INF for w in self.windows
         )
         if lossy:
@@ -160,10 +197,20 @@ class FaultPlan:
             windows=bool(self.windows),
             drops=self.drop_bp > 0,
             horizon=self.horizon_ms is not None,
+            jitter=self.jitter_max > 1,
         )
 
     def is_noop(self) -> bool:
-        return self.flags == NO_FAULTS
+        return (
+            self.flags == NO_FAULTS
+            and not self.jitter_overrides
+            and not self.drop_list
+        )
+
+    def host_only(self) -> bool:
+        """Plans carrying explicit per-message perturbations replay
+        through the host oracle only (shrunk repro artifacts)."""
+        return bool(self.jitter_overrides) or bool(self.drop_list)
 
     # -- host-side model ----------------------------------------------
 
@@ -178,49 +225,69 @@ class FaultPlan:
         return None
 
     def wire(self, src: int, dst: int, send_ms: int, base_delay: int,
-             kcnt: int, drop_table: "np.ndarray | None" = None
+             kcnt: int, drop_table: "np.ndarray | None" = None,
+             jitter_table: "np.ndarray | None" = None,
              ) -> Tuple[int, bool]:
         """The oracle's wire model: (effective delay, lost?). Mirrors
         the device's emission choke point exactly — window by send
-        time, then the threefry drop verdict by channel index."""
+        time, then the jitter multiplier, then the threefry drop
+        verdict, all by channel emission index. Explicit
+        ``jitter_overrides``/``drop_list`` entries (host-only shrunk
+        plans) take the seeded tables' place per message."""
         delay, lost = base_delay, False
         w = self.window_at(src, dst, send_ms)
         if w is not None:
             delay = w.effective(base_delay)
             if delay >= INF:
                 return delay, True
-        if drop_table is not None:
+        mult = self.jitter_mult(src, dst, kcnt, jitter_table)
+        if mult is not None and mult > 1:
+            delay = min(delay * mult, INF)
+            if delay >= INF:
+                return delay, True
+        if (src, dst, kcnt) in self._drop_set:
+            lost = True
+        elif drop_table is not None:
             assert kcnt < drop_table.shape[2], (
                 "drop table too small; raise kmax"
             )
             lost = bool(drop_table[src, dst, kcnt])
         return delay, lost
 
+    def jitter_mult(self, src: int, dst: int, kcnt: int,
+                    jitter_table: "np.ndarray | None" = None
+                    ) -> Optional[int]:
+        """The jitter multiplier this plan applies to one message —
+        explicit override first, else the seeded table. The single
+        source of truth for :meth:`wire` AND the shrinker's recording
+        wrapper (mc/shrink.py), so the recorder can never drift from
+        the real wire model."""
+        mult = self.jitter_overrides.get((src, dst, kcnt))
+        if mult is None and jitter_table is not None:
+            assert kcnt < jitter_table.shape[2], (
+                "jitter table too small; raise kmax"
+            )
+            mult = int(jitter_table[src, dst, kcnt])
+        return mult
+
+    @property
+    def _drop_set(self):
+        s = self.__dict__.get("_drop_set_cache")
+        if s is None:
+            s = frozenset(self.drop_list)
+            object.__setattr__(self, "_drop_set_cache", s)
+        return s
+
     def drop_table(self, n: int, kmax: int = 1 << 14) -> np.ndarray:
         """Precomputed ``[n, n, kmax]`` drop verdicts for the host
         oracle — one batched threefry call instead of one per message.
         ``table[src, dst, k]`` must equal the device's in-loop draw for
         channel emission ``k`` (see ``drop_draw``)."""
-        import jax
-        import jax.numpy as jnp
-
-        key = jnp.asarray(self.drop_key())
         num = self.drop_bp
-
-        def one(s, d, k):
-            return drop_draw(key, s, d, k) < num
-
-        grid = jnp.arange
-        table = jax.jit(
-            jax.vmap(
-                lambda s: jax.vmap(
-                    lambda d: jax.vmap(lambda k: one(s, d, k))(
-                        grid(kmax)
-                    )
-                )(grid(n))
-            )
-        )(grid(n))
-        return np.asarray(table)
+        return _wire_table(
+            self.drop_key(), n, kmax,
+            lambda key, s, d, k: drop_draw(key, s, d, k) < num,
+        )
 
     def drop_key(self) -> np.ndarray:
         import jax.random as jr
@@ -229,13 +296,33 @@ class FaultPlan:
             jr.fold_in(jr.PRNGKey(self.drop_seed), 0xFA17)
         )
 
+    def jitter_table(self, n: int, kmax: int = 1 << 14) -> np.ndarray:
+        """Precomputed ``[n, n, kmax]`` delay multipliers for the host
+        oracle (the jitter analog of :meth:`drop_table`):
+        ``table[src, dst, k]`` equals the device's in-loop draw for
+        channel emission ``k`` (see ``jitter_draw``)."""
+        jmax = self.jitter_max
+        return _wire_table(
+            self.jitter_key(), n, kmax,
+            lambda key, s, d, k: jitter_draw(key, s, d, k, jmax),
+        )
+
+    def jitter_key(self) -> np.ndarray:
+        import jax.random as jr
+
+        return np.asarray(
+            jr.fold_in(jr.PRNGKey(self.jitter_seed), 0x717E)
+        )
+
     # -- serialization (CLI --faults spec) ----------------------------
 
     @staticmethod
     def from_json(obj: dict) -> "FaultPlan":
         """``{"crash": {"1": 200}, "windows": [{"src": 0, "dst": 1,
         "t0": 100, "t1": 400, "mult": 5}], "drop_bp": 50, "seed": 1,
-        "horizon": 5000}`` — window ``"delay": "inf"`` partitions."""
+        "horizon": 5000}`` — window ``"delay": "inf"`` partitions.
+        Accepts :meth:`meta` output too (``horizon_ms``/``drop_seed``
+        spellings), so repro artifacts round-trip through it."""
         windows = []
         for w in obj.get("windows", ()):
             delay = w.get("delay")
@@ -255,8 +342,18 @@ class FaultPlan:
             },
             windows=tuple(windows),
             drop_bp=int(obj.get("drop_bp", 0)),
-            drop_seed=int(obj.get("seed", 0)),
-            horizon_ms=obj.get("horizon"),
+            drop_seed=int(obj.get("seed", obj.get("drop_seed", 0))),
+            horizon_ms=obj.get("horizon", obj.get("horizon_ms")),
+            jitter_max=int(obj.get("jitter_max", 0)),
+            jitter_seed=int(obj.get("jitter_seed", 0)),
+            jitter_overrides={
+                (int(o["src"]), int(o["dst"]), int(o["k"])): int(o["mult"])
+                for o in obj.get("jitter_overrides", ())
+            },
+            drop_list=tuple(
+                (int(o["src"]), int(o["dst"]), int(o["k"]))
+                for o in obj.get("drop_list", ())
+            ),
         )
 
     def meta(self, **extra) -> dict:
@@ -283,6 +380,18 @@ class FaultPlan:
             out["drop_seed"] = self.drop_seed
         if self.horizon_ms is not None:
             out["horizon_ms"] = int(self.horizon_ms)
+        if self.jitter_max > 1:
+            out["jitter_max"] = self.jitter_max
+            out["jitter_seed"] = self.jitter_seed
+        if self.jitter_overrides:
+            out["jitter_overrides"] = [
+                {"src": s, "dst": d, "k": k, "mult": m}
+                for (s, d, k), m in sorted(self.jitter_overrides.items())
+            ]
+        if self.drop_list:
+            out["drop_list"] = [
+                {"src": s, "dst": d, "k": k} for s, d, k in self.drop_list
+            ]
         out.update(extra)
         return out
 
@@ -309,8 +418,31 @@ def parse_fault_specs(text: str) -> List[Optional[FaultPlan]]:
     return out
 
 
+def _wire_table(key, n: int, kmax: int, draw_one) -> np.ndarray:
+    """Batch one per-message wire draw over the full ``[n, n, kmax]``
+    (src, dst, channel-emission-index) grid — the host oracle's
+    precomputed twin of a device in-loop draw. ``draw_one(key, s, d,
+    k)`` must be the exact device function so both sides agree on
+    every message."""
+    import jax
+    import jax.numpy as jnp
+
+    key = jnp.asarray(key)
+    grid = jnp.arange
+    table = jax.jit(
+        jax.vmap(
+            lambda s: jax.vmap(
+                lambda d: jax.vmap(
+                    lambda k: draw_one(key, s, d, k)
+                )(grid(kmax))
+            )(grid(n))
+        )
+    )(grid(n))
+    return np.asarray(table)
+
+
 # ----------------------------------------------------------------------
-# device-side primitives (shared by engine/core.py and drop_table)
+# device-side primitives (shared by engine/core.py and the wire tables)
 # ----------------------------------------------------------------------
 
 
@@ -322,6 +454,18 @@ def drop_draw(key, src, dst, kcnt):
 
     k = jr.fold_in(jr.fold_in(jr.fold_in(key, src), dst), kcnt)
     return jr.randint(k, (), 0, DROP_DENOM)
+
+
+def jitter_draw(key, src, dst, kcnt, jmax):
+    """The jitter multiplier's threefry draw in [1, jmax] — the same
+    schedule-independent keying as :func:`drop_draw`, so the host
+    oracle's precomputed table and the device's in-loop draw agree on
+    every message. ``jmax <= 1`` yields the identity multiplier."""
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    k = jr.fold_in(jr.fold_in(jr.fold_in(key, src), dst), kcnt)
+    return jr.randint(k, (), 0, jnp.maximum(jmax, 1)) + 1
 
 
 # ----------------------------------------------------------------------
@@ -428,8 +572,13 @@ def fault_ctx(plan: Optional[FaultPlan], dims) -> Dict[str, np.ndarray]:
     win_mul = np.ones((MAX_WINDOWS,), np.int32)
     win_ovr = np.full((MAX_WINDOWS,), -1, np.int32)
     drop_bp = 0
+    jitter_num = 1
     horizon = INF
     if plan is not None:
+        assert not plan.host_only(), (
+            "explicit per-message perturbations (jitter_overrides/"
+            "drop_list) replay through the host oracle only"
+        )
         for row, t in plan.crashes.items():
             assert row < N, f"crash row {row} out of range"
             crash_t[row] = min(t, INF)
@@ -441,11 +590,16 @@ def fault_ctx(plan: Optional[FaultPlan], dims) -> Dict[str, np.ndarray]:
             win_mul[i] = w.mult
             win_ovr[i] = -1 if w.delay is None else min(w.delay, INF)
         drop_bp = plan.drop_bp
+        jitter_num = max(plan.jitter_max, 1)
         if plan.horizon_ms is not None:
             horizon = min(plan.horizon_ms, INF)
     drop_key = (
         plan.drop_key() if plan is not None and plan.drop_bp
         else FaultPlan().drop_key()
+    )
+    jitter_key = (
+        plan.jitter_key() if plan is not None and plan.jitter_max > 1
+        else FaultPlan().jitter_key()
     )
     return {
         "fault_crash_t": crash_t,
@@ -457,6 +611,8 @@ def fault_ctx(plan: Optional[FaultPlan], dims) -> Dict[str, np.ndarray]:
         "fault_win_ovr": win_ovr,
         "fault_drop_num": np.int32(drop_bp),
         "fault_drop_key": drop_key,
+        "fault_jitter_num": np.int32(jitter_num),
+        "fault_jitter_key": jitter_key,
         "fault_horizon": np.int32(horizon),
         # set by make_lane after the availability check
         "fault_unavail": np.int32(0),

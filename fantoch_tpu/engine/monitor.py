@@ -1,0 +1,240 @@
+"""On-device safety monitors for the batched engine.
+
+The host explorer (``fantoch_tpu/mc/checker.py``) checks agreement and
+exactly-once execution by materializing every process's full per-key
+execution order — fine for 5k-state workloads, impossible for a
+million-schedule device fuzz run. These monitors compress the same
+properties into O(N x K) integers that update *inside* the vmapped step
+function and reduce to two scalars per lane:
+
+* ``_mon_hash [K]`` per process — a rolling order-sensitive hash of the
+  commands executed on each key, updated at the protocol's executor
+  choke point (``mon_exec``). Two processes that executed the same
+  *number* of commands on a key but in different orders end with
+  different hashes (modulo an astronomically unlikely i32 collision),
+  so the cross-process comparison at lane end is the array analog of
+  the reference's ``check_monitors`` (fantoch_ps protocol/mod.rs:724).
+  Crucially the equal-count/different-hash test is sound *mid-run* too:
+  for protocols whose executors enforce a per-key total order
+  (timestamp, clock, slot or dependency order), two live processes with
+  the same per-key execution count must have executed the same prefix;
+* ``_mon_cnt [K]`` per process — exactly-once counters. At clean
+  quiescence every live process must have executed every command
+  exactly once, so each per-process total must equal the lane's
+  completed-command total;
+* ``_mon_flags`` per process — in-run guard bits: the
+  execute-before-commit guard (``premature``; a command executed whose
+  dot is not in the process's *committed* record — an independent data
+  path from the executor's own readiness predicate) and a key-range
+  guard that makes a misconfigured monitor key capacity loud instead of
+  a false violation.
+
+Monitoring is trace-gated by the ``monitor_keys`` argument threaded
+through ``build_runner``/``init_lane_state``: when it is 0 the monitor
+arrays are never created, ``mon_exec`` is a no-op at *trace time* (it
+keys on the presence of ``_mon_hash`` in the state dict), and the
+compiled step is bit-identical to an unmonitored engine — a fuzz-
+disabled sweep pays nothing (tests/test_mc_fuzz.py pins this).
+
+What the order hash does and does not prove is documented in
+``docs/MC.md``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from .dims import INF, SEQ_BOUND
+
+I32 = jnp.int32
+
+# rolling-hash multiplier (a prime; i32 multiplication wraps two's
+# complement under XLA, which is exactly the modulus we want)
+HASH_MUL = 1_000_003
+
+# per-process in-run guard bits (``_mon_flags``)
+MON_F_PREMATURE = 1  # executed a dot absent from the committed record
+MON_F_KEYRANGE = 2   # executed key >= monitor_keys (monitor misconfig)
+
+# per-lane violation bitmask (LaneResults.violation)
+VIOL_ORDER = 1      # per-key execution orders diverge across live
+                    # processes (equal counts, different hashes)
+VIOL_DUP = 2        # a process executed more commands than completed
+                    # (clean quiescent lanes only)
+VIOL_MISSING = 4    # a process executed fewer (clean quiescent lanes;
+                    # fuzz drivers may treat this as advisory — an
+                    # undersized extra_time tail can leave a correct
+                    # protocol's executors undrained)
+VIOL_PREMATURE = 8  # execute-before-commit guard tripped
+VIOL_KEYRANGE = 16  # monitor key capacity too small (setup error, not
+                    # a protocol bug)
+
+VIOL_NAMES = {
+    VIOL_ORDER: "order-divergence",
+    VIOL_DUP: "duplicate-execution",
+    VIOL_MISSING: "missing-execution",
+    VIOL_PREMATURE: "execute-before-commit",
+    VIOL_KEYRANGE: "monitor-key-range",
+}
+
+# monitor keys carried inside the per-process protocol state during a
+# step (merged before the handler vmap, stripped after)
+MON_PS_KEYS = ("_mon_hash", "_mon_cnt", "_mon_flags")
+
+
+def viol_names(code: int) -> str:
+    if not code:
+        return "ok"
+    return "+".join(
+        name for bit, name in sorted(VIOL_NAMES.items()) if code & bit
+    ) or f"unknown({code})"
+
+
+def mon_init(dims, monitor_keys: int) -> Dict[str, np.ndarray]:
+    """Host-side monitor state for one lane (top-level lane-state keys;
+    the engine merges the per-process arrays into ``ps`` around the
+    handler vmap)."""
+    N = dims.N
+    return {
+        "mon_hash": np.zeros((N, monitor_keys), np.int32),
+        "mon_cnt": np.zeros((N, monitor_keys), np.int32),
+        "mon_flags": np.zeros((N,), np.int32),
+        "viol": np.int32(0),
+        "viol_step": np.int32(INF),
+    }
+
+
+def mon_exec(ps, key, src, seq, enable, premature=False):
+    """Record one command execution at the calling protocol's executor
+    choke point: ``(src, seq)`` executed on ``key`` by this process.
+
+    A trace-time no-op when monitors are disabled (the ``_mon_*`` keys
+    are only merged into ``ps`` by a monitored engine), so unmonitored
+    sweeps compile zero monitor ops. ``premature`` is the protocol's
+    execute-before-commit guard — True means the executed dot is NOT in
+    this process's committed record."""
+    if "_mon_hash" not in ps:
+        return ps
+    km = ps["_mon_hash"].shape[0]
+    do = jnp.asarray(enable, bool)
+    key = jnp.asarray(key, I32)
+    in_range = (key >= 0) & (key < km)
+    # command identity packs into i32: src < N << seq bound
+    cmd = jnp.asarray(src, I32) * SEQ_BOUND + jnp.asarray(seq, I32) + 1
+    iota = jnp.arange(km, dtype=I32)
+    hit = (iota == key) & do & in_range
+    return dict(
+        ps,
+        _mon_hash=jnp.where(
+            hit, ps["_mon_hash"] * HASH_MUL + cmd, ps["_mon_hash"]
+        ),
+        _mon_cnt=ps["_mon_cnt"] + hit.astype(I32),
+        _mon_flags=ps["_mon_flags"]
+        | MON_F_PREMATURE * (do & jnp.asarray(premature, bool))
+        | MON_F_KEYRANGE * (do & ~in_range),
+    )
+
+
+def merge_mon(st):
+    """Lane-state monitor arrays → per-process ``ps`` keys (pre-vmap)."""
+    return dict(
+        st["ps"],
+        _mon_hash=st["mon_hash"],
+        _mon_cnt=st["mon_cnt"],
+        _mon_flags=st["mon_flags"],
+    )
+
+
+def strip_mon(ps):
+    """Inverse of :func:`merge_mon` after the handler vmap: returns
+    (clean ps, monitor dict)."""
+    ps = dict(ps)
+    mon = {k.lstrip("_"): ps.pop(k) for k in MON_PS_KEYS}
+    return ps, mon
+
+
+def step_viol(st, mon_flags):
+    """Per-step violation tracking: fold the in-run guard bits into the
+    lane bitmask and pin the first violating step. A couple of tiny
+    reductions — the heavy checks run once at lane end."""
+    flags = jnp.bitwise_or.reduce(jnp.asarray(mon_flags, I32))
+    viol = (
+        st["viol"]
+        | VIOL_PREMATURE * ((flags & MON_F_PREMATURE) != 0)
+        | VIOL_KEYRANGE * ((flags & MON_F_KEYRANGE) != 0)
+    )
+    viol_step = jnp.where(
+        (viol != 0) & (st["viol_step"] >= INF),
+        st["steps"] + 1,
+        st["viol_step"],
+    )
+    return viol, viol_step
+
+
+def finalize_lane(protocol, dims, st, ctx, faults, running):
+    """End-of-run monitor reduction (on device, once per lane): the
+    cross-process order/count comparisons, folded into ``viol`` /
+    ``viol_step``. ``running`` guards the segmented runner — a lane
+    still mid-flight keeps its in-run bits only (the checks re-run
+    idempotently on the final segment, when its state is frozen).
+
+    * order: any pair of live processes with equal per-key counts but
+      different hashes (skipped for protocols that declare
+      ``MONITOR_ORDER = False`` — Basic's executor provides no
+      cross-process order guarantee). Gated on a lossless lane: under
+      message *loss* two correct processes can each permanently miss a
+      different dropped commit and end with equal counts over different
+      command sets — a modeling artifact of the no-retransmission
+      network, not a protocol bug (docs/MC.md);
+    * exactly-once/completeness: at clean quiescence (budget done, no
+      error, nothing lost to faults, no crash plan, and the grace tail
+      not cut by a fault horizon) every live process must have executed
+      exactly the completed-command total.
+    """
+    N = dims.N
+    procs = jnp.arange(N, dtype=I32)
+    live = procs < ctx["rows"]
+    if faults.crash:
+        live = live & (ctx["fault_crash_t"] >= INF)
+
+    hashes = st["mon_hash"]  # [N, K]
+    cnts = st["mon_cnt"]
+    viol = st["viol"]
+
+    if getattr(protocol, "MONITOR_ORDER", True):
+        pair = live[:, None] & live[None, :]
+        same_cnt = cnts[:, None, :] == cnts[None, :, :]
+        diff_hash = hashes[:, None, :] != hashes[None, :, :]
+        order_bad = jnp.any(pair[:, :, None] & same_cnt & diff_hash)
+        viol = viol | VIOL_ORDER * (
+            order_bad & (st["fault_dropped"] == 0)
+        )
+
+    clean = (
+        (st["done_time"] < INF)
+        & (st["err"] == 0)
+        & (st["fault_dropped"] == 0)
+    )
+    if faults.crash:
+        clean = clean & jnp.all(ctx["fault_crash_t"] >= INF)
+    if faults.horizon:
+        # the extra_time drain tail must fit before the horizon, else
+        # executors are legitimately undrained at lane end
+        clean = clean & (
+            st["done_time"] + ctx["extra_time"] <= ctx["fault_horizon"]
+        )
+    total = jnp.sum(st["clients"]["completed"])
+    per_proc = jnp.sum(cnts, axis=1)  # [N]
+    viol = viol | VIOL_DUP * (clean & jnp.any(live & (per_proc > total)))
+    viol = viol | VIOL_MISSING * (
+        clean & jnp.any(live & (per_proc < total))
+    )
+
+    viol = jnp.where(running, st["viol"], viol)
+    viol_step = jnp.where(
+        (viol != 0) & (st["viol_step"] >= INF), st["steps"], st["viol_step"]
+    )
+    return dict(st, viol=viol, viol_step=viol_step)

@@ -30,6 +30,7 @@ from ..core import (
     I32, emit, emit_broadcast, empty_outbox, oh_get, oh_set, oh_set2,
 )
 from ..dims import ERR_DOT, ERR_PROTO, INF, EngineDims
+from ..monitor import mon_exec
 from .identity import DevIdentity
 
 
@@ -43,6 +44,11 @@ class BasicDev(DevIdentity):
     TO_CLIENT = 6  # any id ≥ NUM_TYPES; routing is by dst ≥ N
 
     PERIODIC_ROWS = 1  # garbage collection
+    MONITORED = True
+    # Basic's executor applies commits in arrival order and guarantees
+    # no cross-process order, so only the exactly-once counters are
+    # checked (all executions share monitor key 0)
+    MONITOR_ORDER = False
 
     # -- host-side builders -------------------------------------------
 
@@ -163,6 +169,10 @@ def _apply_commit(ps, src, seq, me, do, ob, ob_slot, dims):
     the coordinator, report back to the waiting client. ``do`` masks the
     whole operation (commit may be buffered awaiting the payload)."""
     expected = oh_get(ps["committed_cnt"], src) + 1
+    # safety monitor (engine/monitor.py; the ``if`` is a trace-time
+    # gate): count-only, see MONITOR_ORDER above
+    if "_mon_hash" in ps:
+        ps = mon_exec(ps, 0, src, seq, do)
     ps = dict(
         ps,
         err=ps["err"] | ERR_PROTO * (do & (seq != expected)),

@@ -58,6 +58,7 @@ from ..core import (
 from ..dims import ERR_CAPACITY, ERR_DOT, ERR_PROTO, ERR_SEQ, INF, SEQ_BOUND, EngineDims, dot_slot
 from .identity import DevIdentity
 from ..iset import iset_add, iset_contains_gathered
+from ..monitor import mon_exec
 
 
 # statuses (caesar.rs Status; PROPOSE_BEGIN is transient host-side only)
@@ -84,6 +85,7 @@ class CaesarDev(DevIdentity):
     TO_CLIENT = 11
 
     PERIODIC_ROWS = 2  # [garbage collection, executed notification]
+    MONITORED = True  # mon_exec hook at the predecessors-executor scan
 
     def __init__(
         self,
@@ -632,6 +634,13 @@ def _exec_scan(dev, ps, me, ctx, dims, ob, client_slot, chain_slot,
     client = oh_get(oh_get(ps["client_of"], esrc), eslot)
 
     do = jnp.asarray(enable, bool) & (num > 0)
+    # safety monitor (engine/monitor.py; the ``if`` is a trace-time
+    # gate). Caesar keeps no committed interval set independent of
+    # the status table that gates this scan, so the execute-before-
+    # commit guard stays off here (docs/MC.md).
+    if "_mon_hash" in ps:
+        ekey = oh_get(oh_get(ps["key_of"], esrc), eslot)
+        ps = mon_exec(ps, ekey, esrc, eseq, do)
     front, gaps, overflow = iset_add(
         oh_get(ps["ex_front"], esrc), oh_get(ps["ex_gaps"], esrc), eseq, do
     )

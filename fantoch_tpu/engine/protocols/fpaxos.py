@@ -35,6 +35,7 @@ from ..core import (
     I32, emit, emit_broadcast, empty_outbox, oh_get, oh_set,
 )
 from ..dims import ERR_DOT, ERR_PROTO, INF, EngineDims, dot_slot
+from ..monitor import mon_exec
 from .identity import DevIdentity
 
 
@@ -49,6 +50,7 @@ class FPaxosDev(DevIdentity):
     TO_CLIENT = 7
 
     PERIODIC_ROWS = 1  # garbage collection
+    MONITORED = True  # mon_exec hook at the slot executor's frontier
 
     # -- host-side builders -------------------------------------------
 
@@ -277,6 +279,15 @@ def _mchosen(ps, msg, me, ctx, dims):
     process reports the result (executor/slot.rs:17-69)."""
     slot, client = msg["payload"][0], msg["payload"][1]
     in_order = slot == ps["exec_frontier"] + 1
+    # safety monitor (engine/monitor.py; the ``if`` is a trace-time
+    # gate). FPaxos executes ONE total order — every process applies
+    # every slot in slot order — so all executions hash into monitor
+    # key 0: equal counts mean the same slot prefix, and any stream
+    # divergence diverges the rolling hash. Commands are identified by
+    # slot (src 0); the per-key split other protocols need carries no
+    # extra information here.
+    if "_mon_hash" in ps:
+        ps = mon_exec(ps, 0, 0, slot, in_order)
     ps = dict(
         ps,
         err=ps["err"] | ERR_PROTO * ~in_order,

@@ -72,7 +72,8 @@ from ..core import (
 )
 from ..dims import ERR_CAPACITY, ERR_DOT, ERR_PROTO, ERR_SEQ, INF, SEQ_BOUND, EngineDims, dot_slot
 from .identity import DevIdentity
-from ..iset import iset_add, iset_contains_gathered
+from ..iset import iset_add, iset_contains, iset_contains_gathered
+from ..monitor import mon_exec
 
 
 class _DepDev(DevIdentity):
@@ -91,6 +92,7 @@ class _DepDev(DevIdentity):
     TO_CLIENT = 9
 
     PERIODIC_ROWS = 1  # garbage collection
+    MONITORED = True  # mon_exec hook at the graph executor's drain
 
     def __init__(self, keys: int, gap_slots: int = 8):
         self.K = keys
@@ -407,6 +409,20 @@ def _drain(dev, ps, me, ctx, dims, ob, exec_slot, drain_slot, enable=True):
     client = oh_get(oh_get(ps["vx_client"], esrc), eslot)
 
     do = jnp.asarray(enable, bool) & (num_ok > 0)
+    # safety monitor (engine/monitor.py; the ``if`` is a trace-time
+    # gate): the execute-before-commit guard checks the GC
+    # committed-clock record, an independent data path from the vertex
+    # store's committed flags
+    if "_mon_hash" in ps:
+        ekey = oh_get(oh_get(ps["vx_key"], esrc), eslot)
+        ps = mon_exec(
+            ps, ekey, esrc, eseq, do,
+            premature=~iset_contains(
+                oh_get(ps["comm_front"], esrc),
+                oh_get(ps["comm_gaps"], esrc),
+                eseq,
+            ),
+        )
     front, gaps, overflow = iset_add(
         oh_get(ps["exec_front"], esrc), oh_get(ps["exec_gaps"], esrc),
         eseq, do,

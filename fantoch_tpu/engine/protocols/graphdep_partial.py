@@ -85,6 +85,10 @@ class AtlasPartialDev(AtlasDev):
     TO_CLIENT = 15
 
     PERIODIC_ROWS = 2  # [garbage collection, executor cleanup]
+    # the partial twin's handlers don't carry the safety-monitor hooks
+    # (fuzzing is single-shard, like fault plans) — don't inherit the
+    # base class's capability flag
+    MONITORED = False
 
     def __init__(
         self,

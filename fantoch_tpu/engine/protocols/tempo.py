@@ -50,7 +50,8 @@ from ..dims import (
     dot_slot,
 )
 from .identity import DevIdentity
-from ..iset import iset_add, iset_add_range
+from ..iset import iset_add, iset_add_range, iset_contains
+from ..monitor import mon_exec
 
 
 
@@ -69,6 +70,7 @@ class TempoDev(DevIdentity):
     TO_CLIENT = 11
 
     PERIODIC_ROWS = 3  # [garbage collection, clock bump, send detached]
+    MONITORED = True  # mon_exec hook at the table executor's drain
 
     def __init__(
         self,
@@ -419,6 +421,22 @@ def _drain(tempo, ps, key, me, ctx, dims, ob, exec_slot, drain_slot,
 
     do = jnp.asarray(enable, bool) & (num_ready > 0)
     client = oh_get(oh_get(ps["pend_client"], key), idx)
+    # safety monitor (engine/monitor.py; the ``if`` is a trace-time
+    # gate — fuzz-disabled sweeps trace zero monitor ops): record the
+    # execution on this key; the execute-before-commit guard checks
+    # the GC committed-clock record — a data path independent of the
+    # pending table that fed this drain
+    if "_mon_hash" in ps:
+        e_src = oh_get(oh_get(ps["pend_src"], key), idx)
+        e_seq = oh_get(oh_get(ps["pend_seq"], key), idx)
+        ps = mon_exec(
+            ps, key, e_src, e_seq, do,
+            premature=~iset_contains(
+                oh_get(ps["comm_front"], e_src),
+                oh_get(ps["comm_gaps"], e_src),
+                e_seq,
+            ),
+        )
     ps = dict(
         ps,
         pend_clock=oh_set2(

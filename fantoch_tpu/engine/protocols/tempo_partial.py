@@ -76,6 +76,10 @@ class TempoPartialDev(TempoDev):
     TO_CLIENT = 16
 
     PERIODIC_ROWS = 3
+    # the partial twin's handlers don't carry the safety-monitor hooks
+    # (fuzzing is single-shard, like fault plans) — don't inherit the
+    # base class's capability flag
+    MONITORED = False
 
     def __init__(
         self,

@@ -12,6 +12,7 @@ import numpy as np
 
 from ..core.metrics import Histogram
 from .dims import INF, EngineDims, err_names
+from .monitor import viol_names
 from .spec import LaneSpec
 
 
@@ -36,10 +37,18 @@ class LaneResults:
     # fault-free lanes) and messages lost to windows/drops
     faults: "dict | None" = None
     dropped: int = 0
+    # safety-monitor outputs (engine/monitor.py; monitored runs only):
+    # violation bitmask (VIOL_*) and the first violating engine step
+    violation: int = 0
+    violation_step: int = INF
 
     @property
     def err_cause(self) -> str:
         return err_names(self.err)
+
+    @property
+    def violation_cause(self) -> str:
+        return viol_names(self.violation)
 
     def latency_mean(self, region: str) -> float:
         row = self.region_rows.index(region)
@@ -86,6 +95,13 @@ def collect_results(
                     int(st["fault_dropped"][lane])
                     if "fault_dropped" in st
                     else 0
+                ),
+                violation=(
+                    int(st["viol"][lane]) if "viol" in st else 0
+                ),
+                violation_step=(
+                    int(st["viol_step"][lane]) if "viol_step" in st
+                    else INF
                 ),
             )
         )

@@ -1,14 +1,44 @@
-"""Model checking for protocol implementations.
+"""Model checking for protocol implementations — two explorers.
 
 The analog of ``fantoch_mc`` — the reference adapts ``Protocol`` to a
 stateright ``Actor`` but its init/next logic is commented out
-(fantoch_mc/src/lib.rs:84-238, excluded from the workspace); this
-module is a working explicit-state explorer over the same host
-``Protocol`` interface: it enumerates message-delivery interleavings
-exhaustively (depth-first, bounded) and checks safety properties on
-every reachable quiescent state.
+(fantoch_mc/src/lib.rs:84-238, excluded from the workspace). Here both
+halves of the state-space-exploration trade-off are working code:
+
+* :class:`ModelChecker` (``checker.py``) — bounded *exhaustive*
+  explicit-state exploration over the host ``Protocol`` interface: it
+  enumerates message-delivery interleavings depth-first over a tiny
+  workload and checks agreement/exactly-once/progress on every
+  reachable quiescent state;
+* the *fuzzer* (``fuzz.py`` + ``shrink.py``) — device-scale
+  *stochastic* exploration: thousands of independently perturbed
+  schedules of a real closed-loop workload advance in lockstep on the
+  batched engine with safety monitors compiled into the vmapped step
+  (``engine/monitor.py``); flagged schedules replay through the host
+  oracle for confirmation and shrink to minimal, replayable repro
+  artifacts (``python -m fantoch_tpu mc``; semantics in docs/MC.md).
 """
 
 from .checker import CheckResult, ModelChecker
 
-__all__ = ["CheckResult", "ModelChecker"]
+# the fuzzer pulls in jax and the whole device engine; re-export it
+# lazily so host-only consumers of the bounded checker don't pay jax
+# startup (or accidental backend init) at package-import time
+_FUZZ_EXPORTS = (
+    "FuzzPointResult",
+    "FuzzSpec",
+    "host_check",
+    "load_artifact",
+    "replay_artifact",
+    "run_fuzz_point",
+)
+
+__all__ = ["CheckResult", "ModelChecker", *_FUZZ_EXPORTS]
+
+
+def __getattr__(name):
+    if name in _FUZZ_EXPORTS:
+        from . import fuzz
+
+        return getattr(fuzz, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

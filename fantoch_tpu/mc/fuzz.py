@@ -1,0 +1,622 @@
+"""Device-scale stochastic model checking: schedule fuzzing.
+
+The bounded explorer (``checker.py``) walks every interleaving of a
+2-client workload — exhaustive but tiny. This module drives the other
+end of the spectrum: thousands of *randomly perturbed* schedules of a
+real closed-loop workload advance in lockstep on the batched device
+engine with safety monitors compiled into the step function
+(``engine/monitor.py``) — randomized schedule exploration with cheap
+per-schedule safety checks finds ordering bugs with high probability
+(PCT, Burckhardt et al. ASPLOS'10) and is embarrassingly batchable,
+exactly the shape the TPU sweep engine was built for.
+
+Pipeline per (protocol, config) point:
+
+1. a :class:`FuzzSpec` draws one :class:`FaultPlan` per schedule from a
+   root PRNG: always a seeded **jitter** plan (per-message delay
+   multipliers keyed on (src, dst, channel index) — host-replayable,
+   unlike the legacy per-step ``reorder`` draws), plus optional
+   threefry **drop masks** and **crash plans** kept within the
+   protocol's ``min_live`` bound;
+2. the whole batch runs through ``parallel.run_sweep`` with
+   ``monitor_keys`` set — a million-schedule run returns two scalars
+   per lane (violation bitmask + first violating step);
+3. every flagged lane **replays through the host oracle**
+   (``sim/runner.py`` + the ``DeviceStream`` workload + the identical
+   fault plan — the differential machinery that already holds the
+   engine bit-exact on faulty schedules) to confirm against the
+   reference implementation's execution monitors;
+4. confirmed violations **shrink** (``shrink.py``) to a minimal
+   explicit perturbation set, serialized as a JSON repro artifact that
+   ``python -m fantoch_tpu mc --replay <artifact>`` re-executes
+   deterministically.
+
+``TempoStabilityBug``/``TempoStabilityBugDev`` are deliberately broken
+twins (stability threshold off by one — the executor counts one voter
+too few before declaring a timestamp stable, so a command can execute
+before every lower-timestamp conflict is known) used by the regression
+test and CI smoke job to prove the whole pipeline catches, confirms
+and shrinks a real ordering bug; see docs/MC.md.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..client import DeviceStream, Workload
+from ..core.config import Config
+from ..core.planet import Planet
+from ..engine import EngineDims, FaultPlan, make_lane
+from ..engine.dims import INF
+from ..engine.faults import unavailable
+from ..engine.monitor import VIOL_MISSING, viol_names
+from ..engine.protocols import dev_config_kwargs, dev_protocol
+from ..engine.protocols.tempo import TempoDev
+from ..executor.table import TableExecutor
+from ..parallel.sweep import run_sweep
+from ..protocol import BY_NAME as ORACLES
+from ..protocol import Tempo
+from ..sim import Runner
+from .shrink import (
+    ARTIFACT_KIND,
+    RecordingPlan,
+    ShrinkResult,
+    artifact as make_artifact,
+    shrink as shrink_plan,
+)
+
+# host replays of lossless plans still get a horizon: a genuinely buggy
+# protocol can deadlock the oracle loop (a client that never completes
+# keeps periodic events flowing forever); beyond the lane's natural end
+# the horizon is behaviorally inert
+REPLAY_HORIZON_MS = 600_000
+
+
+# ----------------------------------------------------------------------
+# deliberately broken twins (regression tests / CI smoke / --inject-bug)
+# ----------------------------------------------------------------------
+
+
+class TempoStabilityBugDev(TempoDev):
+    """Tempo with the executor's stability threshold off by one: the
+    stable clock becomes a higher order statistic of the per-voter
+    frontiers, so one fast voter can make a timestamp "stable" before
+    every lower-timestamp conflicting command is known — under the
+    right message timing two processes execute the same key in
+    different orders. Test-only; never registered in dev_protocol."""
+
+    def lane_ctx(self, config, dims, sorted_idx):
+        ctx = dict(super().lane_ctx(config, dims, sorted_idx))
+        ctx["threshold"] = np.int32(max(int(ctx["threshold"]) - 1, 1))
+        return ctx
+
+
+class _BuggyTableExecutor(TableExecutor):
+    def __init__(self, process_id, shard_id, config, **kw):
+        super().__init__(process_id, shard_id, config, **kw)
+        self.stability_threshold = max(self.stability_threshold - 1, 1)
+
+
+class TempoStabilityBug(Tempo):
+    """Host twin of :class:`TempoStabilityBugDev` (same off-by-one in
+    the table executor), so device-flagged violations of the injected
+    bug host-confirm through the standard differential replay."""
+
+    EXECUTOR = _BuggyTableExecutor
+
+
+# ----------------------------------------------------------------------
+# fuzz specification + perturbation drawing
+# ----------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class FuzzSpec:
+    """One (protocol, config) fuzz point: the workload is fixed, every
+    schedule gets an independently drawn perturbation plan."""
+
+    protocol: str
+    n: int = 3
+    f: int = 1
+    conflict: int = 100
+    pool_size: int = 1
+    clients_per_region: int = 1
+    commands_per_client: int = 5
+    schedules: int = 512
+    seed: int = 0                  # root PRNG key (plans + workload)
+    jitter_max: int = 8            # per-message delay x U{1..jitter_max}
+    crash_share: float = 0.2       # fraction of lanes with crash plans
+    drop_share: float = 0.15       # fraction of lanes with drop masks
+    drop_bp: int = 200             # drop probability (basis points)
+    # lossy lanes end here at the latest; far beyond the workload's
+    # natural completion but small enough that a stalled lane's
+    # periodic-timer grind stays bounded on the CPU mesh
+    drop_horizon_ms: int = 20_000
+    extra_time_ms: int = 0         # 0 = auto (scales with jitter_max)
+    regions: Tuple[str, ...] = ()  # () = first n of the planet
+    aws: bool = False              # AWS planet dataset (else GCP);
+                                   # recorded in artifacts for --replay
+    inject_bug: bool = False       # swap in the broken Tempo twins
+
+    def planet(self) -> Planet:
+        if self.aws:
+            return Planet.from_dataset("latency_aws_2021_02_13")
+        return Planet.new()
+
+    @property
+    def extra_ms(self) -> int:
+        # the post-quiescence drain tail must cover a jittered RTT plus
+        # a few periodic intervals, else correct protocols report
+        # missing executions
+        return self.extra_time_ms or (1000 + 500 * self.jitter_max)
+
+
+def _protocol_pair(spec: FuzzSpec, clients: int):
+    """(device protocol, oracle class) for the spec — the injected-bug
+    twins when asked.
+
+    Device capacity bounds are sized as if for 4x the clients:
+    ``for_load`` tunes pending/detached/gap slots for the reorder
+    perturbation, but fuzz jitter (x jitter_max on every wire hop,
+    stacked with crash quorum degradation) stretches the stability lag
+    further, and fuzz lanes are small enough that the headroom is
+    nearly free. Capacity overflow stays loud either way (ERR_CAPACITY
+    discards the lane), this just keeps correct protocols from
+    spending fuzz budget on discarded lanes."""
+    keys = spec.pool_size + clients
+    sized = max(clients * 4, clients + 8)
+    if spec.inject_bug:
+        assert spec.protocol == "tempo", (
+            "--inject-bug is a Tempo-specific self-check"
+        )
+        return (
+            TempoStabilityBugDev.for_load(keys=keys, clients=sized),
+            TempoStabilityBug,
+        )
+    return dev_protocol(spec.protocol, sized, keys=keys), \
+        ORACLES[spec.protocol]
+
+
+def draw_plans(spec: FuzzSpec, config: Config, protocol) -> List[FaultPlan]:
+    """Per-lane perturbation plans from the root PRNG key: always
+    seeded jitter; a slice of lanes adds threefry drop masks (with the
+    mandatory horizon); another slice adds crash plans that stay within
+    what the protocol tolerates (``min_live`` via ``unavailable``) and
+    never target the leader (a leader crash halts every client —
+    vacuously clean, nothing to check)."""
+    rng = np.random.default_rng(
+        [spec.seed & 0x7FFFFFFF, spec.n, spec.f, spec.conflict]
+    )
+    leader_row = None if config.leader is None else config.leader - 1
+    crashable = [r for r in range(spec.n) if r != leader_row]
+    plans: List[FaultPlan] = []
+    for _ in range(spec.schedules):
+        kw = dict(
+            jitter_max=spec.jitter_max,
+            jitter_seed=int(rng.integers(1 << 31)),
+        )
+        u = rng.random()
+        if u < spec.crash_share and config.f >= 1 and crashable:
+            k = int(rng.integers(1, config.f + 1))
+            rows = rng.choice(
+                crashable, size=min(k, len(crashable)), replace=False
+            )
+            kw["crashes"] = {
+                int(r): int(rng.integers(0, 2000)) for r in rows
+            }
+        elif u < spec.crash_share + spec.drop_share:
+            kw["drop_bp"] = spec.drop_bp
+            kw["drop_seed"] = int(rng.integers(1 << 31))
+            kw["horizon_ms"] = spec.drop_horizon_ms
+        plan = FaultPlan(**kw)
+        if plan.crashes and unavailable(plan, protocol, config):
+            # can only happen for protocols whose min_live exceeds
+            # n - f; fall back to a jitter-only lane
+            plan = FaultPlan(
+                jitter_max=kw["jitter_max"], jitter_seed=kw["jitter_seed"]
+            )
+        plans.append(plan)
+    return plans
+
+
+# ----------------------------------------------------------------------
+# host-oracle confirmation
+# ----------------------------------------------------------------------
+
+
+def _live_pids(plan: Optional[FaultPlan], n: int) -> List[int]:
+    doomed = set() if plan is None else {r + 1 for r in plan.crashes}
+    return [pid for pid in range(1, n + 1) if pid not in doomed]
+
+
+def check_host_monitors(
+    monitors: dict,
+    live_pids: Sequence[int],
+    expected_total: Optional[int],
+    lossless: bool,
+) -> Optional[str]:
+    """The host-side violation check over the oracle's per-process
+    ExecutionOrderMonitors — the reference ``check_monitors`` plus
+    exactly-once, with the same loss gating as the device monitors:
+    order/count comparisons only bind on lossless runs (a dropped
+    commit legitimately skips one process forever)."""
+    orders = {}
+    for pid in live_pids:
+        m = monitors.get(pid)
+        if m is None:
+            return f"process {pid}: no execution monitor"
+        orders[pid] = {k: list(m.get_order(k)) for k in m.keys()}
+    for pid, od in sorted(orders.items()):
+        for key, order in od.items():
+            if len(set(order)) != len(order):
+                return f"process {pid} key {key!r}: duplicate execution"
+    if not lossless:
+        return None
+    pids = sorted(orders)
+    for i, pa in enumerate(pids):
+        for pb in pids[i + 1:]:
+            a, b = orders[pa], orders[pb]
+            for key in sorted(set(a) | set(b), key=str):
+                oa, ob = a.get(key, []), b.get(key, [])
+                m = min(len(oa), len(ob))
+                bad = next(
+                    (x for x in range(m) if oa[x] != ob[x]), None
+                )
+                if bad is not None:
+                    return (
+                        f"execution orders diverge on key {key!r} at "
+                        f"index {bad}: p{pa}={oa[bad]} p{pb}={ob[bad]}"
+                    )
+                if len(oa) != len(ob):
+                    return (
+                        f"key {key!r}: execution counts diverge "
+                        f"(p{pa}={len(oa)} p{pb}={len(ob)})"
+                    )
+    if expected_total is not None:
+        for pid, od in sorted(orders.items()):
+            total = sum(len(v) for v in od.values())
+            if total != expected_total:
+                return (
+                    f"process {pid} executed {total} != "
+                    f"{expected_total} commands"
+                )
+    return None
+
+
+def host_check(
+    spec: FuzzSpec,
+    plan: Optional[FaultPlan],
+    *,
+    planet: Optional[Planet] = None,
+    regions: Optional[Sequence[str]] = None,
+    record: bool = False,
+) -> Tuple[Optional[str], Optional[list]]:
+    """Replay one perturbed schedule through the host oracle and check
+    its execution monitors. Returns (violation | None, recorded wire
+    events when ``record``)."""
+    planet = planet or spec.planet()
+    regions = list(regions or spec.regions or planet.regions()[: spec.n])
+    clients = spec.clients_per_region * len(regions)
+    _, oracle_cls = _protocol_pair(spec, clients)
+    config = Config(
+        **dev_config_kwargs(spec.protocol, spec.n, spec.f)
+    ).with_(executor_monitor_execution_order=True)
+
+    run_plan = plan
+    if run_plan is not None and run_plan.horizon_ms is None:
+        # deadlock guard for buggy protocols; inert past the natural end
+        run_plan = replace(run_plan, horizon_ms=REPLAY_HORIZON_MS)
+    if record and run_plan is not None:
+        run_plan = RecordingPlan.of(run_plan)
+
+    workload = Workload(
+        shard_count=1,
+        key_gen=DeviceStream(
+            conflict_rate=spec.conflict,
+            pool_size=spec.pool_size,
+            seed=spec.seed,
+        ),
+        keys_per_command=1,
+        commands_per_client=spec.commands_per_client,
+        payload_size=0,
+    )
+    runner = Runner(
+        oracle_cls,
+        planet,
+        config,
+        workload,
+        spec.clients_per_region,
+        regions,
+        regions,
+        fault_plan=run_plan,
+    )
+    _metrics, monitors, latencies = runner.run(
+        extra_sim_time_ms=spec.extra_ms
+    )
+
+    lossy = plan is not None and (
+        plan.drop_bp > 0
+        or plan.drop_list
+        or any(
+            w.delay is not None and w.delay >= INF for w in plan.windows
+        )
+    )
+    crashed = plan is not None and bool(plan.crashes)
+    completed = sum(h.count() for _iss, h in latencies.values())
+    expected = (
+        spec.commands_per_client * clients
+        if not lossy and not crashed and completed
+        == spec.commands_per_client * clients
+        else None
+    )
+    violation = check_host_monitors(
+        monitors,
+        _live_pids(plan, spec.n),
+        expected,
+        lossless=not lossy,
+    )
+    events = (
+        list(run_plan.events)
+        if record and isinstance(run_plan, RecordingPlan)
+        else None
+    )
+    return violation, events
+
+
+# ----------------------------------------------------------------------
+# the fuzz driver
+# ----------------------------------------------------------------------
+
+
+@dataclass
+class LaneFinding:
+    """One device-flagged lane and what became of it."""
+
+    lane: int
+    plan: Optional[FaultPlan]
+    violation: int
+    violation_step: int
+    host_violation: Optional[str] = None
+    shrunk: Optional[ShrinkResult] = None
+    artifact: Optional[dict] = None
+
+    @property
+    def violation_cause(self) -> str:
+        return viol_names(self.violation)
+
+    @property
+    def confirmed(self) -> bool:
+        return self.host_violation is not None
+
+
+@dataclass
+class FuzzPointResult:
+    spec: FuzzSpec
+    schedules: int
+    elapsed_s: float
+    schedules_per_sec: float
+    findings: List[LaneFinding] = field(default_factory=list)
+    engine_errors: Dict[str, int] = field(default_factory=dict)
+    flagged: int = 0
+    confirmed: int = 0
+    unprocessed: int = 0  # flagged lanes skipped by the budget guard
+
+    def summary(self) -> dict:
+        return {
+            "protocol": self.spec.protocol,
+            "n": self.spec.n,
+            "f": self.spec.f,
+            "conflict": self.spec.conflict,
+            "schedules": self.schedules,
+            # device fan-out time only (host confirmation/shrink time
+            # is deliberately excluded — this is the benchmarked
+            # fuzz-throughput capability)
+            "fuzz_elapsed_s": round(self.elapsed_s, 2),
+            "schedules_per_sec": round(self.schedules_per_sec, 2),
+            "flagged": self.flagged,
+            "confirmed": self.confirmed,
+            "unprocessed": self.unprocessed,
+            "engine_errors": self.engine_errors,
+            "violations": [
+                {
+                    "lane": f.lane,
+                    "device": f.violation_cause,
+                    "step": f.violation_step,
+                    "host": f.host_violation,
+                    **(
+                        {
+                            "shrunk_to": f.shrunk.size,
+                            "shrink_runs": f.shrunk.runs,
+                        }
+                        if f.shrunk
+                        else {}
+                    ),
+                }
+                for f in self.findings
+            ],
+        }
+
+
+def run_fuzz_point(
+    spec: FuzzSpec,
+    *,
+    planet: Optional[Planet] = None,
+    confirm: bool = True,
+    do_shrink: bool = True,
+    shrink_budget: int = 150,
+    max_confirmations: int = 8,
+    strict_missing: bool = False,
+) -> FuzzPointResult:
+    """Fuzz one (protocol, config) point: fan the schedule batch out on
+    device, then host-confirm and shrink flagged lanes.
+
+    Budget guards: at most ``max_confirmations`` flagged lanes go
+    through the host pipeline (the rest are counted as unprocessed) and
+    each shrink spends at most ``shrink_budget`` host runs.
+    ``strict_missing`` promotes the advisory missing-execution bit to a
+    finding (off by default: an undersized drain tail can leave a
+    correct protocol's executors undrained — docs/MC.md)."""
+    planet = planet or spec.planet()
+    regions = list(spec.regions or planet.regions()[: spec.n])
+    assert len(regions) == spec.n
+    clients = spec.clients_per_region * spec.n
+    dev, _oracle = _protocol_pair(spec, clients)
+    config = Config(**dev_config_kwargs(spec.protocol, spec.n, spec.f))
+    total = spec.commands_per_client * clients
+    dims = EngineDims.for_protocol(
+        dev,
+        n=spec.n,
+        clients=clients,
+        payload=dev.payload_width(spec.n),
+        total_commands=total,
+        dot_slots=total + 1,
+        regions=spec.n,
+    )
+    plans = draw_plans(spec, config, dev)
+    lane_specs = [
+        make_lane(
+            dev,
+            planet,
+            config,
+            conflict_rate=spec.conflict,
+            pool_size=spec.pool_size,
+            commands_per_client=spec.commands_per_client,
+            clients_per_region=spec.clients_per_region,
+            process_regions=regions,
+            client_regions=regions,
+            dims=dims,
+            extra_time_ms=spec.extra_ms,
+            seed=spec.seed,
+            faults=plan,
+        )
+        for plan in plans
+    ]
+    t0 = time.perf_counter()
+    results = run_sweep(
+        dev, dims, lane_specs, monitor_keys=spec.pool_size + clients
+    )
+    elapsed = time.perf_counter() - t0
+
+    out = FuzzPointResult(
+        spec=spec,
+        schedules=len(lane_specs),
+        elapsed_s=elapsed,
+        schedules_per_sec=len(lane_specs) / max(elapsed, 1e-9),
+    )
+    for r in results:
+        if r.err:
+            out.engine_errors[r.err_cause] = (
+                out.engine_errors.get(r.err_cause, 0) + 1
+            )
+    mask = ~0 if strict_missing else ~VIOL_MISSING
+    flagged = [
+        (i, r) for i, r in enumerate(results) if (r.violation & mask)
+    ]
+    out.flagged = len(flagged)
+    for i, r in flagged:
+        if len(out.findings) >= max_confirmations:
+            out.unprocessed += 1
+            continue
+        finding = LaneFinding(
+            lane=i,
+            plan=plans[i],
+            violation=r.violation,
+            violation_step=r.violation_step,
+        )
+        if confirm:
+            violation, events = host_check(
+                spec, plans[i], planet=planet, regions=regions,
+                record=True,
+            )
+            finding.host_violation = violation
+            if violation is not None:
+                out.confirmed += 1
+                if do_shrink:
+                    run_plan = plans[i]
+                    if run_plan.horizon_ms is None:
+                        run_plan = replace(
+                            run_plan, horizon_ms=REPLAY_HORIZON_MS
+                        )
+
+                    def check(p, _spec=spec, _planet=planet,
+                              _regions=regions):
+                        return host_check(
+                            _spec, p, planet=_planet, regions=_regions
+                        )[0]
+
+                    finding.shrunk = shrink_plan(
+                        run_plan, events or [], check,
+                        budget=shrink_budget,
+                    )
+                    if finding.shrunk is not None:
+                        finding.artifact = make_artifact(
+                            finding.shrunk,
+                            protocol=spec.protocol,
+                            n=spec.n,
+                            f=spec.f,
+                            conflict=spec.conflict,
+                            pool_size=spec.pool_size,
+                            clients_per_region=spec.clients_per_region,
+                            commands_per_client=spec.commands_per_client,
+                            regions=regions,
+                            workload_seed=spec.seed,
+                            extra_time_ms=spec.extra_ms,
+                            inject_bug=spec.inject_bug,
+                            aws=spec.aws,
+                            device={
+                                "lane": i,
+                                "violation": r.violation,
+                                "violation_step": r.violation_step,
+                            },
+                        )
+        out.findings.append(finding)
+    return out
+
+
+# ----------------------------------------------------------------------
+# repro-artifact replay (cli.py mc --replay)
+# ----------------------------------------------------------------------
+
+
+def replay_artifact(obj: dict, planet: Optional[Planet] = None) -> dict:
+    """Re-execute a shrunk repro artifact through the host oracle and
+    report whether its violation reproduces."""
+    assert obj.get("kind") == ARTIFACT_KIND, "not a fuzz repro artifact"
+    spec = FuzzSpec(
+        protocol=obj["protocol"],
+        n=int(obj["n"]),
+        f=int(obj["f"]),
+        conflict=int(obj["conflict"]),
+        pool_size=int(obj["pool_size"]),
+        clients_per_region=int(obj["clients_per_region"]),
+        commands_per_client=int(obj["commands_per_client"]),
+        seed=int(obj["workload_seed"]),
+        extra_time_ms=int(obj["extra_time_ms"]),
+        regions=tuple(obj["regions"]),
+        aws=bool(obj.get("aws", False)),
+        inject_bug=bool(obj.get("inject_bug", False)),
+    )
+    plan = FaultPlan.from_json(obj["perturbations"])
+    violation, _ = host_check(
+        spec, plan, planet=planet, regions=spec.regions
+    )
+    return {
+        # shrinking preserves "some violation", not a specific one
+        # (docs/MC.md) — reproduced means a violation occurred;
+        # matches_expected reports whether it is the recorded string
+        "reproduced": violation is not None,
+        "matches_expected": violation == obj.get("violation"),
+        "violation": violation,
+        "expected": obj.get("violation"),
+        "perturbation_count": obj.get("perturbation_count"),
+    }
+
+
+def load_artifact(path: str) -> dict:
+    with open(path) as fh:
+        return json.load(fh)

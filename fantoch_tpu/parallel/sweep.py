@@ -100,16 +100,18 @@ def _cached_key_table(C: int, T: int):
 
 @functools.lru_cache(maxsize=None)
 def _cached_runner(protocol, dims: EngineDims, max_steps: int,
-                   reorder: bool, faults):
+                   reorder: bool, faults, monitor_keys: int = 0):
     """One compiled segmented runner per (protocol value, dims,
-    max_steps, fault flags): ``build_segment_runner`` returns fresh
-    ``jax.jit`` closures, so without the cache every ``run_sweep`` call
-    would retrace and recompile. Device protocols have value identity
-    (protocols/identity.py), so fresh instances with equal shape bounds
-    share one compiled runner; a batch mixing fault-free and faulty
-    lanes shares one too (its flags are the union)."""
+    max_steps, fault flags, monitor capacity): ``build_segment_runner``
+    returns fresh ``jax.jit`` closures, so without the cache every
+    ``run_sweep`` call would retrace and recompile. Device protocols
+    have value identity (protocols/identity.py), so fresh instances
+    with equal shape bounds share one compiled runner; a batch mixing
+    fault-free and faulty lanes shares one too (its flags are the
+    union). ``monitor_keys`` is part of the key — a monitored fuzz
+    runner never aliases an unmonitored sweep runner."""
     return build_segment_runner(protocol, dims, max_steps, reorder,
-                                faults)
+                                faults, monitor_keys)
 
 
 def run_sweep(
@@ -119,11 +121,15 @@ def run_sweep(
     mesh: Optional[Mesh] = None,
     max_steps: int = 1 << 22,
     segment_steps: int = 8192,
+    monitor_keys: int = 0,
 ) -> List[LaneResults]:
     """Run a sweep batch, sharded over ``mesh`` (default: all local
     devices on one axis). The device loop runs in ``segment_steps``
     increments with host-side resume, keeping each device execution
-    bounded (tunneled workers die on multi-minute single calls)."""
+    bounded (tunneled workers die on multi-minute single calls).
+    ``monitor_keys > 0`` compiles the on-device safety monitors in
+    (engine/monitor.py) and surfaces per-lane violation bitmasks
+    through ``LaneResults`` — the schedule-fuzzing subsystem's path."""
     import os
     import time as _t
 
@@ -160,7 +166,10 @@ def run_sweep(
         first = lambda i: first_keys[i, :, 1]
     mark("key_table")
     states = [
-        init_lane_state(protocol, dims, s.ctx, first_keys=first(i))
+        init_lane_state(
+            protocol, dims, s.ctx, first_keys=first(i),
+            monitor_keys=monitor_keys,
+        )
         for i, s in enumerate(padded)
     ]
     state = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *states)
@@ -172,7 +181,7 @@ def run_sweep(
     )
     runner, alive = _cached_runner(
         protocol, dims, max_steps, batch_reorder_flag(padded),
-        batch_fault_flags(padded),
+        batch_fault_flags(padded), monitor_keys,
     )
     state = put(state)
     ctx = put(ctx)
@@ -201,6 +210,11 @@ def run_sweep(
             k: v for k, v in state["ps"].items() if k.startswith("m_")
         },
     }
+    if monitor_keys:
+        # the monitor reduction already ran on device: two scalars per
+        # lane ride home instead of [N, K] hash/count planes
+        fetch["viol"] = state["viol"]
+        fetch["viol_step"] = state["viol_step"]
     final = finish_segmented(jax.device_get(fetch), max_steps)
     mark("device_get")
     out = collect_results(protocol, dims, final, padded)[: len(specs)]

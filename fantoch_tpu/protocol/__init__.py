@@ -22,3 +22,16 @@ from .caesar import Caesar
 from .epaxos import EPaxos
 from .fpaxos import FPaxos
 from .tempo import Tempo, TempoAtomic
+
+# the one protocol-name -> host (oracle) class table; the CLI and the
+# schedule fuzzer both resolve through here so a new protocol is one
+# registration, not a drift hazard across hand-maintained copies
+BY_NAME = {
+    "basic": Basic,
+    "fpaxos": FPaxos,
+    "tempo": Tempo,
+    "tempo_atomic": TempoAtomic,
+    "atlas": Atlas,
+    "epaxos": EPaxos,
+    "caesar": Caesar,
+}

@@ -96,6 +96,7 @@ class Runner:
         self._fault = fault_plan
         self._crash_ms: Dict[int, int] = {}
         self._drop_table = None
+        self._jitter_table = None
         self._horizon: Optional[int] = None
         doomed_pids: set = set()
         if fault_plan is not None:
@@ -108,6 +109,11 @@ class Runner:
             doomed_pids = set(self._crash_ms)
             if fault_plan.drop_bp:
                 self._drop_table = fault_plan.drop_table(config.n)
+            if fault_plan.jitter_max > 1:
+                # seeded schedule jitter: the same (src, dst, channel
+                # emission index)-keyed multipliers the device draws
+                # in-loop (engine/faults.py jitter_draw)
+                self._jitter_table = fault_plan.jitter_table(config.n)
             self._horizon = fault_plan.horizon_ms
 
         self.planet = planet
@@ -553,6 +559,7 @@ class Runner:
                 distance,
                 chan_seq,
                 self._drop_table,
+                self._jitter_table,
             )
             if lost:
                 return

@@ -1,0 +1,147 @@
+"""Direct unit tests for the fixed-shape interval sets
+(fantoch_tpu/engine/iset.py) — previously exercised only indirectly
+through the engine differential suites: insert/merge/contains edge
+cases including full-range and adjacent-range coalescing, overflow
+flagging, and the gathered-membership equivalence."""
+
+import numpy as np
+
+from fantoch_tpu.engine.iset import (
+    iset_add,
+    iset_add_range,
+    iset_contains,
+    iset_contains_gathered,
+    iset_empty,
+)
+
+G = 4
+
+
+def as_set(frontier, gaps):
+    """Materialize the set's members (reference semantics)."""
+    out = set(range(1, int(frontier) + 1))
+    for s, e in np.asarray(gaps):
+        if s > 0:
+            out.update(range(int(s), int(e) + 1))
+    return out
+
+
+def test_empty():
+    f, g = iset_empty(G)
+    assert as_set(f, g) == set()
+    assert not bool(iset_contains(f, g, np.int32(1)))
+    assert not bool(iset_contains(f, g, np.int32(0)))
+
+
+def test_frontier_extension_direct():
+    f, g = iset_empty(G)
+    f, g, ovf = iset_add_range(f, g, 1, 3)
+    assert not bool(ovf)
+    assert int(f) == 3 and as_set(f, g) == {1, 2, 3}
+
+
+def test_gap_buffer_and_adjacent_coalescing():
+    f, g = iset_empty(G)
+    f, g, _ = iset_add_range(f, g, 1, 2)       # frontier 2
+    f, g, _ = iset_add_range(f, g, 5, 6)       # buffered gap
+    assert int(f) == 2 and as_set(f, g) == {1, 2, 5, 6}
+    # filling 3..4 must absorb the adjacent 5..6 gap into the frontier
+    f, g, _ = iset_add_range(f, g, 3, 4)
+    assert int(f) == 6
+    assert as_set(f, g) == {1, 2, 3, 4, 5, 6}
+    assert np.all(np.asarray(g)[:, 0] == 0), "gap slots must be freed"
+
+
+def test_full_range_coalescing():
+    """One add covering everything at once: frontier jumps in one go."""
+    f, g = iset_empty(G)
+    f, g, ovf = iset_add_range(f, g, 1, 100)
+    assert not bool(ovf) and int(f) == 100
+    assert bool(iset_contains(f, g, np.int32(100)))
+    assert not bool(iset_contains(f, g, np.int32(101)))
+
+
+def test_chained_gap_absorption():
+    """Multiple buffered gaps that all touch once the hole fills must
+    absorb in one add (the statically unrolled absorption pass)."""
+    f, g = iset_empty(G)
+    for s in (3, 5, 7):  # three disjoint single-event gaps
+        f, g, ovf = iset_add(f, g, s)
+        assert not bool(ovf)
+    assert int(f) == 0
+    f, g, _ = iset_add_range(f, g, 1, 2)  # 1..2 + 3 + absorb 5? no: 4 missing
+    assert int(f) == 3 and as_set(f, g) == {1, 2, 3, 5, 7}
+    f, g, _ = iset_add(f, g, 4)  # now 1..5 then 6 missing
+    assert int(f) == 5 and as_set(f, g) == {1, 2, 3, 4, 5, 7}
+    f, g, _ = iset_add(f, g, 6)  # absorbs the last gap: 1..7
+    assert int(f) == 7
+    assert np.all(np.asarray(g)[:, 0] == 0)
+
+
+def test_overlap_union_semantics():
+    f, g = iset_empty(G)
+    f, g, _ = iset_add_range(f, g, 1, 5)
+    f, g, ovf = iset_add_range(f, g, 3, 8)  # overlaps the frontier
+    assert not bool(ovf)
+    assert int(f) == 8
+
+
+def test_enable_false_is_noop():
+    f, g = iset_empty(G)
+    f, g, ovf = iset_add_range(f, g, 1, 5, enable=False)
+    assert not bool(ovf) and int(f) == 0 and as_set(f, g) == set()
+
+
+def test_empty_range_is_noop():
+    f, g = iset_empty(G)
+    f, g, ovf = iset_add_range(f, g, 5, 4)  # end < start
+    assert not bool(ovf) and as_set(f, g) == set()
+
+
+def test_overflow_flagged():
+    f, g = iset_empty(2)
+    f, g, o1 = iset_add(f, g, 3)
+    f, g, o2 = iset_add(f, g, 5)
+    assert not bool(o1) and not bool(o2)
+    f2, g2, o3 = iset_add(f, g, 7)  # third disjoint gap: no slot left
+    assert bool(o3), "overflow must be reported, not silently dropped"
+    # the set itself is unchanged on overflow
+    assert as_set(f2, g2) == as_set(f, g)
+
+
+def test_contains_zero_never_member():
+    f, g = iset_empty(G)
+    f, g, _ = iset_add_range(f, g, 1, 4)
+    assert not bool(iset_contains(f, g, np.int32(0)))
+
+
+def test_contains_gap_members():
+    f, g = iset_empty(G)
+    f, g, _ = iset_add_range(f, g, 4, 6)
+    for x, want in [(1, False), (3, False), (4, True), (6, True), (7, False)]:
+        assert bool(iset_contains(f, g, np.int32(x))) == want, x
+
+
+def test_contains_gathered_matches_contains():
+    """iset_contains_gathered(front[src], gaps[src], x) equivalence over
+    a random per-source population."""
+    rng = np.random.default_rng(7)
+    S = 3
+    fronts = np.zeros((S,), np.int32)
+    gapss = np.zeros((S, G, 2), np.int32)
+    for s in range(S):
+        f, g = iset_empty(G)
+        for _ in range(5):
+            a = int(rng.integers(1, 20))
+            b = a + int(rng.integers(0, 3))
+            f, g, _ = iset_add_range(f, g, a, b)
+        fronts[s] = int(f)
+        gapss[s] = np.asarray(g)
+    src = np.asarray(rng.integers(0, S, size=(16,)), np.int32)
+    x = np.asarray(rng.integers(0, 25, size=(16,)), np.int32)
+    got = np.asarray(iset_contains_gathered(fronts, gapss, src, x))
+    for i in range(16):
+        want = bool(
+            iset_contains(fronts[src[i]], gapss[src[i]], x[i])
+        )
+        assert bool(got[i]) == want, (i, src[i], x[i])

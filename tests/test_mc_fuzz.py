@@ -1,0 +1,356 @@
+"""Schedule-fuzzing subsystem tests (mc/fuzz.py, mc/shrink.py,
+engine/monitor.py).
+
+Fast tier: monitor trace-gating (a fuzz-disabled engine compiles zero
+monitor ops and carries zero monitor state), jitter plan serialization
+and the device/host draw agreement, perturbation drawing invariants,
+and ddmin/artifact unit behavior — no compiled engine runs.
+
+Slow tier (one compiled fuzz runner per protocol variant): the
+injected-bug regression — a deliberately broken Tempo (stability
+threshold off by one) must be caught by the fuzzer within a bounded
+schedule budget, host-confirm, and shrink to a replayable artifact of
+<= 10 perturbations — plus the zero-violation check on correct Tempo
+and bit-exact host replay of jittered device schedules.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from fantoch_tpu.core import Config, Planet
+from fantoch_tpu.engine import EngineDims, FaultPlan, make_lane
+from fantoch_tpu.engine.core import _lane_step, init_lane_state
+from fantoch_tpu.engine.faults import (
+    NO_FAULTS,
+    FaultFlags,
+    fault_ctx,
+    jitter_draw,
+)
+from fantoch_tpu.engine.monitor import (
+    VIOL_ORDER,
+    mon_exec,
+    viol_names,
+)
+from fantoch_tpu.engine.protocols import TempoDev, dev_config_kwargs
+from fantoch_tpu.mc.fuzz import (
+    FuzzSpec,
+    draw_plans,
+    host_check,
+    replay_artifact,
+    run_fuzz_point,
+)
+from fantoch_tpu.mc.shrink import (
+    RecordingPlan,
+    components_plan,
+    ddmin,
+    plan_components,
+)
+
+import jax
+
+
+def _tempo_lane(monitor_keys=0, faults_plan=None):
+    n, clients, commands = 3, 3, 5
+    config = Config(**dev_config_kwargs("tempo", n, 1))
+    planet = Planet.new()
+    regions = planet.regions()[:n]
+    dev = TempoDev.for_load(keys=1 + clients, clients=clients)
+    total = commands * clients
+    dims = EngineDims.for_protocol(
+        dev, n=n, clients=clients, payload=dev.payload_width(n),
+        total_commands=total, dot_slots=total + 1, regions=n,
+    )
+    spec = make_lane(
+        dev, planet, config, conflict_rate=100, pool_size=1,
+        commands_per_client=commands, clients_per_region=1,
+        process_regions=regions, client_regions=regions, dims=dims,
+        faults=faults_plan,
+    )
+    st = init_lane_state(dev, dims, spec.ctx, monitor_keys=monitor_keys)
+    return dev, dims, spec, st
+
+
+# ----------------------------------------------------------------------
+# trace gating: a fuzz-disabled engine pays nothing
+# ----------------------------------------------------------------------
+
+
+def test_monitors_trace_gated_out():
+    """monitor_keys=0 must (a) add no monitor state, (b) trace a step
+    with strictly fewer equations than the monitored step — the
+    step-count regression pinning 'fuzz-disabled sweeps compile the
+    same graph as before'."""
+    dev, dims, spec, st0 = _tempo_lane(monitor_keys=0)
+    assert "mon_hash" not in st0 and "viol" not in st0
+    _, _, _, st1 = _tempo_lane(monitor_keys=4)
+    assert st1["mon_hash"].shape == (dims.N, 4)
+
+    def step(mk):
+        def f(s, c):
+            return _lane_step(dev, dims, s, c, False, NO_FAULTS, mk)
+        return f
+
+    jx0 = jax.make_jaxpr(step(0))(st0, spec.ctx)
+    jx1 = jax.make_jaxpr(step(4))(st1, spec.ctx)
+    n0, n1 = len(jx0.eqns), len(jx1.eqns)
+    assert n0 < n1, (n0, n1)
+    # the disabled step's output state mirrors its input structure —
+    # no monitor leaves appear anywhere in the traced pytree
+    out_tree = jax.eval_shape(step(0), st0, spec.ctx)
+    assert sorted(out_tree.keys()) == sorted(st0.keys())
+
+
+def test_mon_exec_noop_without_monitor_state():
+    """The protocol hooks are free when fuzzing is off: without the
+    merged monitor keys, mon_exec returns its input dict unchanged (the
+    very same object — nothing traced)."""
+    ps = {"clocks": np.zeros((4,), np.int32)}
+    assert mon_exec(ps, 1, 0, 1, True) is ps
+
+
+# ----------------------------------------------------------------------
+# jitter plans: serialization, flags, device/host draw agreement
+# ----------------------------------------------------------------------
+
+
+def test_jitter_plan_flags_and_roundtrip():
+    plan = FaultPlan(jitter_max=8, jitter_seed=5)
+    assert plan.flags == FaultFlags(jitter=True)
+    assert not plan.is_noop() and not plan.host_only()
+    again = FaultPlan.from_json(plan.meta())
+    assert again == plan
+
+    explicit = FaultPlan(
+        jitter_overrides={(0, 1, 7): 5},
+        drop_list=((1, 2, 3),),
+        horizon_ms=1000,
+        crashes={2: 400},
+    )
+    assert explicit.host_only()
+    again = FaultPlan.from_json(explicit.meta())
+    assert again.jitter_overrides == {(0, 1, 7): 5}
+    assert again.drop_list == ((1, 2, 3),)
+    assert again.crashes == {2: 400}
+
+
+def test_host_only_plans_rejected_by_device():
+    explicit = FaultPlan(jitter_overrides={(0, 1, 7): 5})
+
+    class _Dims:
+        N = 3
+
+    with pytest.raises(AssertionError):
+        fault_ctx(explicit, _Dims())
+
+
+def test_explicit_lossy_plan_requires_horizon():
+    with pytest.raises(AssertionError):
+        FaultPlan(drop_list=((0, 1, 2),))  # lossy, no horizon
+
+
+def test_jitter_table_matches_device_draw():
+    """The host oracle's precomputed table and the device's in-loop
+    threefry draw must agree on every (src, dst, channel index)."""
+    plan = FaultPlan(jitter_max=6, jitter_seed=11)
+    table = plan.jitter_table(n=3, kmax=32)
+    assert table.min() >= 1 and table.max() <= 6
+    assert len(np.unique(table)) > 1, "degenerate jitter draws"
+    key = plan.jitter_key()
+    for s, d, k in [(0, 1, 0), (2, 0, 31), (1, 2, 17)]:
+        got = int(jitter_draw(key, s, d, k, 6))
+        assert got == int(table[s, d, k]), (s, d, k)
+
+
+def test_jitter_plan_wire_applies_override_and_droplist():
+    plan = FaultPlan(
+        jitter_overrides={(0, 1, 3): 4},
+        drop_list=((0, 2, 1),),
+        horizon_ms=1000,
+    )
+    delay, lost = plan.wire(0, 1, 10, 50, 3)
+    assert (delay, lost) == (200, False)
+    delay, lost = plan.wire(0, 1, 10, 50, 4)  # un-overridden message
+    assert (delay, lost) == (50, False)
+    _, lost = plan.wire(0, 2, 10, 50, 1)
+    assert lost
+
+
+# ----------------------------------------------------------------------
+# perturbation drawing
+# ----------------------------------------------------------------------
+
+
+def test_draw_plans_deterministic_and_bounded():
+    spec = FuzzSpec(
+        protocol="fpaxos", n=3, f=1, schedules=64, seed=9,
+        crash_share=0.4, drop_share=0.3,
+    )
+    config = Config(**dev_config_kwargs("fpaxos", 3, 1))
+    from fantoch_tpu.engine.protocols import FPaxosDev
+
+    a = draw_plans(spec, config, FPaxosDev)
+    b = draw_plans(spec, config, FPaxosDev)
+    assert a == b, "plans must be a pure function of the root seed"
+    crash = [p for p in a if p.crashes]
+    drops = [p for p in a if p.drop_bp]
+    assert crash and drops, "the mix must include both fault kinds"
+    leader_row = config.leader - 1
+    for p in crash:
+        assert len(p.crashes) <= config.f
+        assert leader_row not in p.crashes, (
+            "crashing the leader halts every client - nothing to check"
+        )
+    for p in drops:
+        assert p.horizon_ms is not None, "lossy plans need a horizon"
+    assert all(p.jitter_max == spec.jitter_max for p in a)
+
+
+# ----------------------------------------------------------------------
+# shrinker units
+# ----------------------------------------------------------------------
+
+
+def test_ddmin_reduces_to_culprit():
+    comps = [("jit", (0, 1, k), 2) for k in range(40)]
+    culprit = ("jit", (2, 0, 99), 7)
+    comps.insert(17, culprit)
+
+    calls = []
+
+    def test_fn(cand):
+        calls.append(len(cand))
+        return "viol" if culprit in cand else None
+
+    minimal, viol, runs = ddmin(comps, test_fn, budget=100)
+    assert minimal == [culprit]
+    assert viol == "viol"
+    assert runs == len(calls) <= 100
+
+
+def test_ddmin_respects_budget():
+    def never(_cand):
+        return None
+
+    comps = [("jit", (0, 1, k), 2) for k in range(64)]
+    minimal, viol, runs = ddmin(comps, never, budget=10)
+    assert runs <= 10 and minimal == comps and viol is None
+
+
+def test_components_roundtrip():
+    plan = FaultPlan(
+        crashes={1: 300}, drop_bp=100, drop_seed=3, horizon_ms=5000,
+        jitter_max=4, jitter_seed=2,
+    )
+    events = [
+        ("jit", (0, 1, 5), 3),
+        ("drop", (2, 0, 9), None),
+        ("jit", (0, 1, 5), 3),  # duplicates collapse
+    ]
+    comps = plan_components(plan, events)
+    assert comps == [
+        ("crash", 1, 300),
+        ("jit", (0, 1, 5), 3),
+        ("drop", (2, 0, 9), None),
+    ]
+    explicit = components_plan(comps, plan.horizon_ms)
+    assert explicit.crashes == {1: 300}
+    assert explicit.jitter_overrides == {(0, 1, 5): 3}
+    assert explicit.drop_list == ((2, 0, 9),)
+    assert explicit.horizon_ms == 5000
+    assert explicit.host_only() and explicit.jitter_max == 0
+
+
+def test_recording_plan_records_wire_events():
+    plan = RecordingPlan.of(
+        FaultPlan(jitter_overrides={(0, 1, 3): 4}, horizon_ms=1000)
+    )
+    plan.wire(0, 1, 10, 50, 3)
+    plan.wire(0, 1, 10, 50, 4)  # identity multiplier: not an event
+    assert plan.events == [("jit", (0, 1, 3), 4)]
+
+
+# ----------------------------------------------------------------------
+# the device pipeline (slow tier: compiled fuzz runners)
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_fuzzer_catches_injected_stability_bug():
+    """Regression pin for the monitors: Tempo with the stability
+    threshold off by one MUST be caught within a small schedule budget,
+    host-confirm through the buggy oracle twin, and shrink to a repro
+    artifact of <= 10 perturbations that replays deterministically."""
+    spec = FuzzSpec(
+        protocol="tempo", n=3, f=1, schedules=8,
+        commands_per_client=5, seed=3, inject_bug=True,
+        crash_share=0.0, drop_share=0.0,
+    )
+    res = run_fuzz_point(spec, max_confirmations=1, shrink_budget=80)
+    assert res.flagged >= 1, "monitors must catch the injected bug"
+    assert res.confirmed >= 1, [
+        (f.violation_cause, f.host_violation) for f in res.findings
+    ]
+    confirmed = [f for f in res.findings if f.confirmed]
+    assert confirmed[0].violation & VIOL_ORDER, viol_names(
+        confirmed[0].violation
+    )
+    shrunk = confirmed[0].shrunk
+    assert shrunk is not None, "confirmed violations must shrink"
+    assert shrunk.size <= 10, (shrunk.size, shrunk.components)
+    art = confirmed[0].artifact
+    assert art is not None
+    # artifacts survive JSON and replay deterministically
+    art = json.loads(json.dumps(art))
+    rep = replay_artifact(art)
+    assert rep["reproduced"], rep
+
+
+@pytest.mark.slow
+def test_fuzz_correct_tempo_no_violations_and_host_exact():
+    """Correct Tempo over mixed jitter/crash/drop lanes: zero device
+    flags, zero engine errors on non-lossy lanes, and the jitter-only
+    lanes' latency results replay bit-exact through the host oracle
+    (the confirmation leg of the differential contract)."""
+    spec = FuzzSpec(
+        protocol="tempo", n=3, f=1, schedules=12,
+        commands_per_client=5, seed=5,
+        crash_share=0.25, drop_share=0.25,
+    )
+    planet = Planet.new()
+    res = run_fuzz_point(spec, planet=planet, confirm=False)
+    assert res.flagged == 0, res.summary()
+    bad = {
+        k: v for k, v in res.engine_errors.items()
+        if k not in ("requeue-livelock",)  # legitimate under drops
+    }
+    assert not bad, res.engine_errors
+
+    # host-replay two jitter-only lanes bit-exact: the identical fault
+    # plan drives the identical perturbed schedule on both sides
+    from fantoch_tpu.mc.fuzz import draw_plans as _dp
+    from fantoch_tpu.engine.protocols import dev_protocol
+
+    config = Config(**dev_config_kwargs("tempo", 3, 1))
+    dev = dev_protocol("tempo", 3, keys=4)
+    plans = _dp(spec, config, dev)
+    jitter_only = [p for p in plans if not p.crashes and not p.drop_bp]
+    assert jitter_only, "mix must contain jitter-only lanes"
+    for plan in jitter_only[:2]:
+        violation, _ = host_check(spec, plan, planet=planet)
+        assert violation is None, violation
+
+
+@pytest.mark.slow
+def test_fuzz_basic_count_monitoring():
+    """Basic (order monitoring off — its executor guarantees none):
+    the exactly-once counters still run clean across a jittered batch."""
+    spec = FuzzSpec(
+        protocol="basic", n=3, f=1, schedules=6,
+        commands_per_client=5, seed=1,
+        crash_share=0.0, drop_share=0.0,
+    )
+    res = run_fuzz_point(spec, confirm=False)
+    assert res.flagged == 0, res.summary()
+    assert not res.engine_errors, res.engine_errors
