@@ -20,13 +20,14 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import List
 
 from .core.config import Config
 from .core.planet import Planet
+from .registry import DEV_PROTOCOLS as ENGINE_PROTOCOLS
 
-ENGINE_PROTOCOLS = ("basic", "fpaxos", "tempo", "atlas", "epaxos", "caesar")
 # host-oracle-only variants (sim/proc): the tempo_atomic binary analog
 ORACLE_PROTOCOLS = ENGINE_PROTOCOLS + ("tempo_atomic",)
 
@@ -449,6 +450,79 @@ def cmd_mc(args) -> None:
     )
 
 
+def cmd_lint(args) -> None:
+    """graft-lint (fantoch_tpu/lint): jaxpr interval audits over every
+    device protocol's step, the structural gating differ, and AST /
+    hook-registry rules. Exits non-zero on any finding not covered by
+    the baseline (docs/LINT.md)."""
+    from .lint import (
+        DEFAULT_BASELINE,
+        load_baseline,
+        run_lint,
+        write_baseline,
+    )
+
+    protocols = args.protocols.split(",") if args.protocols else None
+    if protocols:
+        unknown = [p for p in protocols if p not in ENGINE_PROTOCOLS]
+        if unknown:
+            raise SystemExit(
+                f"unknown protocol(s) {unknown}; choose from "
+                f"{','.join(ENGINE_PROTOCOLS)}"
+            )
+
+    report = run_lint(
+        protocols,
+        ast_paths=args.paths or None,
+        jaxpr_audits=not args.no_jaxpr,
+        progress=lambda msg: print(f"lint: {msg}", file=sys.stderr),
+    )
+
+    baseline_path = args.baseline or DEFAULT_BASELINE
+    if args.write_baseline:
+        narrowed = args.no_jaxpr or protocols or args.paths
+        if narrowed and os.path.abspath(baseline_path) == os.path.abspath(
+            DEFAULT_BASELINE
+        ):
+            raise SystemExit(
+                "refusing to overwrite the checked-in baseline from a "
+                "narrowed run (--no-jaxpr/--protocols/--paths drop whole "
+                "audit classes, so the partial counts would turn every "
+                "skipped finding into a CI regression); pass "
+                "--baseline PATH to write elsewhere"
+            )
+        write_baseline(baseline_path, report)
+        print(
+            json.dumps(
+                {
+                    "baseline": baseline_path,
+                    "findings": len(report.findings),
+                    "ids": len(report.counts()),
+                }
+            )
+        )
+        return
+
+    baseline = None
+    if args.baseline is not None:
+        baseline = load_baseline(baseline_path)
+    regressions = report.regressions(baseline)
+    out = {
+        "audits": len(report.audits_run),
+        "findings": len(report.findings),
+        "baselined": len(report.findings) - len(regressions),
+        "regressions": len(regressions),
+        "stale_baseline": report.stale_baseline_ids(baseline),
+    }
+    if args.json:
+        out["detail"] = report.to_json(baseline)
+    for f in regressions:
+        print(f.render(), file=sys.stderr)
+    print(json.dumps(out, indent=2 if args.json else None))
+    if regressions:
+        raise SystemExit(1)
+
+
 def cmd_bote(args) -> None:
     from .bote.search import RankingParams, Search
 
@@ -792,6 +866,33 @@ def main(argv=None) -> None:
     mc.add_argument("--replay", default=None,
                     help="re-execute a repro artifact (host oracle)")
     mc.set_defaults(fn=cmd_mc)
+
+    ln = sub.add_parser(
+        "lint",
+        help="static analysis: jaxpr interval audits + gating differ "
+        "+ AST rules (docs/LINT.md)",
+    )
+    ln.add_argument(
+        "--baseline",
+        nargs="?",
+        const="",
+        default=None,
+        help="suppress baselined findings; optional value overrides "
+        "the checked-in fantoch_tpu/lint/baseline.json path. Without "
+        "this flag EVERY finding fails the run.",
+    )
+    ln.add_argument("--write-baseline", action="store_true",
+                    help="regenerate the baseline from this run")
+    ln.add_argument("--protocols", default=None,
+                    help="comma-separated subset of protocols to audit "
+                    "(default: all)")
+    ln.add_argument("--paths", nargs="*", default=None,
+                    help="override the AST scan set (fixture tests)")
+    ln.add_argument("--no-jaxpr", action="store_true",
+                    help="AST/hook rules only (fast)")
+    ln.add_argument("--json", action="store_true",
+                    help="include full finding detail in the output")
+    ln.set_defaults(fn=cmd_lint)
 
     bt = sub.add_parser("bote", help="closed-form latency config search")
     bt.add_argument("--metric", default="f1", choices=["f1", "f1f2"])

@@ -57,6 +57,7 @@ from .dims import (
     ERR_STUCK,
     ERR_TRUNCATED,
     ERR_UNAVAIL,
+    F32_EXACT,
     INF,
     REQUEUE_LIMIT,
     EngineDims,
@@ -95,11 +96,27 @@ def enable_debug_log(depth: int) -> None:
 _MM_CUMSUM_LIMIT = 4096
 
 
-def cumsum_i32(x):
-    """Inclusive cumsum along the last axis as one f32 matmul (exact:
-    counts are bounded by the axis length « 2^24)."""
+def cumsum_i32(x, bound: "int | None" = None):
+    """Inclusive cumsum along the last axis as one f32 matmul.
+
+    The matmul is exact only while every partial sum stays within the
+    float32-exact integer range (``F32_EXACT`` = 2^24): for bool masks
+    that bound is the axis length. Non-bool inputs must pass ``bound``
+    (a static ceiling on element magnitude) so the exactness check
+    ``m * bound <= F32_EXACT`` can run at trace time — inputs that
+    could exceed it fall back to the stock (multi-kernel) cumsum
+    lowering instead of silently returning rounded sums."""
     m = x.shape[-1]
-    if m > _MM_CUMSUM_LIMIT:
+    if bound is None:
+        if x.dtype != jnp.bool_:
+            raise TypeError(
+                "cumsum_i32 on non-bool input needs an explicit "
+                "`bound` (static max element magnitude) to prove the "
+                "f32 matmul stays integer-exact; got dtype "
+                f"{x.dtype}"
+            )
+        bound = 1
+    if m > _MM_CUMSUM_LIMIT or m * bound > F32_EXACT:
         return jnp.cumsum(x.astype(I32), axis=-1)
     tri = jnp.triu(jnp.ones((m, m), jnp.float32))
     return (x.astype(jnp.float32) @ tri).astype(I32)
@@ -155,6 +172,26 @@ def oh_pack_pairs(pay, lo, a, b):
         axis=0,
         dtype=I32,
     )
+
+
+def oh_match(match, vals):
+    """Select ``vals[i]`` into output position ``j`` where
+    ``match[i, j]`` — for precomputed one-hot pairings (at most one
+    True per column by the caller's contract, e.g. rank-matching the
+    i-th new entry onto the i-th free slot). Columns with no match
+    yield 0."""
+    return jnp.sum(jnp.where(match, vals[:, None], 0), axis=0, dtype=I32)
+
+
+def oh_route(idx, vals, n):
+    """Route ``vals[i]`` to lane ``idx[i]`` of an ``[n]`` output — the
+    fusable inverse of a gather, as a one-hot sum. The ``idx`` entries
+    must be distinct by the caller's contract (out-of-range entries
+    drop); with duplicates the sums would silently merge, so callers
+    route only naturally-unique ids (e.g. one vote range per quorum
+    member)."""
+    oh = idx[:, None] == jnp.arange(n, dtype=I32)[None, :]
+    return jnp.sum(jnp.where(oh, vals[:, None], 0), axis=0, dtype=I32)
 
 
 def oh_take(vec, idxs):
@@ -219,6 +256,25 @@ def emit(outbox, i, dst, mtype, payload, valid=True, delay=-1, src=-1):
         "payload": outbox["payload"].at[i].set(pay),
         "delay": outbox["delay"].at[i].set(jnp.asarray(delay, I32)),
         "src": outbox["src"].at[i].set(jnp.asarray(src, I32)),
+    }
+
+
+def pack_outbox(valid, dst, mtype, payload, delay=None, src=None):
+    """Assemble a whole outbox from bulk row arrays — the third
+    sanctioned emission constructor next to :func:`emit` and
+    :func:`emit_broadcast`, for handlers that build every row with
+    vectorized writes (FPaxos's forward + accept fan-out). Keeping
+    construction inside this module lets the AST lint (docs/LINT.md
+    rule GL101) prove every protocol emission flows through the
+    engine's choke points (fault masks, channel counters)."""
+    f = valid.shape[0]
+    return {
+        "valid": jnp.asarray(valid, bool),
+        "dst": jnp.asarray(dst, I32),
+        "mtype": jnp.asarray(mtype, I32),
+        "payload": jnp.asarray(payload, I32),
+        "delay": jnp.full((f,), -1, I32) if delay is None else delay,
+        "src": jnp.full((f,), -1, I32) if src is None else src,
     }
 
 

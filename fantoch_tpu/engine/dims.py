@@ -16,6 +16,16 @@ from dataclasses import dataclass
 # `INF + delay` cannot wrap
 INF = 1 << 30
 
+# i32 value ceiling: the bound the lint auditor (fantoch_tpu/lint)
+# checks derived interval bounds against — any add/mul/sum chain that
+# can exceed it without a clamp/`where` guard is flagged GL001
+I32_MAX = (1 << 31) - 1
+
+# largest integer magnitude float32 represents exactly; integer sums
+# computed through f32 matmuls (engine/core.py cumsum_i32) must stay
+# at or below this or the result silently rounds
+F32_EXACT = 1 << 24
+
 # dot sequences must stay below this bound so (source, sequence) packs
 # into one i32 for lexicographic argmin scans; protocols flag `err` on a
 # sequence reaching it

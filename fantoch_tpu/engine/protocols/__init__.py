@@ -15,13 +15,20 @@ from .graphdep_partial import AtlasPartialDev
 from .tempo import TempoDev
 from .tempo_partial import TempoPartialDev
 
+# the canonical name lists live in the jax-free fantoch_tpu.registry
+# (the CLI imports them before jax may initialize); re-exported here so
+# engine-side consumers find them next to the constructors they mirror
+from ...registry import DEV_PROTOCOLS, PARTIAL_DEV_PROTOCOLS
+
 __all__ = [
     "AtlasDev",
     "AtlasPartialDev",
     "BasicDev",
     "CaesarDev",
+    "DEV_PROTOCOLS",
     "EPaxosDev",
     "FPaxosDev",
+    "PARTIAL_DEV_PROTOCOLS",
     "TempoDev",
     "TempoPartialDev",
     "dev_protocol",

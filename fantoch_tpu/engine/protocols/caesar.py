@@ -53,7 +53,7 @@ import numpy as np
 
 from ..core import (
     I32, compact_order, emit, emit_broadcast, empty_outbox, oh_get,
-    oh_pack_pairs, oh_set, oh_set2, oh_take,
+    oh_match, oh_pack_pairs, oh_set, oh_set2, oh_take,
 )
 from ..dims import ERR_CAPACITY, ERR_DOT, ERR_PROTO, ERR_SEQ, INF, SEQ_BOUND, EngineDims, dot_slot
 from .identity import DevIdentity
@@ -565,15 +565,7 @@ def _propose_reply(dev, ps, me, wsrc, wslot, wseq, accept, ctx, dims, ob,
     apay = apay.at[3].set(1)
     apay = apay.at[4].set(jnp.sum(my_dep_seq > 0))
     order = 5 + 2 * jnp.arange(dev.DEP, dtype=I32)
-    iota_ap = jnp.arange(dims.P, dtype=I32)
-    oh_o = order[:, None] == iota_ap[None, :]
-    oh_o1 = (order + 1)[:, None] == iota_ap[None, :]
-    apay = apay + jnp.sum(
-        jnp.where(oh_o, my_dep_src[:, None], 0)
-        + jnp.where(oh_o1, my_dep_seq[:, None], 0),
-        axis=0,
-        dtype=I32,
-    )
+    apay = oh_pack_pairs(apay, order, my_dep_src, my_dep_seq)
 
     pay = jnp.where(rej, rpay, apay)
     ps = dict(ps, err=ps["err"] | ERR_CAPACITY * (rej & roverflow))
@@ -625,9 +617,14 @@ def _exec_scan(dev, ps, me, ctx, dims, ob, client_slot, chain_slot,
     num = jnp.sum(ready)
 
     # clock order (phase-two executes in clock order, mod.rs:208-275);
-    # clk_seq * (N+1) + pid stays well under 2^30 for feasible lane
-    # sizes (clk_seq grows by a few per command)
-    packed = ps["clk_seq"] * (dims.N + 1) + ps["clk_pid"]
+    # ERR_SEQ keeps clk_seq < INF // (N + 1), so the packing stays
+    # *strictly* below the INF not-ready sentinel in the argmin — the
+    # min makes that bound structural (GL001); the - 1 matters when
+    # INF divides by N + 1 exactly (a saturated entry must not tie INF)
+    packed = (
+        jnp.minimum(ps["clk_seq"], INF // (dims.N + 1) - 1) * (dims.N + 1)
+        + ps["clk_pid"]
+    )
     flat = jnp.argmin(jnp.where(ready, packed, INF))
     esrc, eslot = flat // dims.D, flat % dims.D
     eseq = oh_get(oh_get(ps["pseq"], esrc), eslot)
@@ -820,7 +817,12 @@ def _mpropose(dev, ps, msg, me, ctx, dims):
         msg["payload"][2],
         msg["payload"][3],
     )
-    cpid = s
+    # clock sequences ride in payload words; the generator enforces
+    # cseq < INF // (N + 1) (ERR_SEQ), so clamping the re-entry to the
+    # strict bound keeps every downstream cseq * (N + 1) + pid packing
+    # wrap-free AND strictly below the INF sentinel on any word (GL001)
+    cseq = jnp.clip(cseq, 0, INF // (dims.N + 1) - 1)
+    cpid = jnp.clip(s, 0, dims.N)
     slot = dot_slot(seq, dims)
     dirty = oh_get(oh_get(ps["pseq"], s), slot) != 0
     ps = dict(
@@ -919,8 +921,8 @@ def _agg_union(dev, ps, slot, pay_base, msg, enable):
         & free[None, :]
     )
     write = jnp.any(match, axis=0)  # [Q] table slots written
-    w_src = jnp.sum(jnp.where(match, dsrcs[:, None], 0), axis=0, dtype=I32)
-    w_seq = jnp.sum(jnp.where(match, dseqs[:, None], 0), axis=0, dtype=I32)
+    w_src = oh_match(match, dsrcs)
+    w_seq = oh_match(match, dseqs)
     overflow = n_new > n_free
     return dict(
         ps,
@@ -960,7 +962,8 @@ def _mproposeack(dev, ps, msg, me, ctx, dims):
     clocks, union deps, and fire fast path (all ok at fq_size) or the
     retry round (some reject once a majority replied)."""
     seq = msg["payload"][0]
-    cseq = msg["payload"][1]
+    # clamped like _mpropose: payload clocks stay packing-safe
+    cseq = jnp.clip(msg["payload"][1], 0, INF // (dims.N + 1) - 1)
     cpid = msg["payload"][2]
     ok = msg["payload"][3] > 0
     slot = dot_slot(seq, dims)
@@ -1036,8 +1039,14 @@ def _store_deps_from_msg(dev, ps, src, slot, msg, base, skip_self, seq,
 
 
 def _update_clock(dev, ps, src, slot, key, new_cseq, new_cpid, enable, dims):
-    """Swap the registered clock (caesar.rs:893-918)."""
+    """Swap the registered clock (caesar.rs:893-918). ``new_cseq`` may
+    ride in from a payload word, so it is clamped to the executor's
+    cseq * (N + 1) + pid packing bound here (lint GL001) — a no-op for
+    every in-contract clock (ERR_SEQ enforces the bound at
+    generation)."""
     do = jnp.asarray(enable, bool)
+    new_cseq = jnp.clip(new_cseq, 0, INF // (dims.N + 1) - 1)
+    new_cpid = jnp.clip(new_cpid, 0, dims.N)
     old_cseq = oh_get(oh_get(ps["clk_seq"], src), slot)
     old_cpid = oh_get(oh_get(ps["clk_pid"], src), slot)
     changed = do & ((old_cseq != new_cseq) | (old_cpid != new_cpid))
@@ -1150,8 +1159,12 @@ def _mretry(dev, ps, msg, me, ctx, dims):
     dup_in_msg = jnp.any(same & earlier, axis=1)
     add = m_en & ~have_already & ~dup_in_msg
     add_order, n_add = compact_order(add, Q)
+    # bound the INF sentinel before the affine packing math: masked
+    # entries pick dims.P below anyway, and 2 * INF would wrap i32
+    # (lint GL001)
+    safe_order = jnp.minimum(add_order, Q)
     lo = jnp.where(
-        add & (nd + add_order < Q), 3 + 2 * (nd + add_order), dims.P
+        add & (nd + add_order < Q), 3 + 2 * (nd + safe_order), dims.P
     )
     pay = oh_pack_pairs(pay, lo, msrcs, mseqs)
     o2 = nd + n_add > Q

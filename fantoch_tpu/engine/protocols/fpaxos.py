@@ -32,7 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import (
-    I32, emit, emit_broadcast, empty_outbox, oh_get, oh_set,
+    I32, emit, emit_broadcast, empty_outbox, oh_get, oh_set, pack_outbox,
 )
 from ..dims import ERR_DOT, ERR_PROTO, INF, EngineDims, dot_slot
 from ..monitor import mon_exec
@@ -214,11 +214,7 @@ def _submit(ps, msg, me, ctx, dims):
     payload = payload.at[1 : N + 1, 1].set(client)
     payload = payload.at[1 : N + 1, 2].set(key)
 
-    return ps, {
-        "valid": valid, "dst": dst, "mtype": mtype, "payload": payload,
-        "delay": jnp.full((valid.shape[0],), -1, I32),
-        "src": jnp.full((valid.shape[0],), -1, I32),
-    }
+    return ps, pack_outbox(valid, dst, mtype, payload)
 
 
 def _maccept(ps, msg, me, ctx, dims):

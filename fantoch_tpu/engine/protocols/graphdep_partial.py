@@ -308,7 +308,10 @@ def _pack_deps(pay, lo_base, src_row, seq_row, km_row, present, limit):
     at ``lo_base``; returns (payload, count)."""
     order, nd = compact_order(present, limit)
     P = pay.shape[0]
-    lo = jnp.where(order < limit, lo_base + 3 * order, P)
+    # bound the INF sentinel before the affine packing math: masked
+    # entries pick P below anyway, and 3 * INF would wrap i32
+    safe_order = jnp.minimum(order, limit)
+    lo = jnp.where(order < limit, lo_base + 3 * safe_order, P)
     iota = jnp.arange(P, dtype=I32)
     oh0 = lo[:, None] == iota[None, :]
     oh1 = (lo + 1)[:, None] == iota[None, :]
@@ -939,7 +942,9 @@ def _g_drain(pp, ps, me, ctx, dims, ob):
         & (dep_seq > 0)
     )
     any_missing = jnp.any(missing)
-    m_packed = dep_src * SEQ_BOUND + dep_seq
+    # dep sources ride in from payload words; clamp before the i32
+    # (src, seq) packing so a corrupt word cannot wrap it (lint GL001)
+    m_packed = jnp.clip(dep_src, 0, dims.N) * SEQ_BOUND + dep_seq
     m_flat = jnp.argmin(jnp.where(missing, m_packed, INF))
     mi = m_flat // (D * missing.shape[2])
     rest = m_flat % (D * missing.shape[2])

@@ -43,7 +43,7 @@ import numpy as np
 
 from ..core import (
     I32, cumsum_i32, emit, emit_broadcast, empty_outbox, oh_get, oh_set,
-    oh_pack_pairs, oh_set2, oh_take,
+    oh_pack_pairs, oh_route, oh_set2, oh_take,
 )
 from ..dims import (
     ERR_CAPACITY, ERR_DOT, ERR_PROTO, ERR_SEQ, INF, SEQ_BOUND, EngineDims,
@@ -269,8 +269,13 @@ class TempoDev(DevIdentity):
         )
         ob = dict(ob, valid=ob["valid"] & fire[0])
 
-        # clock bump: lift every key to max(max commit clock, micros)
-        min_clock = jnp.maximum(ps["max_commit_clock"], now * 1000)
+        # clock bump: lift every key to max(max commit clock, micros).
+        # The micros conversion saturates at INF (lint GL001): past
+        # INF // 1000 simulated ms the i32 multiply would wrap negative
+        # and *lower* every key clock; saturated clocks stay monotone
+        # (the cap sits ~10^3x beyond any sweep the dims admit)
+        micros = jnp.where(now >= INF // 1000, INF, now * 1000)
+        min_clock = jnp.maximum(ps["max_commit_clock"], micros)
         ps = _detached_all(self, ps, min_clock, fire[1])
 
         # send-detached: start the per-key drain chain (the oracle sends
@@ -299,7 +304,7 @@ def _det_add(tempo, ps, key, start, end, enable):
     selects: scatters cost a kernel each on the target runtime."""
     det = ps["det"]  # [K, R, 2]
     krow = jnp.arange(tempo.K, dtype=I32) == key               # [K]
-    row = jnp.sum(jnp.where(krow[:, None, None], det, 0), axis=0)  # [R, 2]
+    row = oh_get(det, key)                                     # [R, 2]
     # compress with an existing contiguous range (votes.rs:131-147)
     touch = (row[:, 0] > 0) & (row[:, 1] + 1 == start)
     can_compress = jnp.any(touch)
@@ -681,7 +686,7 @@ def _commit_broadcast(tempo, ps, me, seq, clock, key, client, ctx, dims,
                       valid):
     """Build the MCommit broadcast carrying the aggregated votes."""
     slot = dot_slot(seq, dims)
-    N, P = dims.N, dims.P
+    P = dims.P
     pay = jnp.zeros((P,), I32)
     pay = pay.at[0].set(me)
     pay = pay.at[1].set(seq)
@@ -701,31 +706,20 @@ def _commit_broadcast(tempo, ps, me, seq, clock, key, client, ctx, dims,
         ).reshape(-1),
         (6,),
     )
-    procs = jnp.arange(N, dtype=I32)
-    F = dims.F
-    v = jnp.zeros((F,), bool).at[:N].set(
-        jnp.asarray(valid, bool) & (procs < ctx["n"])
+    ob = emit_broadcast(
+        empty_outbox(dims), TempoDev.MCOMMIT, pay, ctx["n"]
     )
-    d = jnp.zeros((F,), I32).at[:N].set(procs)
-    m = jnp.zeros((F,), I32).at[:N].set(
-        jnp.full((N,), TempoDev.MCOMMIT, I32)
-    )
-    p = jnp.zeros((F, P), I32).at[:N].set(jnp.broadcast_to(pay, (N, P)))
-    return {
-        "valid": v,
-        "dst": d,
-        "mtype": m,
-        "payload": p,
-        "delay": jnp.full((F,), -1, I32),
-        "src": jnp.full((F,), -1, I32),
-    }
+    return dict(ob, valid=ob["valid"] & jnp.asarray(valid, bool))
 
 
 def _mcommit(tempo, ps, msg, me, ctx, dims):
     """tempo.rs:556-654: detached-bump the committed clock, feed the
     votes table (attached votes + pending entry), record the commit for
     GC, then drain."""
-    dsrc = msg["payload"][0]
+    # the dot source rides in a payload word; clamp it to a process id
+    # so the drain's (src, seq) i32 packing (src * SEQ_BOUND + seq)
+    # cannot wrap on an out-of-range word (lint GL001)
+    dsrc = jnp.clip(msg["payload"][0], 0, dims.N - 1)
     seq = msg["payload"][1]
     clock = msg["payload"][2]
     key = msg["payload"][3]
@@ -757,11 +751,11 @@ def _mcommit(tempo, ps, msg, me, ctx, dims):
     bys = jnp.where(enable, bys, dims.N)
     # voters are distinct, so route (start, end, enable) to per-voter
     # lanes with one-hot sums (each .at[bys].set was a scatter kernel)
-    oh_by = bys[:, None] == jnp.arange(dims.N, dtype=I32)[None, :]
     starts = oh_take(msg["payload"], idxs + 1)
     ends = oh_take(msg["payload"], idxs + 2)
-    per_s = jnp.sum(jnp.where(oh_by, starts[:, None], 0), axis=0)
-    per_e = jnp.sum(jnp.where(oh_by, ends[:, None], 0), axis=0)
+    per_s = oh_route(bys, starts, dims.N)
+    per_e = oh_route(bys, ends, dims.N)
+    oh_by = bys[:, None] == jnp.arange(dims.N, dtype=I32)[None, :]
     per_enable = jnp.any(oh_by & enable[:, None], axis=0)
     fronts, gaps, ovf = jax.vmap(iset_add_range)(
         oh_get(ps["vote_front"], key),

@@ -46,7 +46,7 @@ import numpy as np
 
 from ..core import (
     I32, cumsum_i32, emit, emit_broadcast, empty_outbox, oh_get, oh_set,
-    oh_pack_pairs, oh_set2, oh_take,
+    oh_pack_pairs, oh_route, oh_set2, oh_take,
 )
 from ..dims import (
     ERR_CAPACITY, ERR_DOT, ERR_PROTO, ERR_SEQ, INF, SEQ_BOUND, EngineDims,
@@ -255,7 +255,10 @@ class TempoPartialDev(TempoDev):
         )
         ob = dict(ob, valid=ob["valid"] & fire[0])
 
-        min_clock = jnp.maximum(ps["max_commit_clock"], now * 1000)
+        # micros conversion saturates at INF — an i32 wrap would lower
+        # every key clock (see tempo.py periodic / lint GL001)
+        micros = jnp.where(now >= INF // 1000, INF, now * 1000)
+        min_clock = jnp.maximum(ps["max_commit_clock"], micros)
         ps = _detached_all_p(self, ps, min_clock, fire[1])
 
         has = jnp.any(ps["det"][:, :, 0] > 0)
@@ -843,7 +846,11 @@ def _p_mcommit(pp, ps, msg, me, ctx, dims):
     per-key pending entries, record the commit for GC (own-shard dots
     only — foreign dots free their slot immediately, the gc_single
     path), then kick one drain per key."""
-    dsrc = msg["payload"][0]
+    # the dot source rides in a payload word; clamp it to a process id
+    # so the drain's (src, seq) i32 packing (src * SEQ_BOUND + seq)
+    # cannot wrap on an out-of-range word (lint GL001) — mirrors
+    # tempo._mcommit
+    dsrc = jnp.clip(msg["payload"][0], 0, dims.N - 1)
     dseq = msg["payload"][1]
     clock = msg["payload"][2]
     client = msg["payload"][3]
@@ -883,8 +890,8 @@ def _p_mcommit(pp, ps, msg, me, ctx, dims):
         # voters are distinct: route ranges to per-voter lanes with
         # one-hot sums, then one vmapped interval-set union
         oh_by = bys[:, None] == jnp.arange(N, dtype=I32)[None, :]
-        per_s = jnp.sum(jnp.where(oh_by, starts[:, None], 0), axis=0)
-        per_e = jnp.sum(jnp.where(oh_by, ends[:, None], 0), axis=0)
+        per_s = oh_route(bys, starts, N)
+        per_e = oh_route(bys, ends, N)
         per_en = (
             jnp.any(oh_by & enable_v[:, None], axis=0)
             & (per_s > 0)

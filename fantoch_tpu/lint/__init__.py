@@ -1,0 +1,131 @@
+"""``graft-lint``: static analysis for the device engine's invariants.
+
+Three analysis levels, one driver (``python -m fantoch_tpu.cli lint``):
+
+1. **Jaxpr auditor** (:mod:`.jaxpr`) — traces every device protocol's
+   engine step once with abstract values and runs an interval/width
+   dataflow analysis seeded from the ``EngineDims`` bounds. Rules
+   GL001 (unguarded i32 wrap), GL002 (f32-matmul exactness), GL003
+   (host-sync primitive in the step), GL004 (64-bit promotion leak).
+2. **Structural gating differ** (:mod:`.gating`) — proves the
+   ``monitor_keys=0`` / ``NO_FAULTS`` trace is alpha-equivalent to a
+   feature-stripped trace (GL005), replacing the old raw
+   equation-count pin.
+3. **AST + registry rules** (:mod:`.rules`) — emission choke-point
+   discipline (GL101), protocol ``min_live``/``mon_exec`` hook
+   registration (GL102), Python branching on tracers (GL103), host
+   ops inside traced functions (GL104).
+
+Findings carry stable IDs suppressed by a checked-in baseline
+(``lint/baseline.json``): CI fails only on *regressions* — a finding
+whose ID is absent from the baseline or whose per-ID count grew.
+Rule catalogue, per-rule soundness notes and the suppression workflow
+live in docs/LINT.md.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Sequence
+
+from .report import (
+    DEFAULT_BASELINE,
+    Finding,
+    LintReport,
+    load_baseline,
+    write_baseline,
+)
+
+# audited protocol grid: every full-replication device protocol, the
+# partial-replication twins, and one faulted+monitored tempo variant so
+# the fault/monitor code paths are audited too (they trace extra graph).
+# Imported from the canonical jax-free registry (shared with the CLI
+# grids) so a protocol added there cannot silently miss lint coverage.
+from ..registry import (
+    DEV_PROTOCOLS as FULL_PROTOCOLS,
+    PARTIAL_DEV_PROTOCOLS as PARTIAL_PROTOCOLS,
+)
+
+
+def run_lint(
+    protocols: "Sequence[str] | None" = None,
+    *,
+    ast_paths: "Sequence[str] | None" = None,
+    include_partial: bool = True,
+    include_faulted: bool = True,
+    jaxpr_audits: bool = True,
+    progress=None,
+) -> LintReport:
+    """Run every analysis level; returns a :class:`LintReport`.
+
+    ``protocols`` narrows the jaxpr audits (default: all). ``ast_paths``
+    overrides the AST scan set (the CI fixture test points this at a
+    deliberately broken file)."""
+    from . import rules
+
+    report = LintReport()
+    say = progress or (lambda *_: None)
+
+    t0 = time.perf_counter()
+    say("ast rules ...")
+    report.extend(rules.run_ast_rules(ast_paths))
+    report.audits_run.append("ast")
+
+    say("protocol hook registry ...")
+    report.extend(rules.check_protocol_hooks())
+    report.audits_run.append("hooks")
+
+    if jaxpr_audits:
+        from .gating import check_gating
+        from .jaxpr import audit_trace, build_protocol_trace
+
+        names = list(protocols or FULL_PROTOCOLS)
+        for name in names:
+            say(f"jaxpr audit: {name} ...")
+            trace = build_protocol_trace(name)
+            report.extend(audit_trace(trace))
+            report.extend(check_gating(trace))
+            report.audits_run.append(trace.name)
+
+        if include_partial:
+            for name in PARTIAL_PROTOCOLS:
+                if protocols and name not in protocols:
+                    continue
+                say(f"jaxpr audit: {name} (2 shards) ...")
+                trace = build_protocol_trace(name, shards=2)
+                report.extend(audit_trace(trace))
+                report.extend(check_gating(trace))
+                report.audits_run.append(trace.name)
+
+        if include_faulted and (not protocols or "tempo" in protocols):
+            # one fully-featured variant: jitter+crash+drop plan and
+            # live monitors, so the gated-out code paths get audited
+            from ..engine.faults import FaultPlan
+
+            say("jaxpr audit: tempo (faults + monitors) ...")
+            plan = FaultPlan(
+                crashes={2: 400},
+                drop_bp=100,
+                drop_seed=1,
+                jitter_max=4,
+                jitter_seed=1,
+                horizon_ms=5000,
+            )
+            trace = build_protocol_trace(
+                name="tempo", faults=plan, monitor_keys=4
+            )
+            report.extend(audit_trace(trace))
+            report.audits_run.append(trace.name)
+
+    say(f"lint done in {time.perf_counter() - t0:.1f}s")
+    return report
+
+
+__all__ = [
+    "DEFAULT_BASELINE",
+    "Finding",
+    "LintReport",
+    "load_baseline",
+    "run_lint",
+    "write_baseline",
+]

@@ -1,0 +1,290 @@
+"""Structural gating differ: prove feature-disabled traces are clean.
+
+The fuzz subsystem's whole "zero-cost when off" contract used to be
+pinned by a raw equation count (5355 == 5355). This module replaces the
+pin with a *proof by construction*: re-trace the engine step with every
+monitor entry point and fault draw replaced by stubs that either
+degrade to identity (``mon_exec``) or raise (``merge_mon``,
+``drop_draw``, ...), then check the stripped trace is **alpha-
+equivalent** to the normal ``monitor_keys=0`` / ``NO_FAULTS`` trace —
+same equations, same primitives, same parameters, same constants, up to
+variable renaming. If any monitor or fault op leaked into the gated
+graph, either a stub raises at trace time or the diff names the first
+divergent equation.
+
+``alpha_equivalent`` is generic over closed jaxprs (the unit tests run
+it on small synthetic functions); ``check_gating`` wires it to a traced
+protocol step from :mod:`fantoch_tpu.lint.jaxpr`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+try:  # jax >= 0.4.33: jax.extend.core is the supported home
+    from jax.extend.core import ClosedJaxpr, Jaxpr, Literal
+except ImportError:  # pragma: no cover — older jax
+    from jax.core import ClosedJaxpr, Jaxpr, Literal
+
+from .report import Finding
+
+
+# ----------------------------------------------------------------------
+# alpha-equivalence over closed jaxprs
+# ----------------------------------------------------------------------
+
+
+def _aval_sig(aval) -> Tuple:
+    return (getattr(aval, "shape", None), str(getattr(aval, "dtype", "?")))
+
+
+def _arrays_equal(a, b) -> bool:
+    """Value equality with NaN == NaN (float constants inside traced
+    library code legitimately carry NaN sentinels)."""
+    a, b = np.asarray(a), np.asarray(b)
+    try:
+        return bool(np.array_equal(a, b, equal_nan=True))
+    except TypeError:  # dtypes without NaN (bool/int/object)
+        return bool(np.array_equal(a, b))
+
+
+def _params_equal(a: Any, b: Any, path: str) -> Optional[str]:
+    """Deep param comparison; returns a mismatch description or None.
+    Nested (closed) jaxprs recurse through alpha-equivalence."""
+    a_jax = isinstance(a, (ClosedJaxpr, Jaxpr))
+    b_jax = isinstance(b, (ClosedJaxpr, Jaxpr))
+    if a_jax or b_jax:
+        if not (a_jax and b_jax):
+            return f"{path}: jaxpr vs non-jaxpr param"
+        ca = a if hasattr(a, "consts") else ClosedJaxpr(a, ())
+        cb = b if hasattr(b, "consts") else ClosedJaxpr(b, ())
+        ok, why = alpha_equivalent(ca, cb)
+        return None if ok else f"{path}: nested jaxpr differs: {why}"
+    if isinstance(a, (tuple, list)):
+        if not isinstance(b, (tuple, list)) or len(a) != len(b):
+            return f"{path}: sequence shape {a!r} != {b!r}"
+        for i, (x, y) in enumerate(zip(a, b)):
+            why = _params_equal(x, y, f"{path}[{i}]")
+            if why:
+                return why
+        return None
+    if isinstance(a, dict):
+        if not isinstance(b, dict) or sorted(a) != sorted(b):
+            return f"{path}: dict keys {sorted(a)} != {sorted(b)}"
+        for k in a:
+            why = _params_equal(a[k], b[k], f"{path}.{k}")
+            if why:
+                return why
+        return None
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        if not _arrays_equal(a, b):
+            return f"{path}: array param differs"
+        return None
+    if callable(a) and callable(b):
+        return None  # trace-time callbacks (e.g. jit wrappers): ignore
+    try:
+        if a != b:
+            return f"{path}: {a!r} != {b!r}"
+    except Exception:
+        if repr(a) != repr(b):
+            return f"{path}: {a!r} !~ {b!r}"
+    return None
+
+
+def alpha_equivalent(ca, cb) -> Tuple[bool, Optional[str]]:
+    """Structural equality of two closed jaxprs up to variable renaming.
+
+    Constants compare by value (a changed clamp threshold is a diff);
+    equations must match pairwise in order (jax traces
+    deterministically, so a reordered graph IS a changed graph)."""
+    ja, jb = ca.jaxpr, cb.jaxpr
+    if len(ca.consts) != len(cb.consts):
+        return False, (
+            f"const count {len(ca.consts)} != {len(cb.consts)}"
+        )
+    for i, (x, y) in enumerate(zip(ca.consts, cb.consts)):
+        if not _arrays_equal(x, y):
+            return False, f"const {i} differs"
+    if len(ja.invars) != len(jb.invars):
+        return False, f"invar count {len(ja.invars)} != {len(jb.invars)}"
+    if len(ja.outvars) != len(jb.outvars):
+        return False, (
+            f"outvar count {len(ja.outvars)} != {len(jb.outvars)}"
+        )
+    if len(ja.eqns) != len(jb.eqns):
+        return False, f"eqn count {len(ja.eqns)} != {len(jb.eqns)}"
+
+    ren = {}  # var(a) -> var(b)
+
+    def bind(va, vb, where) -> Optional[str]:
+        if _aval_sig(va.aval) != _aval_sig(vb.aval):
+            return (
+                f"{where}: aval {_aval_sig(va.aval)} != "
+                f"{_aval_sig(vb.aval)}"
+            )
+        prev = ren.setdefault(va, vb)
+        if prev is not vb:
+            return f"{where}: inconsistent renaming"
+        return None
+
+    def match_atom(aa, ab, where) -> Optional[str]:
+        lit_a = isinstance(aa, Literal)
+        lit_b = isinstance(ab, Literal)
+        if lit_a != lit_b:
+            return f"{where}: literal vs var"
+        if lit_a:
+            if not _arrays_equal(aa.val, ab.val):
+                return f"{where}: literal {aa.val!r} != {ab.val!r}"
+            return None
+        if aa not in ren:
+            return f"{where}: unbound variable read"
+        if ren[aa] is not ab:
+            return f"{where}: variable renaming mismatch"
+        return None
+
+    for va, vb in zip(
+        list(ja.constvars) + list(ja.invars),
+        list(jb.constvars) + list(jb.invars),
+    ):
+        why = bind(va, vb, "inputs")
+        if why:
+            return False, why
+
+    for i, (ea, eb) in enumerate(zip(ja.eqns, jb.eqns)):
+        where = f"eqn {i} ({ea.primitive.name})"
+        if ea.primitive.name != eb.primitive.name:
+            return False, (
+                f"eqn {i}: primitive {ea.primitive.name} != "
+                f"{eb.primitive.name}"
+            )
+        if len(ea.invars) != len(eb.invars) or len(ea.outvars) != len(
+            eb.outvars
+        ):
+            return False, f"{where}: arity differs"
+        for aa, ab in zip(ea.invars, eb.invars):
+            why = match_atom(aa, ab, where)
+            if why:
+                return False, why
+        if sorted(ea.params) != sorted(eb.params):
+            return False, (
+                f"{where}: param keys {sorted(ea.params)} != "
+                f"{sorted(eb.params)}"
+            )
+        for k in ea.params:
+            why = _params_equal(ea.params[k], eb.params[k], f"{where}.{k}")
+            if why:
+                return False, why
+        for oa, ob in zip(ea.outvars, eb.outvars):
+            why = bind(oa, ob, where)
+            if why:
+                return False, why
+
+    for aa, ab in zip(ja.outvars, jb.outvars):
+        why = match_atom(aa, ab, "outputs")
+        if why:
+            return False, why
+    return True, None
+
+
+# ----------------------------------------------------------------------
+# feature stripping
+# ----------------------------------------------------------------------
+
+
+def _raise_stub(what: str):
+    def stub(*a, **k):
+        raise AssertionError(
+            f"{what} traced into a feature-disabled engine step — the "
+            "monitor_keys=0 / NO_FAULTS gating leaks"
+        )
+
+    return stub
+
+
+@contextlib.contextmanager
+def stripped_features():
+    """Replace every monitor entry point and fault draw with stubs:
+    ``mon_exec`` becomes the identity (its disabled contract), the rest
+    raise if reached. Patches both ``engine.monitor``/``engine.core``
+    and every protocol module's imported reference."""
+    import sys
+
+    from ..engine import core as core_mod
+    from ..engine import monitor as monitor_mod
+
+    identity = lambda ps, *a, **k: ps  # noqa: E731
+    targets: List[Tuple[Any, str, Any]] = [
+        (monitor_mod, "mon_exec", identity),
+        (monitor_mod, "merge_mon", _raise_stub("merge_mon")),
+        (monitor_mod, "strip_mon", _raise_stub("strip_mon")),
+        (monitor_mod, "step_viol", _raise_stub("step_viol")),
+        (monitor_mod, "finalize_lane", _raise_stub("finalize_lane")),
+        (core_mod, "drop_draw", _raise_stub("drop_draw")),
+        (core_mod, "jitter_draw", _raise_stub("jitter_draw")),
+    ]
+    for mod_name, mod in list(sys.modules.items()):
+        if (
+            mod is not None
+            and mod_name.startswith("fantoch_tpu.engine.protocols")
+            and getattr(mod, "mon_exec", None) is not None
+        ):
+            targets.append((mod, "mon_exec", identity))
+
+    saved = [(m, n, getattr(m, n)) for m, n, _ in targets]
+    try:
+        for m, n, repl in targets:
+            setattr(m, n, repl)
+        yield
+    finally:
+        for m, n, orig in saved:
+            setattr(m, n, orig)
+
+
+def stripped_trace(trace) -> Any:
+    """Re-trace ``trace``'s step with features stripped; returns the
+    stripped ClosedJaxpr (raises if a stub is reached)."""
+    from .jaxpr import trace_step
+
+    with stripped_features():
+        again = trace_step(
+            trace.protocol,
+            trace.dims,
+            trace.state,
+            trace.ctx,
+            faults=None,  # NO_FAULTS
+            monitor_keys=0,
+            name=trace.name + "+stripped",
+        )
+    return again.closed
+
+
+def check_gating(trace) -> List[Finding]:
+    """GL005: the ``monitor_keys=0`` + ``NO_FAULTS`` step must be
+    alpha-equivalent to the feature-stripped step. ``trace`` must be a
+    gated-off :class:`~fantoch_tpu.lint.jaxpr.StepTrace` (monitor_keys
+    == 0, no fault flags)."""
+    assert trace.monitor_keys == 0, "diff the gated-off trace"
+    try:
+        stripped = stripped_trace(trace)
+    except AssertionError as e:
+        return [
+            Finding(
+                "GL005", trace.name, "engine/core.py:_lane_step:strip",
+                str(e),
+            )
+        ]
+    ok, why = alpha_equivalent(trace.closed, stripped)
+    if ok:
+        return []
+    return [
+        Finding(
+            "GL005",
+            trace.name,
+            "engine/core.py:_lane_step:diff",
+            "feature-disabled step is not alpha-equivalent to the "
+            f"stripped step: {why}",
+        )
+    ]

@@ -1,0 +1,1164 @@
+"""Jaxpr auditor: interval/width dataflow over a traced engine step.
+
+``audit_protocol`` traces one device protocol's ``_lane_step`` (the
+body :func:`fantoch_tpu.engine.core.build_runner` wraps in its
+``while_loop``/``vmap``) once with abstract values — no XLA compile —
+then walks the closed jaxpr with a lightweight interval analysis seeded
+from the documented per-field engine invariants (:data:`SEED_EXACT` /
+:data:`SEED_SUBSTR`, anchored on ``EngineDims`` bounds like
+``SEQ_BOUND`` and the ``INF`` time sentinel).
+
+What it proves (and does not): see docs/LINT.md. In one line — *if*
+every state field respects its documented bound at step entry, no i32
+add/mul/sum chain in one step can wrap without a structural guard
+(GL001), the f32-matmul cumsum stays integer-exact (GL002), no
+host-sync primitive hides in the step (GL003), and nothing promotes to
+64-bit (GL004). It does NOT prove the invariants themselves hold (the
+runtime ERR_* flags own that) and its guard recognition is structural,
+not semantic: a ``where`` whose predicate reads the overflowing
+operands counts as a clamp whether or not the predicate is correct.
+
+Guard recognition, concretely: a flagged-range result is suppressed
+when every consumer (looking through shape-only ops) is
+- ``min`` for an upper escape / ``max`` for a lower escape / ``clamp``
+  / ``rem`` — ops that re-bound the value, or
+- a ``select_n`` whose predicate's backward slice reaches the
+  overflowing equation's own inputs (the ``where(x > cap, INF, x * y)``
+  idiom from PR 1's fix) — a plain masked write like
+  ``where(lane_hit, x * y, old)`` does *not* qualify,
+
+and additionally the raw value must not land in the jaxpr's own
+outvars (carried state): a copy stored unclamped stays wrapped no
+matter how its sibling consumers re-bound theirs.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+try:  # jax >= 0.4.33: jax.extend.core is the supported home
+    from jax.extend.core import ClosedJaxpr, Literal
+except ImportError:  # pragma: no cover — older jax
+    from jax.core import ClosedJaxpr, Literal
+
+from ..engine.dims import INF, SEQ_BOUND, EngineDims, F32_EXACT, I32_MAX
+from .report import Finding
+
+# ----------------------------------------------------------------------
+# seeds: the documented per-field invariants (docs/LINT.md #seeds)
+# ----------------------------------------------------------------------
+
+# simulated-time ceiling: INF sentinel plus a few max-delay hops of
+# slack (delays are < DELAY_MAX; events at or past INF never qualify)
+DELAY_MAX = 1 << 20
+TIME_MAX = INF + (1 << 22)
+# per-channel emission counters / executed-command counters: a lane is
+# assumed to emit fewer than 2^24 messages per channel (pool capacity
+# times step budget makes more unreachable in any real sweep)
+CNT_ASSUME = 1 << 24
+U32_MAX = (1 << 32) - 1
+
+SEED_EXACT: Dict[str, Tuple[float, float]] = {
+    # engine lane state
+    "pool": (-1, TIME_MAX),
+    "now": (0, TIME_MAX),
+    "steps": (0, 1 << 22),
+    "done_time": (0, INF),
+    "max_completion": (0, TIME_MAX),
+    "pair_cnt": (0, CNT_ASSUME),
+    "next_periodic": (0, INF),
+    "err": (0, 1 << 10),
+    "viol": (0, 1 << 10),
+    "viol_step": (0, INF),
+    "hlog": (-1, TIME_MAX),
+    "hlog_n": (0, 1 << 22),
+    "requeues": (0, 1 << 22),
+    "fault_dropped": (0, 1 << 22),
+    "pool_peak": (0, 1 << 22),
+    "issued": (0, CNT_ASSUME),
+    "completed": (0, CNT_ASSUME),
+    "parts": (0, CNT_ASSUME),
+    "start_time": (0, TIME_MAX),
+    "part_max": (0, TIME_MAX),
+    "hist": (0, CNT_ASSUME),
+    "lat_count": (0, CNT_ASSUME),
+    # running latency sum: commands x latency stays far below 2^29 for
+    # any sweep the dims admit (see docs/LINT.md #seeds)
+    "lat_sum": (0, 1 << 29),
+    "lat_log": (-1, TIME_MAX),
+    # monitors: the rolling hash wraps i32 BY DESIGN (engine/monitor.py)
+    "mon_hash": (-(1 << 31), I32_MAX),
+    "mon_cnt": (0, CNT_ASSUME),
+    "mon_flags": (0, 255),
+    # lane ctx
+    "lookahead": (0, INF),
+    "delay_pp": (0, DELAY_MAX),
+    "client_delay": (0, DELAY_MAX),
+    "periodic_intervals": (0, INF),
+    "cmd_budget": (0, 1 << 20),
+    "extra_time": (0, 1 << 20),
+    "conflict_rate": (0, 100),
+    "pool_size": (0, 1 << 20),
+    "key_gen_kind": (0, 1),
+    "key_table": (0, 1 << 20),
+    "client_attach": (0, 128),
+    "client_attach_s": (0, 128),
+    "client_region_row": (0, 64),
+    "cmd_parts": (0, 64),
+    "cmd_target": (0, 64),
+    "cmd_keys": (0, 1 << 20),
+    "fault_crash_t": (0, INF),
+    "fault_horizon": (0, INF),
+    "fault_win_t0": (0, INF),
+    "fault_win_t1": (0, INF),
+    "fault_win_mul": (0, 1 << 20),
+    "fault_win_ovr": (-1, INF),
+    "fault_win_src": (-1, 64),
+    "fault_win_dst": (-1, 64),
+    "fault_drop_num": (0, U32_MAX),
+    "fault_jitter_num": (0, 1 << 20),
+    "fault_unavail": (0, 1),
+    # small config scalars / tables
+    "n": (0, 64),
+    "f": (0, 64),
+    "rows": (0, 128),
+    "threshold": (0, 64),
+    "fq_size": (0, 64),
+    "wq_size": (0, 64),
+    "q_size": (0, 64),
+    "shard_of": (0, 64),
+    "cmd_kmask": (0, 255),
+    "cmd_skey": (0, 1 << 20),
+    # committed-sequence frontiers (GC): dot sequences < SEQ_BOUND
+    "comm_front": (0, SEQ_BOUND),
+    "comm_gaps": (0, SEQ_BOUND),
+    "others_frontier": (0, SEQ_BOUND),
+    "prev_stable": (0, SEQ_BOUND),
+    # protocol metric counters
+    "m_stable": (0, CNT_ASSUME),
+    "m_fast": (0, CNT_ASSUME),
+    "m_slow": (0, CNT_ASSUME),
+    "m_fast_path": (0, CNT_ASSUME),
+}
+
+# substring fallbacks for protocol-state fields, first match wins;
+# checked after SEED_EXACT misses
+SEED_SUBSTR: List[Tuple[str, Tuple[float, float]]] = [
+    # Caesar clock-sequences pack as cseq * (N + 1) + pid under an
+    # ERR_SEQ guard of cseq < INF // (N + 1) (caesar.py). The audits
+    # run the smallest mesh (N = 3), which has the *loosest* clamp —
+    # INF // 4 — so that is the sound ceiling for every audited mesh
+    # (larger N only clamps tighter). clk_seq stores the same clamped
+    # values and must match before the generic "seq" fallback.
+    ("cseq", (0, INF // 4)),
+    ("clk_seq", (0, INF // 4)),
+    # sequence/dot numbers: ERR_SEQ enforces seq < SEQ_BOUND
+    ("seq", (0, SEQ_BOUND)),
+    ("committed_cnt", (0, SEQ_BOUND)),
+    # counters
+    ("cnt", (0, CNT_ASSUME)),
+    ("acks", (0, CNT_ASSUME)),
+    # process/voter/client id fields (pend_src, votes_by, clk_pid, ...)
+    ("src", (0, 128)),
+    ("_by", (0, 128)),
+    ("dst", (0, 128)),
+    ("pid", (0, 128)),
+    ("client", (0, 1 << 20)),
+    ("leader", (0, 64)),
+]
+
+# generic protocol-state default: clock/frontier-like values stay below
+# the INF time/clock sentinel (tempo's bump clamp + ERR_SEQ own this)
+SEED_DEFAULT = (0, INF)
+
+
+def seed_interval(name: str, aval) -> "Iv":
+    try:
+        dt = np.dtype(aval.dtype)
+    except TypeError:
+        return Iv(-math.inf, math.inf)  # extended dtypes (PRNG keys)
+    if dt == np.bool_:
+        return Iv(0, 1)
+    if dt.kind == "f":
+        return Iv(-math.inf, math.inf)
+    if dt.kind == "u":
+        return Iv(0, float(np.iinfo(dt).max))
+    if name in SEED_EXACT:
+        lo, hi = SEED_EXACT[name]
+        return Iv(lo, hi)
+    for sub, (lo, hi) in SEED_SUBSTR:
+        if sub in name:
+            return Iv(lo, hi)
+    return Iv(*SEED_DEFAULT)
+
+
+# ----------------------------------------------------------------------
+# intervals
+# ----------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Iv:
+    lo: float
+    hi: float
+
+    def hull(self, other: "Iv") -> "Iv":
+        return Iv(min(self.lo, other.lo), max(self.hi, other.hi))
+
+    def __repr__(self) -> str:  # compact finding messages
+        def s(v):
+            if v in (math.inf, -math.inf):
+                return "inf" if v > 0 else "-inf"
+            return str(int(v))
+
+        return f"[{s(self.lo)}, {s(self.hi)}]"
+
+
+def dtype_iv(dtype) -> Iv:
+    try:
+        dt = np.dtype(dtype)
+    except TypeError:
+        # extended dtypes (PRNG keys): opaque
+        return Iv(-math.inf, math.inf)
+    if dt == np.bool_:
+        return Iv(0, 1)
+    if dt.kind in "iu":
+        info = np.iinfo(dt)
+        return Iv(float(info.min), float(info.max))
+    return Iv(-math.inf, math.inf)
+
+
+def _np_dtype(aval):
+    try:
+        return np.dtype(aval.dtype)
+    except TypeError:
+        return None  # extended dtypes (PRNG keys)
+
+
+def _const_iv(val) -> Iv:
+    arr = np.asarray(val)
+    if arr.size == 0:
+        return Iv(0, 0)
+    if arr.dtype == np.bool_:
+        return Iv(float(arr.min()), float(arr.max()))
+    if arr.dtype.kind in "iuf":
+        return Iv(float(arr.min()), float(arr.max()))
+    return Iv(-math.inf, math.inf)  # opaque (e.g. PRNG key arrays)
+
+
+def _mul_iv(a: Iv, b: Iv) -> Iv:
+    prods = []
+    for x in (a.lo, a.hi):
+        for y in (b.lo, b.hi):
+            if (x == 0 and abs(y) == math.inf) or (
+                y == 0 and abs(x) == math.inf
+            ):
+                prods.append(0.0)
+            else:
+                prods.append(x * y)
+    return Iv(min(prods), max(prods))
+
+
+# ----------------------------------------------------------------------
+# jaxpr flattening (pjit/call inlining)
+# ----------------------------------------------------------------------
+
+CALL_PRIMS = {
+    "pjit", "closed_call", "core_call", "xla_call", "remat",
+    "checkpoint", "custom_jvp_call", "custom_vjp_call",
+    "custom_jvp_call_jaxpr", "custom_vjp_call_jaxpr",
+}
+# control-flow prims we do NOT recurse into: outputs degrade to dtype
+# range (none appear in the engine step today; the vmapped lax.switch
+# batches into inline select_n chains). ``scan`` (fori_loop bodies like
+# Caesar's executed-notification drain) gets a proper widening fixpoint
+# instead — see IntervalAnalysis._eval_scan.
+OPAQUE_CTRL = {"while", "cond"}
+
+# widening ladder for loop carries that keep growing: jump the bound to
+# the next engine landmark instead of creeping one unit per iteration
+_LANDMARKS = [
+    0.0, 1.0, 128.0, float(SEQ_BOUND), float(CNT_ASSUME), float(INF),
+    float(TIME_MAX), float(I32_MAX), math.inf,
+]
+
+
+def _widen(iv: "Iv") -> "Iv":
+    hi = next(L for L in _LANDMARKS if L >= iv.hi)
+    lo = iv.lo
+    if lo < 0:
+        lo = -next(L for L in _LANDMARKS if L >= -iv.lo)
+    return Iv(lo, hi)
+
+HOST_SYNC_PRIMS = {
+    "io_callback", "pure_callback", "python_callback", "callback",
+    "outside_call", "host_callback", "debug_callback", "debug_print",
+    "infeed", "outfeed",
+}
+
+# shape-only ops looked through when finding a value's real consumers
+TRANSPARENT = {
+    "broadcast_in_dim", "reshape", "squeeze", "transpose", "copy",
+    "expand_dims", "rev",
+}
+
+
+# functions whose reductions run over one-hot masks by contract (their
+# docstrings define them as gather/scatter/selection emulations): their
+# masked sums are bounded by the operand hull, not operand x count, and
+# GL001 trusts the contract (each has direct unit coverage) — but only
+# for the reductions and masked-merge adds (_one_hot_exempt); their
+# affine packing arithmetic is checked like any other code
+ONE_HOT_FNS = {
+    "oh_get", "oh_take", "oh_pack_pairs", "oh_route", "oh_match",
+    # order-statistic selection: exactly one rank matches
+    "_stable_clock", "_stable_clock_p",
+    # payload packers over compact_order one-hot position masks
+    "_pack_deps",
+}
+
+# the only prims the ONE_HOT_FNS contract re-bounds: one-hot masked
+# reductions. Everything else in those functions (the affine packing
+# adds/muls) is ordinary arithmetic and gets the full GL001 check.
+ONE_HOT_REDUCTIONS = {"reduce_sum", "dot_general", "cumsum", "scatter-add"}
+
+
+@dataclass
+class FlatEqn:
+    prim: str
+    invars: List[Any]   # Var | Literal | _Const
+    outvars: List[Any]  # Var
+    params: Dict[str, Any]
+    src: Tuple[str, str, int]  # (relfile, function, line)
+    rng_internal: bool = False  # bound inside jax's PRNG library code
+
+
+class _Const:
+    __slots__ = ("val",)
+
+    def __init__(self, val):
+        self.val = val
+
+
+class _FVar:
+    """Fresh variable identity for one flattened equation instance.
+    A sub-jaxpr inlined at two call sites (the vmapped switch shares
+    branch jaxprs) reuses jax ``Var`` objects; rebinding each defined
+    output to a fresh token keeps def/use maps single-assignment."""
+
+    __slots__ = ("aval",)
+
+    def __init__(self, aval):
+        self.aval = aval
+
+
+def _src_of(eqn) -> Tuple[Tuple[str, str], bool]:
+    """(stable (file, function) anchor, bound-inside-PRNG-library flag).
+
+    PRNG library internals (threefry mixing, randint's modular
+    arithmetic) wrap integers BY DESIGN; GL001 skips equations whose
+    traceback passes through jax's random/prng modules."""
+    try:
+        from jax._src import source_info_util
+
+        rng = False
+        tb = eqn.source_info.traceback
+        if tb is not None:
+            for f in tb.frames:
+                fn = f.file_name.replace("\\", "/")
+                if "jax/_src/random.py" in fn or "jax/_src/prng.py" in fn:
+                    rng = True
+                    break
+        frame = source_info_util.user_frame(eqn.source_info)
+        if frame is None:
+            return ("?", "?", 0), rng
+        fn = frame.file_name
+        marker = "fantoch_tpu"
+        if marker in fn:
+            fn = "fantoch_tpu" + fn.split(marker, 1)[1].replace("\\", "/")
+        return (fn, frame.function_name, frame.start_line), rng
+    except Exception:
+        return ("?", "?", 0), False
+
+
+def _is_literal(a) -> bool:
+    return isinstance(a, (Literal, _Const))
+
+
+def flatten_jaxpr(closed):
+    """Inline pjit/call sub-jaxprs into one flat equation list. Every
+    defined value gets a fresh :class:`_FVar` identity (sub-jaxprs may
+    be inlined at several call sites, reusing jax ``Var`` objects).
+    Returns ``(flat_eqns, root_invars, root_outvars)`` — the fresh
+    identities of the closed jaxpr's inputs and outputs, in order."""
+    out: List[FlatEqn] = []
+
+    def resolve(sub, a):
+        if isinstance(a, Literal):
+            return a
+        return sub[a]
+
+    def walk(closed_jaxpr, sub):
+        jaxpr = closed_jaxpr.jaxpr
+        for cv, cval in zip(jaxpr.constvars, closed_jaxpr.consts):
+            sub[cv] = _Const(cval)
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            inner = None
+            if name in CALL_PRIMS:
+                inner = eqn.params.get("jaxpr") or eqn.params.get(
+                    "call_jaxpr"
+                )
+            if inner is not None:
+                if not hasattr(inner, "consts"):  # bare Jaxpr
+                    inner = ClosedJaxpr(inner, ())
+                isub = {
+                    iv: resolve(sub, ov)
+                    for iv, ov in zip(inner.jaxpr.invars, eqn.invars)
+                }
+                walk(inner, isub)
+                for outer_ov, inner_ov in zip(
+                    eqn.outvars, inner.jaxpr.outvars
+                ):
+                    sub[outer_ov] = resolve(isub, inner_ov)
+            else:
+                src, rng = _src_of(eqn)
+                new_outs = [_FVar(v.aval) for v in eqn.outvars]
+                for ov, nv in zip(eqn.outvars, new_outs):
+                    sub[ov] = nv
+                out.append(
+                    FlatEqn(
+                        name,
+                        [resolve(sub, v) for v in eqn.invars],
+                        new_outs,
+                        eqn.params,
+                        src,
+                        rng,
+                    )
+                )
+
+    root_invars = [_FVar(v.aval) for v in closed.jaxpr.invars]
+    root_sub = dict(zip(closed.jaxpr.invars, root_invars))
+    walk(closed, root_sub)
+    root_outvars = [resolve(root_sub, v) for v in closed.jaxpr.outvars]
+    return out, root_invars, root_outvars
+
+
+# ----------------------------------------------------------------------
+# the dataflow pass
+# ----------------------------------------------------------------------
+
+# integer arithmetic that can silently wrap (GL001 candidates)
+OVERFLOW_PRIMS = {
+    "add", "sub", "mul", "dot_general", "reduce_sum", "scatter-add",
+    "cumsum", "integer_pow",
+}
+
+
+class IntervalAnalysis:
+    """One pass over a flattened jaxpr; collects findings."""
+
+    def __init__(self, flat: List[FlatEqn], audit: str, outvars=()):
+        self.flat = flat
+        self.audit = audit
+        # jaxpr root outputs: a value landing here raw is carried state
+        # and no guard on a *sibling* consumer can re-bound that copy
+        self.root_out = {v for v in outvars if not _is_literal(v)}
+        self.env: Dict[Any, Iv] = {}
+        self.findings: List[Finding] = []
+        # def/use maps for guard recognition
+        self.def_of: Dict[Any, int] = {}
+        self.uses: Dict[Any, List[int]] = {}
+        for i, e in enumerate(flat):
+            for v in e.outvars:
+                self.def_of[v] = i
+            for v in e.invars:
+                if not _is_literal(v):
+                    self.uses.setdefault(v, []).append(i)
+
+    # -- reading -------------------------------------------------------
+
+    def read(self, a) -> Iv:
+        if isinstance(a, Literal):
+            return _const_iv(a.val)
+        if isinstance(a, _Const):
+            return _const_iv(a.val)
+        if a in self.env:
+            return self.env[a]
+        return dtype_iv(a.aval.dtype)
+
+    def seed(self, var, name: str) -> None:
+        self.env[var] = seed_interval(name, var.aval)
+
+    # -- guard recognition --------------------------------------------
+
+    def _real_consumers(self, eqn_idx: int) -> List[int]:
+        """Consumer eqn indexes of eqn's outputs, looking through
+        shape-only ops. Unconsumed outputs (jaxpr outvars) yield no
+        consumers (the escaping value lands in carried state — never a
+        guard, handled by the caller)."""
+        seen = set()
+        out: List[int] = []
+        stack = list(self.flat[eqn_idx].outvars)
+        while stack:
+            v = stack.pop()
+            for ci in self.uses.get(v, ()):
+                if ci in seen:
+                    continue
+                seen.add(ci)
+                c = self.flat[ci]
+                if c.prim in TRANSPARENT:
+                    stack.extend(c.outvars)
+                else:
+                    out.append(ci)
+        return out
+
+    def _root(self, v):
+        """Look through shape-only ops to a value's defining variable
+        (broadcasts give ``x`` and ``x[:, None]`` distinct vars; guard
+        recognition must identify them)."""
+        seen = set()
+        while id(v) not in seen:
+            seen.add(id(v))
+            di = self.def_of.get(v)
+            if di is None or self.flat[di].prim not in TRANSPARENT:
+                return v
+            nxt = next(
+                (a for a in self.flat[di].invars if not _is_literal(a)),
+                None,
+            )
+            if nxt is None:
+                return v
+            v = nxt
+        return v
+
+    def _slice_hits(self, root_var, targets, depth: int = 8) -> bool:
+        """Does ``root_var``'s backward slice (bounded depth) reach any
+        of ``targets`` (compared through shape-only ops)?"""
+        tset = {id(self._root(t)) for t in targets}
+        frontier = [root_var]
+        for _ in range(depth):
+            nxt = []
+            for v in frontier:
+                if id(self._root(v)) in tset:
+                    return True
+                di = self.def_of.get(v)
+                if di is None:
+                    continue
+                for iv in self.flat[di].invars:
+                    if not _is_literal(iv):
+                        nxt.append(iv)
+            if not nxt:
+                return False
+            frontier = nxt
+        return any(id(self._root(v)) in tset for v in frontier)
+
+    def _escapes_to_state(self, eqn_idx: int) -> bool:
+        """Does any output of the eqn (looking through shape-only ops)
+        land *raw* in the jaxpr's outvars? A clamp on one consumer
+        cannot re-bound the unclamped copy stored in carried state, so
+        such an eqn is never guarded — even when every consuming eqn
+        individually looks like a guard."""
+        if not self.root_out:
+            return False
+        seen = set()
+        stack = list(self.flat[eqn_idx].outvars)
+        while stack:
+            v = stack.pop()
+            if v in self.root_out:
+                return True
+            for ci in self.uses.get(v, ()):
+                if ci in seen:
+                    continue
+                seen.add(ci)
+                c = self.flat[ci]
+                if c.prim in TRANSPARENT:
+                    stack.extend(c.outvars)
+        return False
+
+    def _literal_zero(self, a, depth: int = 4) -> bool:
+        """Is ``a`` (looking through shape-only ops) the literal 0?"""
+        while depth > 0:
+            if _is_literal(a):
+                val = getattr(a, "val", None)
+                return val is not None and bool(
+                    np.all(np.asarray(val) == 0)
+                )
+            di = self.def_of.get(a)
+            if di is None:
+                return False
+            e = self.flat[di]
+            if e.prim not in TRANSPARENT and e.prim != "convert_element_type":
+                return False
+            a = e.invars[0]
+            depth -= 1
+        return False
+
+    def _zero_masked(self, a, depth: int = 6) -> bool:
+        """Is ``a`` (transparently) a zero-masked select —
+        ``where(m, x, 0)`` — or a reduction/merge of such? Inside
+        ONE_HOT_FNS the documented disjoint-mask contract bounds adds
+        of these by the operand hull (at most one live addend per
+        element), so GL001 trusts them there — and only there."""
+        if depth <= 0 or _is_literal(a):
+            return False
+        di = self.def_of.get(a)
+        if di is None:
+            return False
+        e = self.flat[di]
+        if e.prim in TRANSPARENT or e.prim in (
+            "convert_element_type", "reduce_sum"
+        ):
+            return any(
+                self._zero_masked(v, depth - 1)
+                for v in e.invars
+                if not _is_literal(v)
+            )
+        if e.prim == "select_n":
+            return any(self._literal_zero(v) for v in e.invars[1:])
+        if e.prim == "add":
+            return all(
+                self._zero_masked(v, depth - 1)
+                for v in e.invars
+                if not _is_literal(v)
+            )
+        return False
+
+    def _one_hot_exempt(self, eqn: FlatEqn) -> bool:
+        """GL001 exemption inside ONE_HOT_FNS: the one-hot contract
+        re-bounds masked reductions and disjoint masked-merge adds
+        (``where(lo_hit, a, 0) + where(hi_hit, b, 0)``, ``pay + sum``
+        onto zero slots). Plain affine packing math — ``lo_base +
+        3 * order`` and every mul — stays fully checked, so losing a
+        sentinel clamp in a packer still flags."""
+        if eqn.src[1] not in ONE_HOT_FNS:
+            return False
+        if eqn.prim in ONE_HOT_REDUCTIONS:
+            return True
+        return eqn.prim == "add" and any(
+            self._zero_masked(v)
+            for v in eqn.invars
+            if not _is_literal(v)
+        )
+
+    def _guarded(self, eqn_idx: int, upper_escape: bool) -> bool:
+        if self._escapes_to_state(eqn_idx):
+            return False
+        consumers = self._real_consumers(eqn_idx)
+        if not consumers:
+            return False  # dead value: conservatively unguarded
+        producer_inputs = [
+            v for v in self.flat[eqn_idx].invars if not _is_literal(v)
+        ]
+        for ci in consumers:
+            c = self.flat[ci]
+            if c.prim == "clamp" or c.prim == "rem":
+                continue
+            if c.prim == "min" and upper_escape:
+                continue
+            if c.prim == "max" and not upper_escape:
+                continue
+            if c.prim == "select_n" and self._slice_hits(
+                c.invars[0], producer_inputs
+            ):
+                continue
+            return False
+        return True
+
+    # -- transfer ------------------------------------------------------
+
+    def _axis_count(self, eqn: FlatEqn) -> int:
+        axes = eqn.params.get("axes", ())
+        shape = eqn.invars[0].aval.shape if not _is_literal(
+            eqn.invars[0]
+        ) else np.shape(getattr(eqn.invars[0], "val", ()))
+        n = 1
+        for ax in axes:
+            n *= int(shape[ax]) if ax < len(shape) else 1
+        return max(n, 1)
+
+    def _contract_count(self, eqn: FlatEqn) -> int:
+        (lhs_c, _), _ = eqn.params["dimension_numbers"]
+        a = eqn.invars[0]
+        shape = (
+            a.aval.shape
+            if not _is_literal(a)
+            else np.shape(getattr(a, "val", ()))
+        )
+        n = 1
+        for ax in lhs_c:
+            n *= int(shape[ax]) if ax < len(shape) else 1
+        return max(n, 1)
+
+    def transfer(self, eqn: FlatEqn) -> List[Iv]:
+        p = eqn.prim
+        ivs = [self.read(a) for a in eqn.invars]
+        out_dt = (
+            eqn.outvars[0].aval.dtype if eqn.outvars else np.dtype("i4")
+        )
+
+        if p == "add":
+            r = Iv(ivs[0].lo + ivs[1].lo, ivs[0].hi + ivs[1].hi)
+        elif p == "sub":
+            r = Iv(ivs[0].lo - ivs[1].hi, ivs[0].hi - ivs[1].lo)
+        elif p == "mul":
+            r = _mul_iv(ivs[0], ivs[1])
+        elif p == "neg":
+            r = Iv(-ivs[0].hi, -ivs[0].lo)
+        elif p == "abs":
+            lo = 0.0 if ivs[0].lo <= 0 <= ivs[0].hi else min(
+                abs(ivs[0].lo), abs(ivs[0].hi)
+            )
+            r = Iv(lo, max(abs(ivs[0].lo), abs(ivs[0].hi)))
+        elif p == "max":
+            r = Iv(max(ivs[0].lo, ivs[1].lo), max(ivs[0].hi, ivs[1].hi))
+        elif p == "min":
+            r = Iv(min(ivs[0].lo, ivs[1].lo), min(ivs[0].hi, ivs[1].hi))
+        elif p == "clamp":  # clamp(lo, x, hi)
+            r = Iv(ivs[0].lo, ivs[2].hi)
+        elif p == "select_n":
+            r = ivs[1]
+            for c in ivs[2:]:
+                r = r.hull(c)
+        elif p == "rem":
+            d = max(abs(ivs[1].lo), abs(ivs[1].hi))
+            if d == math.inf:
+                r = dtype_iv(out_dt)
+            else:
+                lo = 0.0 if ivs[0].lo >= 0 else -(d - 1)
+                r = Iv(lo, d - 1 if d > 0 else 0)
+        elif p == "div":
+            if ivs[1].lo <= 0 <= ivs[1].hi:
+                r = dtype_iv(out_dt)  # divisor may straddle 0
+            else:
+                cands = [
+                    x / y
+                    for x in (ivs[0].lo, ivs[0].hi)
+                    for y in (ivs[1].lo, ivs[1].hi)
+                    if abs(x) != math.inf and abs(y) != math.inf
+                ] or [0.0]
+                r = Iv(min(cands), max(cands))
+        elif p in ("eq", "ne", "lt", "le", "gt", "ge", "reduce_or",
+                   "reduce_and", "not", "is_finite"):
+            r = Iv(0, 1)
+        elif p == "and":
+            if np.dtype(out_dt) == np.bool_:
+                r = Iv(0, 1)
+            else:
+                # x & y <= y for any nonneg y (AND cannot set bits the
+                # nonneg operand lacks), and the result is nonneg
+                nonneg = [
+                    iv for iv in ivs if iv.lo >= 0 and iv.hi < math.inf
+                ]
+                r = (
+                    Iv(0, min(iv.hi for iv in nonneg))
+                    if nonneg
+                    else dtype_iv(out_dt)
+                )
+        elif p in ("or", "xor"):
+            if np.dtype(out_dt) == np.bool_:
+                r = Iv(0, 1)
+            elif all(iv.lo >= 0 and iv.hi < math.inf for iv in ivs):
+                # nonneg bitwise: bounded by the next all-ones pattern
+                m = max(iv.hi for iv in ivs)
+                bound = float((1 << max(int(m), 1).bit_length()) - 1)
+                r = Iv(0, bound)
+            else:
+                r = dtype_iv(out_dt)
+        elif p == "shift_right_arithmetic":
+            r = ivs[0].hull(Iv(0, 0))  # magnitude shrinks toward 0
+        elif p == "shift_right_logical":
+            r = Iv(0, ivs[0].hi) if ivs[0].lo >= 0 else dtype_iv(out_dt)
+        elif p == "shift_left":
+            if ivs[1].hi < math.inf:
+                r = _mul_iv(
+                    ivs[0], Iv(1, float(1 << min(int(ivs[1].hi), 32)))
+                )
+            else:
+                r = dtype_iv(out_dt)
+        elif p == "reduce_sum":
+            if eqn.src[1] in ONE_HOT_FNS:
+                # one-hot masked reduction (gather/scatter emulation):
+                # at most one addend is live per output element
+                r = ivs[0].hull(Iv(0, 0))
+            else:
+                n = self._axis_count(eqn)
+                r = _mul_iv(ivs[0], Iv(0, n)) if ivs[0].lo >= 0 else _mul_iv(
+                    ivs[0], Iv(n, n)
+                ).hull(_mul_iv(ivs[0], Iv(0, 0)))
+        elif p in ("reduce_max", "reduce_min", "cummax", "cummin"):
+            r = ivs[0]
+        elif p == "cumsum":
+            n = eqn.invars[0].aval.shape[
+                eqn.params.get("axis", -1)
+            ] if not _is_literal(eqn.invars[0]) else 1
+            r = _mul_iv(ivs[0], Iv(0, int(n)))
+        elif p == "dot_general":
+            n = self._contract_count(eqn)
+            r = _mul_iv(_mul_iv(ivs[0], ivs[1]), Iv(0, n)) if (
+                ivs[0].lo >= 0 and ivs[1].lo >= 0
+            ) else _mul_iv(_mul_iv(ivs[0], ivs[1]), Iv(n, n)).hull(Iv(0, 0))
+        elif p in ("argmax", "argmin"):
+            shape = eqn.invars[0].aval.shape
+            axes = eqn.params.get("axes", (0,))
+            n = shape[axes[0]] if shape else 1
+            r = Iv(0, max(int(n) - 1, 0))
+        elif p == "iota":
+            dim = eqn.params.get("dimension", 0)
+            shape = eqn.params.get("shape", (1,))
+            r = Iv(0, max(int(shape[dim]) - 1, 0))
+        elif p == "convert_element_type":
+            tgt = dtype_iv(out_dt)
+            r = Iv(max(ivs[0].lo, tgt.lo), min(ivs[0].hi, tgt.hi))
+            if r.lo > r.hi:
+                r = tgt
+        elif p in TRANSPARENT or p in (
+            "slice", "dynamic_slice", "gather", "sort", "stop_gradient",
+        ):
+            base = ivs[0]
+            if p == "gather":
+                base = base.hull(Iv(0, 0))  # OOB drop fill
+            r = base
+        elif p in ("concatenate", "pad", "dynamic_update_slice",
+                   "scatter", "select_and_scatter_add"):
+            r = ivs[0]
+            for o in ivs[1:]:
+                r = r.hull(o)
+        elif p == "scatter-add":
+            upd = ivs[-1]
+            n = 1
+            if not _is_literal(eqn.invars[-1]):
+                for s in eqn.invars[-1].aval.shape:
+                    n *= int(s)
+            r = Iv(
+                ivs[0].lo + min(0.0, upd.lo * n),
+                ivs[0].hi + max(0.0, upd.hi * n),
+            )
+        elif p == "integer_pow":
+            y = eqn.params.get("y", 2)
+            r = ivs[0]
+            for _ in range(max(int(y) - 1, 0)):
+                r = _mul_iv(r, ivs[0])
+        elif p in OPAQUE_CTRL:
+            # while/cond stay opaque (none trace into the engine step
+            # today — the vmapped switch batches into inline selects)
+            return [dtype_iv(v.aval.dtype) for v in eqn.outvars]
+        else:
+            # unknown primitive (PRNG plumbing etc.): dtype range per
+            # output, never flagged itself
+            return [dtype_iv(v.aval.dtype) for v in eqn.outvars]
+        return [r] * len(eqn.outvars)
+
+    # -- scan fixpoint -------------------------------------------------
+
+    def _eval_scan(self, eqn: FlatEqn) -> List[Iv]:
+        """Widening fixpoint over a ``scan`` body (fori_loop lowers to
+        scan): iterate the body's interval transfer until the carry
+        stops growing, jumping runaway components up the engine's
+        landmark ladder; the final converged pass contributes findings
+        at their body source locations."""
+        params = eqn.params
+        closed = params["jaxpr"]
+        nc, ncar = params["num_consts"], params["num_carry"]
+        in_ivs = [self.read(a) for a in eqn.invars]
+        consts, carry = in_ivs[:nc], in_ivs[nc:nc + ncar]
+        xs = in_ivs[nc + ncar:]  # per-element hull == array hull
+
+        flat, binvars, boutvars = flatten_jaxpr(closed)
+
+        def one_pass(carry_ivs):
+            sub = IntervalAnalysis(flat, self.audit, outvars=boutvars)
+            for v, iv in zip(binvars, consts + carry_ivs + xs):
+                if isinstance(v, _FVar):
+                    sub.env[v] = iv
+            fs = sub.run()
+            outs = [sub.read(ov) for ov in boutvars]
+            return outs[:ncar], outs[ncar:], fs
+
+        for _ in range(4):
+            new_carry, ys, _ = one_pass(carry)
+            if all(
+                n.lo >= c.lo and n.hi <= c.hi
+                for n, c in zip(new_carry, carry)
+            ):
+                break
+            carry = [c.hull(n) for c, n in zip(carry, new_carry)]
+        else:
+            carry = [_widen(c) for c in carry]
+        new_carry, ys, fs = one_pass(carry)
+        self.findings.extend(fs)
+        carry = [c.hull(n) for c, n in zip(carry, new_carry)]
+        return carry + ys
+
+    # -- the pass ------------------------------------------------------
+
+    def run(self) -> List[Finding]:
+        for i, eqn in enumerate(self.flat):
+            if eqn.prim == "scan" and "jaxpr" in eqn.params:
+                out_ivs = self._eval_scan(eqn)
+                for v, iv in zip(eqn.outvars, out_ivs):
+                    self.env[v] = iv
+                continue
+            out_ivs = self.transfer(eqn)
+
+            if eqn.prim in HOST_SYNC_PRIMS:
+                self.findings.append(
+                    Finding(
+                        "GL003",
+                        self.audit,
+                        f"{eqn.src[0]}:{eqn.src[1]}:{eqn.prim}",
+                        f"host-sync primitive `{eqn.prim}` inside the "
+                        "vmapped step: every lane stalls on a host "
+                        "round-trip per step",
+                        detail=f"line {eqn.src[2]}",
+                    )
+                )
+
+            for v in eqn.outvars:
+                dt = _np_dtype(v.aval)
+                if dt is not None and dt.itemsize == 8 and dt.kind in "iuf":
+                    self.findings.append(
+                        Finding(
+                            "GL004",
+                            self.audit,
+                            f"{eqn.src[0]}:{eqn.src[1]}:{eqn.prim}",
+                            f"64-bit value ({dt}) in the traced step — "
+                            "a weak-type/x64 promotion leak (doubles "
+                            "every byte moved on device)",
+                            detail=f"line {eqn.src[2]}",
+                        )
+                    )
+                    break
+
+            if eqn.prim == "dot_general" and eqn.outvars:
+                in_dt = (
+                    np.dtype(eqn.invars[0].aval.dtype)
+                    if not _is_literal(eqn.invars[0])
+                    else np.dtype("f4")
+                )
+                if in_dt == np.float32:
+                    bound = max(abs(out_ivs[0].lo), abs(out_ivs[0].hi))
+                    feeds_int = any(
+                        self.flat[ci].prim == "convert_element_type"
+                        and np.dtype(
+                            self.flat[ci].outvars[0].aval.dtype
+                        ).kind in "iu"
+                        for ci in self._real_consumers(i)
+                    )
+                    if feeds_int and bound > F32_EXACT:
+                        self.findings.append(
+                            Finding(
+                                "GL002",
+                                self.audit,
+                                f"{eqn.src[0]}:{eqn.src[1]}:dot_general",
+                                "float32 matmul feeding an integer "
+                                f"convert can reach {int(bound)} > 2^24"
+                                " — partial sums leave the f32-exact "
+                                "integer range (silently wrong sums)",
+                                detail=f"line {eqn.src[2]}",
+                            )
+                        )
+
+            # GL001: integer wrap without a structural guard. The
+            # ONE_HOT_FNS contract only covers their reductions and
+            # disjoint masked-merge adds (see _one_hot_exempt); affine
+            # packing math in those functions stays fully checked, so
+            # losing a clamp there still flags.
+            if (
+                eqn.prim in OVERFLOW_PRIMS
+                and eqn.outvars
+                and not eqn.rng_internal
+                and not self._one_hot_exempt(eqn)
+            ):
+                dt = _np_dtype(eqn.outvars[0].aval)
+                if dt is not None and dt.kind in "iu" and dt.itemsize <= 4:
+                    rng = dtype_iv(dt)
+                    iv = out_ivs[0]
+                    upper = iv.hi > rng.hi
+                    lower = iv.lo < rng.lo
+                    if upper or lower:
+                        # each escaping side needs its own guard: a
+                        # `min` consumer re-bounds only the upper
+                        # escape and must not excuse a negative wrap
+                        guarded = (
+                            not upper or self._guarded(i, True)
+                        ) and (not lower or self._guarded(i, False))
+                        if guarded:
+                            # a recognized guard re-bounds the value
+                            # into the engine's domain, whose ceiling
+                            # is the TIME_MAX sentinel slack — clip so
+                            # downstream `x + 1` chains don't cascade
+                            clip = Iv(-TIME_MAX, TIME_MAX)
+                        else:
+                            self.findings.append(
+                                Finding(
+                                    "GL001",
+                                    self.audit,
+                                    f"{eqn.src[0]}:{eqn.src[1]}:"
+                                    f"{eqn.prim}",
+                                    f"i32 `{eqn.prim}` can reach {iv} "
+                                    "— wraps without a clamp/`where` "
+                                    "guard (bound derived from the "
+                                    "seeded engine invariants; "
+                                    "docs/LINT.md#gl001)",
+                                    detail=f"line {eqn.src[2]}",
+                                )
+                            )
+                            clip = rng  # one finding per root cause
+                        out_ivs = [
+                            Iv(max(x.lo, clip.lo), min(x.hi, clip.hi))
+                            for x in out_ivs
+                        ]
+
+            for v, iv in zip(eqn.outvars, out_ivs):
+                self.env[v] = iv
+        return self.findings
+
+
+# ----------------------------------------------------------------------
+# protocol tracing
+# ----------------------------------------------------------------------
+
+
+@dataclass
+class StepTrace:
+    """One traced engine step plus everything needed to re-trace it."""
+
+    name: str
+    protocol: Any
+    dims: EngineDims
+    state: Dict[str, Any]
+    ctx: Dict[str, Any]
+    faults: Any
+    monitor_keys: int
+    closed: Any  # ClosedJaxpr
+    leaf_names: List[str] = field(default_factory=list)
+
+
+def _leaf_names(tree) -> List[str]:
+    import jax
+
+    out = []
+    for path, _ in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        name = "?"
+        for part in reversed(path):
+            key = getattr(part, "key", None)
+            if isinstance(key, str):
+                name = key
+                break
+        out.append(name)
+    return out
+
+
+def trace_step(protocol, dims, state, ctx, faults=None,
+               monitor_keys: int = 0, name: str = "step") -> StepTrace:
+    import jax
+
+    from ..engine.core import _lane_step
+    from ..engine.faults import NO_FAULTS
+
+    faults = NO_FAULTS if faults is None else faults
+
+    closed = jax.make_jaxpr(
+        lambda s, c: _lane_step(
+            protocol, dims, s, c, False, faults, monitor_keys
+        )
+    )(state, ctx)
+    return StepTrace(
+        name, protocol, dims, state, ctx, faults, monitor_keys, closed,
+        _leaf_names((state, ctx)),
+    )
+
+
+def build_protocol_trace(name: str, *, n: int = 3, clients: int = 3,
+                         commands: int = 2, shards: int = 1,
+                         faults=None, monitor_keys: int = 0) -> StepTrace:
+    """Build a small representative lane for ``name`` and trace its
+    step (abstract values only — no XLA compile, ~1 s per protocol)."""
+    from ..core.config import Config
+    from ..core.planet import Planet
+    from ..engine import EngineDims, make_lane
+    from ..engine.core import init_lane_state
+    from ..engine.protocols import (
+        dev_config_kwargs,
+        dev_protocol,
+        partial_dev_protocol,
+    )
+
+    planet = Planet.new()
+    regions = planet.regions()[:n]
+    total = commands * clients
+    if shards > 1:
+        dev = partial_dev_protocol(name, clients, shards)
+        config = Config(
+            **dev_config_kwargs(name, n, 1),
+        ).with_(
+            shard_count=shards,
+            executor_executed_notification_interval_ms=100,
+            executor_cleanup_interval_ms=100,
+        )
+        dims = EngineDims.for_partial(dev, n, clients, total)
+    else:
+        dev = dev_protocol(name, clients)
+        config = Config(**dev_config_kwargs(name, n, 1))
+        dims = EngineDims.for_protocol(
+            dev, n=n, clients=clients, payload=dev.payload_width(n),
+            total_commands=total, dot_slots=total + 1, regions=n,
+        )
+    # multi-key partial commands need a pool that can produce distinct
+    # keys; single-shard lanes keep the max-conflict workload
+    conflict, pool_size = (50, 8) if shards > 1 else (100, 1)
+    spec = make_lane(
+        dev, planet, config, conflict_rate=conflict, pool_size=pool_size,
+        commands_per_client=commands, clients_per_region=1,
+        process_regions=regions, client_regions=regions, dims=dims,
+        faults=faults,
+    )
+    state = init_lane_state(dev, dims, spec.ctx, monitor_keys=monitor_keys)
+    audit = name if shards == 1 else f"{name}@{shards}shards"
+    if faults is not None:
+        audit += "+faults"
+    if monitor_keys:
+        audit += "+mon"
+    return trace_step(
+        dev, dims, state, spec.ctx, spec.fault_flags, monitor_keys, audit
+    )
+
+
+def audit_trace(trace: StepTrace) -> List[Finding]:
+    """Run the interval pass (GL001-GL004) over one traced step."""
+    flat, invars, outvars = flatten_jaxpr(trace.closed)
+    ana = IntervalAnalysis(flat, trace.name, outvars=outvars)
+    assert len(invars) == len(trace.leaf_names), (
+        len(invars), len(trace.leaf_names),
+    )
+    for var, leaf in zip(invars, trace.leaf_names):
+        ana.seed(var, leaf)
+    return ana.run()
+
+
+def audit_fn(fn, *args, seeds: "Dict[str, Tuple[float, float]] | None" = None,
+             audit: str = "fn") -> List[Finding]:
+    """Audit an arbitrary jax-traceable function (unit-test surface).
+    ``seeds`` maps positional arg index (as str) or leaf key name to
+    (lo, hi); unseeded integer leaves get the dtype default via the
+    engine tables."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args)
+    flat, invars, outvars = flatten_jaxpr(closed)
+    ana = IntervalAnalysis(flat, audit, outvars=outvars)
+    names = _leaf_names(args)
+    for i, (var, name) in enumerate(zip(invars, names)):
+        key = None
+        if seeds:
+            key = seeds.get(str(i), seeds.get(name))
+        if key is not None:
+            ana.env[var] = Iv(*key)
+        else:
+            ana.seed(var, name)
+    return ana.run()

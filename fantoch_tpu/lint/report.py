@@ -1,0 +1,123 @@
+"""Finding/report/baseline plumbing for ``graft-lint``.
+
+Findings carry *stable IDs* — ``rule:audit:anchor`` where the anchor is
+a file + enclosing-function (never a line number) for AST findings, or
+``file:function:primitive`` for jaxpr findings — so adding unrelated
+code does not churn the baseline. Two findings of the same ID are the
+same *kind* of issue at the same anchor; the baseline therefore stores
+``id -> allowed count`` and a run regresses when any ID's observed
+count exceeds its allowance (a brand-new unclamped multiply in a
+function that already has one baselined shows up as a count bump, not
+a silent pass).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+# the checked-in suppression file (CI runs against this)
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str     # GLxxx
+    audit: str    # which audit produced it: protocol name, "ast", "hooks"
+    anchor: str   # stable location anchor (file:function[:primitive])
+    message: str  # human explanation with concrete values
+    detail: str = ""  # volatile extras (line numbers, derived bounds)
+
+    @property
+    def id(self) -> str:
+        return f"{self.rule}:{self.audit}:{self.anchor}"
+
+    def render(self) -> str:
+        loc = f" [{self.detail}]" if self.detail else ""
+        return f"{self.id}{loc}\n    {self.message}"
+
+
+@dataclass
+class LintReport:
+    findings: List[Finding] = field(default_factory=list)
+    audits_run: List[str] = field(default_factory=list)
+
+    def extend(self, fs) -> None:
+        self.findings.extend(fs)
+
+    def counts(self) -> Dict[str, int]:
+        return dict(Counter(f.id for f in self.findings))
+
+    def regressions(self, baseline: "Dict[str, int] | None") -> List[Finding]:
+        """Findings beyond the baseline allowance, worst first. With no
+        baseline every finding is a regression."""
+        allowed = dict(baseline or {})
+        out: List[Finding] = []
+        for f in self.findings:
+            if allowed.get(f.id, 0) > 0:
+                allowed[f.id] -= 1
+            else:
+                out.append(f)
+        return out
+
+    def stale_baseline_ids(self, baseline: "Dict[str, int] | None") -> List[str]:
+        """Baseline IDs whose allowance exceeds what this run observed —
+        candidates for pruning (kept advisory, never a failure: audits
+        can be narrowed with --protocols)."""
+        got = self.counts()
+        return sorted(
+            k for k, v in (baseline or {}).items() if got.get(k, 0) < v
+        )
+
+    def to_json(self, baseline: "Dict[str, int] | None" = None) -> dict:
+        return {
+            "audits": self.audits_run,
+            "findings": [
+                {
+                    "id": f.id,
+                    "rule": f.rule,
+                    "audit": f.audit,
+                    "anchor": f.anchor,
+                    "message": f.message,
+                    "detail": f.detail,
+                }
+                for f in self.findings
+            ],
+            "regressions": [f.id for f in self.regressions(baseline)],
+            "stale_baseline": self.stale_baseline_ids(baseline),
+        }
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    """Accepts the checked-in ``{"findings": {id: count}}`` layout or a
+    plain hand-written ``{id: count}`` map; top-level keys starting with
+    ``_`` (comments) are ignored either way."""
+    with open(path) as fh:
+        data = json.load(fh)
+    if isinstance(data, dict) and isinstance(data.get("findings"), dict):
+        data = data["findings"]
+    assert isinstance(data, dict), "baseline must map finding id -> count"
+    return {
+        str(k): int(v)
+        for k, v in data.items()
+        if not str(k).startswith("_")
+    }
+
+
+def write_baseline(path: str, report: LintReport) -> None:
+    payload = {
+        "_comment": (
+            "graft-lint suppression baseline: finding id -> allowed "
+            "count. Regenerate with `python -m fantoch_tpu.cli lint "
+            "--write-baseline` and REVIEW the diff — every entry is a "
+            "deliberately accepted finding (docs/LINT.md documents why "
+            "each current entry is sound)."
+        ),
+        "findings": dict(sorted(report.counts().items())),
+    }
+    with open(path, "w") as fh:
+        json.dump(payload, fh, indent=2)
+        fh.write("\n")

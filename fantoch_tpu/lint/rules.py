@@ -1,0 +1,380 @@
+"""AST lint rules over the engine + protocol sources, and the
+protocol-registry hook checks.
+
+AST rules scan *traced* functions — any function whose parameters
+include one of the tracer-carrying names (``ps``, ``msg``, ``st``) plus
+the canonically named protocol entry points (``handle`` / ``periodic``
+/ ``ready``). Host-side builders (``lane_ctx``, ``init_state``, ...)
+take neither and are exempt, which is what lets GL104 ban ``np.`` there
+without drowning in false positives.
+
+Rules (stable IDs anchor on file + enclosing function, no line
+numbers):
+
+* GL101 — raw outbox construction: every emission must flow through
+  ``emit`` / ``emit_broadcast`` / ``pack_outbox`` (engine/core.py); a
+  dict literal or ``dict(...)`` call with the outbox field set anywhere
+  else bypasses the choke point the fault machinery and the channel
+  counters rely on. (``**``-unpacked merges are invisible to this
+  rule; the jaxpr gating differ still catches what they'd leak.)
+* GL102 — hook discipline (registry, not AST): every device protocol
+  must expose a callable ``min_live`` and an explicit ``MONITORED``
+  capability flag, and a ``MONITORED`` protocol's module must actually
+  call ``mon_exec`` at its executor choke point.
+* GL103 — Python-level branching on tracers: an ``if``/``while``/
+  ``assert`` whose test reads ``ps``/``msg``/``st``/``me``/``now``/
+  ``fire`` inside a traced function either crashes at trace time or —
+  worse — silently specializes the compiled graph on one traced value.
+  Static membership tests (``"key" in ps``) and ``hasattr`` checks are
+  exempt.
+* GL104 — host ops in traced code: ``np.`` or ``.item()`` inside a
+  traced function forces a device sync (or a constant-folded wrong
+  value) per step.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List, Sequence, Tuple
+
+from .report import Finding
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
+
+# default scan set: everything that traces into the engine step
+DEFAULT_PATHS = (
+    "fantoch_tpu/engine/core.py",
+    "fantoch_tpu/engine/monitor.py",
+    "fantoch_tpu/engine/iset.py",
+    "fantoch_tpu/engine/protocols",
+)
+
+OUTBOX_KEYS = {"valid", "dst", "mtype", "payload"}
+# the sanctioned constructors (GL101 exempts their defining module)
+CHOKE_POINT_FILE = "fantoch_tpu/engine/core.py"
+
+TRACER_PARAMS = {"ps", "msg", "st", "m", "me", "now", "t", "fire"}
+# params that are always trace-time static, whatever their name
+STATIC_PARAMS = {
+    "self", "ctx", "dims", "config", "protocol", "faults",
+    "monitor_keys", "reorder",
+}
+
+
+def _rel(path: str) -> str:
+    ap = os.path.abspath(path)
+    root = os.path.abspath(REPO_ROOT)
+    if ap.startswith(root):
+        return os.path.relpath(ap, root).replace("\\", "/")
+    return path.replace("\\", "/")
+
+
+def expand_paths(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(REPO_ROOT, p)
+        if os.path.isdir(full):
+            for fn in sorted(os.listdir(full)):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(full, fn))
+        elif os.path.exists(full):
+            out.append(full)
+        else:
+            raise FileNotFoundError(p)
+    return out
+
+
+# ----------------------------------------------------------------------
+# AST helpers
+# ----------------------------------------------------------------------
+
+
+def _is_traced_function(fn: ast.AST) -> bool:
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    params = {a.arg for a in fn.args.args + fn.args.kwonlyargs}
+    if fn.name in ("handle", "periodic", "ready"):
+        return True
+    return bool(params & {"ps", "msg", "st"})
+
+
+def _tracer_names(fn: ast.FunctionDef) -> set:
+    params = {a.arg for a in fn.args.args + fn.args.kwonlyargs}
+    return (params & TRACER_PARAMS) - STATIC_PARAMS
+
+
+def _names_in(node: ast.AST) -> set:
+    return {
+        n.id for n in ast.walk(node) if isinstance(n, ast.Name)
+    }
+
+
+def _is_static_test(test: ast.AST) -> bool:
+    """Membership tests on dicts and hasattr() are trace-time static."""
+    if isinstance(test, ast.Compare) and all(
+        isinstance(op, (ast.In, ast.NotIn)) for op in test.ops
+    ):
+        return True
+    if (
+        isinstance(test, ast.Call)
+        and isinstance(test.func, ast.Name)
+        and test.func.id in ("hasattr", "isinstance", "getattr", "len")
+    ):
+        return True
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _is_static_test(test.operand)
+    if isinstance(test, ast.BoolOp):
+        return all(_is_static_test(v) for v in test.values)
+    return False
+
+
+class _FileScan(ast.NodeVisitor):
+    def __init__(self, relpath: str):
+        self.relpath = relpath
+        self.findings: List[Finding] = []
+        self.fn_stack: List[Tuple[str, set]] = []  # (name, tracer names)
+
+    # -- function tracking --------------------------------------------
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # nested fns inherit traced-ness only from a *traced* outer fn
+        # (a host-side builder's local helper is still host code)
+        traced = _is_traced_function(node) or self._in_traced()
+        tracers = _tracer_names(node) if traced else set()
+        if self.fn_stack:  # nested fns inherit the outer tracer set
+            tracers |= self.fn_stack[-1][1]
+        self.fn_stack.append((node.name, tracers if traced else set()))
+        self.generic_visit(node)
+        self.fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _anchor(self, suffix: str = "") -> str:
+        fn = self.fn_stack[0][0] if self.fn_stack else "<module>"
+        base = f"{self.relpath}:{fn}"
+        return f"{base}:{suffix}" if suffix else base
+
+    def _in_traced(self) -> bool:
+        return any(t for _, t in self.fn_stack)
+
+    def _tracers(self) -> set:
+        out = set()
+        for _, t in self.fn_stack:
+            out |= t
+        return out
+
+    # -- GL101: raw outbox dicts --------------------------------------
+
+    def _flag_outbox(self, node, what: str) -> None:
+        self.findings.append(
+            Finding(
+                "GL101",
+                "ast",
+                self._anchor("outbox-dict"),
+                f"raw outbox {what} — emissions must flow "
+                "through emit/emit_broadcast/pack_outbox "
+                "(engine/core.py) so fault choke points and "
+                "channel counters see every message",
+                detail=f"line {node.lineno}",
+            )
+        )
+
+    def visit_Dict(self, node: ast.Dict) -> None:
+        if self.relpath != CHOKE_POINT_FILE:
+            keys = {
+                k.value
+                for k in node.keys
+                if isinstance(k, ast.Constant) and isinstance(k.value, str)
+            }
+            if OUTBOX_KEYS <= keys:
+                self._flag_outbox(node, "dict literal")
+        self.generic_visit(node)
+
+    # -- GL103: branching on tracers ----------------------------------
+
+    def _check_test(self, node, test: ast.AST, kind: str) -> None:
+        if not self._in_traced() or _is_static_test(test):
+            return
+        hit = _names_in(test) & self._tracers()
+        if hit:
+            self.findings.append(
+                Finding(
+                    "GL103",
+                    "ast",
+                    self._anchor(kind),
+                    f"Python-level `{kind}` on tracer value(s) "
+                    f"{sorted(hit)} inside a traced function — use "
+                    "jnp.where/lax.select (a tracer branch fails at "
+                    "trace time or specializes the graph)",
+                    detail=f"line {node.lineno}",
+                )
+            )
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_test(node, node.test, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_test(node, node.test, "while")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        self._check_test(node, node.test, "assert")
+        self.generic_visit(node)
+
+    # -- GL104: host ops ----------------------------------------------
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if self._in_traced():
+            if isinstance(node.value, ast.Name) and node.value.id == "np":
+                self.findings.append(
+                    Finding(
+                        "GL104",
+                        "ast",
+                        self._anchor("np"),
+                        f"`np.{node.attr}` inside a traced function — "
+                        "numpy ops constant-fold against tracers or "
+                        "crash; use jnp",
+                        detail=f"line {node.lineno}",
+                    )
+                )
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # GL101 through the dict() constructor — same outbox shape,
+        # different spelling than the literal visit_Dict catches
+        if (
+            self.relpath != CHOKE_POINT_FILE
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "dict"
+            and OUTBOX_KEYS
+            <= {kw.arg for kw in node.keywords if kw.arg is not None}
+        ):
+            self._flag_outbox(node, "dict(...) constructor")
+        if (
+            self._in_traced()
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("item", "tolist", "block_until_ready")
+        ):
+            self.findings.append(
+                Finding(
+                    "GL104",
+                    "ast",
+                    self._anchor(node.func.attr),
+                    f"`.{node.func.attr}()` inside a traced function "
+                    "forces a host sync per step",
+                    detail=f"line {node.lineno}",
+                )
+            )
+        self.generic_visit(node)
+
+
+def run_ast_rules(paths: "Sequence[str] | None" = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in expand_paths(paths or DEFAULT_PATHS):
+        with open(path) as fh:
+            tree = ast.parse(fh.read(), filename=path)
+        scan = _FileScan(_rel(path))
+        scan.visit(tree)
+        findings.extend(scan.findings)
+    return findings
+
+
+# ----------------------------------------------------------------------
+# GL102: protocol hook discipline (registry reflection)
+# ----------------------------------------------------------------------
+
+
+def _module_calls_mon_exec(cls) -> bool:
+    import inspect
+    import sys
+
+    mods = []
+    for klass in type(cls).__mro__ if not isinstance(cls, type) else cls.__mro__:
+        mod = sys.modules.get(klass.__module__)
+        if mod is not None and mod not in mods:
+            mods.append(mod)
+    for mod in mods:
+        try:
+            tree = ast.parse(inspect.getsource(mod))
+        except (OSError, TypeError):
+            continue
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "mon_exec"
+            ):
+                return True
+    return False
+
+
+def check_protocol_hooks(
+    protocols: "Iterable[Tuple[str, object]] | None" = None,
+) -> List[Finding]:
+    """Every device protocol must register its hooks: a callable
+    ``min_live`` (fault plans use it to flag intolerable crash sets as
+    ERR_UNAVAIL instead of hanging) and an explicit ``MONITORED``
+    declaration (True requires a reachable ``mon_exec`` call in the
+    implementing module — a protocol silently fuzzed without its
+    executor hook reports every lane as missing-execution).
+
+    ``protocols`` (name, instance-or-class) overrides the registry for
+    tests; default is every engine protocol plus the partial twins."""
+    if protocols is None:
+        # the one canonical grid (lint/__init__.py) — a protocol added
+        # there is audited here automatically, never silently skipped
+        from . import FULL_PROTOCOLS, PARTIAL_PROTOCOLS
+        from ..engine.protocols import (
+            dev_protocol,
+            partial_dev_protocol,
+        )
+
+        protocols = [(n, dev_protocol(n, 3)) for n in FULL_PROTOCOLS]
+        protocols += [
+            (f"{n}@partial", partial_dev_protocol(n, 3, 2))
+            for n in PARTIAL_PROTOCOLS
+        ]
+
+    findings: List[Finding] = []
+    for name, proto in protocols:
+        cls = proto if isinstance(proto, type) else type(proto)
+        anchor = f"{cls.__module__.replace('.', '/')}.py:{cls.__name__}"
+
+        if not callable(getattr(proto, "min_live", None)):
+            findings.append(
+                Finding(
+                    "GL102",
+                    "hooks",
+                    f"{anchor}:min_live",
+                    f"protocol `{name}` has no callable min_live hook — "
+                    "fault plans cannot distinguish tolerable crashes "
+                    "from quorum loss (engine/faults.py would fall "
+                    "back to the generic n-f bound silently)",
+                )
+            )
+        monitored = getattr(proto, "MONITORED", None)
+        if monitored is None:
+            findings.append(
+                Finding(
+                    "GL102",
+                    "hooks",
+                    f"{anchor}:MONITORED",
+                    f"protocol `{name}` declares no MONITORED flag — "
+                    "fuzz capability must be an explicit True (with a "
+                    "mon_exec hook) or False (documented opt-out)",
+                )
+            )
+        elif monitored and not _module_calls_mon_exec(cls):
+            findings.append(
+                Finding(
+                    "GL102",
+                    "hooks",
+                    f"{anchor}:mon_exec",
+                    f"protocol `{name}` sets MONITORED=True but its "
+                    "module never calls mon_exec — every fuzzed lane "
+                    "would report missing-execution",
+                )
+            )
+    return findings

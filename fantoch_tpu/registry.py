@@ -1,0 +1,20 @@
+"""Canonical device-protocol name lists.
+
+One source of truth for every grid that enumerates the device
+protocols — the CLI sweep/mc/lint drivers and the lint audit +
+hook-registry grids all import these tuples, so adding a protocol to
+``engine.protocols.dev_protocol`` without extending the matching tuple
+here is one visible edit away from every consumer instead of a silent
+drop from lint/CI coverage.
+
+This lives outside ``fantoch_tpu.engine`` on purpose: importing
+anything under that package runs its jax-heavy ``__init__``, and the
+CLI must stay jax-free at import time so host-only subcommands can
+pin the CPU backend before jax initializes.
+"""
+
+# every full-replication device protocol (engine.protocols.dev_protocol)
+DEV_PROTOCOLS = ("basic", "fpaxos", "tempo", "atlas", "epaxos", "caesar")
+
+# the partial-replication twins (engine.protocols.partial_dev_protocol)
+PARTIAL_DEV_PROTOCOLS = ("tempo", "atlas")

@@ -1,0 +1,30 @@
+"""Deliberately broken traced code for the AST-lint fixture tests.
+
+Never imported by the package — `cli.py lint --paths` points the AST
+scanner here to prove the CI entrypoint exits non-zero on findings
+(GL101 raw outbox, GL103 tracer branch, GL104 host ops)."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+def handle(ps, msg, me, now, ctx, dims):
+    # GL103: Python-level branch on a tracer
+    if msg["mtype"] > 0:
+        seq = ps["own_seq"] + 1
+    else:
+        seq = ps["own_seq"]
+    # GL104: numpy op against tracer values
+    limit = np.maximum(seq, 0)
+    # GL104: host sync
+    count = ps["acks"].item()
+    # GL101: raw outbox dict bypassing emit/emit_broadcast/pack_outbox
+    return ps, {
+        "valid": jnp.ones((4,), bool),
+        "dst": jnp.zeros((4,), jnp.int32),
+        "mtype": jnp.full((4,), limit, jnp.int32),
+        "payload": jnp.zeros((4, 3), jnp.int32),
+        "delay": jnp.full((4,), count, jnp.int32),
+        "src": jnp.full((4,), -1, jnp.int32),
+    }

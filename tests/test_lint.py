@@ -1,0 +1,400 @@
+"""graft-lint tests (fantoch_tpu/lint): interval-analysis units on
+synthetic jaxprs, alpha-equivalence units, the two seeded regressions
+the CI contract demands (an unclamped i32 multiply reachable from a
+protocol step, and a protocol registered without its monitor hooks),
+AST-rule fixtures, and the CLI gate's exit behavior."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fantoch_tpu.core import Config, Planet
+from fantoch_tpu.engine import EngineDims, make_lane
+from fantoch_tpu.engine.core import cumsum_i32, init_lane_state
+from fantoch_tpu.engine.dims import INF
+from fantoch_tpu.engine.protocols import BasicDev, dev_config_kwargs
+from fantoch_tpu.lint import DEFAULT_BASELINE, load_baseline
+from fantoch_tpu.lint.gating import alpha_equivalent, check_gating
+from fantoch_tpu.lint.jaxpr import audit_fn, audit_trace, trace_step
+from fantoch_tpu.lint.rules import check_protocol_hooks, run_ast_rules
+
+I32 = jnp.int32
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "lint_bad.py")
+
+
+# ----------------------------------------------------------------------
+# interval analysis units (synthetic jaxprs)
+# ----------------------------------------------------------------------
+
+
+def test_unclamped_mul_flagged():
+    def f(x):
+        return x * 70001 * 70001
+
+    fs = audit_fn(f, np.int32(3), seeds={"0": (0, 1 << 20)})
+    assert any(g.rule == "GL001" and ":mul" in g.anchor for g in fs), fs
+
+
+def test_clamped_mul_clean():
+    """The PR-1 idiom: `where(mul would overflow, INF, x * mul)` — the
+    select's predicate reads the multiplicands, so the escape is
+    recognized as guarded."""
+
+    def f(x, m):
+        cap = INF // jnp.maximum(x, 1)
+        return jnp.where(m > cap, INF, x * m)
+
+    fs = audit_fn(
+        f, np.int32(3), np.int32(5),
+        seeds={"0": (0, 1 << 24), "1": (0, 1 << 24)},
+    )
+    assert fs == [], [g.render() for g in fs]
+
+
+def test_masked_write_is_not_a_guard():
+    """A lane-select whose predicate ignores the product must NOT count
+    as a clamp (the guard check is pred-linked, not any-select)."""
+
+    def f(x, arr, i):
+        big = x * 70001 * 70001
+        hit = jnp.arange(arr.shape[0], dtype=I32) == i
+        return jnp.where(hit, big, arr)
+
+    fs = audit_fn(
+        f, np.int32(3), np.zeros((4,), np.int32), np.int32(1),
+        seeds={"0": (0, 1 << 20), "1": (0, 100), "2": (0, 3)},
+    )
+    assert any(g.rule == "GL001" for g in fs), fs
+
+
+def test_min_clamp_suppresses_upper_escape():
+    def f(x):
+        return jnp.minimum(x * 70001 * 70001, INF)
+
+    fs = audit_fn(f, np.int32(3), seeds={"0": (0, 1 << 20)})
+    # the inner mul feeds another mul (not a guard) and stays flagged;
+    # the outer one feeds min and is suppressed
+    outer_flagged = [g for g in fs if g.rule == "GL001"]
+    assert len(outer_flagged) == 1, fs
+
+
+def test_min_guard_does_not_excuse_lower_escape():
+    """A `min` consumer re-bounds only the upper escape; a product
+    whose interval also wraps below INT32_MIN must stay flagged (each
+    escaping side needs its own guard)."""
+
+    def f(x):
+        return jnp.minimum(x * 70001 * 70001, INF)
+
+    fs = audit_fn(f, np.int32(3), seeds={"0": (-(1 << 20), 1 << 20)})
+    # both muls escape both sides; neither is fully guarded
+    assert len([g for g in fs if g.rule == "GL001"]) == 2, [
+        g.render() for g in fs
+    ]
+
+
+def test_one_hot_masked_merge_adds_exempt():
+    """oh_pack_pairs' disjoint masked merges (`where(lo_hit, a, 0) +
+    where(hi_hit, b, 0)`, `pay + sum` onto zero slots) are trusted to
+    the one-hot contract even with INF-scale operands."""
+    from fantoch_tpu.engine import core
+
+    def f(pay, lo, a, b):
+        return core.oh_pack_pairs(pay, lo, a, b)
+
+    fs = audit_fn(
+        f,
+        np.zeros((8,), np.int32), np.zeros((2,), np.int32),
+        np.zeros((2,), np.int32), np.zeros((2,), np.int32),
+        seeds={"0": (0, INF), "1": (0, 8), "2": (0, INF), "3": (0, INF)},
+    )
+    assert [g for g in fs if g.rule == "GL001"] == [], [
+        g.render() for g in fs
+    ]
+
+
+def test_one_hot_fn_affine_math_still_checked():
+    """Dropping the sentinel clamp inside a ONE_HOT_FNS packer must
+    still flag — the one-hot trust covers only masked reductions and
+    merges, never the affine packing muls/adds (the _pack_deps
+    regression class)."""
+
+    def _pack_deps(pay, lo_base, order):
+        lo = lo_base + 3 * order  # unclamped: order can carry INF
+        iota = jnp.arange(pay.shape[0], dtype=I32)
+        oh = lo[:, None] == iota[None, :]
+        return pay + jnp.sum(
+            jnp.where(oh, order[:, None], 0), axis=0, dtype=I32
+        )
+
+    fs = audit_fn(
+        _pack_deps,
+        np.zeros((8,), np.int32), np.int32(0), np.zeros((2,), np.int32),
+        seeds={"0": (0, 100), "1": (0, 8), "2": (0, INF)},
+    )
+    assert any(g.rule == "GL001" for g in fs), fs
+
+
+def test_state_escape_is_not_guarded():
+    """A wrapped value that *also* lands raw in the jaxpr's outputs
+    (carried state) stays flagged even though its other consumer is a
+    clamp — the clamp cannot re-bound the stored copy."""
+
+    def f(x):
+        big = x * 70001
+        return big, jnp.minimum(big, INF)
+
+    fs = audit_fn(f, np.int32(3), seeds={"0": (0, 1 << 20)})
+    assert any(g.rule == "GL001" and ":mul" in g.anchor for g in fs), fs
+
+
+def test_f32_matmul_exactness_gl002():
+    def f(x):
+        tri = jnp.triu(jnp.ones((8, 8), jnp.float32))
+        return (x.astype(jnp.float32) @ tri).astype(I32)
+
+    big = audit_fn(
+        f, np.zeros((8,), np.int32), seeds={"0": (0, 1 << 23)}
+    )
+    assert any(g.rule == "GL002" for g in big), big
+    small = audit_fn(
+        f, np.zeros((8,), np.int32), seeds={"0": (0, 1 << 10)}
+    )
+    assert not any(g.rule == "GL002" for g in small), small
+
+
+def test_cumsum_i32_static_exactness_guard():
+    # bool masks keep the single-matmul path
+    jx = jax.make_jaxpr(cumsum_i32)(np.ones((16,), bool))
+    assert any(e.primitive.name == "dot_general" for e in jx.eqns)
+    # non-bool without a bound: loud trace-time error, never wrong sums
+    with pytest.raises(TypeError, match="bound"):
+        cumsum_i32(jnp.ones((16,), I32))
+    # a bound that breaks f32 exactness falls back to the stock cumsum
+    jx = jax.make_jaxpr(
+        lambda x: cumsum_i32(x, bound=1 << 22)
+    )(np.ones((16,), np.int32))
+    assert not any(e.primitive.name == "dot_general" for e in jx.eqns)
+
+
+# ----------------------------------------------------------------------
+# alpha-equivalence units
+# ----------------------------------------------------------------------
+
+
+def _jx(f, *args):
+    return jax.make_jaxpr(f)(*args)
+
+
+def test_alpha_equivalent_renamed_vars():
+    def f(x, y):
+        a = x + y
+        return a * 2
+
+    def g(p, q):  # same graph, different python names
+        fresh = p + q
+        return fresh * 2
+
+    ok, why = alpha_equivalent(
+        _jx(f, np.int32(1), np.int32(2)), _jx(g, np.int32(1), np.int32(2))
+    )
+    assert ok, why
+
+
+def test_alpha_diff_on_constant_and_primitive():
+    x = np.int32(1)
+    ok, why = alpha_equivalent(
+        _jx(lambda v: v * 2, x), _jx(lambda v: v * 3, x)
+    )
+    assert not ok and "literal" in why, why
+    ok, why = alpha_equivalent(
+        _jx(lambda v: v * 2, x), _jx(lambda v: v + 2, x)
+    )
+    assert not ok and "primitive" in why, why
+    ok, why = alpha_equivalent(
+        _jx(lambda v: v * 2, x), _jx(lambda v: (v * 2) + 0 * v, x)
+    )
+    assert not ok, "extra equations must not be equivalent"
+    # output arity: a dropped (or leaked) output that adds no equation
+    # must still diff — it changes what the step carries
+    ok, why = alpha_equivalent(
+        _jx(lambda v: (v * 2, v), x), _jx(lambda v: (v * 2,), x)
+    )
+    assert not ok and "outvar" in why, why
+
+
+def test_audit_fn_const_lhs_matmul():
+    """A host-side constant matrix as the dot lhs (the constant-hoisted
+    cumsum_i32 form) must audit, not crash _contract_count."""
+    tri = np.triu(np.ones((4, 4), np.float32))
+
+    def f(x):
+        return (tri @ x.astype(np.float32)).astype(np.int32)
+
+    fs = audit_fn(f, np.zeros((4,), np.int32), seeds={"0": (0, 100)})
+    assert [g.rule for g in fs] in ([], ["GL002"]), fs
+
+
+# ----------------------------------------------------------------------
+# seeded regressions (the CI contract)
+# ----------------------------------------------------------------------
+
+
+def _basic_lane(dev, monitor_keys=0):
+    n, clients, commands = 3, 3, 2
+    config = Config(**dev_config_kwargs("basic", n, 1))
+    planet = Planet.new()
+    regions = planet.regions()[:n]
+    total = commands * clients
+    dims = EngineDims.for_protocol(
+        dev, n=n, clients=clients, payload=dev.payload_width(n),
+        total_commands=total, dot_slots=total + 1, regions=n,
+    )
+    spec = make_lane(
+        dev, planet, config, conflict_rate=100, pool_size=1,
+        commands_per_client=commands, clients_per_region=1,
+        process_regions=regions, client_regions=regions, dims=dims,
+    )
+    st = init_lane_state(dev, dims, spec.ctx, monitor_keys=monitor_keys)
+    return dims, spec, st
+
+
+class OverflowDev(BasicDev):
+    """Seeded regression: an unclamped i32 multiply on a sequence
+    counter, reachable from the protocol step."""
+
+    @staticmethod
+    def periodic(ps, fire, me, now, ctx, dims):
+        ps, ob = BasicDev.periodic(ps, fire, me, now, ctx, dims)
+        return dict(ps, own_seq=ps["own_seq"] * 70001), ob
+
+
+def test_auditor_catches_seeded_overflow_mul():
+    dims, spec, st = _basic_lane(OverflowDev)
+    trace = trace_step(OverflowDev, dims, st, spec.ctx, name="seeded")
+    fs = audit_trace(trace)
+    hits = [
+        g for g in fs if g.rule == "GL001" and ":periodic:mul" in g.anchor
+    ]
+    assert hits, [g.render() for g in fs]
+    # the same lane through the clean protocol has no periodic finding
+    dims, spec, st = _basic_lane(BasicDev)
+    clean = audit_trace(
+        trace_step(BasicDev, dims, st, spec.ctx, name="clean")
+    )
+    assert not any(":periodic:" in g.anchor for g in clean), clean
+
+
+class NoHooksDev:
+    """Seeded regression: protocol registered without its hooks."""
+
+    MONITORED = True  # claims monitors but this module never calls
+    # mon_exec, and there is no min_live
+
+
+def test_hook_rule_catches_missing_registration():
+    fs = check_protocol_hooks([("nohooks", NoHooksDev)])
+    kinds = {g.anchor.rsplit(":", 1)[1] for g in fs}
+    assert "min_live" in kinds, fs
+    assert "mon_exec" in kinds, fs
+
+    class Undeclared:
+        @staticmethod
+        def min_live(config):
+            return config.n - config.f
+
+    fs = check_protocol_hooks([("undeclared", Undeclared)])
+    assert any(g.anchor.endswith(":MONITORED") for g in fs), fs
+
+
+def test_registry_hooks_clean_at_head():
+    assert check_protocol_hooks() == []
+
+
+# ----------------------------------------------------------------------
+# AST rules
+# ----------------------------------------------------------------------
+
+
+def test_ast_rules_flag_fixture():
+    fs = run_ast_rules([FIXTURE])
+    rules = {g.rule for g in fs}
+    assert {"GL101", "GL103", "GL104"} <= rules, [g.render() for g in fs]
+
+
+def test_ast_rules_clean_at_head():
+    assert run_ast_rules() == [], [
+        g.render() for g in run_ast_rules()
+    ]
+
+
+def test_outbox_dict_constructor_flagged(tmp_path):
+    """GL101 must also catch the dict() spelling of a raw outbox."""
+    path = tmp_path / "proto_bad.py"
+    path.write_text(
+        "def handle(ps, msg):\n"
+        "    return dict(valid=v, dst=d, mtype=t, payload=p)\n"
+    )
+    fs = run_ast_rules([str(path)])
+    assert any(g.rule == "GL101" for g in fs), [g.render() for g in fs]
+
+
+# ----------------------------------------------------------------------
+# audits vs the checked-in baseline + gating proof (one cheap protocol)
+# ----------------------------------------------------------------------
+
+
+def test_basic_audit_within_baseline_and_gated():
+    dims, spec, st = _basic_lane(BasicDev)
+    trace = trace_step(BasicDev, dims, st, spec.ctx, name="basic")
+    fs = audit_trace(trace)
+    allowed = set(load_baseline(DEFAULT_BASELINE))
+    assert {g.id for g in fs} <= allowed, [g.render() for g in fs]
+    assert check_gating(trace) == []
+
+
+# ----------------------------------------------------------------------
+# the CI entrypoint
+# ----------------------------------------------------------------------
+
+
+def test_cli_lint_broken_fixture_exits_nonzero(capsys):
+    from fantoch_tpu import cli
+
+    with pytest.raises(SystemExit) as e:
+        cli.main(
+            ["lint", "--no-jaxpr", "--paths", FIXTURE, "--baseline"]
+        )
+    assert e.value.code == 1
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["regressions"] > 0
+
+
+def test_cli_lint_clean_ast_exits_zero(capsys):
+    from fantoch_tpu import cli
+
+    cli.main(["lint", "--no-jaxpr", "--baseline"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["regressions"] == 0
+
+
+def test_cli_write_baseline_refuses_narrowed_run():
+    """A run missing whole audit classes must not clobber the
+    checked-in baseline (every skipped finding would become a CI
+    regression on the next full run)."""
+    from fantoch_tpu import cli
+
+    with pytest.raises(SystemExit) as e:
+        cli.main(["lint", "--no-jaxpr", "--write-baseline"])
+    assert "narrowed" in str(e.value.code)
+
+
+def test_load_baseline_plain_map(tmp_path):
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps({"_comment": "x", "GL001:a:b:mul": 2}))
+    assert load_baseline(str(path)) == {"GL001:a:b:mul": 2}
