@@ -73,6 +73,39 @@ FUZZ_MIN_BUDGET_S = float(
 )
 
 
+def _static_kernel_cost(timeout_s: float = 240.0) -> "dict | None":
+    """Device-free kernel-cost estimate of the tempo 512-lane step
+    (the GL201 ledger, fantoch_tpu/lint/cost.py) — a real static
+    number the artifact carries even when the TPU backend is
+    unreachable. Runs in a throwaway JAX_PLATFORMS=cpu subprocess so a
+    dead device tunnel can neither hang nor pollute this process's
+    backend, and degrades to None (never an exception) — the measured
+    sweep metric must not be lost to a lint import error."""
+    import subprocess
+    import sys
+
+    env = dict(_os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)  # the ledger traces; it never executes
+    try:
+        out = subprocess.run(
+            [sys.executable, "-m", "fantoch_tpu.lint.cost", "tempo"],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=env,
+        )
+        line = out.stdout.strip().splitlines()[-1]
+        cost = json.loads(line)
+        assert cost.get("kernels")
+        return cost
+    except Exception as e:  # noqa: BLE001
+        import sys as _sys
+
+        print(f"bench: static kernel cost unavailable: {e!r}",
+              file=_sys.stderr)
+        return None
+
+
 def _fuzz_selfcheck() -> float:
     from fantoch_tpu.mc.fuzz import FuzzSpec, run_fuzz_point
 
@@ -229,6 +262,7 @@ def main() -> None:
     per_chip_target = 10_000 / 60.0 / 8.0  # north-star rate, per chip
     platform = jax.devices()[0].platform
     fallback = bool(int(_os.environ.get("FANTOCH_BENCH_CPU_FALLBACK", "0")))
+    static_cost = _static_kernel_cost()
     print(
         json.dumps(
             {
@@ -249,6 +283,11 @@ def main() -> None:
                 "vs_baseline": round(points_per_sec / per_chip_target, 3),
                 "fuzz_schedules_per_sec": round(fuzz_sps, 2),
                 **({"fuzz_note": fuzz_note} if fuzz_note else {}),
+                **(
+                    {"static_kernel_cost": static_cost}
+                    if static_cost
+                    else {}
+                ),
             }
         )
     )
@@ -370,6 +409,10 @@ def _emit_unreachable(reason: str = "unreachable at startup") -> None:
         f"{_since_birth():.0f}s total) — emitting zero-value artifact",
         file=sys.stderr,
     )
+    # the artifact still carries a real device-free number: the static
+    # kernel ledger of the tempo 512-lane step (CPU subprocess, never
+    # touches the dead backend)
+    static_cost = _static_kernel_cost(timeout_s=180.0)
     print(
         json.dumps(
             {
@@ -383,6 +426,11 @@ def _emit_unreachable(reason: str = "unreachable at startup") -> None:
                 "platform": "none",
                 "vs_baseline": 0.0,
                 "fuzz_schedules_per_sec": 0.0,
+                **(
+                    {"static_kernel_cost": static_cost}
+                    if static_cost
+                    else {}
+                ),
             }
         )
     )

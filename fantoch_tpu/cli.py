@@ -296,7 +296,9 @@ def cmd_sweep(args) -> None:
         pool_size=args.pool_size,
         faults=fault_plans,
     )
-    results = run_sweep(dev, dims, specs)
+    results = run_sweep(
+        dev, dims, specs, shard_lanes=True if args.shard_lanes else None
+    )
     errs = sum(1 for r in results if r.err)
     summary = {
         "protocol": args.protocol,
@@ -452,15 +454,36 @@ def cmd_mc(args) -> None:
 
 def cmd_lint(args) -> None:
     """graft-lint (fantoch_tpu/lint): jaxpr interval audits over every
-    device protocol's step, the structural gating differ, and AST /
-    hook-registry rules. Exits non-zero on any finding not covered by
-    the baseline (docs/LINT.md)."""
+    device protocol's step, the structural gating differ, AST /
+    hook-registry rules, and (``--cost``) the kernel/VMEM/lane cost
+    family. Exits non-zero on any finding not covered by the baseline
+    (docs/LINT.md)."""
     from .lint import (
         DEFAULT_BASELINE,
         load_baseline,
         run_lint,
         write_baseline,
     )
+
+    say = lambda msg: print(f"lint: {msg}", file=sys.stderr)  # noqa: E731
+
+    if args.cost_selfcheck:
+        # CI broken-fixture check: the seeded defect must make the
+        # cost gate exit non-zero, or the gate itself is broken
+        from .lint.cost import run_cost_selfcheck
+
+        findings = run_cost_selfcheck(args.cost_selfcheck, progress=say)
+        for f in findings:
+            print(f.render(), file=sys.stderr)
+        print(
+            json.dumps(
+                {
+                    "selfcheck": args.cost_selfcheck,
+                    "regressions": len(findings),
+                }
+            )
+        )
+        raise SystemExit(1 if findings else 0)
 
     protocols = args.protocols.split(",") if args.protocols else None
     if protocols:
@@ -471,16 +494,42 @@ def cmd_lint(args) -> None:
                 f"{','.join(ENGINE_PROTOCOLS)}"
             )
 
+    if args.write_cost_baseline:
+        from .lint.cost import (
+            DEFAULT_COST_BASELINE,
+            SWEEP_LANES,
+            run_cost,
+            write_cost_baseline,
+        )
+
+        if protocols:
+            raise SystemExit(
+                "refusing to write the cost baseline from a run "
+                "narrowed by --protocols (missing protocols would "
+                "turn into CI regressions); run without it"
+            )
+        _, summary = run_cost(ENGINE_PROTOCOLS, progress=say)
+        write_cost_baseline(DEFAULT_COST_BASELINE, summary, SWEEP_LANES)
+        print(
+            json.dumps(
+                {"cost_baseline": DEFAULT_COST_BASELINE, "cost": summary}
+            )
+        )
+        return
+
     report = run_lint(
         protocols,
         ast_paths=args.paths or None,
-        jaxpr_audits=not args.no_jaxpr,
-        progress=lambda msg: print(f"lint: {msg}", file=sys.stderr),
+        jaxpr_audits=not args.no_jaxpr and not args.cost_only,
+        cost=args.cost or args.cost_only,
+        progress=say,
     )
 
     baseline_path = args.baseline or DEFAULT_BASELINE
     if args.write_baseline:
-        narrowed = args.no_jaxpr or protocols or args.paths
+        narrowed = (
+            args.no_jaxpr or args.cost_only or protocols or args.paths
+        )
         if narrowed and os.path.abspath(baseline_path) == os.path.abspath(
             DEFAULT_BASELINE
         ):
@@ -514,6 +563,8 @@ def cmd_lint(args) -> None:
         "regressions": len(regressions),
         "stale_baseline": report.stale_baseline_ids(baseline),
     }
+    if report.cost:
+        out["cost"] = report.cost
     if args.json:
         out["detail"] = report.to_json(baseline)
     for f in regressions:
@@ -821,6 +872,13 @@ def main(argv=None) -> None:
         '"dst": 1, "t0": 0, "t1": 500, "delay": "inf"}], '
         '"horizon": 5000}]\' (lossy plans need a horizon)',
     )
+    sw.add_argument(
+        "--shard-lanes",
+        action="store_true",
+        help="prove the step lane-independent (GL203 taint, a few "
+        "seconds once per protocol) before sharding lanes over the "
+        "mesh; refuses to run if the proof fails",
+    )
     sw.add_argument("--out", default=None, help="results JSONL path")
     sw.set_defaults(fn=cmd_sweep)
 
@@ -890,6 +948,20 @@ def main(argv=None) -> None:
                     help="override the AST scan set (fixture tests)")
     ln.add_argument("--no-jaxpr", action="store_true",
                     help="AST/hook rules only (fast)")
+    ln.add_argument("--cost", action="store_true",
+                    help="add the cost family: GL201 kernel ledger + "
+                    "GL202 VMEM footprint (vs lint/cost_baseline.json) "
+                    "+ GL203 lane-independence prover")
+    ln.add_argument("--cost-only", action="store_true",
+                    help="cost family without the interval/gating "
+                    "audits (the CI cost-gate job)")
+    ln.add_argument("--cost-selfcheck", default=None,
+                    choices=["scatter", "vmem"],
+                    help="CI broken-fixture check: audit a tempo step "
+                    "with the named seeded defect; must exit non-zero")
+    ln.add_argument("--write-cost-baseline", action="store_true",
+                    help="regenerate lint/cost_baseline.json from this "
+                    "run")
     ln.add_argument("--json", action="store_true",
                     help="include full finding detail in the output")
     ln.set_defaults(fn=cmd_lint)

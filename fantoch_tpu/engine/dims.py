@@ -31,6 +31,20 @@ F32_EXACT = 1 << 24
 # sequence reaching it
 SEQ_BOUND = 1 << 20
 
+# measured per-kernel fixed overhead on the target runtime (docs/PERF.md
+# "cost model"): every emitted kernel — fusion, scatter, gather, reduce,
+# sort, loop iteration — costs this much regardless of data size at
+# engine scales. The lint cost ledger (fantoch_tpu/lint/cost.py, GL201)
+# turns a static kernel count into an estimated ms/step range with it.
+KERNEL_MS_LO = 0.1
+KERNEL_MS_HI = 0.3
+
+# the measured throughput sweet spot of the target runtime: batch
+# scaling turns bandwidth-bound past ~512 lanes (docs/PERF.md), so 512
+# is the documented sweep shape — the lane count the VMEM-footprint
+# estimator (GL202) multiplies per-lane intermediates by
+SWEEP_LANES = 512
+
 # per-lane error taxonomy: the engine and the protocol modules OR these
 # bits into int32 error words (per process for protocol state, per lane
 # for engine conditions), so a failing lane names its cause instead of

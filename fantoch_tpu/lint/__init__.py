@@ -1,6 +1,6 @@
 """``graft-lint``: static analysis for the device engine's invariants.
 
-Three analysis levels, one driver (``python -m fantoch_tpu.cli lint``):
+Four analysis families, one driver (``python -m fantoch_tpu.cli lint``):
 
 1. **Jaxpr auditor** (:mod:`.jaxpr`) — traces every device protocol's
    engine step once with abstract values and runs an interval/width
@@ -15,12 +15,23 @@ Three analysis levels, one driver (``python -m fantoch_tpu.cli lint``):
    discipline (GL101), protocol ``min_live``/``mon_exec`` hook
    registration (GL102), Python branching on tracers (GL103), host
    ops inside traced functions (GL104).
+4. **Cost family** (:mod:`.cost`, :mod:`.lanes`; opt-in ``--cost``) —
+   enforces docs/PERF.md's measured cost model over the *batched*
+   step at the documented 512-lane sweep shape: GL201 kernel-boundary
+   ledger gated against ``lint/cost_baseline.json``, GL202
+   fused-group VMEM footprint (the gap-gather worker-crash class),
+   GL203 lane-independence taint proof — the gate for the verified
+   lane-sharded sweep path (``run_sweep(shard_lanes=True)``).
 
-Findings carry stable IDs suppressed by a checked-in baseline
-(``lint/baseline.json``): CI fails only on *regressions* — a finding
-whose ID is absent from the baseline or whose per-ID count grew.
-Rule catalogue, per-rule soundness notes and the suppression workflow
-live in docs/LINT.md.
+Every pass shares one cached trace per protocol variant
+(:class:`.jaxpr.TraceCache`), so adding passes does not multiply the
+~78 s trace budget. Findings carry stable IDs suppressed by a
+checked-in baseline (``lint/baseline.json``; the cost family gates
+against its own ``cost_baseline.json`` and emits findings only on
+violation): CI fails only on *regressions* — a finding whose ID is
+absent from the baseline or whose per-ID count grew. Rule catalogue,
+per-rule soundness notes and the suppression workflow live in
+docs/LINT.md.
 """
 
 from __future__ import annotations
@@ -54,13 +65,22 @@ def run_lint(
     include_partial: bool = True,
     include_faulted: bool = True,
     jaxpr_audits: bool = True,
+    cost: bool = False,
+    cost_baseline: "dict | None" = None,
+    cache=None,
     progress=None,
 ) -> LintReport:
     """Run every analysis level; returns a :class:`LintReport`.
 
     ``protocols`` narrows the jaxpr audits (default: all). ``ast_paths``
     overrides the AST scan set (the CI fixture test points this at a
-    deliberately broken file)."""
+    deliberately broken file). ``cost=True`` adds the cost family —
+    GL201 kernel ledger + GL202 VMEM footprint (gated against
+    ``cost_baseline``, default the checked-in ``cost_baseline.json``)
+    and the GL203 lane-independence prover. All passes share one
+    :class:`~fantoch_tpu.lint.jaxpr.TraceCache` (pass ``cache`` to
+    share across calls), so adding the cost family re-traces nothing
+    the audits already traced."""
     from . import rules
 
     report = LintReport()
@@ -75,27 +95,39 @@ def run_lint(
     report.extend(rules.check_protocol_hooks())
     report.audits_run.append("hooks")
 
+    names = list(protocols or FULL_PROTOCOLS)
+    partial_names = [
+        n for n in (PARTIAL_PROTOCOLS if include_partial else ())
+        if not protocols or n in protocols
+    ]
+
+    if jaxpr_audits or cost:
+        from .jaxpr import TraceCache, build_protocol_trace
+
+        cache = cache or TraceCache()
+
+        def audit_trace_for(name, **kw):
+            key = (name,) + tuple(sorted(kw.items()))
+            return cache.get(
+                key, lambda: build_protocol_trace(name, **kw)
+            )
+
     if jaxpr_audits:
         from .gating import check_gating
-        from .jaxpr import audit_trace, build_protocol_trace
+        from .jaxpr import audit_trace
 
-        names = list(protocols or FULL_PROTOCOLS)
         for name in names:
             say(f"jaxpr audit: {name} ...")
-            trace = build_protocol_trace(name)
+            trace = audit_trace_for(name)
             report.extend(audit_trace(trace))
             report.extend(check_gating(trace))
             report.audits_run.append(trace.name)
-
-        if include_partial:
-            for name in PARTIAL_PROTOCOLS:
-                if protocols and name not in protocols:
-                    continue
-                say(f"jaxpr audit: {name} (2 shards) ...")
-                trace = build_protocol_trace(name, shards=2)
-                report.extend(audit_trace(trace))
-                report.extend(check_gating(trace))
-                report.audits_run.append(trace.name)
+        for name in partial_names:
+            say(f"jaxpr audit: {name} (2 shards) ...")
+            trace = audit_trace_for(name, shards=2)
+            report.extend(audit_trace(trace))
+            report.extend(check_gating(trace))
+            report.audits_run.append(trace.name)
 
         if include_faulted and (not protocols or "tempo" in protocols):
             # one fully-featured variant: jitter+crash+drop plan and
@@ -111,11 +143,44 @@ def run_lint(
                 jitter_seed=1,
                 horizon_ms=5000,
             )
-            trace = build_protocol_trace(
-                name="tempo", faults=plan, monitor_keys=4
+            trace = cache.get(
+                ("tempo", "faulted"),
+                lambda: build_protocol_trace(
+                    name="tempo", faults=plan, monitor_keys=4
+                ),
             )
             report.extend(audit_trace(trace))
             report.audits_run.append(trace.name)
+
+    if cost:
+        from .cost import SWEEP_LANES, load_cost_baseline, run_cost, sweep_trace
+        from .lanes import check_lanes
+
+        if cost_baseline is None:
+            cost_baseline = load_cost_baseline()
+        findings, summary = run_cost(
+            names, cache=cache, baseline=cost_baseline, progress=say
+        )
+        report.extend(findings)
+        report.cost = summary
+        report.audits_run.extend(f"cost:{n}" for n in names)
+
+        # GL203: full protocols taint the cost pass's already-built
+        # batched sweep-shape graphs (the replay and flatten are cached
+        # on the trace, so this walk is ~free); the partial twins taint
+        # their audit traces — lane mixing is shape-independent, so
+        # both shapes prove the same property
+        lanes = int(cost_baseline.get("lanes", SWEEP_LANES))
+        for name in names:
+            say(f"lane-independence: {name} ...")
+            trace = sweep_trace(name, cache)
+            report.extend(check_lanes(trace, lanes=lanes))
+            report.audits_run.append(f"lanes:{trace.name}")
+        for name in partial_names:
+            say(f"lane-independence: {name} (2 shards) ...")
+            trace = audit_trace_for(name, shards=2)
+            report.extend(check_lanes(trace))
+            report.audits_run.append(f"lanes:{trace.name}")
 
     say(f"lint done in {time.perf_counter() - t0:.1f}s")
     return report

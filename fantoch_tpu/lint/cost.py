@@ -1,0 +1,595 @@
+"""graft-cost: static kernel-cost and VMEM-footprint analysis.
+
+The engine's performance invariants (docs/PERF.md) are a *cost model* —
+per-kernel fixed overhead dominates, fused elementwise chains are near
+free, and a fusion whose intermediate exceeds VMEM kills the TPU worker
+outright. This module turns that model into two statically checkable
+gates over the traced step:
+
+* **GL201 — kernel-boundary ledger.** Classify every equation of the
+  *batched* step (the vmapped graph the sweep driver actually runs) as
+  fused-elementwise vs. kernel-boundary (scatter/gather/sort/reduce/
+  matmul/loop classes), count kernels (boundaries + one per fused
+  group, loop bodies times their trip count) and derive an estimated
+  ms/step range from the measured 0.1-0.3 ms per-kernel overhead.
+  Gated against the checked-in ``lint/cost_baseline.json``: CI fails
+  only when a protocol's kernel count *regresses*.
+* **GL202 — conservative VMEM intermediate footprint.** Group fusable
+  elementwise chains (connected components over def-use), scan each
+  group's intermediates for peak live bytes, and flag any group whose
+  peak exceeds the protocol's gate — ``vmem_headroom`` times its
+  baselined peak (healthy footprints are protocol-specific, so the
+  gate is relative; an explicit ``vmem_budget_mib`` override serves
+  tests) — the static form of the documented
+  ``[lanes, N, D, deps, G, 2]`` gap-gather worker crash.
+
+Both passes analyze the step traced at the documented 512-lane sweep
+shape (:data:`SWEEP_SHAPE`, bench.py's all-protocol grid point) and
+*batched* over :data:`~fantoch_tpu.engine.dims.SWEEP_LANES` lanes via
+the jaxpr replay in :meth:`StepTrace.batched_closed` — so lane-carried
+tensors show their real ``[512, ...]`` bytes while trace constants
+(e.g. ``cumsum_i32``'s triangular matrix) correctly stay unbatched.
+
+Soundness notes (what this does NOT prove) live in docs/LINT.md#gl201.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..engine.dims import KERNEL_MS_HI, KERNEL_MS_LO, SWEEP_LANES
+from .jaxpr import (
+    FlatEqn,
+    StepTrace,
+    _closedify,
+    _is_literal,
+    _np_dtype,
+    build_protocol_trace,
+    flatten_jaxpr,
+)
+from .report import Finding
+
+# the checked-in cost gate (CI runs against this)
+DEFAULT_COST_BASELINE = os.path.join(
+    os.path.dirname(__file__), "cost_baseline.json"
+)
+
+# the documented sweep shape the ledger audits at: bench.py's
+# all-protocol grid point (n=5, one client per region, 50 commands per
+# client, recycled 64-slot dot window), batched over SWEEP_LANES lanes
+SWEEP_SHAPE: Dict[str, int] = dict(n=5, clients=5, commands=50, dot_slots=64)
+
+# ----------------------------------------------------------------------
+# kernel classification (docs/PERF.md "cost model": each fusion,
+# scatter, gather, reduce, sort and loop iteration is its own kernel)
+# ----------------------------------------------------------------------
+
+BOUNDARY_CLASS: Dict[str, str] = {}
+for _p in ("scatter", "scatter-add", "scatter-mul", "scatter-max",
+           "scatter-min", "select_and_scatter_add", "dynamic_update_slice"):
+    BOUNDARY_CLASS[_p] = "scatter"
+for _p in ("gather", "dynamic_slice"):
+    BOUNDARY_CLASS[_p] = "gather"
+for _p in ("reduce_sum", "reduce_prod", "reduce_max", "reduce_min",
+           "reduce_and", "reduce_or", "reduce_xor", "argmax", "argmin",
+           "reduce_window_sum", "reduce_window_max", "reduce_window_min"):
+    BOUNDARY_CLASS[_p] = "reduce"
+for _p in ("cumsum", "cumprod", "cummax", "cummin", "cumlogsumexp"):
+    BOUNDARY_CLASS[_p] = "cumulative"
+for _p in ("sort", "top_k"):
+    BOUNDARY_CLASS[_p] = "sort"
+for _p in ("dot_general", "conv_general_dilated"):
+    BOUNDARY_CLASS[_p] = "matmul"
+# loop prims are handled specially (body kernels x trips); the class
+# only names them in the per-class breakdown
+for _p in ("scan", "while", "cond"):
+    BOUNDARY_CLASS[_p] = "loop"
+
+# fusable-elementwise / shape-only prims: XLA merges chains of these
+# into one kernel. Anything neither here nor in BOUNDARY_CLASS counts
+# as a boundary of class "other" — conservative for a *regression*
+# gate (a genuinely fusable new primitive shows up as a count bump to
+# be reviewed, never as a silent pass).
+FUSABLE = frozenset({
+    "add", "sub", "mul", "neg", "abs", "sign", "max", "min", "clamp",
+    "select_n", "rem", "div", "pow", "integer_pow", "exp", "log",
+    "expm1", "log1p", "sqrt", "rsqrt", "square", "floor", "ceil",
+    "round", "sin", "cos", "tanh", "logistic", "erf", "is_finite",
+    "eq", "ne", "lt", "le", "gt", "ge", "and", "or", "xor", "not",
+    "shift_left", "shift_right_logical", "shift_right_arithmetic",
+    "population_count", "clz", "nextafter",
+    "convert_element_type", "bitcast_convert_type", "reduce_precision",
+    "broadcast_in_dim", "reshape", "squeeze", "expand_dims",
+    "transpose", "rev", "slice", "concatenate", "pad", "iota", "copy",
+    "stop_gradient",
+    "random_wrap", "random_unwrap", "random_bits", "random_fold_in",
+    "random_split", "random_clone", "threefry2x32",
+})
+
+
+def classify(prim: str) -> str:
+    """Kernel class of a primitive: ``"fused"`` for fusable
+    elementwise/shape ops, else the boundary class name."""
+    if prim in FUSABLE:
+        return "fused"
+    return BOUNDARY_CLASS.get(prim, "other")
+
+
+def _bytes(aval) -> int:
+    dt = _np_dtype(aval)
+    shape = getattr(aval, "shape", None)
+    if dt is None or shape is None:
+        return 0  # extended dtypes (PRNG keys): negligible
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n * dt.itemsize
+
+
+# ----------------------------------------------------------------------
+# fusion grouping + per-group liveness
+# ----------------------------------------------------------------------
+
+
+@dataclass
+class GroupStat:
+    """One fused-elementwise group's footprint."""
+
+    peak_bytes: int            # max simultaneously-live intermediate bytes
+    eqns: int                  # equations merged into the group
+    anchor: Tuple[str, str, str]  # (file, function, prim) of the largest value
+    largest_bytes: int
+    largest_shape: Tuple[int, ...]
+    line: int
+
+
+def _fusion_groups(flat: List[FlatEqn]) -> List[List[int]]:
+    """Connected components of fusable equations over def-use edges —
+    the fusion heuristic: XLA merges producer/consumer elementwise
+    chains; every boundary prim cuts the component."""
+    parent = list(range(len(flat)))
+
+    def find(i: int) -> int:
+        while parent[i] != i:
+            parent[i] = parent[parent[i]]
+            i = parent[i]
+        return i
+
+    def union(i: int, j: int) -> None:
+        ri, rj = find(i), find(j)
+        if ri != rj:
+            parent[ri] = rj
+
+    def_of: Dict[Any, int] = {}
+    fusable = [classify(e.prim) == "fused" for e in flat]
+    for i, e in enumerate(flat):
+        if fusable[i]:
+            for v in e.outvars:
+                def_of[v] = i
+    for i, e in enumerate(flat):
+        if not fusable[i]:
+            continue
+        for v in e.invars:
+            if not _is_literal(v) and v in def_of:
+                union(i, def_of[v])
+    groups: Dict[int, List[int]] = {}
+    for i in range(len(flat)):
+        if fusable[i]:
+            groups.setdefault(find(i), []).append(i)
+    return [sorted(g) for g in groups.values()]
+
+
+def _group_stat(flat: List[FlatEqn], group: List[int],
+                uses: Dict[Any, List[int]]) -> GroupStat:
+    """Peak live intermediate bytes for one fused group: a value lives
+    from its defining position to its last in-group use. Values
+    consumed *outside* the group (or carried in the jaxpr outputs) are
+    fusion outputs — they stream to HBM as produced, so they count at
+    their production point but do not stack to the end of the group
+    (holding every output live would charge a long fusion for its
+    whole output set at once, which is not how the documented crashes
+    behaved — the killer was one oversized in-flight broadcast)."""
+    pos = {idx: p for p, idx in enumerate(group)}
+    gset = set(group)
+    delta = [0] * (len(group) + 1)
+    largest, largest_eqn, largest_shape = 0, group[0], ()
+    for idx in group:
+        e = flat[idx]
+        for v in e.outvars:
+            b = _bytes(v.aval)
+            if b == 0:
+                continue
+            in_group = [
+                pos[c] for c in uses.get(v, ()) if c in gset
+            ]
+            end = max(in_group) if in_group else pos[idx]
+            delta[pos[idx]] += b
+            delta[end + 1] -= b
+            if b > largest:
+                largest, largest_eqn = b, idx
+                largest_shape = tuple(
+                    int(s) for s in getattr(e.outvars[0].aval, "shape", ())
+                )
+    peak = cur = 0
+    for d in delta[:-1]:
+        cur += d
+        peak = max(peak, cur)
+    anchor_eqn = flat[largest_eqn]
+    return GroupStat(
+        peak_bytes=peak,
+        eqns=len(group),
+        anchor=(anchor_eqn.src[0], anchor_eqn.src[1], anchor_eqn.prim),
+        largest_bytes=largest,
+        largest_shape=largest_shape,
+        line=anchor_eqn.src[2],
+    )
+
+
+# ----------------------------------------------------------------------
+# the ledger
+# ----------------------------------------------------------------------
+
+
+@dataclass
+class CostLedger:
+    audit: str
+    kernels: int
+    fusion_groups: int
+    boundaries: Dict[str, int]
+    est_ms: Tuple[float, float]
+    groups: List[GroupStat]
+
+    @property
+    def peak(self) -> Optional[GroupStat]:
+        return max(self.groups, key=lambda g: g.peak_bytes, default=None)
+
+    def summary(self) -> Dict[str, Any]:
+        pk = self.peak
+        return {
+            "kernels": self.kernels,
+            "fusion_groups": self.fusion_groups,
+            "boundaries": dict(sorted(self.boundaries.items())),
+            "est_ms_step": [
+                round(self.kernels * KERNEL_MS_LO, 2),
+                round(self.kernels * KERNEL_MS_HI, 2),
+            ],
+            "peak_fused_mib": round((pk.peak_bytes if pk else 0) / 2**20, 1),
+            "peak_anchor": (
+                f"{pk.anchor[0]}:{pk.anchor[1]}:{pk.anchor[2]}"
+                f"{list(pk.largest_shape)}" if pk else None
+            ),
+        }
+
+
+def _ledger_core(
+    flat: List[FlatEqn],
+) -> Tuple[int, Counter, List[GroupStat]]:
+    """(kernel count, per-class boundary counts, fused-group stats) for
+    one flat equation list; loop bodies recurse (their kernels multiply
+    by the trip count, their group footprints count once — only one
+    iteration's intermediates are live at a time)."""
+    boundaries: Counter = Counter()
+    kernels = 0
+    groups: List[GroupStat] = []
+
+    def recurse(jaxpr) -> int:
+        body = flatten_jaxpr(_closedify(jaxpr))
+        k, b, g = _ledger_core(body[0])
+        boundaries.update(b)
+        groups.extend(g)
+        return k
+
+    for eqn in flat:
+        cls = classify(eqn.prim)
+        if cls == "fused":
+            continue
+        if eqn.prim == "scan" and "jaxpr" in eqn.params:
+            k = recurse(eqn.params["jaxpr"])
+            trips = int(eqn.params.get("length", 1))
+            kernels += trips * k
+            boundaries["loop"] += trips * k - k  # body classes count once
+            continue
+        if eqn.prim == "while":
+            # trip count is dynamic: count one iteration's kernels (a
+            # lower bound — documented in docs/LINT.md#gl201)
+            body = eqn.params.get("body_jaxpr")
+            if body is not None:
+                kernels += recurse(body)
+            continue
+        if eqn.prim == "cond":
+            worst = max(
+                (recurse(br) for br in eqn.params.get("branches", ())),
+                default=0,
+            )
+            kernels += worst + 1
+            boundaries["loop"] += 1
+            continue
+        boundaries[cls] += 1
+        kernels += 1
+
+    uses: Dict[Any, List[int]] = {}
+    for i, e in enumerate(flat):
+        for v in e.invars:
+            if not _is_literal(v):
+                uses.setdefault(v, []).append(i)
+    own = [_group_stat(flat, g, uses) for g in _fusion_groups(flat)]
+    kernels += len(own)
+    return kernels, boundaries, own + groups
+
+
+def build_ledger(closed, audit: str) -> CostLedger:
+    """Run the ledger over a closed (typically batched) jaxpr."""
+    return build_ledger_from_parts(flatten_jaxpr(closed), audit)
+
+
+def build_ledger_from_parts(parts, audit: str) -> CostLedger:
+    flat = parts[0]
+    kernels, boundaries, groups = _ledger_core(flat)
+    return CostLedger(
+        audit=audit,
+        kernels=kernels,
+        fusion_groups=len(groups),
+        boundaries=dict(boundaries),
+        est_ms=(kernels * KERNEL_MS_LO, kernels * KERNEL_MS_HI),
+        groups=groups,
+    )
+
+
+# ----------------------------------------------------------------------
+# baseline + findings
+# ----------------------------------------------------------------------
+
+
+def load_cost_baseline(path: str = DEFAULT_COST_BASELINE) -> Dict[str, Any]:
+    """``{"kernels": {audit: count}, "vmem_peak_mib": {audit: mib},
+    "vmem_headroom": float, "lanes": int}`` — top-level ``_``-prefixed
+    keys are comments."""
+    with open(path) as fh:
+        data = json.load(fh)
+    assert isinstance(data, dict) and isinstance(
+        data.get("kernels"), dict
+    ), "cost baseline must carry a kernels map"
+    return data
+
+
+# a protocol's effective VMEM gate is headroom x its baselined peak:
+# healthy graphs carry protocol-specific streaming footprints (caesar's
+# dep tensors dwarf basic's), so only a relative gate separates "the
+# shape this protocol already runs" from a crash-class blowup
+DEFAULT_VMEM_HEADROOM = 1.25
+
+
+def write_cost_baseline(path: str, summary: Dict[str, Dict[str, Any]],
+                        lanes: int,
+                        headroom: float = DEFAULT_VMEM_HEADROOM) -> None:
+    payload = {
+        "_comment": (
+            "graft-cost gate: per-protocol kernel count and peak "
+            "fused-group VMEM footprint of the batched step at the "
+            "documented sweep shape. Regenerate with `python -m "
+            "fantoch_tpu.cli lint --cost --write-cost-baseline` and "
+            "REVIEW the diff — a kernel-count increase is a per-step "
+            "device cost increase of ~0.1-0.3 ms per kernel, and a "
+            "peak increase past vmem_headroom is the documented "
+            "worker-crash class (docs/LINT.md#gl201)."
+        ),
+        "lanes": lanes,
+        "vmem_headroom": headroom,
+        "kernels": {
+            name: info["kernels"] for name, info in sorted(summary.items())
+        },
+        "vmem_peak_mib": {
+            name: int(-(-info["peak_fused_mib"] // 1))
+            for name, info in sorted(summary.items())
+        },
+    }
+    with open(path, "w") as fh:
+        json.dump(payload, fh, indent=2)
+        fh.write("\n")
+
+
+def cost_findings(ledger: CostLedger,
+                  baseline: Optional[Dict[str, Any]],
+                  vmem_budget_mib: Optional[float] = None) -> List[Finding]:
+    """GL201 (kernel regression vs baseline) + GL202 (fused group over
+    the protocol's VMEM gate) findings for one ledger. Both rules only
+    emit on violation, so every finding is a regression by
+    construction — the suppression baseline never needs entries for
+    them. ``vmem_budget_mib`` overrides the baseline-derived gate
+    (unit-test surface)."""
+    out: List[Finding] = []
+    budget = vmem_budget_mib
+    if baseline is not None:
+        allowed = baseline.get("kernels", {}).get(ledger.audit)
+        if budget is None:
+            peak = baseline.get("vmem_peak_mib", {}).get(ledger.audit)
+            if peak is not None:
+                budget = float(
+                    baseline.get("vmem_headroom", DEFAULT_VMEM_HEADROOM)
+                ) * float(peak)
+        if allowed is None:
+            out.append(
+                Finding(
+                    "GL201",
+                    ledger.audit,
+                    "engine/core.py:_lane_step:kernels",
+                    f"no cost-baseline entry for `{ledger.audit}` "
+                    f"({ledger.kernels} kernels/step observed) — "
+                    "regenerate with `lint --cost --write-cost-baseline`"
+                    " and review the count",
+                )
+            )
+        elif ledger.kernels > int(allowed):
+            d = ledger.kernels - int(allowed)
+            out.append(
+                Finding(
+                    "GL201",
+                    ledger.audit,
+                    "engine/core.py:_lane_step:kernels",
+                    f"kernel ledger regressed: {ledger.kernels} "
+                    f"kernels/step vs baseline {allowed} (+{d} ≈ "
+                    f"+{d * KERNEL_MS_LO:.1f}-{d * KERNEL_MS_HI:.1f} "
+                    "ms/step at the measured per-kernel overhead; "
+                    "docs/LINT.md#gl201)",
+                    detail=json.dumps(
+                        dict(sorted(ledger.boundaries.items()))
+                    ),
+                )
+            )
+    if budget is not None:
+        budget_b = float(budget) * 2**20
+        for g in ledger.groups:
+            if g.peak_bytes > budget_b:
+                out.append(
+                    Finding(
+                        "GL202",
+                        ledger.audit,
+                        f"{g.anchor[0]}:{g.anchor[1]}:{g.anchor[2]}",
+                        f"fused elementwise group peaks at "
+                        f"{g.peak_bytes / 2**20:.0f} MiB of live "
+                        f"intermediates (> the {budget:.0f} MiB gate "
+                        f"for `{ledger.audit}`) at the documented "
+                        "sweep shape — the VMEM worker-crash class; "
+                        f"largest intermediate {list(g.largest_shape)} "
+                        f"({g.largest_bytes / 2**20:.0f} MiB); break "
+                        "the fusion (per-slice accumulation like "
+                        "iset_contains_gathered) or shrink the "
+                        "broadcast (docs/LINT.md#gl202)",
+                        detail=f"line {g.line}, {g.eqns} eqns in group",
+                    )
+                )
+    return out
+
+
+# ----------------------------------------------------------------------
+# driver surface
+# ----------------------------------------------------------------------
+
+
+def sweep_trace(name: str, cache=None) -> StepTrace:
+    """The cost pass's trace of ``name`` at the documented sweep shape
+    (cache key ``("cost", name)`` when a TraceCache is supplied)."""
+    build = lambda: build_protocol_trace(  # noqa: E731
+        name, audit=name, **SWEEP_SHAPE
+    )
+    if cache is None:
+        return build()
+    return cache.get(("cost", name), build)
+
+
+def ledger_for(name: str, cache=None, lanes: int = SWEEP_LANES) -> CostLedger:
+    trace = sweep_trace(name, cache)
+    return build_ledger_from_parts(trace.batched_flat_parts(lanes), name)
+
+
+def run_cost(protocols, cache=None, baseline: Optional[Dict[str, Any]] = None,
+             vmem_budget_mib: Optional[int] = None, progress=None,
+             ) -> Tuple[List[Finding], Dict[str, Dict[str, Any]]]:
+    """GL201 + GL202 over every protocol in ``protocols``. Returns
+    (findings, per-protocol summary). ``baseline=None`` skips the
+    GL201 gate (summary only) — the CLI passes the checked-in file."""
+    say = progress or (lambda *_: None)
+    findings: List[Finding] = []
+    summary: Dict[str, Dict[str, Any]] = {}
+    lanes = int((baseline or {}).get("lanes", SWEEP_LANES))
+    for name in protocols:
+        say(f"cost ledger: {name} ({lanes} lanes) ...")
+        ledger = ledger_for(name, cache, lanes)
+        findings.extend(cost_findings(ledger, baseline, vmem_budget_mib))
+        summary[name] = ledger.summary()
+    return findings, summary
+
+
+def static_kernel_cost(protocol: str = "tempo",
+                       lanes: int = SWEEP_LANES) -> Dict[str, Any]:
+    """Device-free kernel-cost estimate for one protocol's batched step
+    at the documented sweep shape — bench.py embeds this in its
+    artifact so a run with an unreachable TPU backend still carries a
+    real static number instead of only zeros."""
+    ledger = ledger_for(protocol, None, lanes)
+    out = {"protocol": protocol, "lanes": lanes, **ledger.summary()}
+    return out
+
+
+# ----------------------------------------------------------------------
+# CI self-check: seeded defects that must fail the gate
+# ----------------------------------------------------------------------
+
+
+def selfcheck_trace(kind: str) -> StepTrace:
+    """Re-trace tempo's sweep-shape step with a seeded defect appended:
+    ``"scatter"`` adds one dynamic-index row scatter (a GL201 kernel
+    regression), ``"vmem"`` builds a ``[lanes, N, D, deps, G, 2]``-class
+    broadcast intermediate inside a fused chain (a GL202 budget blowout
+    replicating the documented worker crash). The defective trace
+    audits under the ``tempo`` name so it gates against the real
+    checked-in baseline."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..engine.core import _lane_step
+
+    assert kind in ("scatter", "vmem"), kind
+    base = sweep_trace("tempo")
+    protocol, dims = base.protocol, base.dims
+
+    def wrapped(st, ctx):
+        out = _lane_step(
+            protocol, dims, st, ctx, False, base.faults, base.monitor_keys
+        )
+        if kind == "scatter":
+            pool = out["pool"]
+            row = out["steps"] % pool.shape[0]
+            pool = pool.at[row, 0].set(out["steps"])
+            out = dict(out, pool=pool)
+        else:
+            # the documented crash shape class [lanes, N, D, deps, G, 2]
+            # (deps sized past the baseline headroom so the relative
+            # gate must trip): ~1.3 GiB live at 512 lanes
+            i32 = jnp.int32
+            big = (
+                jnp.arange(dims.N, dtype=i32)[:, None, None, None, None]
+                + jnp.arange(dims.D, dtype=i32)[None, :, None, None, None]
+                + jnp.arange(128, dtype=i32)[None, None, :, None, None]
+                + jnp.arange(8, dtype=i32)[None, None, None, :, None]
+                + (out["now"] + jnp.arange(2, dtype=i32))[
+                    None, None, None, None, :
+                ]
+            )
+            out = dict(out, now=out["now"] + 0 * jnp.max(big))
+        return out
+
+    closed = jax.make_jaxpr(wrapped)(base.state, base.ctx)
+    return StepTrace(
+        "tempo", protocol, dims, base.state, base.ctx, base.faults,
+        base.monitor_keys, closed, base.leaf_names,
+    )
+
+
+def run_cost_selfcheck(kind: str,
+                       baseline: Optional[Dict[str, Any]] = None,
+                       progress=None) -> List[Finding]:
+    """The CI broken-fixture check: the seeded ``kind`` defect must
+    produce at least one GL201/GL202 finding against the checked-in
+    baseline, or the gate itself is broken."""
+    say = progress or (lambda *_: None)
+    say(f"cost self-check: seeded `{kind}` defect ...")
+    if baseline is None:
+        baseline = load_cost_baseline()
+    trace = selfcheck_trace(kind)
+    lanes = int(baseline.get("lanes", SWEEP_LANES))
+    ledger = build_ledger(trace.batched_closed(lanes), "tempo")
+    return cost_findings(ledger, baseline)
+
+
+if __name__ == "__main__":  # pragma: no cover — bench subprocess entry
+    # device-free: run under JAX_PLATFORMS=cpu (bench.py's subprocess
+    # sets it; a dead TPU tunnel must never hang this computation)
+    import sys
+
+    proto = sys.argv[1] if len(sys.argv) > 1 else "tempo"
+    print(json.dumps(static_kernel_cost(proto)))

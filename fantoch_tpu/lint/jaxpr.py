@@ -389,6 +389,14 @@ def _is_literal(a) -> bool:
     return isinstance(a, (Literal, _Const))
 
 
+def _closedify(j):
+    """Wrap a bare ``Jaxpr`` param (scan/while bodies on some jax
+    versions) as a const-free ``ClosedJaxpr``."""
+    if hasattr(j, "consts"):
+        return j
+    return ClosedJaxpr(j, ())
+
+
 def flatten_jaxpr(closed):
     """Inline pjit/call sub-jaxprs into one flat equation list. Every
     defined value gets a fresh :class:`_FVar` identity (sub-jaxprs may
@@ -1037,6 +1045,75 @@ class StepTrace:
     monitor_keys: int
     closed: Any  # ClosedJaxpr
     leaf_names: List[str] = field(default_factory=list)
+    # memoized flatten (the jaxpr is immutable; every pass that walks
+    # equations — interval audit, cost ledger, VMEM estimator — shares
+    # this instead of re-inlining the pjit tree per pass)
+    _flat: Any = field(default=None, repr=False, compare=False)
+    # memoized vmapped re-traces keyed by batch size (lint/lanes.py)
+    _batched: Dict[int, Any] = field(
+        default_factory=dict, repr=False, compare=False
+    )
+    _batched_flat: Dict[int, Any] = field(
+        default_factory=dict, repr=False, compare=False
+    )
+
+    def flat_parts(self):
+        """``(flat_eqns, root_invars, root_outvars)`` — computed once."""
+        if self._flat is None:
+            self._flat = flatten_jaxpr(self.closed)
+        return self._flat
+
+    def batched_flat_parts(self, lanes: int):
+        """Flattened form of :meth:`batched_closed` — computed once per
+        batch size so the cost ledger and the lane-taint pass share
+        both the replay and the flatten."""
+        if lanes not in self._batched_flat:
+            self._batched_flat[lanes] = flatten_jaxpr(
+                self.batched_closed(lanes)
+            )
+        return self._batched_flat[lanes]
+
+    def batched_closed(self, lanes: int):
+        """Re-trace this step under ``vmap`` with an abstract batch of
+        ``lanes`` lanes (the sweep driver's vmap axis) by replaying the
+        already-traced jaxpr through the batching interpreter — no
+        protocol Python re-runs, and equation source info survives the
+        replay, so findings still anchor to engine/protocol lines."""
+        if lanes not in self._batched:
+            import jax
+
+            try:  # jax >= 0.4.33
+                from jax.extend.core import jaxpr_as_fun
+            except ImportError:  # pragma: no cover — older jax
+                from jax.core import jaxpr_as_fun
+
+            fn = jaxpr_as_fun(self.closed)
+            structs = [
+                jax.ShapeDtypeStruct(
+                    (lanes,) + tuple(v.aval.shape), v.aval.dtype
+                )
+                for v in self.closed.jaxpr.invars
+            ]
+            self._batched[lanes] = jax.make_jaxpr(
+                jax.vmap(lambda *xs: fn(*xs))
+            )(*structs)
+        return self._batched[lanes]
+
+
+class TraceCache:
+    """Per-run memo of :class:`StepTrace` objects so the jaxpr audit,
+    gating differ, cost ledger and lane prover share one trace (and one
+    flatten) per protocol variant instead of re-tracing per pass — the
+    trace budget stays ~the number of *distinct* variants, not
+    variants × passes."""
+
+    def __init__(self) -> None:
+        self._traces: Dict[Any, StepTrace] = {}
+
+    def get(self, key, builder) -> StepTrace:
+        if key not in self._traces:
+            self._traces[key] = builder()
+        return self._traces[key]
 
 
 def _leaf_names(tree) -> List[str]:
@@ -1055,7 +1132,8 @@ def _leaf_names(tree) -> List[str]:
 
 
 def trace_step(protocol, dims, state, ctx, faults=None,
-               monitor_keys: int = 0, name: str = "step") -> StepTrace:
+               monitor_keys: int = 0, name: str = "step",
+               reorder: bool = False) -> StepTrace:
     import jax
 
     from ..engine.core import _lane_step
@@ -1065,7 +1143,7 @@ def trace_step(protocol, dims, state, ctx, faults=None,
 
     closed = jax.make_jaxpr(
         lambda s, c: _lane_step(
-            protocol, dims, s, c, False, faults, monitor_keys
+            protocol, dims, s, c, reorder, faults, monitor_keys
         )
     )(state, ctx)
     return StepTrace(
@@ -1076,7 +1154,9 @@ def trace_step(protocol, dims, state, ctx, faults=None,
 
 def build_protocol_trace(name: str, *, n: int = 3, clients: int = 3,
                          commands: int = 2, shards: int = 1,
-                         faults=None, monitor_keys: int = 0) -> StepTrace:
+                         dot_slots: "int | None" = None,
+                         faults=None, monitor_keys: int = 0,
+                         audit: "str | None" = None) -> StepTrace:
     """Build a small representative lane for ``name`` and trace its
     step (abstract values only — no XLA compile, ~1 s per protocol)."""
     from ..core.config import Config
@@ -1107,7 +1187,9 @@ def build_protocol_trace(name: str, *, n: int = 3, clients: int = 3,
         config = Config(**dev_config_kwargs(name, n, 1))
         dims = EngineDims.for_protocol(
             dev, n=n, clients=clients, payload=dev.payload_width(n),
-            total_commands=total, dot_slots=total + 1, regions=n,
+            total_commands=total,
+            dot_slots=dot_slots if dot_slots is not None else total + 1,
+            regions=n,
         )
     # multi-key partial commands need a pool that can produce distinct
     # keys; single-shard lanes keep the max-conflict workload
@@ -1119,11 +1201,12 @@ def build_protocol_trace(name: str, *, n: int = 3, clients: int = 3,
         faults=faults,
     )
     state = init_lane_state(dev, dims, spec.ctx, monitor_keys=monitor_keys)
-    audit = name if shards == 1 else f"{name}@{shards}shards"
-    if faults is not None:
-        audit += "+faults"
-    if monitor_keys:
-        audit += "+mon"
+    if audit is None:
+        audit = name if shards == 1 else f"{name}@{shards}shards"
+        if faults is not None:
+            audit += "+faults"
+        if monitor_keys:
+            audit += "+mon"
     return trace_step(
         dev, dims, state, spec.ctx, spec.fault_flags, monitor_keys, audit
     )
@@ -1131,7 +1214,7 @@ def build_protocol_trace(name: str, *, n: int = 3, clients: int = 3,
 
 def audit_trace(trace: StepTrace) -> List[Finding]:
     """Run the interval pass (GL001-GL004) over one traced step."""
-    flat, invars, outvars = flatten_jaxpr(trace.closed)
+    flat, invars, outvars = trace.flat_parts()
     ana = IntervalAnalysis(flat, trace.name, outvars=outvars)
     assert len(invars) == len(trace.leaf_names), (
         len(invars), len(trace.leaf_names),

@@ -1,0 +1,640 @@
+"""graft-lanes: lane-independence taint analysis (GL203).
+
+The sweep driver runs ``vmap(run_lane)`` and shards the lane axis over
+the device mesh. Sharding is only *bit-safe* if no equation of the
+batched step mixes data across lanes — a cross-lane reduction, a
+gather whose indices reach into other lanes' rows, a sort or reverse
+over the lane axis. ``vmap`` constructs such a graph today, but
+nothing stopped a hand-batched rewrite (or a cross-lane "global
+normalization") from silently breaking the property the multichip
+sweeps rely on.
+
+This pass *proves* it per protocol: the traced step is replayed under
+``vmap`` with an abstract batch of :data:`TAINT_LANES` lanes
+(:meth:`StepTrace.batched_closed` — equation source info survives the
+replay), then a forward taint walk tracks, for every value, **which
+axis carries the lane dimension**:
+
+* ``None`` — unbatched (trace constants, shared tables); identical for
+  every lane, safe anywhere.
+* ``k`` (an int) — batched: lane *i*'s data lives at index *i* of
+  axis ``k``, and only lane *i*'s data.
+* ``MIXED`` — lane data smeared across lanes. Any equation that
+  *creates* MIXED from clean inputs is a GL203 finding.
+
+Transfer rules are structural per primitive (reduce/cum/sort axes
+checked against the lane axis; gather/scatter batching dims checked
+against ``operand_batching_dims``/``start_indices_batching_dims``;
+``dot_general`` lane dims must ride the dot's batch dims). A
+positional axis-size fallback applies ONLY to the allowlisted
+leading-axis-preserving primitives (PRNG plumbing, trailing-dim
+bitcasts); every other primitive without a rule degrades to MIXED —
+conservative: a false positive names a rule to add, never a silent
+pass. What the verdict does and does not prove: docs/LINT.md#gl203.
+
+The HEAD verdict gates the lane-sharded sweep path
+(``parallel/sweep.py run_sweep(shard_lanes=True)``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .jaxpr import FlatEqn, StepTrace, _closedify, _is_literal, flatten_jaxpr
+from .report import Finding
+
+# distinctive prime batch size for the abstract taint trace: no engine
+# dimension (pool rows, histogram buckets, dot slots) is ever 8191, so
+# the leading-axis size check for the allowlisted PRNG/bitcast
+# primitives cannot collide. The lint gate also taints cost traces at
+# the 512-lane sweep batch — sound there too, because the size check
+# only ever decides allowlisted primitives, never unknown ones
+TAINT_LANES = 8191
+
+# lane data smeared across lanes (the violation state)
+MIXED = "MIXED"
+
+# primitives with lane-permuting or cross-element semantics that have
+# no structural rule here: batched inputs conservatively degrade to
+# MIXED (none appear in the engine step at HEAD)
+CONSERVATIVE_MIXED = {
+    "conv_general_dilated", "select_and_scatter_add", "cond",
+    "fft", "triangular_solve", "cholesky",
+}
+
+# primitives known to preserve leading axes while changing trailing
+# structure (PRNG plumbing, bitcasts growing/shrinking a trailing
+# dim): the ONLY primitives the size-based leading-axis fallback
+# applies to. A primitive in neither this set nor the structural
+# rules degrades to MIXED — so the fallback's axis-size check never
+# decides a truly-unknown primitive, and running the taint at the
+# 512-lane sweep batch (where a histogram axis could alias the size)
+# stays sound
+LEADING_AXIS_PRESERVING = {
+    "bitcast_convert_type", "reduce_precision", "copy",
+    "stop_gradient", "random_wrap", "random_unwrap", "random_bits",
+    "random_fold_in", "random_split", "random_clone", "threefry2x32",
+}
+
+# elementwise primitives (rank-equal, dims broadcast 1 -> n): the lane
+# axis of every batched operand must be full-size (never broadcast —
+# it carries 8191 distinct lanes) and survives at the same position
+ELEMENTWISE = {
+    "add", "sub", "mul", "neg", "abs", "sign", "max", "min", "clamp",
+    "select_n", "rem", "div", "pow", "integer_pow", "exp", "log",
+    "expm1", "log1p", "sqrt", "rsqrt", "square", "floor", "ceil",
+    "round", "sin", "cos", "tanh", "logistic", "erf", "is_finite",
+    "eq", "ne", "lt", "le", "gt", "ge", "and", "or", "xor", "not",
+    "eq_to", "ne_to", "lt_to", "le_to", "gt_to", "ge_to",
+    "shift_left", "shift_right_logical", "shift_right_arithmetic",
+    "population_count", "clz", "nextafter", "convert_element_type",
+}
+
+
+def _dn_tuple(dn, attr) -> tuple:
+    return tuple(int(x) for x in getattr(dn, attr, ()) or ())
+
+
+class LaneTaint:
+    """One forward pass over a flattened batched jaxpr."""
+
+    def __init__(self, flat: List[FlatEqn], audit: str, lanes: int):
+        self.flat = flat
+        self.audit = audit
+        self.lanes = lanes
+        self.env: Dict[Any, Any] = {}  # var -> None | int | MIXED
+        self.findings: List[Finding] = []
+
+    # -- plumbing ------------------------------------------------------
+
+    def read(self, a):
+        if _is_literal(a):
+            return None
+        return self.env.get(a)
+
+    def _shape(self, a):
+        aval = getattr(a, "aval", None)
+        return tuple(getattr(aval, "shape", ()) or ())
+
+    def _flag(self, eqn: FlatEqn, why: str) -> None:
+        self.findings.append(
+            Finding(
+                "GL203",
+                self.audit,
+                f"{eqn.src[0]}:{eqn.src[1]}:{eqn.prim}",
+                f"lane-mixing `{eqn.prim}`: {why} — the step is not "
+                "lane-independent, so lane-sharding the sweep would "
+                "change results (docs/LINT.md#gl203)",
+                detail=f"line {eqn.src[2]}",
+            )
+        )
+
+    # -- per-primitive transfer ----------------------------------------
+
+    def transfer(self, eqn: FlatEqn):
+        """Taints for eqn outputs, or MIXED (the caller flags). Inputs
+        are guaranteed clean (no MIXED) when called."""
+        p = eqn.prim
+        ins = [
+            (a, self.read(a))
+            for a in eqn.invars
+        ]
+        batched = [(a, t) for a, t in ins if t is not None]
+        if not batched:
+            return [None] * len(eqn.outvars)
+        axes = {t for _, t in batched}
+
+        if p in CONSERVATIVE_MIXED:
+            return MIXED
+
+        # reductions/cumulations/argreductions: lane axis must survive
+        if p in ("reduce_sum", "reduce_prod", "reduce_max", "reduce_min",
+                 "reduce_and", "reduce_or", "reduce_xor", "argmax",
+                 "argmin"):
+            if len(axes) != 1:
+                return MIXED
+            k = axes.pop()
+            red = tuple(int(x) for x in eqn.params.get("axes", ()))
+            if k in red:
+                return MIXED  # cross-lane reduction
+            out = k - sum(1 for a in red if a < k)
+            return [out] * len(eqn.outvars)
+
+        if p in ("cumsum", "cumprod", "cummax", "cummin", "cumlogsumexp"):
+            (k,) = axes
+            if int(eqn.params.get("axis", 0)) == k:
+                return MIXED
+            return [k] * len(eqn.outvars)
+
+        if p == "sort":
+            if len(axes) != 1:
+                return MIXED
+            k = axes.pop()
+            if int(eqn.params.get("dimension", -1)) == k:
+                return MIXED  # sorting lanes reorders them
+            return [k] * len(eqn.outvars)
+
+        if p == "rev":
+            (k,) = axes
+            if k in tuple(int(x) for x in eqn.params.get("dimensions", ())):
+                return MIXED  # reversing the lane axis permutes lanes
+            return [k] * len(eqn.outvars)
+
+        if p == "broadcast_in_dim":
+            (k,) = axes
+            bd = tuple(int(x) for x in eqn.params["broadcast_dimensions"])
+            return [bd[k]] * len(eqn.outvars)
+
+        if p == "reshape":
+            (k,) = axes
+            if eqn.params.get("dimensions") is not None:
+                return MIXED  # permuting reshape
+            ish = self._shape(batched[0][0])
+            osh = tuple(
+                int(x) for x in eqn.params.get(
+                    "new_sizes", self._shape(eqn.outvars[0])
+                )
+            )
+            pre = 1
+            for s in ish[:k]:
+                pre *= int(s)
+            acc = 1
+            for j, s in enumerate(osh):
+                if acc == pre and int(s) == int(ish[k]):
+                    return [j] * len(eqn.outvars)
+                acc *= int(s)
+            return MIXED  # lane axis merged with another dimension
+
+        if p == "squeeze":
+            (k,) = axes
+            dims = tuple(int(x) for x in eqn.params.get("dimensions", ()))
+            if k in dims:
+                return MIXED  # impossible with lanes > 1; be safe
+            return [k - sum(1 for d in dims if d < k)] * len(eqn.outvars)
+
+        if p == "transpose":
+            (k,) = axes
+            perm = tuple(int(x) for x in eqn.params["permutation"])
+            return [perm.index(k)] * len(eqn.outvars)
+
+        if p == "slice":
+            (k,) = axes
+            ish = self._shape(batched[0][0])
+            start = int(eqn.params["start_indices"][k])
+            limit = int(eqn.params["limit_indices"][k])
+            strides = eqn.params.get("strides")
+            stride = int(strides[k]) if strides else 1
+            if start != 0 or limit != int(ish[k]) or stride != 1:
+                return MIXED  # slicing away lanes
+            return [k] * len(eqn.outvars)
+
+        if p == "pad":
+            (k,) = axes
+            lo, hi, interior = eqn.params["padding_config"][k]
+            if (int(lo), int(hi), int(interior)) != (0, 0, 0):
+                return MIXED
+            return [k] * len(eqn.outvars)
+
+        if p == "concatenate":
+            if len(axes) != 1:
+                return MIXED
+            k = axes.pop()
+            if int(eqn.params["dimension"]) == k:
+                return MIXED  # stacking along the lane axis
+            return [k] * len(eqn.outvars)
+
+        if p == "dot_general":
+            return self._dot(eqn, ins)
+
+        if p == "gather":
+            return self._gather(eqn, ins)
+
+        if p in ("scatter", "scatter-add", "scatter-mul", "scatter-max",
+                 "scatter-min"):
+            return self._scatter(eqn, ins)
+
+        if p == "dynamic_slice":
+            (k,) = axes
+            a0, t0 = ins[0]
+            if t0 != k or any(t is not None for _, t in ins[1:]):
+                return MIXED  # lane-dependent start index
+            if int(eqn.params["slice_sizes"][k]) != int(self._shape(a0)[k]):
+                return MIXED
+            return [k] * len(eqn.outvars)
+
+        if p == "dynamic_update_slice":
+            (k,) = axes
+            (op, t_op), (up, t_up) = ins[0], ins[1]
+            if any(t is not None for _, t in ins[2:]):
+                return MIXED  # lane-dependent start index
+            if t_op not in (k, None) or t_up not in (k, None):
+                return MIXED
+            up_sh, op_sh = self._shape(up), self._shape(op)
+            if (
+                k >= len(up_sh)
+                or int(up_sh[k]) != int(op_sh[k])
+                or not self._start_is_zero(eqn.invars[2 + k])
+            ):
+                return MIXED  # partial window over the lane axis
+            return [k] * len(eqn.outvars)
+
+        if p == "scan":
+            return self._scan(eqn, ins)
+
+        if p == "while":
+            return self._while(eqn, ins)
+
+        # elementwise (rank-equal jaxpr broadcasting — a dim of 1 in
+        # one operand stretches to the other's): every batched operand
+        # must carry the FULL lane axis at the same position, and the
+        # output must keep it there
+        if p in ELEMENTWISE:
+            if len(axes) != 1:
+                return MIXED
+            k = axes.pop()
+            if any(
+                k >= len(self._shape(a))
+                or int(self._shape(a)[k]) != self.lanes
+                for a, _ in batched
+            ):
+                return MIXED
+            osh = self._shape(eqn.outvars[0]) if eqn.outvars else ()
+            if k >= len(osh) or int(osh[k]) != self.lanes:
+                return MIXED
+            return [k] * len(eqn.outvars)
+
+        # rank-preserving leading axes (PRNG plumbing, bitcasts whose
+        # trailing dims change): the lane axis survives as-is when the
+        # output still carries it at the same position and size. Only
+        # the allowlisted primitives qualify — anything else is an
+        # unknown primitive and degrades to MIXED (a false positive
+        # names a rule to add; a size coincidence must never pass one)
+        if p in LEADING_AXIS_PRESERVING and len(axes) == 1:
+            k = next(iter(axes))
+            outs = []
+            for v in eqn.outvars:
+                sh = self._shape(v)
+                if k < len(sh) and int(sh[k]) == self.lanes:
+                    outs.append(k)
+                else:
+                    return MIXED
+            return outs
+
+        return MIXED
+
+    def _start_is_zero(self, a) -> bool:
+        if _is_literal(a):
+            import numpy as np
+
+            val = getattr(a, "val", None)
+            return val is not None and bool((np.asarray(val) == 0).all())
+        return False
+
+    def _dot(self, eqn: FlatEqn, ins):
+        (lhs, tl), (rhs, tr) = ins[0], ins[1]
+        (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+        lc, rc = tuple(map(int, lc)), tuple(map(int, rc))
+        lb, rb = tuple(map(int, lb)), tuple(map(int, rb))
+        lsh, rsh = self._shape(lhs), self._shape(rhs)
+        lfree = [d for d in range(len(lsh)) if d not in lc and d not in lb]
+        rfree = [d for d in range(len(rsh)) if d not in rc and d not in rb]
+        if tl is not None and tl in lc or tr is not None and tr in rc:
+            return MIXED  # contracting over the lane axis
+        if tl is not None and tl in lb:
+            pos = lb.index(tl)
+            if tr is not None and (tr not in rb or rb.index(tr) != pos):
+                return MIXED
+            return [pos] * len(eqn.outvars)
+        if tr is not None and tr in rb:
+            if tl is not None:  # lhs batched outside the dot batch dims
+                return MIXED
+            return [rb.index(tr)] * len(eqn.outvars)
+        if tl is not None:
+            if tr is not None:
+                return MIXED  # lane x lane outer product
+            return [len(lb) + lfree.index(tl)] * len(eqn.outvars)
+        if tr is not None:
+            return [
+                len(lb) + len(lfree) + rfree.index(tr)
+            ] * len(eqn.outvars)
+        return [None] * len(eqn.outvars)
+
+    def _gather(self, eqn: FlatEqn, ins):
+        (op, to), (idx, ti) = ins[0], ins[1]
+        dn = eqn.params["dimension_numbers"]
+        offset = _dn_tuple(dn, "offset_dims")
+        obd = _dn_tuple(dn, "operand_batching_dims")
+        sibd = _dn_tuple(dn, "start_indices_batching_dims")
+        op_sh = self._shape(op)
+        idx_rank = len(self._shape(idx))
+
+        def out_axis_for_indices_axis(j):
+            # indices axes except the trailing index-vector dim map, in
+            # order, onto the output axes that are not offset dims
+            out_rank = len(self._shape(eqn.outvars[0]))
+            batch_out = [a for a in range(out_rank) if a not in offset]
+            return batch_out[j] if j < len(batch_out) else None
+
+        if to is not None and to in obd:
+            # declared batching dims pair the operand's lane axis with
+            # one indices axis; a lane-constant side (broadcast iota
+            # indices) is fine — equal content per lane is stronger
+            # than batched
+            ji = sibd[obd.index(to)]
+            if ti is not None and ti != ji:
+                return MIXED
+            out = out_axis_for_indices_axis(ji)
+            return MIXED if out is None else [out] * len(eqn.outvars)
+        if to is not None and ti is None:
+            # batched operand outside the batching dims, lane-constant
+            # indices: safe only when the gathered slices cover the
+            # FULL lane axis (the clamped start is then 0, so lane
+            # rows stay aligned)
+            collapsed = _dn_tuple(dn, "collapsed_slice_dims")
+            sizes = tuple(int(x) for x in eqn.params["slice_sizes"])
+            if (
+                to in collapsed
+                or sizes[to] != int(op_sh[to])
+                or int(op_sh[to]) != self.lanes
+            ):
+                return MIXED
+            non_collapsed = [
+                a for a in range(len(op_sh))
+                if a not in collapsed and a not in obd
+            ]
+            out = offset[non_collapsed.index(to)]
+            return [out] * len(eqn.outvars)
+        if to is not None:
+            return MIXED  # batched operand + batched undeclared indices
+        if ti is not None:
+            # lane-constant operand (shared or replicated table): each
+            # lane gathers with its own indices from identical data
+            if ti >= idx_rank - 1:
+                return MIXED  # lane axis inside the index vector
+            out = out_axis_for_indices_axis(ti)
+            return MIXED if out is None else [out] * len(eqn.outvars)
+        return [None] * len(eqn.outvars)
+
+    def _scatter(self, eqn: FlatEqn, ins):
+        (op, to), (idx, ti), (upd, tu) = ins[0], ins[1], ins[2]
+        dn = eqn.params["dimension_numbers"]
+        uwd = _dn_tuple(dn, "update_window_dims")
+        iwd = _dn_tuple(dn, "inserted_window_dims")
+        sdod = _dn_tuple(dn, "scatter_dims_to_operand_dims")
+        obd = _dn_tuple(dn, "operand_batching_dims")
+        sibd = _dn_tuple(dn, "scatter_indices_batching_dims")
+        op_sh, up_sh = self._shape(op), self._shape(upd)
+
+        if to is None and tu is None and ti is None:
+            return [None] * len(eqn.outvars)
+        # update batch axes correspond, in order, to the
+        # scatter-indices axes (excluding the index vector)
+        up_batch = [a for a in range(len(up_sh)) if a not in uwd]
+        if obd:
+            # declared batching dims: derive the lane triple (operand
+            # axis, indices axis, updates axis) from whichever side is
+            # batched; lane-constant sides (broadcast templates, iota
+            # indices) are fine — equal content per lane is stronger
+            # than batched — as long as their axis sizes line up
+            if ti is not None:
+                ji = ti
+            elif tu is not None:
+                if tu not in up_batch:
+                    return MIXED
+                ji = up_batch.index(tu)
+            else:
+                if to not in obd:
+                    return MIXED
+                ji = sibd[obd.index(to)]
+            if ji not in sibd:
+                return MIXED
+            ax = obd[sibd.index(ji)]
+            if to is not None and to != ax:
+                return MIXED
+            if tu is not None and (
+                ji >= len(up_batch) or tu != up_batch[ji]
+            ):
+                return MIXED
+            if int(op_sh[ax]) != self.lanes:
+                return MIXED
+            return [ax] * len(eqn.outvars)
+        if ti is not None:
+            return MIXED  # batched indices without declared batch dims
+        # lane-constant indices, no batching dims: the lane axis must
+        # be a fully-covered window dim (implicit start 0, so lane
+        # rows stay aligned). The operand may be lane-constant (a
+        # broadcast template with the lane-sized axis) — vmap's
+        # "broadcast then write per-lane" pattern — as long as the
+        # updates' lane axis maps onto exactly that operand axis.
+        window = [a for a in range(len(op_sh)) if a not in iwd]
+        if to is not None:
+            ax = to
+        elif tu is not None:
+            if tu not in uwd:
+                return MIXED  # lane axis consumed by the index batch
+            ax = window[uwd.index(tu)]
+        else:
+            return MIXED
+        if ax in sdod or ax not in window:
+            return MIXED
+        u_axis = uwd[window.index(ax)] if window.index(ax) < len(uwd) else None
+        if u_axis is None:
+            return MIXED
+        if tu is not None and tu != u_axis:
+            return MIXED
+        if int(up_sh[u_axis]) != int(op_sh[ax]) or int(op_sh[ax]) != (
+            self.lanes
+        ):
+            return MIXED  # partial window could land in another lane
+        return [ax] * len(eqn.outvars)
+
+    @staticmethod
+    def _join(a, b):
+        """Taint lattice join: None (lane-constant) below every axis;
+        distinct axes join to MIXED; MIXED absorbs."""
+        if a is None:
+            return b
+        if b is None or a == b:
+            return a
+        return MIXED
+
+    def _loop_fixpoint(self, flat, binvars, boutvars, consts, carries):
+        """Widen loop-carry taints to a fixpoint (a carry that starts
+        lane-constant — broadcast zeros — and picks up the lane axis
+        from a batched const converges in one join), then run the body
+        once more keeping findings. Returns the converged carry-out
+        taints (the fixpoint run's findings land in self.findings)."""
+        for _ in range(4):
+            sub = LaneTaint(flat, self.audit, self.lanes)
+            for v, t in zip(binvars, consts + carries):
+                sub.env[v] = t
+            sub.run()
+            outs = [sub.read(v) for v in boutvars]
+            joined = [
+                self._join(c, o) for c, o in zip(carries, outs[:len(carries)])
+            ]
+            if joined == carries:
+                self.findings.extend(sub.findings)
+                return outs
+            carries = joined
+        # non-converging (alternating axes): degrade every carry
+        self.findings.extend(sub.findings)
+        return [MIXED] * len(boutvars)
+
+    def _scan(self, eqn: FlatEqn, ins):
+        params = eqn.params
+        nc, ncar = int(params["num_consts"]), int(params["num_carry"])
+        flat, binvars, boutvars = flatten_jaxpr(
+            _closedify(params["jaxpr"])
+        )
+        body_in: List[Any] = []
+        for i, (a, t) in enumerate(ins):
+            if i < nc + ncar:
+                body_in.append(t)
+            else:  # xs: the scan strips the leading scan axis
+                if t is None:
+                    body_in.append(None)
+                elif t == 0:
+                    return MIXED  # scanning over the lane axis
+                else:
+                    body_in.append(t - 1)
+        outs = self._loop_fixpoint(
+            flat, binvars, boutvars, body_in[:nc], body_in[nc:],
+        )
+        final = []
+        for i, t in enumerate(outs):
+            if t == MIXED:
+                return MIXED
+            if i < ncar:
+                final.append(t)
+            else:  # ys gain the leading scan axis
+                final.append(None if t is None else t + 1)
+        return final
+
+    def _while(self, eqn: FlatEqn, ins):
+        """Batched ``while``: taint the body with a carry fixpoint.
+        The vmapped cond's any-lane-running reduction is control, not
+        data (the body's select-masking keeps finished lanes frozen —
+        vmap's batching contract, pinned empirically by the sharded
+        bit-identical sweep test), so the cond jaxpr is not tainted."""
+        params = eqn.params
+        ncc = int(params.get("cond_nconsts", 0))
+        nbc = int(params.get("body_nconsts", 0))
+        flat, binvars, boutvars = flatten_jaxpr(
+            _closedify(params["body_jaxpr"])
+        )
+        taints = [t for _, t in ins]
+        consts, carries = taints[ncc:ncc + nbc], taints[ncc + nbc:]
+        outs = self._loop_fixpoint(flat, binvars, boutvars, consts, carries)
+        if any(t == MIXED for t in outs):
+            return MIXED
+        return outs
+
+    # -- the pass ------------------------------------------------------
+
+    def run(self) -> List[Finding]:
+        for eqn in self.flat:
+            in_taints = [self.read(a) for a in eqn.invars]
+            if any(t == MIXED for t in in_taints):
+                outs = [MIXED] * len(eqn.outvars)  # propagate silently
+            else:
+                try:
+                    res = self.transfer(eqn)
+                except Exception as e:  # malformed params vs a rule:
+                    # conservative — an unanalyzable equation is a
+                    # violation naming the rule to fix, never a pass
+                    self._flag(eqn, f"taint rule error ({e!r})")
+                    res = "FLAGGED"
+                if res == "FLAGGED":
+                    outs = [MIXED] * len(eqn.outvars)
+                elif res == MIXED:
+                    self._flag(
+                        eqn,
+                        "an output no longer carries each lane's data "
+                        "at its own index of the vmap lane axis",
+                    )
+                    outs = [MIXED] * len(eqn.outvars)
+                else:
+                    outs = res
+            for v, t in zip(eqn.outvars, outs):
+                self.env[v] = t
+        return self.findings
+
+
+def taint_closed(closed, audit: str, lanes: int = TAINT_LANES) -> List[Finding]:
+    """Run the lane-taint pass over a *batched* closed jaxpr whose
+    every root input carries the lane axis at axis 0."""
+    flat, invars, _outvars = flatten_jaxpr(closed)
+    ana = LaneTaint(flat, audit, lanes)
+    for v in invars:
+        ana.env[v] = 0
+    return ana.run()
+
+
+def check_lanes(trace: StepTrace, lanes: int = TAINT_LANES) -> List[Finding]:
+    """GL203 over one traced step: replay it batched and taint (the
+    replay and its flatten are cached on the trace, so a cost pass
+    that already built the batched graph makes this walk ~free)."""
+    flat, invars, _outvars = trace.batched_flat_parts(lanes)
+    ana = LaneTaint(flat, trace.name, lanes)
+    for v in invars:
+        ana.env[v] = 0
+    return ana.run()
+
+
+def prove_step_lane_independent(
+    protocol, dims, state, ctx, faults=None, monitor_keys: int = 0,
+    reorder: bool = False, audit: "Optional[str]" = None,
+) -> List[Finding]:
+    """The sweep driver's gate: trace the exact step a sharded
+    ``run_sweep`` would compile (same fault flags, same monitor
+    capacity, same reorder mode) and prove no equation mixes lanes.
+    Returns the findings (empty = proven lane-independent)."""
+    from .jaxpr import trace_step
+
+    trace = trace_step(
+        protocol, dims, state, ctx, faults, monitor_keys,
+        name=audit or f"{type(protocol).__name__}:sweep",
+        reorder=reorder,
+    )
+    return check_lanes(trace)

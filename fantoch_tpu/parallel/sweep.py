@@ -98,6 +98,61 @@ def _cached_key_table(C: int, T: int):
     return jax.jit(jax.vmap(key_table_fn(C, T)))
 
 
+class LaneMixingError(RuntimeError):
+    """The lane-independence proof (GL203) failed: some equation of the
+    step mixes data across lanes, so sharding the lane axis over the
+    mesh would change results. Carries the findings."""
+
+    def __init__(self, findings):
+        self.findings = list(findings)
+        lines = "\n".join(f.render() for f in self.findings[:8])
+        super().__init__(
+            f"step is not lane-independent ({len(self.findings)} "
+            f"finding(s)):\n{lines}"
+        )
+
+
+# one GL203 proof per compiled-runner key extended with the per-lane
+# (state, ctx) structure signature — lane mixing is a property of the
+# traced graph, not of lane values, but the graph itself varies with
+# ctx structure (a batch past KEY_TABLE_LIMIT has no key_table and
+# traces the in-loop threefry path instead of the table gather), so
+# the signature keeps a proof from covering a graph it never saw; a
+# sweep loop pays the ~5 s trace + taint once per variant per process
+_LANE_PROOFS: dict = {}
+
+
+def _tree_sig(tree) -> tuple:
+    """Shape/dtype signature of a pytree of arrays (dict-keyed)."""
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return tuple(
+        (
+            str(path),
+            tuple(np.shape(leaf)),
+            str(getattr(leaf, "dtype", type(leaf).__name__)),
+        )
+        for path, leaf in leaves
+    )
+
+
+def _prove_lane_independent(protocol, dims: EngineDims, reorder: bool,
+                            faults, monitor_keys: int, state, ctx) -> tuple:
+    key = (
+        protocol, dims, reorder, faults, monitor_keys,
+        _tree_sig(state), _tree_sig(ctx),
+    )
+    if key not in _LANE_PROOFS:
+        from ..lint.lanes import prove_step_lane_independent
+
+        _LANE_PROOFS[key] = tuple(
+            prove_step_lane_independent(
+                protocol, dims, state, ctx, faults=faults,
+                monitor_keys=monitor_keys, reorder=reorder,
+            )
+        )
+    return _LANE_PROOFS[key]
+
+
 @functools.lru_cache(maxsize=None)
 def _cached_runner(protocol, dims: EngineDims, max_steps: int,
                    reorder: bool, faults, monitor_keys: int = 0):
@@ -122,6 +177,7 @@ def run_sweep(
     max_steps: int = 1 << 22,
     segment_steps: int = 8192,
     monitor_keys: int = 0,
+    shard_lanes: "bool | None" = None,
 ) -> List[LaneResults]:
     """Run a sweep batch, sharded over ``mesh`` (default: all local
     devices on one axis). The device loop runs in ``segment_steps``
@@ -129,7 +185,19 @@ def run_sweep(
     bounded (tunneled workers die on multi-minute single calls).
     ``monitor_keys > 0`` compiles the on-device safety monitors in
     (engine/monitor.py) and surfaces per-lane violation bitmasks
-    through ``LaneResults`` — the schedule-fuzzing subsystem's path."""
+    through ``LaneResults`` — the schedule-fuzzing subsystem's path.
+
+    ``shard_lanes`` selects the lane-sharding contract:
+
+    * ``None`` (default) — today's behavior: shard over ``mesh``
+      without a proof (vmap semantics are trusted).
+    * ``True`` — the *verified* multichip path: first prove the step
+      lane-independent (the GL203 taint pass over the batched trace,
+      cached per protocol), raising :class:`LaneMixingError` if any
+      equation mixes lanes; only then shard over the mesh.
+    * ``False`` — the unsharded reference path: a single-device mesh
+      (the bit-identical baseline the sharded test compares against).
+    """
     import os
     import time as _t
 
@@ -141,7 +209,10 @@ def run_sweep(
             marks.append((label, _t.perf_counter()))
 
     if mesh is None:
-        mesh = Mesh(np.asarray(jax.devices()), ("sweep",))
+        devices = jax.devices()
+        if shard_lanes is False:
+            devices = devices[:1]
+        mesh = Mesh(np.asarray(devices), ("sweep",))
     shards = mesh.devices.size
     pad = (-len(specs)) % shards
     padded = list(specs) + [specs[-1]] * pad
@@ -174,6 +245,20 @@ def run_sweep(
     ]
     state = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *states)
     mark("init+stack_states")
+
+    if shard_lanes:
+        # the verified multichip path: refuse to shard a step that
+        # mixes lanes (GL203; one trace + taint per protocol, cached).
+        # The proof runs on the exact per-lane (state, ctx) the batched
+        # runner sees — including the key table when present.
+        ctx0 = {k: np.asarray(v)[0] for k, v in ctx.items()}
+        findings = _prove_lane_independent(
+            protocol, dims, batch_reorder_flag(padded),
+            batch_fault_flags(padded), monitor_keys, states[0], ctx0,
+        )
+        if findings:
+            raise LaneMixingError(findings)
+        mark("lane_proof")
 
     sharding = NamedSharding(mesh, PartitionSpec("sweep"))
     put = lambda tree: jax.tree_util.tree_map(

@@ -1,0 +1,351 @@
+"""graft-cost tests (fantoch_tpu/lint/cost.py + lanes.py): kernel
+ledger units on synthetic jaxprs, the GL201 regression gate, the GL202
+fused-footprint gate, GL203 lane-taint units (cross-lane reductions,
+rolls, sorts and gathers must flag; vmap-built graphs must prove
+clean), the sweep driver's verified lane-sharding path, and the seeded
+CI self-checks."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fantoch_tpu.lint.cost import (
+    DEFAULT_COST_BASELINE,
+    CostLedger,
+    build_ledger,
+    classify,
+    cost_findings,
+    load_cost_baseline,
+)
+from fantoch_tpu.lint.lanes import TAINT_LANES, taint_closed
+from fantoch_tpu.registry import DEV_PROTOCOLS
+
+I32 = jnp.int32
+
+
+# ----------------------------------------------------------------------
+# GL201: kernel classification + ledger
+# ----------------------------------------------------------------------
+
+
+def test_classify_kernel_classes():
+    assert classify("add") == "fused"
+    assert classify("broadcast_in_dim") == "fused"
+    assert classify("scatter") == "scatter"
+    assert classify("dynamic_update_slice") == "scatter"
+    assert classify("gather") == "gather"
+    assert classify("reduce_sum") == "reduce"
+    assert classify("dot_general") == "matmul"
+    assert classify("sort") == "sort"
+    # unknown primitives count as boundaries (conservative for a
+    # regression gate), never silently as fused
+    assert classify("some_new_primitive") == "other"
+
+
+def _ledger(f, *args) -> CostLedger:
+    return build_ledger(jax.make_jaxpr(f)(*args), "syn")
+
+
+def test_ledger_counts_boundaries_and_fusions():
+    def f(x, i):
+        y = x * 2 + 1                      # fused chain
+        y = y.at[i].set(0)                 # scatter kernel
+        return jnp.sum(y)                  # reduce kernel
+
+    led = _ledger(f, np.zeros((8,), np.int32), np.int32(1))
+    assert led.boundaries.get("scatter") == 1
+    assert led.boundaries.get("reduce") == 1
+    assert led.fusion_groups >= 1
+    assert led.kernels == (
+        sum(led.boundaries.values()) + led.fusion_groups
+    )
+    lo, hi = led.est_ms
+    assert 0 < lo < hi
+
+
+def test_ledger_scan_body_multiplies_by_trips():
+    trips = 7
+
+    def body(c, _):
+        return c.at[c[0] % 4].add(1), None  # one scatter per iteration
+
+    def f(x):
+        out, _ = jax.lax.scan(body, x, None, length=trips)
+        return out
+
+    led = _ledger(f, np.zeros((4,), np.int32))
+
+    def one(c, _):
+        return c.at[c[0] % 4].add(1), None
+
+    led1 = build_ledger(
+        jax.make_jaxpr(
+            lambda x: jax.lax.scan(one, x, None, length=1)[0]
+        )(np.zeros((4,), np.int32)),
+        "syn1",
+    )
+    # trips x the per-iteration kernels (docs/PERF.md: a loop body pays
+    # the per-kernel overhead every iteration)
+    assert led.kernels - led1.kernels >= (trips - 1) * 1
+
+
+def test_gl201_regression_gate():
+    led = CostLedger(
+        audit="tempo", kernels=100, fusion_groups=10,
+        boundaries={"scatter": 90}, est_ms=(10.0, 30.0), groups=[],
+    )
+    baseline = {"kernels": {"tempo": 100}}
+    assert cost_findings(led, baseline) == []
+    baseline = {"kernels": {"tempo": 99}}
+    fs = cost_findings(led, baseline)
+    assert [f.rule for f in fs] == ["GL201"], fs
+    assert "regressed" in fs[0].message
+    # a protocol missing from the baseline is itself a finding (a new
+    # protocol must be consciously added to the cost gate)
+    fs = cost_findings(led, {"kernels": {}})
+    assert [f.rule for f in fs] == ["GL201"] and "no cost-baseline" in (
+        fs[0].message
+    )
+
+
+# ----------------------------------------------------------------------
+# GL202: fused-group footprint
+# ----------------------------------------------------------------------
+
+
+def test_gl202_flags_oversized_fused_group():
+    # a fused broadcast chain whose intermediate is ~4 MiB: over a
+    # 2 MiB budget, fine under 16 MiB
+    def f(x):
+        big = x[:, None] * jnp.ones((1, 1024), I32)  # [1024, 1024] i32
+        return jnp.max(big * 2 + 1)
+
+    closed = jax.make_jaxpr(f)(np.zeros((1024,), np.int32))
+    led = build_ledger(closed, "syn")
+    over = cost_findings(led, None, vmem_budget_mib=2)
+    assert any(g.rule == "GL202" for g in over), over
+    assert "MiB" in over[0].message
+    assert cost_findings(led, None, vmem_budget_mib=16) == []
+
+
+def test_gl202_budget_from_baseline_headroom():
+    led = build_ledger(
+        jax.make_jaxpr(
+            lambda x: jnp.max(x[:, None] * jnp.ones((1, 1024), I32))
+        )(np.zeros((1024,), np.int32)),
+        "syn",
+    )
+    peak_mib = max(g.peak_bytes for g in led.groups) / 2**20
+    tight = {"vmem_peak_mib": {"syn": peak_mib / 2}, "vmem_headroom": 1.25}
+    assert any(
+        f.rule == "GL202" for f in cost_findings(led, tight)
+    )
+    loose = {"vmem_peak_mib": {"syn": peak_mib}, "vmem_headroom": 1.25}
+    assert not any(
+        f.rule == "GL202" for f in cost_findings(led, loose)
+    )
+
+
+def test_cost_baseline_covers_every_device_protocol():
+    base = load_cost_baseline(DEFAULT_COST_BASELINE)
+    assert set(DEV_PROTOCOLS) <= set(base["kernels"]), base["kernels"]
+    assert set(DEV_PROTOCOLS) <= set(base["vmem_peak_mib"])
+    assert base["lanes"] == 512
+    assert base["vmem_headroom"] > 1.0
+
+
+# ----------------------------------------------------------------------
+# GL203: lane-taint units
+# ----------------------------------------------------------------------
+
+B = 64
+
+
+def _taint(f, *shapes):
+    args = [jax.ShapeDtypeStruct((B,) + s, np.int32) for s in shapes]
+    return taint_closed(jax.make_jaxpr(f)(*args), "syn", B)
+
+
+def test_taint_flags_cross_lane_reduction():
+    fs = _taint(
+        lambda x: x - jnp.sum(x, axis=0, keepdims=True) // B, (4,)
+    )
+    assert any(":reduce_sum" in g.anchor for g in fs), fs
+
+
+def test_taint_flags_lane_roll_and_sort():
+    assert _taint(lambda x: jnp.roll(x, 1, axis=0), (4,))
+    assert _taint(lambda x: jnp.sort(x, axis=0), (4,))
+
+
+def test_taint_flags_cross_lane_gather():
+    def f(x, i):
+        return x[(i[:, 0] + 1) % B]  # lane i reads lane i+1's row
+
+    assert _taint(f, (4,), (1,))
+
+
+def test_taint_clean_on_vmapped_step_shapes():
+    # per-lane elementwise + per-lane reductions + a vmapped scan (the
+    # carry starts lane-constant and picks the lane axis up — the
+    # fixpoint must converge instead of flagging)
+    def lane(x):
+        def body(c, v):
+            return c + v, c * 2
+
+        tot, ys = jax.lax.scan(body, jnp.int32(0), x * 2 + 1)
+        return tot + jnp.max(x), ys
+
+    args = [jax.ShapeDtypeStruct((B, 8), np.int32)]
+    closed = jax.make_jaxpr(jax.vmap(lane))(*args)
+    assert taint_closed(closed, "syn", B) == []
+
+
+def test_taint_clean_on_vmapped_scatter_gather():
+    def lane(tbl, i):
+        row = tbl[i % 4]                     # per-lane gather
+        return tbl.at[i % 4].set(row * 2)    # per-lane scatter
+
+    args = [
+        jax.ShapeDtypeStruct((B, 4, 3), np.int32),
+        jax.ShapeDtypeStruct((B,), np.int32),
+    ]
+    closed = jax.make_jaxpr(jax.vmap(lane))(*args)
+    assert taint_closed(closed, "syn", B) == []
+
+
+def test_lanes_prove_basic_protocol_clean():
+    """One real protocol's step proves lane-independent in tier-1 (the
+    full grid is the CI cost-gate job)."""
+    from fantoch_tpu.lint.jaxpr import build_protocol_trace
+    from fantoch_tpu.lint.lanes import check_lanes
+
+    trace = build_protocol_trace("basic")
+    assert check_lanes(trace) == []
+
+
+# ----------------------------------------------------------------------
+# the verified lane-sharding path (parallel/sweep.py)
+# ----------------------------------------------------------------------
+
+
+def test_run_sweep_shard_lanes_proves_once(monkeypatch):
+    from fantoch_tpu.parallel import sweep as sweep_mod
+
+    calls = []
+
+    def fake_prove(protocol, dims, state, ctx, **kw):
+        calls.append(kw)
+        return []
+
+    monkeypatch.setattr(
+        "fantoch_tpu.lint.lanes.prove_step_lane_independent", fake_prove
+    )
+    sweep_mod._LANE_PROOFS.clear()
+    from fantoch_tpu.core import Config, Planet
+    from fantoch_tpu.engine import EngineDims
+    from fantoch_tpu.engine.protocols import dev_config_kwargs, dev_protocol
+    from fantoch_tpu.parallel.sweep import make_sweep_specs, run_sweep
+
+    planet = Planet.new()
+    dev = dev_protocol("basic", 3)
+    dims = EngineDims.for_protocol(
+        dev, n=3, clients=3, payload=dev.payload_width(3),
+        total_commands=6, dot_slots=7, regions=3,
+    )
+    specs = make_sweep_specs(
+        dev, planet, region_sets=[planet.regions()[:3]], fs=[1],
+        conflicts=[100], commands_per_client=2, clients_per_region=1,
+        dims=dims, config_base=Config(**dev_config_kwargs("basic", 3, 1)),
+    )
+    try:
+        run_sweep(dev, dims, specs, shard_lanes=True)
+        run_sweep(dev, dims, specs, shard_lanes=True)
+        assert len(calls) == 1, "the proof must be cached per protocol"
+    finally:
+        # the fake proof must not leak into tests that exercise the
+        # real prover on the same (protocol, dims) key
+        sweep_mod._LANE_PROOFS.clear()
+
+
+def test_run_sweep_shard_lanes_refuses_mixing(monkeypatch):
+    from fantoch_tpu.lint.report import Finding
+    from fantoch_tpu.parallel import sweep as sweep_mod
+    from fantoch_tpu.parallel.sweep import LaneMixingError
+
+    monkeypatch.setattr(
+        "fantoch_tpu.lint.lanes.prove_step_lane_independent",
+        lambda *a, **k: [
+            Finding("GL203", "syn", "x:y:reduce_sum", "cross-lane")
+        ],
+    )
+    sweep_mod._LANE_PROOFS.clear()
+    from fantoch_tpu.core import Config, Planet
+    from fantoch_tpu.engine import EngineDims
+    from fantoch_tpu.engine.protocols import dev_config_kwargs, dev_protocol
+    from fantoch_tpu.parallel.sweep import make_sweep_specs, run_sweep
+
+    planet = Planet.new()
+    dev = dev_protocol("basic", 3)
+    dims = EngineDims.for_protocol(
+        dev, n=3, clients=3, payload=dev.payload_width(3),
+        total_commands=6, dot_slots=7, regions=3,
+    )
+    specs = make_sweep_specs(
+        dev, planet, region_sets=[planet.regions()[:3]], fs=[1],
+        conflicts=[100], commands_per_client=2, clients_per_region=1,
+        dims=dims, config_base=Config(**dev_config_kwargs("basic", 3, 1)),
+    )
+    with pytest.raises(LaneMixingError, match="GL203"):
+        run_sweep(dev, dims, specs, shard_lanes=True)
+    sweep_mod._LANE_PROOFS.clear()
+
+
+# ----------------------------------------------------------------------
+# seeded CI self-checks (slow: each traces tempo at the sweep shape)
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_cost_selfcheck_scatter_regresses_gl201():
+    from fantoch_tpu.lint.cost import run_cost_selfcheck
+
+    fs = run_cost_selfcheck("scatter")
+    assert any(f.rule == "GL201" for f in fs), fs
+
+
+@pytest.mark.slow
+def test_cost_selfcheck_vmem_trips_gl202():
+    from fantoch_tpu.lint.cost import run_cost_selfcheck
+
+    fs = run_cost_selfcheck("vmem")
+    assert any(f.rule == "GL202" for f in fs), fs
+
+
+@pytest.mark.slow
+def test_cost_head_within_baseline():
+    """The checked-in cost baseline matches HEAD (regenerate with
+    `lint --cost --write-cost-baseline` after a reviewed change)."""
+    from fantoch_tpu.lint.cost import run_cost
+
+    findings, summary = run_cost(DEV_PROTOCOLS)
+    assert findings == [], [f.render() for f in findings]
+    base = load_cost_baseline(DEFAULT_COST_BASELINE)
+    for name in DEV_PROTOCOLS:
+        assert summary[name]["kernels"] <= base["kernels"][name]
+
+
+def test_cli_rejects_unknown_selfcheck(capsys):
+    """argparse owns the --cost-selfcheck vocabulary (the CI job only
+    ever passes scatter/vmem; the real runs are the cost-gate job)."""
+    import contextlib
+    import io
+
+    from fantoch_tpu import cli
+
+    with contextlib.redirect_stderr(io.StringIO()):
+        with pytest.raises(SystemExit) as e:
+            cli.main(["lint", "--cost-selfcheck", "bogus"])
+    assert e.value.code == 2  # argparse usage error

@@ -1,0 +1,93 @@
+"""lint/report.py baseline edge cases: duplicate stable IDs within one
+run (the count-aware allowance), per-ID counts *shrinking* (the
+stale-baseline advisory path), and `load_baseline` round-tripping both
+the checked-in layout and a plain id→count map through
+`write_baseline`."""
+
+import json
+
+from fantoch_tpu.lint.report import (
+    Finding,
+    LintReport,
+    load_baseline,
+    write_baseline,
+)
+
+
+def _f(rule="GL001", audit="syn", anchor="a.py:f:mul"):
+    return Finding(rule, audit, anchor, "msg")
+
+
+def test_duplicate_ids_consume_allowance_per_occurrence():
+    """Two findings with one stable ID are two occurrences: a baseline
+    allowing one suppresses exactly one — the second is a regression
+    (a new unclamped multiply in an already-baselined function must
+    not hide behind the existing entry)."""
+    report = LintReport(findings=[_f(), _f()])
+    fid = _f().id
+    assert report.counts() == {fid: 2}
+    assert len(report.regressions({fid: 1})) == 1
+    assert report.regressions({fid: 2}) == []
+    # with no baseline at all, both are regressions
+    assert len(report.regressions(None)) == 2
+
+
+def test_shrinking_count_is_stale_not_regression():
+    """A fixed finding leaves its baseline allowance over-provisioned:
+    that's advisory (stale), never a failure — narrowed runs
+    (--protocols) legitimately observe fewer findings."""
+    fid = _f().id
+    report = LintReport(findings=[_f()])
+    baseline = {fid: 3, "GL999:gone:b.py:g:add": 1}
+    assert report.regressions(baseline) == []
+    stale = report.stale_baseline_ids(baseline)
+    assert fid in stale  # 1 observed < 3 allowed
+    assert "GL999:gone:b.py:g:add" in stale  # 0 observed < 1 allowed
+    # an exactly-consumed allowance is not stale
+    assert report.stale_baseline_ids({fid: 1}) == []
+
+
+def test_write_baseline_round_trips_through_load(tmp_path):
+    path = str(tmp_path / "baseline.json")
+    report = LintReport(findings=[_f(), _f(), _f(anchor="a.py:g:add")])
+    write_baseline(path, report)
+    loaded = load_baseline(path)
+    assert loaded == report.counts()
+    # the written file carries the checked-in layout (a findings map
+    # under a comment), which load_baseline unwraps
+    raw = json.load(open(path))
+    assert set(raw) == {"_comment", "findings"}
+
+
+def test_write_baseline_never_bakes_in_cost_findings(tmp_path):
+    """Cost-family findings (GL2xx) gate against cost_baseline.json and
+    exist only when something is already wrong — writing one into the
+    suppression baseline would permanently hide a live kernel/VMEM/lane
+    regression from CI, so `--cost --write-baseline` must drop them."""
+    path = str(tmp_path / "baseline.json")
+    report = LintReport(
+        findings=[
+            _f(),
+            Finding("GL201", "tempo", "core.py:_lane_step:kernels", "m"),
+            Finding("GL203", "tempo", "core.py:step:reduce_sum", "m"),
+        ]
+    )
+    write_baseline(path, report)
+    loaded = load_baseline(path)
+    assert loaded == {_f().id: 1}
+    assert not any(k.startswith("GL2") for k in loaded)
+
+
+def test_load_baseline_plain_map_with_comments(tmp_path):
+    """A hand-written plain {id: count} map (no findings wrapper) loads
+    identically, with _-prefixed comment keys dropped."""
+    path = tmp_path / "plain.json"
+    plain = {"_why": "hand-written", "GL001:syn:a.py:f:mul": 2}
+    path.write_text(json.dumps(plain))
+    assert load_baseline(str(path)) == {"GL001:syn:a.py:f:mul": 2}
+    # and a plain map round-trips through write_baseline: rebuild a
+    # report with matching counts, write, re-load
+    report = LintReport(findings=[_f(), _f()])
+    out = tmp_path / "rewritten.json"
+    write_baseline(str(out), report)
+    assert load_baseline(str(out)) == {"GL001:syn:a.py:f:mul": 2}

@@ -1,0 +1,59 @@
+"""The verified lane-sharding contract (parallel/sweep.py +
+lint/lanes.py): `run_sweep(shard_lanes=True)` first *proves* the step
+lane-independent (GL203 taint over the batched trace) and then shards
+the lane axis over the 8-device CPU mesh; its results must be
+bit-identical to the unsharded single-device path
+(`shard_lanes=False`). This is the empirical pin behind the prover's
+soundness note — vmap's select-masking of batched `while` trip counts
+is accepted as control-only because this test holds bitwise."""
+
+import numpy as np
+
+from fantoch_tpu.core import Config, Planet
+from fantoch_tpu.engine import EngineDims
+from fantoch_tpu.engine.protocols import dev_config_kwargs, dev_protocol
+from fantoch_tpu.parallel.sweep import make_sweep_specs, run_sweep
+
+COMMANDS = 2
+
+
+def test_sharded_sweep_bit_identical_to_unsharded():
+    import jax
+
+    assert len(jax.devices()) == 8, "conftest must force 8 CPU devices"
+    planet = Planet.new()
+    regions = planet.regions()
+    clients = 3
+    dev = dev_protocol("basic", clients)
+    total = COMMANDS * clients
+    dims = EngineDims.for_protocol(
+        dev, n=3, clients=clients, payload=dev.payload_width(3),
+        total_commands=total, dot_slots=total + 1, regions=3,
+    )
+    specs = make_sweep_specs(
+        dev,
+        planet,
+        region_sets=[regions[i : i + 3] for i in range(4)],
+        fs=[1],
+        conflicts=[0, 100],
+        commands_per_client=COMMANDS,
+        clients_per_region=1,
+        dims=dims,
+        config_base=Config(**dev_config_kwargs("basic", 3, 1)),
+    )
+    assert len(specs) == 8  # one lane per mesh device when sharded
+
+    sharded = run_sweep(dev, dims, specs, shard_lanes=True)
+    unsharded = run_sweep(dev, dims, specs, shard_lanes=False)
+
+    assert len(sharded) == len(unsharded) == len(specs)
+    for a, b in zip(sharded, unsharded):
+        assert a.err == b.err
+        assert a.completed == b.completed
+        assert a.steps == b.steps
+        np.testing.assert_array_equal(np.asarray(a.hist), np.asarray(b.hist))
+        for key in a.protocol_metrics:
+            np.testing.assert_array_equal(
+                np.asarray(a.protocol_metrics[key]),
+                np.asarray(b.protocol_metrics[key]),
+            )
