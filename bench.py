@@ -72,6 +72,87 @@ FUZZ_MIN_BUDGET_S = float(
     _os.environ.get("FANTOCH_BENCH_FUZZ_MIN_BUDGET", "420")
 )
 
+# checkpoint-roundtrip self-check shape (engine/checkpoint.py): the
+# documented 512-lane tempo sweep state, reduced by the CPU-fallback
+# env so a host-mesh run still finishes inside the driver budget
+CKPT_LANES = int(_os.environ.get("FANTOCH_BENCH_CKPT_LANES", "512"))
+
+
+def _checkpoint_roundtrip() -> "float | None":
+    """Save + restore + bit-exact compare of a ``CKPT_LANES``-lane
+    tempo state through engine/checkpoint.py — the durability tax a
+    campaign pays per checkpointed segment (docs/CAMPAIGN.md). The
+    step-signature trace is computed (and cached) outside the timed
+    window; the timed part is exactly serialize + deserialize +
+    compare. Degrades to None (never an exception) so the measured
+    sweep metric can't be lost to a checkpoint bug."""
+    import shutil
+    import sys
+    import tempfile
+
+    import numpy as np
+
+    try:
+        from fantoch_tpu.engine import make_lane
+        from fantoch_tpu.engine.checkpoint import (
+            load_sweep_checkpoint,
+            save_sweep_checkpoint,
+            step_signature,
+        )
+        from fantoch_tpu.engine.core import init_lane_state
+        from fantoch_tpu.engine.faults import NO_FAULTS
+        from fantoch_tpu.engine.spec import stack_lanes
+
+        planet = Planet.new()
+        regions = planet.regions()[:N]
+        dev, base = _build("tempo", N)
+        dims = EngineDims.for_protocol(
+            dev, n=N, clients=N, payload=dev.payload_width(N),
+            dot_slots=64, regions=N,
+        )
+        lane = make_lane(
+            dev, planet, base, conflict_rate=100,
+            commands_per_client=10, clients_per_region=1,
+            process_regions=regions, client_regions=regions, dims=dims,
+        )
+        state0 = init_lane_state(dev, dims, lane.ctx)
+        state = jax.tree_util.tree_map(
+            lambda x: np.stack([np.asarray(x)] * CKPT_LANES), state0
+        )
+        ctx = stack_lanes([lane] * CKPT_LANES)
+        sig = step_signature(
+            dev, dims, reorder=False, faults=NO_FAULTS, monitor_keys=0,
+            state=state0, ctx=lane.ctx,
+        )
+        work = tempfile.mkdtemp(prefix="fantoch-ckpt-bench-")
+        try:
+            t0 = time.perf_counter()
+            save_sweep_checkpoint(
+                work, state=state, ctx=ctx, signature=sig, until=0,
+                meta={"lanes": CKPT_LANES},
+            )
+            restored, _meta = load_sweep_checkpoint(
+                work, signature=sig, ctx=ctx,
+                meta_expect={"lanes": CKPT_LANES},
+            )
+            before = jax.tree_util.tree_flatten_with_path(state)[0]
+            after = jax.tree_util.tree_flatten_with_path(restored)[0]
+            assert len(before) == len(after)
+            for (pa, a), (pb, b) in zip(before, after):
+                assert str(pa) == str(pb), (pa, pb)
+                a, b = np.asarray(a), np.asarray(b)
+                assert a.dtype == b.dtype and a.shape == b.shape, pa
+                assert np.array_equal(a, b), f"restore not bit-exact: {pa}"
+            return time.perf_counter() - t0
+        finally:
+            shutil.rmtree(work, ignore_errors=True)
+    except Exception as e:  # noqa: BLE001
+        print(
+            f"bench: checkpoint roundtrip unavailable: {e!r}",
+            file=sys.stderr,
+        )
+        return None
+
 
 def _static_kernel_cost(timeout_s: float = 240.0) -> "dict | None":
     """Device-free kernel-cost estimate of the tempo 512-lane step
@@ -258,6 +339,17 @@ def main() -> None:
                 flush=True,
             )
 
+    # durability tax: one checkpointed segment's save+restore+compare
+    # (device-state fetch excluded — measured on host arrays)
+    ckpt_s = _checkpoint_roundtrip()
+    if ckpt_s is not None:
+        print(
+            f"checkpoint roundtrip: {CKPT_LANES} tempo lanes in "
+            f"{ckpt_s:.2f}s (bit-exact)",
+            file=sys.stderr,
+            flush=True,
+        )
+
     points_per_sec = total_points / elapsed
     per_chip_target = 10_000 / 60.0 / 8.0  # north-star rate, per chip
     platform = jax.devices()[0].platform
@@ -283,6 +375,12 @@ def main() -> None:
                 "vs_baseline": round(points_per_sec / per_chip_target, 3),
                 "fuzz_schedules_per_sec": round(fuzz_sps, 2),
                 **({"fuzz_note": fuzz_note} if fuzz_note else {}),
+                # save + restore + bit-exact compare of a CKPT_LANES
+                # tempo state (0.0 = self-check unavailable, see stderr)
+                "checkpoint_roundtrip_s": (
+                    round(ckpt_s, 3) if ckpt_s is not None else 0.0
+                ),
+                "checkpoint_lanes": CKPT_LANES,
                 **(
                     {"static_kernel_cost": static_cost}
                     if static_cost
@@ -426,6 +524,11 @@ def _emit_unreachable(reason: str = "unreachable at startup") -> None:
                 "platform": "none",
                 "vs_baseline": 0.0,
                 "fuzz_schedules_per_sec": 0.0,
+                # the roundtrip needs a live (CPU) jax backend to build
+                # the tempo state; the CPU-fallback path measures it,
+                # this last-ditch artifact records an honest zero
+                "checkpoint_roundtrip_s": 0.0,
+                "checkpoint_lanes": CKPT_LANES,
                 **(
                     {"static_kernel_cost": static_cost}
                     if static_cost
@@ -446,6 +549,7 @@ _CPU_FALLBACK_ENV = {
     "FANTOCH_BENCH_COMMANDS": "10",
     "FANTOCH_BENCH_CHUNK": "16",
     "FANTOCH_BENCH_FUZZ_SCHEDULES": "8",
+    "FANTOCH_BENCH_CKPT_LANES": "64",
 }
 
 # below this remaining total budget a CPU fallback run cannot plausibly

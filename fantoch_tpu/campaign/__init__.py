@@ -1,0 +1,40 @@
+"""Durable, resumable evaluation campaigns.
+
+The reference survives machine churn through its ``fantoch_exp``
+orchestrator by re-running whole experiments; the device engine packs
+thousands of lanes into one process, so anything that kills that
+process used to lose the entire run. A *campaign* makes the work
+larger than one process lifetime: a journal-backed manager chunks a
+(protocol × n × conflict × fault-plan) sweep grid — or a schedule-fuzz
+grid — into batches, checkpoints the in-flight batch at
+``segment_steps`` boundaries (engine/checkpoint.py through
+``run_sweep(checkpoint=...)``), journals every completed unit, and
+resumes exactly where it stopped across process restarts:
+
+    python -m fantoch_tpu campaign --dir D --grid '{"kind": "sweep", ...}'
+    python -m fantoch_tpu campaign --dir D --resume
+
+Resume is **bit-exact** for sweep campaigns: an interrupted-and-resumed
+campaign writes a ``results.jsonl`` byte-identical to an uninterrupted
+control run (pinned by tests and the CI ``campaign-smoke`` job, which
+SIGKILLs a campaign mid-segment). Fuzz campaigns accumulate coverage
+instead of resetting: the plan generator's position, schedules-tried
+counters and confirmed-violation artifacts all persist. See
+docs/CAMPAIGN.md for the artifact format and the refusal rules.
+"""
+
+from .manager import (
+    CampaignError,
+    FuzzCampaign,
+    SweepCampaign,
+    campaign_from_json,
+    run_campaign,
+)
+
+__all__ = [
+    "CampaignError",
+    "FuzzCampaign",
+    "SweepCampaign",
+    "campaign_from_json",
+    "run_campaign",
+]

@@ -1,0 +1,651 @@
+"""The journal-backed campaign manager.
+
+A campaign directory is the durable unit::
+
+    <dir>/campaign.json   # the immutable spec (kind + grid)
+    <dir>/journal.jsonl   # append-only: one line per completed unit
+    <dir>/ckpt/<batch>/   # in-flight sweep-batch checkpoint (transient)
+    <dir>/artifacts/      # fuzz repro artifacts (persisted on confirm)
+    <dir>/results.jsonl   # sweep output, written once the grid is done
+    <dir>/summary.json    # fuzz output, written once the grid is done
+
+Two campaign kinds share the machinery:
+
+* **sweep** — the (protocol × n × f × conflict × fault-plan × region
+  subset) grid is enumerated deterministically, chunked into batches of
+  ``batch_lanes`` lanes, and each batch runs through
+  ``run_sweep(checkpoint=...)``. A completed batch appends its
+  serialized ``LaneResults`` to the journal; the in-flight batch
+  checkpoints at segment boundaries, so a SIGKILL loses at most one
+  segment of device work. The final ``results.jsonl`` of an
+  interrupted-and-resumed campaign is byte-identical to an
+  uninterrupted control run.
+* **fuzz** — each (protocol, n) point fuzzes ``schedules`` perturbed
+  schedules in chunks; the journal carries the schedules-tried counter
+  and the plan generator's exact position (``mc/fuzz.py rng_state``),
+  so a resumed session draws the identical remaining per-lane plans
+  instead of restarting coverage. Confirmed-violation artifacts are
+  written to ``artifacts/`` the moment they exist.
+
+Crash model: journal appends are flushed+fsynced and a torn final line
+is ignored on replay (that unit simply reruns — deterministically).
+Checkpoint staleness/corruption is *refused* with a named error
+(engine/checkpoint.py), never silently misloaded; the CLI surfaces it
+as a non-zero exit naming the reason.
+
+Budget semantics (``budget_s``): at least one unit of progress per
+invocation (a sweep segment or a fuzz chunk), then stop at the next
+boundary once the budget is exhausted — so repeated budgeted
+invocations always converge.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import time
+from dataclasses import asdict, dataclass
+from typing import Dict, List, Optional, Tuple
+
+_JOURNAL = "journal.jsonl"
+_CAMPAIGN = "campaign.json"
+_RESULTS = "results.jsonl"
+_SUMMARY = "summary.json"
+_CKPT = "ckpt"
+_ARTIFACTS = "artifacts"
+
+
+class CampaignError(RuntimeError):
+    """The campaign directory and the request disagree (nothing to
+    resume, spec mismatch, unknown kind/protocol) — refused loudly."""
+
+
+# ----------------------------------------------------------------------
+# campaign specs (JSON round-trip, value equality)
+# ----------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class SweepCampaign:
+    """A (protocol × n × f × conflict × fault-plan × region-subset)
+    sweep grid, chunked into resumable batches."""
+
+    protocols: Tuple[str, ...]
+    ns: Tuple[int, ...] = (3,)
+    fs: Tuple[int, ...] = (1,)
+    conflicts: Tuple[int, ...] = (0, 100)
+    # fault-plan JSON objects (engine/faults.py FaultPlan.from_json);
+    # None/{} = fault-free. Every grid point runs once per entry.
+    faults: Tuple[Optional[dict], ...] = (None,)
+    subsets: int = 1          # region subsets per n
+    commands_per_client: int = 5
+    clients_per_region: int = 1
+    pool_size: int = 1
+    extra_time_ms: int = 500
+    batch_lanes: int = 64     # lanes per journal unit
+    segment_steps: int = 2048
+    max_steps: int = 1 << 22
+    checkpoint_every: int = 1  # segments between in-flight saves
+    shard_lanes: Optional[bool] = None
+    aws: bool = False
+
+    kind = "sweep"
+
+    def to_json(self) -> dict:
+        out = {"kind": self.kind}
+        out.update(asdict(self))
+        return _plain(out)
+
+
+@dataclass(frozen=True)
+class FuzzCampaign:
+    """A (protocol × n) schedule-fuzz grid; each point accumulates
+    ``schedules`` perturbed schedules in resumable chunks."""
+
+    protocols: Tuple[str, ...]
+    ns: Tuple[int, ...] = (3,)
+    f: int = 1
+    conflict: int = 100
+    pool_size: int = 1
+    clients_per_region: int = 1
+    commands_per_client: int = 5
+    schedules: int = 512      # total per (protocol, n) point
+    chunk: int = 128          # schedules per journal unit
+    seed: int = 0
+    jitter_max: int = 8
+    crash_share: float = 0.2
+    drop_share: float = 0.15
+    confirm: bool = True
+    max_confirm: int = 8
+    shrink_budget: int = 150
+    strict_missing: bool = False
+    inject_bug: bool = False
+    aws: bool = False
+
+    kind = "fuzz"
+
+    def to_json(self) -> dict:
+        out = {"kind": self.kind}
+        out.update(asdict(self))
+        return _plain(out)
+
+
+def _plain(obj):
+    """Tuples -> lists so to_json/from_json round-trips to equality."""
+    if isinstance(obj, dict):
+        return {k: _plain(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_plain(v) for v in obj]
+    return obj
+
+
+def campaign_from_json(obj: dict):
+    """Parse a campaign spec dict (the CLI ``--grid`` value / the
+    stored ``campaign.json``)."""
+    kinds = {"sweep": SweepCampaign, "fuzz": FuzzCampaign}
+    kind = obj.get("kind")
+    if kind not in kinds:
+        raise CampaignError(
+            f"unknown campaign kind {kind!r}; expected one of "
+            f"{sorted(kinds)}"
+        )
+    cls = kinds[kind]
+    fields = {f.name for f in cls.__dataclass_fields__.values()}
+    unknown = sorted(set(obj) - fields - {"kind"})
+    if unknown:
+        raise CampaignError(
+            f"unknown campaign field(s) {unknown} for kind {kind!r}"
+        )
+    kw = {}
+    for name in cls.__dataclass_fields__:
+        if name not in obj:
+            continue
+        val = obj[name]
+        if isinstance(val, list):  # JSON arrays -> the tuple fields
+            val = tuple(
+                tuple(v) if isinstance(v, list) else v for v in val
+            )
+        kw[name] = val
+    spec = cls(**kw)
+    from ..registry import DEV_PROTOCOLS
+
+    bad = [p for p in spec.protocols if p not in DEV_PROTOCOLS]
+    if bad:
+        raise CampaignError(
+            f"unknown protocol(s) {bad}; choose from "
+            f"{','.join(DEV_PROTOCOLS)}"
+        )
+    if not spec.protocols:
+        raise CampaignError("campaign needs at least one protocol")
+    return spec
+
+
+# ----------------------------------------------------------------------
+# journal + campaign-file plumbing
+# ----------------------------------------------------------------------
+
+
+def _append_journal(path: str, entry: dict) -> None:
+    with open(os.path.join(path, _JOURNAL), "a") as fh:
+        fh.write(json.dumps(entry, sort_keys=True) + "\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+
+
+def _read_journal(path: str) -> List[dict]:
+    jpath = os.path.join(path, _JOURNAL)
+    if not os.path.exists(jpath):
+        return []
+    entries: List[dict] = []
+    with open(jpath) as fh:
+        lines = fh.read().splitlines()
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            entries.append(json.loads(line))
+        except ValueError:
+            if i == len(lines) - 1:
+                # a SIGKILL can tear the final append; that unit simply
+                # reruns (deterministically) — earlier corruption is a
+                # real problem and must surface
+                break
+            raise CampaignError(
+                f"campaign journal corrupted at line {i + 1} (only the "
+                "final line may be torn)"
+            )
+    return entries
+
+
+def _atomic_write(path: str, text: str) -> None:
+    from ..engine.checkpoint import atomic_write
+
+    atomic_write(path, text)
+
+
+def _load_or_init_spec(path: str, spec, resume: bool):
+    cpath = os.path.join(path, _CAMPAIGN)
+    if resume:
+        if not os.path.exists(cpath):
+            raise CampaignError(
+                f"nothing to resume: no {_CAMPAIGN} in {path}"
+            )
+        stored = campaign_from_json(json.load(open(cpath)))
+        if spec is not None and stored != spec:
+            raise CampaignError(
+                "--grid disagrees with the stored campaign spec; "
+                "resume without --grid or start a fresh directory"
+            )
+        return stored
+    if spec is None:
+        raise CampaignError("a new campaign needs a --grid spec")
+    if os.path.exists(cpath):
+        stored = campaign_from_json(json.load(open(cpath)))
+        if stored != spec:
+            raise CampaignError(
+                f"{path} already holds a different campaign; pass "
+                "--resume to continue it or use a fresh directory"
+            )
+        return stored  # identical spec: behave like resume
+    os.makedirs(path, exist_ok=True)
+    _atomic_write(
+        cpath, json.dumps(spec.to_json(), indent=2, sort_keys=True)
+    )
+    return spec
+
+
+def _planet(aws: bool):
+    from ..core.planet import Planet
+
+    if aws:
+        return Planet.from_dataset("latency_aws_2021_02_13")
+    return Planet.new()
+
+
+# ----------------------------------------------------------------------
+# sweep campaigns
+# ----------------------------------------------------------------------
+
+
+def _sweep_batches(spec: SweepCampaign):
+    """Deterministic batch enumeration: one (protocol, n) group shares
+    a compiled runner; its grid chunks into ``batch_lanes`` units."""
+    from ..engine import EngineDims
+    from ..engine.faults import FaultPlan
+    from ..engine.protocols import dev_config_kwargs, dev_protocol
+    from ..core.config import Config
+    from ..parallel.sweep import make_sweep_specs
+
+    planet = _planet(spec.aws)
+    all_regions = planet.regions()
+    plans = [
+        None if not entry else FaultPlan.from_json(entry)
+        for entry in spec.faults
+    ]
+    plans = [None if p is not None and p.is_noop() else p for p in plans]
+    batches = []
+    for proto in spec.protocols:
+        for n in spec.ns:
+            region_sets = [
+                [all_regions[i] for i in combo]
+                for combo in itertools.islice(
+                    itertools.combinations(range(len(all_regions)), n),
+                    spec.subsets,
+                )
+            ]
+            clients = n * spec.clients_per_region
+            total = spec.commands_per_client * clients
+            dev = dev_protocol(proto, clients)
+            dims = EngineDims.for_protocol(
+                dev,
+                n=n,
+                clients=clients,
+                payload=dev.payload_width(n),
+                total_commands=total,
+                dot_slots=total + 1,
+                regions=n,
+            )
+            base = Config(**dev_config_kwargs(proto, n, spec.fs[0]))
+            lanes = make_sweep_specs(
+                dev,
+                planet,
+                region_sets=region_sets,
+                fs=list(spec.fs),
+                conflicts=list(spec.conflicts),
+                commands_per_client=spec.commands_per_client,
+                clients_per_region=spec.clients_per_region,
+                dims=dims,
+                config_base=base,
+                extra_time_ms=spec.extra_time_ms,
+                pool_size=spec.pool_size,
+                faults=plans,
+            )
+            for j in range(0, len(lanes), spec.batch_lanes):
+                batches.append(
+                    (
+                        f"{proto}/n{n}/b{j // spec.batch_lanes}",
+                        dev,
+                        dims,
+                        lanes[j : j + spec.batch_lanes],
+                    )
+                )
+    return batches
+
+
+def _run_sweep_campaign(path: str, spec: SweepCampaign, deadline,
+                        stop_after_segments, stop_flag) -> dict:
+    from ..engine.checkpoint import (
+        CheckpointSpec,
+        SweepInterrupted,
+        discard_checkpoint,
+    )
+    from ..parallel.sweep import run_sweep
+
+    batches = _sweep_batches(spec)
+    done: Dict[str, List[dict]] = {}
+    for entry in _read_journal(path):
+        if entry.get("kind") == "batch":
+            done[entry["id"]] = entry["results"]
+
+    interrupted = None
+    progressed = 0
+    for key, dev, dims, lanes in batches:
+        if key in done:
+            continue
+        if stop_flag["sig"] is not None:
+            interrupted = f"signal {stop_flag['sig']}"
+            break
+        remaining = None
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 and progressed:
+                interrupted = "budget exhausted"
+                break
+            remaining = max(remaining, 0.0)
+        # per-batch checkpoint dir: a leftover checkpoint of an
+        # already-journaled batch (kill between journal append and
+        # discard) can never be mistaken for the next batch's
+        ckpt_path = os.path.join(path, _CKPT, key.replace("/", "_"))
+        ck = CheckpointSpec(
+            path=ckpt_path,
+            every=spec.checkpoint_every,
+            budget_s=remaining,
+            stop_after_segments=stop_after_segments,
+            # keep until the journal append lands: a kill in between
+            # re-runs at most one segment (resume from the final
+            # boundary), never the whole batch
+            keep=True,
+        )
+        try:
+            results = run_sweep(
+                dev,
+                dims,
+                lanes,
+                max_steps=spec.max_steps,
+                segment_steps=spec.segment_steps,
+                shard_lanes=spec.shard_lanes,
+                checkpoint=ck,
+            )
+        except SweepInterrupted as e:
+            interrupted = e.reason
+            break
+        assert len(results) == len(lanes)
+        rows = [r.to_json() for r in results]
+        _append_journal(path, {"kind": "batch", "id": key, "results": rows})
+        discard_checkpoint(ckpt_path)
+        done[key] = rows
+        progressed += 1
+        if stop_flag["sig"] is not None:
+            interrupted = f"signal {stop_flag['sig']}"
+            break
+
+    summary = {
+        "kind": "sweep",
+        "batches_total": len(batches),
+        "batches_done": sum(1 for k, *_ in batches if k in done),
+        "done": interrupted is None,
+        "interrupted": interrupted,
+        "dir": path,
+    }
+    if interrupted is None:
+        import shutil
+
+        # the journal is the durable output now; orphaned per-batch
+        # checkpoints (kill between a journal append and its discard)
+        # go with the transient directory
+        shutil.rmtree(os.path.join(path, _CKPT), ignore_errors=True)
+        lines = []
+        for key, *_ in batches:
+            for lane, res in enumerate(done[key]):
+                lines.append(
+                    json.dumps(
+                        {"batch": key, "lane": lane, "result": res},
+                        sort_keys=True,
+                    )
+                )
+        _atomic_write(
+            os.path.join(path, _RESULTS), "".join(x + "\n" for x in lines)
+        )
+        summary["results"] = os.path.join(path, _RESULTS)
+        errs = sum(
+            1
+            for key, *_ in batches
+            for res in done[key]
+            if res["err"]
+        )
+        summary["lanes"] = sum(len(done[k]) for k, *_ in batches)
+        summary["errors"] = errs
+    return summary
+
+
+# ----------------------------------------------------------------------
+# fuzz campaigns
+# ----------------------------------------------------------------------
+
+
+def _fuzz_point_spec(spec: FuzzCampaign, proto: str, n: int, chunk: int):
+    from ..mc.fuzz import FuzzSpec
+
+    return FuzzSpec(
+        protocol=proto,
+        n=n,
+        f=spec.f,
+        conflict=spec.conflict,
+        pool_size=spec.pool_size,
+        clients_per_region=spec.clients_per_region,
+        commands_per_client=spec.commands_per_client,
+        schedules=chunk,
+        seed=spec.seed,
+        jitter_max=spec.jitter_max,
+        crash_share=spec.crash_share,
+        drop_share=spec.drop_share,
+        aws=spec.aws,
+        inject_bug=spec.inject_bug,
+    )
+
+
+def _run_fuzz_campaign(path: str, spec: FuzzCampaign, deadline,
+                       stop_flag) -> dict:
+    from ..mc.fuzz import (
+        draw_plans,
+        plan_rng,
+        point_config,
+        point_protocol,
+        restore_rng,
+        rng_state,
+        run_fuzz_point,
+    )
+
+    planet = _planet(spec.aws)
+    points = [(p, n) for p in spec.protocols for n in spec.ns]
+    progress: Dict[str, dict] = {}
+    for entry in _read_journal(path):
+        if entry.get("kind") == "fuzz":
+            progress[entry["point"]] = entry  # latest line wins
+
+    interrupted = None
+    progressed = 0
+    for proto, n in points:
+        key = f"{proto}/n{n}"
+        prev = progress.get(key)
+        tried = int(prev["tried"]) if prev else 0
+        # the journaled generator position — restored, never recomputed
+        # from the root seed, so the remaining plan sequence is
+        # identical to what an uninterrupted session would have drawn
+        rng = (
+            restore_rng(prev["rng_state"])
+            if prev
+            else plan_rng(_fuzz_point_spec(spec, proto, n, spec.chunk))
+        )
+        while tried < spec.schedules:
+            if stop_flag["sig"] is not None:
+                interrupted = f"signal {stop_flag['sig']}"
+                break
+            if (
+                deadline is not None
+                and time.monotonic() > deadline
+                and progressed
+            ):
+                interrupted = "budget exhausted"
+                break
+            size = min(spec.chunk, spec.schedules - tried)
+            pspec = _fuzz_point_spec(spec, proto, n, size)
+            plans = draw_plans(
+                pspec, point_config(pspec), point_protocol(pspec),
+                count=size, rng=rng,
+            )
+            res = run_fuzz_point(
+                pspec,
+                planet=planet,
+                confirm=spec.confirm,
+                max_confirmations=spec.max_confirm,
+                shrink_budget=spec.shrink_budget,
+                strict_missing=spec.strict_missing,
+                plans=plans,
+                lane_offset=tried,
+                artifact_dir=os.path.join(path, _ARTIFACTS),
+            )
+            tried += size
+            entry = {
+                "kind": "fuzz",
+                "point": key,
+                "tried": tried,
+                "rng_state": rng_state(rng),
+                "flagged": (prev["flagged"] if prev else 0) + res.flagged,
+                "confirmed": (
+                    (prev["confirmed"] if prev else 0) + res.confirmed
+                ),
+                "unprocessed": (
+                    (prev.get("unprocessed", 0) if prev else 0)
+                    + res.unprocessed
+                ),
+                "engine_errors": _merge_counts(
+                    prev.get("engine_errors", {}) if prev else {},
+                    res.engine_errors,
+                ),
+                "artifacts": sorted(
+                    set(prev.get("artifacts", []) if prev else [])
+                    | {
+                        os.path.relpath(f.artifact_path, path)
+                        for f in res.findings
+                        if f.artifact_path
+                    }
+                ),
+                "violations": (
+                    (prev.get("violations", []) if prev else [])
+                    + res.summary()["violations"]
+                ),
+            }
+            _append_journal(path, entry)
+            progress[key] = prev = entry
+            progressed += 1
+        if interrupted:
+            break
+
+    done = interrupted is None and all(
+        progress.get(f"{p}/n{n}", {}).get("tried", 0) >= spec.schedules
+        for p, n in points
+    )
+    summary = {
+        "kind": "fuzz",
+        "points_total": len(points),
+        "done": done,
+        "interrupted": interrupted,
+        "dir": path,
+        "points": {
+            key: {
+                k: v
+                for k, v in progress[key].items()
+                if k not in ("kind", "point", "rng_state")
+            }
+            for key in sorted(progress)
+        },
+    }
+    if done:
+        _atomic_write(
+            os.path.join(path, _SUMMARY),
+            json.dumps(summary, indent=2, sort_keys=True),
+        )
+        summary["summary"] = os.path.join(path, _SUMMARY)
+    return summary
+
+
+def _merge_counts(a: dict, b: dict) -> dict:
+    out = dict(a)
+    for k, v in b.items():
+        out[k] = out.get(k, 0) + v
+    return out
+
+
+# ----------------------------------------------------------------------
+# the entry point
+# ----------------------------------------------------------------------
+
+
+def run_campaign(
+    path: str,
+    spec=None,
+    *,
+    resume: bool = False,
+    budget_s: Optional[float] = None,
+    stop_after_segments: Optional[int] = None,
+) -> dict:
+    """Run (or resume) a campaign in ``path``. Returns a summary dict
+    with ``done`` False when interrupted (budget, signal, or the
+    ``stop_after_segments`` test hook) — invoke again with
+    ``resume=True`` to continue exactly where it stopped.
+
+    SIGTERM/SIGINT stop the campaign at the next unit boundary with
+    everything journaled (the in-flight sweep batch additionally
+    flushes its segment checkpoint — run_sweep's own handlers); the
+    summary reports ``interrupted: "signal N"``.
+
+    Checkpoint refusals (stale/corrupt — engine/checkpoint.py) and
+    campaign-directory disagreements (:class:`CampaignError`) raise;
+    they are never silently recovered from."""
+    spec = _load_or_init_spec(path, spec, resume)
+    deadline = (
+        time.monotonic() + budget_s if budget_s is not None else None
+    )
+    stop_flag = {"sig": None}
+    restores = []
+    import signal as _signal
+
+    def _on_signal(num, _frame):
+        stop_flag["sig"] = num
+
+    try:
+        for s in (_signal.SIGTERM, _signal.SIGINT):
+            restores.append((s, _signal.signal(s, _on_signal)))
+    except ValueError:
+        restores = []  # not the main thread: unit-boundary stops only
+    try:
+        if spec.kind == "sweep":
+            return _run_sweep_campaign(
+                path, spec, deadline, stop_after_segments, stop_flag
+            )
+        return _run_fuzz_campaign(path, spec, deadline, stop_flag)
+    finally:
+        for s, old in restores:
+            _signal.signal(s, old)

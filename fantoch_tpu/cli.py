@@ -35,7 +35,12 @@ ORACLE_PROTOCOLS = ENGINE_PROTOCOLS + ("tempo_atomic",)
 # host-only and gets the CPU backend outright so a dead device
 # backend can never hang it ("mc" only fans out on device when
 # fuzzing — artifact replay is host-only and handled in main())
-DEVICE_COMMANDS = ("sweep", "mc")
+DEVICE_COMMANDS = ("sweep", "mc", "campaign")
+
+# cli.py campaign exit code when a campaign stops with work remaining
+# (budget/signal/segment-limit): state is durably checkpointed, re-run
+# with --resume to continue. EX_TEMPFAIL by analogy.
+EXIT_INTERRUPTED = 75
 
 
 def _force_cpu() -> None:
@@ -450,6 +455,54 @@ def cmd_mc(args) -> None:
             }
         )
     )
+
+
+def cmd_campaign(args) -> None:
+    """Durable, resumable campaigns (fantoch_tpu/campaign): a
+    journal-backed manager chunks a sweep or fuzz grid into units,
+    checkpoints the in-flight sweep batch at segment boundaries
+    (engine/checkpoint.py), and resumes exactly where it stopped across
+    process restarts — docs/CAMPAIGN.md. Exits 0 when the grid is
+    done, EXIT_INTERRUPTED (75) when work remains (re-run with
+    --resume), 2 when a stale/corrupted checkpoint or a campaign-dir
+    disagreement is refused."""
+    from .campaign import CampaignError, campaign_from_json, run_campaign
+    from .engine.checkpoint import CheckpointError
+
+    spec = None
+    if args.grid:
+        text = args.grid
+        if text.startswith("@"):
+            with open(text[1:]) as fh:
+                text = fh.read()
+        try:
+            spec = campaign_from_json(json.loads(text))
+        except (ValueError, CampaignError) as e:
+            raise SystemExit(f"bad --grid spec: {e}")
+    try:
+        summary = run_campaign(
+            args.dir,
+            spec,
+            resume=args.resume,
+            budget_s=args.budget_s,
+            stop_after_segments=args.stop_after_segments,
+        )
+    except (CheckpointError, CampaignError) as e:
+        # refusal, not recovery: name the reason and exit non-zero so
+        # CI's corrupted-manifest self-check can pin the gate
+        print(
+            f"campaign refused: {type(e).__name__}: {e}",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    print(json.dumps(summary))
+    if not summary["done"]:
+        print(
+            f"campaign interrupted ({summary['interrupted']}); state "
+            "is checkpointed — re-run with --resume to continue",
+            file=sys.stderr,
+        )
+        raise SystemExit(EXIT_INTERRUPTED)
 
 
 def cmd_lint(args) -> None:
@@ -924,6 +977,37 @@ def main(argv=None) -> None:
     mc.add_argument("--replay", default=None,
                     help="re-execute a repro artifact (host oracle)")
     mc.set_defaults(fn=cmd_mc)
+
+    ca = sub.add_parser(
+        "campaign",
+        help="durable, resumable sweep/fuzz campaigns with "
+        "checkpoint/restore (docs/CAMPAIGN.md)",
+    )
+    ca.add_argument("--dir", required=True,
+                    help="campaign directory (journal, checkpoints, "
+                    "artifacts, results)")
+    ca.add_argument(
+        "--grid",
+        default=None,
+        help="campaign spec: JSON object or @file, e.g. "
+        '\'{"kind": "sweep", "protocols": ["tempo"], "ns": [3, 5], '
+        '"conflicts": [0, 100], "subsets": 4}\' or '
+        '\'{"kind": "fuzz", "protocols": ["tempo"], "ns": [3], '
+        '"schedules": 2048, "chunk": 256}\' '
+        "(required for a new campaign; optional-but-verified with "
+        "--resume)",
+    )
+    ca.add_argument("--resume", action="store_true",
+                    help="continue the campaign stored in --dir "
+                    "exactly where it stopped")
+    ca.add_argument("--budget-s", type=float, default=None,
+                    help="wall-clock budget: make at least one unit of "
+                    "progress, then checkpoint and exit 75 at the next "
+                    "boundary once exceeded")
+    ca.add_argument("--stop-after-segments", type=int, default=None,
+                    help="deterministic-interruption test hook: "
+                    "checkpoint and exit 75 after N sweep segments")
+    ca.set_defaults(fn=cmd_campaign)
 
     ln = sub.add_parser(
         "lint",

@@ -19,6 +19,13 @@ Design (see SURVEY.md §7):
   axis; the whole step is then vmapped over the lane (config) axis.
 """
 
+from .checkpoint import (
+    CheckpointCorruptError,
+    CheckpointError,
+    CheckpointMismatchError,
+    CheckpointSpec,
+    SweepInterrupted,
+)
 from .dims import EngineDims
 from .faults import FaultPlan, LinkWindow, parse_fault_specs
 from .core import build_runner, init_lane_state
@@ -35,6 +42,11 @@ from .results import LaneResults, collect_results
 from .driver import run_lanes
 
 __all__ = [
+    "CheckpointCorruptError",
+    "CheckpointError",
+    "CheckpointMismatchError",
+    "CheckpointSpec",
+    "SweepInterrupted",
     "EngineDims",
     "FaultPlan",
     "LinkWindow",

@@ -1,0 +1,419 @@
+"""Durable checkpoint/restore for batched engine runs.
+
+The reference survives machine churn by re-running whole experiments
+from its orchestrator (fantoch_exp); the device engine instead packs
+thousands of lanes into one process, so a preemption, TPU-worker death
+or budget timeout used to lose the entire campaign. This module makes
+the stacked lane state durable: ``save_sweep_checkpoint`` serializes
+the full batched state tree + lane ctx to a versioned host artifact
+(npz payload + JSON manifest) and ``load_sweep_checkpoint`` restores it
+**bit-exactly** — a run checkpointed at a segment boundary and resumed
+produces byte-identical ``LaneResults`` to an uninterrupted run,
+because the segmented runner's state advances deterministically and
+``device_get``/``device_put`` round-trips preserve every bit.
+
+Staleness is *refused, never silently misloaded*: the manifest carries
+a signature of the things bit-exact resume depends on — protocol
+identity, ``EngineDims``, the jax version, the trace-time runner flags,
+and a content hash of the step function's jaxpr — and a mismatch on any
+component raises :class:`CheckpointMismatchError` naming it. A
+truncated or tampered payload fails its recorded sha256 and raises
+:class:`CheckpointCorruptError`. The loader additionally compares the
+saved lane ctx against the freshly built one, so a checkpoint can never
+be resumed onto different sweep specs.
+
+Artifact layout (a directory)::
+
+    <path>/manifest.json        # version, signature, meta, payload ref
+    <path>/payload-<sha12>.npz  # every state + ctx leaf, flat-keyed
+
+Writes are crash-safe: the payload is written and renamed into place
+*before* the manifest referencing it, and both renames are atomic, so a
+SIGKILL mid-save leaves either the previous consistent pair or the new
+one — never a manifest pointing at a half-written payload.
+
+What bit-exact resume does NOT guarantee: identity across jax versions
+(the jaxpr — and therefore the compiled arithmetic — may change; the
+signature refuses such checkpoints on purpose), across protocol or
+dims edits, or across different ``segment_steps`` ladders (refused via
+manifest meta, conservatively). See docs/CAMPAIGN.md.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import numpy as np
+
+CHECKPOINT_KIND = "fantoch-tpu-checkpoint"
+CHECKPOINT_VERSION = 1
+
+_MANIFEST = "manifest.json"
+
+
+class CheckpointError(RuntimeError):
+    """Base class: a checkpoint could not be used. Never caught
+    silently — callers surface the reason and refuse to resume."""
+
+
+class CheckpointMismatchError(CheckpointError):
+    """The checkpoint is *stale*: it was written under a different
+    protocol / EngineDims / jax version / step jaxpr / lane grid than
+    the run trying to resume from it. Resuming would not be bit-exact,
+    so it is refused with the mismatched component(s) named."""
+
+
+class CheckpointCorruptError(CheckpointError):
+    """The artifact itself is damaged: unreadable manifest, missing
+    payload, or a payload whose bytes fail the recorded sha256
+    (truncation, tampering, torn write)."""
+
+
+class SweepInterrupted(RuntimeError):
+    """``run_sweep`` stopped at a segment boundary with its state saved
+    (signal flush, wall-clock budget, or an explicit segment limit).
+    The checkpoint at ``path`` resumes the run exactly where it
+    stopped."""
+
+    def __init__(self, path: str, until: int, reason: str):
+        self.path = path
+        self.until = int(until)
+        self.reason = reason
+        super().__init__(
+            f"sweep interrupted ({reason}) at step {until}; checkpoint "
+            f"saved at {path}"
+        )
+
+
+@dataclass
+class CheckpointSpec:
+    """How ``run_sweep`` should checkpoint.
+
+    path
+        artifact directory (created on first save).
+    every
+        segments between saves (1 = every boundary). Each save fetches
+        the full batched state to host (~100 MB per 512 lanes), so
+        raise this when the segment cost dwarfs the work between
+        boundaries — docs/PERF.md "checkpoint cadence".
+    resume
+        load an existing valid checkpoint at ``path`` before running
+        (a stale/corrupt one is refused loudly, never ignored).
+    keep
+        keep the artifact after a successful completion (default:
+        removed — the results are the durable output at that point).
+    budget_s
+        wall-clock budget measured from the ``run_sweep`` call; once
+        exceeded the run saves and raises :class:`SweepInterrupted` at
+        the next segment boundary.
+    stop_after_segments
+        stop (save + raise) after this many completed segments — the
+        deterministic interruption hook the tests and the CI smoke
+        job's corrupted-manifest self-check drive.
+    """
+
+    path: str
+    every: int = 1
+    resume: bool = True
+    keep: bool = False
+    budget_s: Optional[float] = None
+    stop_after_segments: Optional[int] = None
+
+
+# ----------------------------------------------------------------------
+# signatures: what bit-exact resume depends on
+# ----------------------------------------------------------------------
+
+# one trace per (protocol, dims, flags, structure) per process — the
+# same memoization shape as parallel/sweep.py's _LANE_PROOFS
+_SIGNATURES: dict = {}
+
+
+def _tree_sig(tree) -> tuple:
+    import jax
+
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return tuple(
+        (
+            str(path),
+            tuple(np.shape(leaf)),
+            str(getattr(leaf, "dtype", type(leaf).__name__)),
+        )
+        for path, leaf in leaves
+    )
+
+
+def protocol_ident(protocol) -> str:
+    """Stable identity string for a device protocol: class path plus
+    the shape-bound attributes that parameterize its traced step
+    (device protocols have value identity — protocols/identity.py)."""
+    cls = protocol if isinstance(protocol, type) else type(protocol)
+    ident = f"{cls.__module__}.{cls.__qualname__}"
+    if not isinstance(protocol, type):
+        ident += repr(sorted(vars(protocol).items()))
+    return ident
+
+
+def step_signature(protocol, dims, *, reorder: bool, faults,
+                   monitor_keys: int, state, ctx) -> Dict[str, str]:
+    """The signature dict stored in (and checked against) a manifest.
+
+    ``state``/``ctx`` are one *unbatched* lane's arrays — the jaxpr of
+    the step traced over them is hashed, so any edit to the step
+    function, a protocol handler, or the trace-time flags changes the
+    signature and stale checkpoints are refused by name.
+    """
+    import jax
+
+    key = (
+        protocol, dims, bool(reorder), faults, int(monitor_keys),
+        _tree_sig(state), _tree_sig(ctx),
+    )
+    if key not in _SIGNATURES:
+        from .core import _lane_step
+
+        jaxpr = jax.make_jaxpr(
+            lambda lane_state, lane_ctx: _lane_step(
+                protocol, dims, lane_state, lane_ctx, reorder, faults,
+                monitor_keys,
+            )
+        )(state, ctx)
+        _SIGNATURES[key] = {
+            "kind": CHECKPOINT_KIND,
+            "protocol": protocol_ident(protocol),
+            "dims": repr(dims),
+            "jax": jax.__version__,
+            "reorder": repr(bool(reorder)),
+            "faults": repr(faults),
+            "monitor_keys": repr(int(monitor_keys)),
+            "step_jaxpr_sha256": hashlib.sha256(
+                str(jaxpr).encode()
+            ).hexdigest(),
+        }
+    return dict(_SIGNATURES[key])
+
+
+# ----------------------------------------------------------------------
+# pytree <-> flat npz keys
+# ----------------------------------------------------------------------
+
+
+def _flatten_tree(tree, prefix: str) -> Dict[str, np.ndarray]:
+    """Nested dict pytree -> flat ``prefix/key/.../leaf`` arrays."""
+    import jax
+
+    out: Dict[str, np.ndarray] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        parts = [str(getattr(p, "key", p)) for p in path]
+        out["/".join([prefix] + parts)] = np.asarray(leaf)
+    return out
+
+
+def _unflatten_tree(flat: Dict[str, np.ndarray], prefix: str) -> dict:
+    """Inverse of :func:`_flatten_tree` for dict-of-dicts pytrees."""
+    root: dict = {}
+    want = prefix + "/"
+    for key in sorted(flat):
+        if not key.startswith(want):
+            continue
+        parts = key[len(want):].split("/")
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = flat[key]
+    return root
+
+
+# ----------------------------------------------------------------------
+# raw artifact I/O
+# ----------------------------------------------------------------------
+
+
+def checkpoint_exists(path: str) -> bool:
+    return os.path.exists(os.path.join(path, _MANIFEST))
+
+
+def atomic_write(path: str, data: "bytes | str") -> None:
+    """Crash-safe file write: temp file in the same directory, flush +
+    fsync, then atomic rename. The one implementation every durable
+    artifact in the repo shares (checkpoint payload/manifest, campaign
+    journal side-files, fuzz repro artifacts) so a crash-safety fix
+    lands everywhere at once."""
+    tmp = path + ".tmp"
+    mode = "wb" if isinstance(data, bytes) else "w"
+    with open(tmp, mode) as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+def save_artifact(path: str, arrays: Dict[str, np.ndarray],
+                  signature: Dict[str, str], meta: dict) -> None:
+    """Atomic write: payload first (renamed into place under a name
+    derived from its own hash), then the manifest referencing it, so a
+    kill at any instant leaves a loadable artifact."""
+    os.makedirs(path, exist_ok=True)
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    payload = buf.getvalue()
+    digest = hashlib.sha256(payload).hexdigest()
+    pname = f"payload-{digest[:12]}.npz"
+    atomic_write(os.path.join(path, pname), payload)
+    manifest = {
+        "kind": CHECKPOINT_KIND,
+        "version": CHECKPOINT_VERSION,
+        "signature": signature,
+        "payload": pname,
+        "payload_sha256": digest,
+        "meta": meta,
+    }
+    atomic_write(
+        os.path.join(path, _MANIFEST),
+        json.dumps(manifest, indent=2, sort_keys=True),
+    )
+    # previous payloads are unreferenced once the manifest lands
+    for fn in os.listdir(path):
+        if fn.startswith("payload-") and fn != pname:
+            try:
+                os.remove(os.path.join(path, fn))
+            except OSError:
+                pass
+
+
+def load_artifact(path: str,
+                  expected_signature: "Dict[str, str] | None" = None,
+                  ) -> "tuple[Dict[str, np.ndarray], dict]":
+    """Read + verify an artifact. Raises the named refusal errors; a
+    valid artifact returns ``(flat arrays, manifest)``."""
+    mpath = os.path.join(path, _MANIFEST)
+    if not os.path.exists(mpath):
+        raise CheckpointCorruptError(f"no checkpoint manifest at {path}")
+    try:
+        with open(mpath) as fh:
+            manifest = json.load(fh)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"checkpoint manifest unreadable at {mpath}: {e}"
+        ) from e
+    if manifest.get("kind") != CHECKPOINT_KIND:
+        raise CheckpointMismatchError(
+            f"not a {CHECKPOINT_KIND} artifact: kind="
+            f"{manifest.get('kind')!r}"
+        )
+    if manifest.get("version") != CHECKPOINT_VERSION:
+        raise CheckpointMismatchError(
+            f"checkpoint version {manifest.get('version')!r} != "
+            f"supported {CHECKPOINT_VERSION}"
+        )
+    if expected_signature is not None:
+        saved = manifest.get("signature") or {}
+        bad = sorted(
+            k for k in expected_signature
+            if saved.get(k) != expected_signature[k]
+        )
+        if bad:
+            detail = "; ".join(
+                f"{k}: saved {str(saved.get(k))[:80]!r} != current "
+                f"{str(expected_signature[k])[:80]!r}"
+                for k in bad
+            )
+            raise CheckpointMismatchError(
+                f"stale checkpoint refused ({', '.join(bad)} changed "
+                f"since it was written): {detail}"
+            )
+    pname = manifest.get("payload")
+    ppath = os.path.join(path, str(pname))
+    if not pname or not os.path.exists(ppath):
+        raise CheckpointCorruptError(
+            f"checkpoint payload {pname!r} missing from {path}"
+        )
+    with open(ppath, "rb") as fh:
+        payload = fh.read()
+    digest = hashlib.sha256(payload).hexdigest()
+    if digest != manifest.get("payload_sha256"):
+        raise CheckpointCorruptError(
+            f"checkpoint payload {pname} truncated or corrupted: "
+            f"sha256 {digest[:12]}... != recorded "
+            f"{str(manifest.get('payload_sha256'))[:12]}..."
+        )
+    try:
+        with np.load(io.BytesIO(payload), allow_pickle=False) as npz:
+            arrays = {k: npz[k] for k in npz.files}
+    except Exception as e:  # zipfile/format errors vary by numpy
+        raise CheckpointCorruptError(
+            f"checkpoint payload {pname} unreadable: {e}"
+        ) from e
+    return arrays, manifest
+
+
+def discard_checkpoint(path: str) -> None:
+    """Remove an artifact this module wrote (manifest + payloads +
+    leftover temp files; the directory itself if then empty)."""
+    if not os.path.isdir(path):
+        return
+    for fn in os.listdir(path):
+        if fn == _MANIFEST or fn.startswith("payload-") or (
+            fn.startswith(_MANIFEST) and fn.endswith(".tmp")
+        ):
+            try:
+                os.remove(os.path.join(path, fn))
+            except OSError:
+                pass
+    try:
+        os.rmdir(path)
+    except OSError:
+        pass
+
+
+# ----------------------------------------------------------------------
+# sweep-level wrappers (the shapes run_sweep and bench.py use)
+# ----------------------------------------------------------------------
+
+
+def save_sweep_checkpoint(path: str, *, state, ctx,
+                          signature: Dict[str, str], until: int,
+                          meta: dict) -> None:
+    """Serialize one batched sweep's full state + ctx. ``state`` must
+    already be host-side (``jax.device_get``)."""
+    arrays = {**_flatten_tree(state, "state"), **_flatten_tree(ctx, "ctx")}
+    save_artifact(path, arrays, signature, dict(meta, until=int(until)))
+
+
+def load_sweep_checkpoint(path: str, *, signature: Dict[str, str],
+                          ctx, meta_expect: "dict | None" = None,
+                          ) -> "tuple[dict, dict]":
+    """Restore a sweep checkpoint: verify signature, meta, payload
+    integrity AND that the saved lane ctx is bit-identical to the
+    freshly built one (``ctx``) — a checkpoint never resumes onto
+    different sweep specs. Returns ``(state tree, manifest meta)``."""
+    arrays, manifest = load_artifact(path, signature)
+    meta = manifest.get("meta") or {}
+    for k, v in (meta_expect or {}).items():
+        if meta.get(k) != v:
+            raise CheckpointMismatchError(
+                f"checkpoint {k}={meta.get(k)!r} does not match the "
+                f"current run's {k}={v!r}"
+            )
+    fresh = _flatten_tree(ctx, "ctx")
+    saved_flat = {k: v for k, v in arrays.items() if k.startswith("ctx/")}
+    if sorted(saved_flat) != sorted(fresh):
+        raise CheckpointMismatchError(
+            "checkpoint lane ctx has different fields than the current "
+            f"specs (saved {len(saved_flat)} vs current {len(fresh)})"
+        )
+    for k, cur in fresh.items():
+        sav = saved_flat[k]
+        if sav.dtype != cur.dtype or sav.shape != cur.shape or not (
+            np.array_equal(sav, cur)
+        ):
+            raise CheckpointMismatchError(
+                f"checkpoint lane ctx differs from the current specs at "
+                f"{k!r} — resuming onto different lanes is refused"
+            )
+    return _unflatten_tree(arrays, "state"), meta

@@ -67,6 +67,56 @@ class LaneResults:
         row = self.region_rows.index(region)
         return int(self.lat_count[row])
 
+    # -- durable serialization (campaign journal, docs/CAMPAIGN.md) ----
+
+    def to_json(self) -> dict:
+        """Deterministic JSON-able form: every array as nested int
+        lists, metrics in sorted key order — two identical results
+        serialize to identical bytes under ``json.dumps(...,
+        sort_keys=True)``, which is what the campaign resume contract
+        (byte-identical results.jsonl) is pinned against."""
+        return {
+            "region_rows": list(self.region_rows),
+            "hist": np.asarray(self.hist).tolist(),
+            "lat_sum": np.asarray(self.lat_sum).tolist(),
+            "lat_count": np.asarray(self.lat_count).tolist(),
+            "protocol_metrics": {
+                k: np.asarray(v).tolist()
+                for k, v in sorted(self.protocol_metrics.items())
+            },
+            "steps": int(self.steps),
+            "err": int(self.err),
+            "completed": int(self.completed),
+            "pool_peak": int(self.pool_peak),
+            "requeues": int(self.requeues),
+            "faults": self.faults,
+            "dropped": int(self.dropped),
+            "violation": int(self.violation),
+            "violation_step": int(self.violation_step),
+        }
+
+    @staticmethod
+    def from_json(obj: dict) -> "LaneResults":
+        return LaneResults(
+            region_rows=list(obj["region_rows"]),
+            hist=np.asarray(obj["hist"], np.int64),
+            lat_sum=np.asarray(obj["lat_sum"], np.int64),
+            lat_count=np.asarray(obj["lat_count"], np.int64),
+            protocol_metrics={
+                k: np.asarray(v, np.int64)
+                for k, v in obj["protocol_metrics"].items()
+            },
+            steps=int(obj["steps"]),
+            err=int(obj["err"]),
+            completed=int(obj["completed"]),
+            pool_peak=int(obj["pool_peak"]),
+            requeues=int(obj["requeues"]),
+            faults=obj.get("faults"),
+            dropped=int(obj.get("dropped", 0)),
+            violation=int(obj.get("violation", 0)),
+            violation_step=int(obj.get("violation_step", INF)),
+        )
+
 
 def collect_results(
     protocol,

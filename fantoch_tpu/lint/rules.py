@@ -42,12 +42,17 @@ from .report import Finding
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
 
-# default scan set: everything that traces into the engine step
+# default scan set: everything that traces into the engine step, plus
+# the checkpoint/campaign entry points (host-side by design — the scan
+# proves they stay that way: no raw emission, no tracer branching, no
+# host-sync ops sneaking into anything that becomes traced)
 DEFAULT_PATHS = (
     "fantoch_tpu/engine/core.py",
     "fantoch_tpu/engine/monitor.py",
     "fantoch_tpu/engine/iset.py",
+    "fantoch_tpu/engine/checkpoint.py",
     "fantoch_tpu/engine/protocols",
+    "fantoch_tpu/campaign",
 )
 
 OUTBOX_KEYS = {"valid", "dst", "mtype", "payload"}

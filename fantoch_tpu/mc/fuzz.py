@@ -182,20 +182,65 @@ def _protocol_pair(spec: FuzzSpec, clients: int):
         ORACLES[spec.protocol]
 
 
-def draw_plans(spec: FuzzSpec, config: Config, protocol) -> List[FaultPlan]:
+def plan_rng(spec: FuzzSpec) -> np.random.Generator:
+    """The root PRNG for a fuzz point's perturbation plans. Campaigns
+    journal its position (:func:`rng_state`) after every chunk so a
+    resumed session draws the identical remaining per-lane plans —
+    the split position is restored, never recomputed."""
+    return np.random.default_rng(
+        [spec.seed & 0x7FFFFFFF, spec.n, spec.f, spec.conflict]
+    )
+
+
+def rng_state(rng: np.random.Generator) -> dict:
+    """JSON-able bit-generator state (plain ints/strs)."""
+    return rng.bit_generator.state
+
+
+def restore_rng(state: dict) -> np.random.Generator:
+    """Inverse of :func:`rng_state`: a generator that continues the
+    journaled stream exactly where it stopped."""
+    bg_cls = getattr(np.random, state["bit_generator"])
+    bg = bg_cls()
+    bg.state = state
+    return np.random.Generator(bg)
+
+
+def point_config(spec: FuzzSpec) -> Config:
+    """The device Config of one fuzz point (shared by the fuzz driver
+    and the campaign manager's plan drawing)."""
+    return Config(**dev_config_kwargs(spec.protocol, spec.n, spec.f))
+
+
+def point_protocol(spec: FuzzSpec):
+    """The device protocol of one fuzz point (injected-bug twin when
+    asked) — what ``draw_plans`` needs for its ``min_live`` bound."""
+    clients = spec.clients_per_region * spec.n
+    dev, _ = _protocol_pair(spec, clients)
+    return dev
+
+
+def draw_plans(spec: FuzzSpec, config: Config, protocol,
+               count: "int | None" = None,
+               rng: "np.random.Generator | None" = None,
+               ) -> List[FaultPlan]:
     """Per-lane perturbation plans from the root PRNG key: always
     seeded jitter; a slice of lanes adds threefry drop masks (with the
     mandatory horizon); another slice adds crash plans that stay within
     what the protocol tolerates (``min_live`` via ``unavailable``) and
     never target the leader (a leader crash halts every client —
-    vacuously clean, nothing to check)."""
-    rng = np.random.default_rng(
-        [spec.seed & 0x7FFFFFFF, spec.n, spec.f, spec.conflict]
-    )
+    vacuously clean, nothing to check).
+
+    ``rng``/``count`` support resumable campaigns: drawing in chunks
+    from one generator yields the identical plan sequence as one shot,
+    and the generator's journaled state (:func:`rng_state`) restores
+    mid-sequence across process restarts."""
+    if rng is None:
+        rng = plan_rng(spec)
     leader_row = None if config.leader is None else config.leader - 1
     crashable = [r for r in range(spec.n) if r != leader_row]
     plans: List[FaultPlan] = []
-    for _ in range(spec.schedules):
+    for _ in range(spec.schedules if count is None else count):
         kw = dict(
             jitter_max=spec.jitter_max,
             jitter_seed=int(rng.integers(1 << 31)),
@@ -384,6 +429,10 @@ class LaneFinding:
     host_violation: Optional[str] = None
     shrunk: Optional[ShrinkResult] = None
     artifact: Optional[dict] = None
+    # where the artifact was persisted (run_fuzz_point(artifact_dir=..)
+    # writes each one the moment it exists, so a campaign killed right
+    # after a confirmation still has the repro on disk)
+    artifact_path: Optional[str] = None
 
     @property
     def violation_cause(self) -> str:
@@ -451,6 +500,9 @@ def run_fuzz_point(
     shrink_budget: int = 150,
     max_confirmations: int = 8,
     strict_missing: bool = False,
+    plans: Optional[Sequence[FaultPlan]] = None,
+    lane_offset: int = 0,
+    artifact_dir: Optional[str] = None,
 ) -> FuzzPointResult:
     """Fuzz one (protocol, config) point: fan the schedule batch out on
     device, then host-confirm and shrink flagged lanes.
@@ -460,7 +512,14 @@ def run_fuzz_point(
     each shrink spends at most ``shrink_budget`` host runs.
     ``strict_missing`` promotes the advisory missing-execution bit to a
     finding (off by default: an undersized drain tail can leave a
-    correct protocol's executors undrained — docs/MC.md)."""
+    correct protocol's executors undrained — docs/MC.md).
+
+    Campaign hooks (fantoch_tpu/campaign): ``plans`` overrides the
+    per-lane perturbation draw (a resumable campaign draws its chunk
+    from a journaled generator), ``lane_offset`` shifts reported lane
+    indices to campaign-global positions, and ``artifact_dir`` persists
+    every shrunk repro artifact the moment it exists — a session killed
+    right after a confirmation keeps it."""
     planet = planet or spec.planet()
     regions = list(spec.regions or planet.regions()[: spec.n])
     assert len(regions) == spec.n
@@ -477,7 +536,9 @@ def run_fuzz_point(
         dot_slots=total + 1,
         regions=spec.n,
     )
-    plans = draw_plans(spec, config, dev)
+    plans = (
+        list(plans) if plans is not None else draw_plans(spec, config, dev)
+    )
     lane_specs = [
         make_lane(
             dev,
@@ -523,7 +584,7 @@ def run_fuzz_point(
             out.unprocessed += 1
             continue
         finding = LaneFinding(
-            lane=i,
+            lane=lane_offset + i,
             plan=plans[i],
             violation=r.violation,
             violation_step=r.violation_step,
@@ -569,13 +630,37 @@ def run_fuzz_point(
                             inject_bug=spec.inject_bug,
                             aws=spec.aws,
                             device={
-                                "lane": i,
+                                "lane": lane_offset + i,
                                 "violation": r.violation,
                                 "violation_step": r.violation_step,
                             },
                         )
+                    if (
+                        finding.artifact is not None
+                        and artifact_dir is not None
+                    ):
+                        finding.artifact_path = _persist_artifact(
+                            artifact_dir, spec, finding,
+                        )
         out.findings.append(finding)
     return out
+
+
+def _persist_artifact(artifact_dir: str, spec: FuzzSpec,
+                      finding: LaneFinding) -> str:
+    """Write one repro artifact durably (atomic rename) the moment it
+    is confirmed + shrunk, so a killed campaign session keeps it."""
+    import os
+
+    from ..engine.checkpoint import atomic_write
+
+    os.makedirs(artifact_dir, exist_ok=True)
+    path = os.path.join(
+        artifact_dir,
+        f"repro_{spec.protocol}_n{spec.n}_lane{finding.lane}.json",
+    )
+    atomic_write(path, json.dumps(finding.artifact, indent=2, sort_keys=True))
+    return path
 
 
 # ----------------------------------------------------------------------

@@ -27,6 +27,15 @@ from ..engine import (
     collect_results,
     make_lane,
 )
+from ..engine.checkpoint import (
+    CheckpointSpec,
+    SweepInterrupted,
+    checkpoint_exists,
+    discard_checkpoint,
+    load_sweep_checkpoint,
+    save_sweep_checkpoint,
+    step_signature,
+)
 from ..engine.core import (
     KEYGEN_CTX_FIELDS,
     build_runner,
@@ -178,6 +187,7 @@ def run_sweep(
     segment_steps: int = 8192,
     monitor_keys: int = 0,
     shard_lanes: "bool | None" = None,
+    checkpoint: "CheckpointSpec | str | None" = None,
 ) -> List[LaneResults]:
     """Run a sweep batch, sharded over ``mesh`` (default: all local
     devices on one axis). The device loop runs in ``segment_steps``
@@ -197,6 +207,18 @@ def run_sweep(
       equation mixes lanes; only then shard over the mesh.
     * ``False`` — the unsharded reference path: a single-device mesh
       (the bit-identical baseline the sharded test compares against).
+
+    ``checkpoint`` (a :class:`~fantoch_tpu.engine.checkpoint
+    .CheckpointSpec` or a bare path) makes the run durable: the full
+    batched state is saved at segment boundaries (the existing
+    host-resume choke point), flushed on SIGTERM/SIGINT, and — when a
+    valid checkpoint already exists at the path — the run resumes
+    exactly where it stopped, producing byte-identical results to an
+    uninterrupted run. A stale or corrupted checkpoint is *refused*
+    with a named error (engine/checkpoint.py), never silently
+    misloaded. Budget/segment-limit stops raise
+    :class:`~fantoch_tpu.engine.checkpoint.SweepInterrupted` with the
+    state saved; docs/CAMPAIGN.md covers cadence and guarantees.
     """
     import os
     import time as _t
@@ -246,6 +268,9 @@ def run_sweep(
     state = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *states)
     mark("init+stack_states")
 
+    reorder_flag = batch_reorder_flag(padded)
+    fault_flags = batch_fault_flags(padded)
+
     if shard_lanes:
         # the verified multichip path: refuse to shard a step that
         # mixes lanes (GL203; one trace + taint per protocol, cached).
@@ -253,32 +278,147 @@ def run_sweep(
         # runner sees — including the key table when present.
         ctx0 = {k: np.asarray(v)[0] for k, v in ctx.items()}
         findings = _prove_lane_independent(
-            protocol, dims, batch_reorder_flag(padded),
-            batch_fault_flags(padded), monitor_keys, states[0], ctx0,
+            protocol, dims, reorder_flag,
+            fault_flags, monitor_keys, states[0], ctx0,
         )
         if findings:
             raise LaneMixingError(findings)
         mark("lane_proof")
+
+    ck = None
+    sig = None
+    ckpt_meta = None
+    ctx_host = ctx  # the pre-device_put numpy ctx, saved verbatim
+    resume_until = 0
+    if checkpoint is not None:
+        ck = (
+            checkpoint
+            if isinstance(checkpoint, CheckpointSpec)
+            else CheckpointSpec(path=str(checkpoint))
+        )
+        ctx0 = {k: np.asarray(v)[0] for k, v in ctx.items()}
+        sig = step_signature(
+            protocol, dims, reorder=reorder_flag, faults=fault_flags,
+            monitor_keys=monitor_keys, state=states[0], ctx=ctx0,
+        )
+        # padded duplicate lanes ride inside the payload (the batched
+        # state needs them) but never the manifest's lane accounting
+        ckpt_meta = {
+            "lanes": len(specs),
+            "padded": pad,
+            "max_steps": int(max_steps),
+            "segment_steps": int(segment_steps),
+            "monitor_keys": int(monitor_keys),
+            "specs": [
+                {
+                    "n": s.config.n,
+                    "f": s.config.f,
+                    "conflict": int(s.ctx["conflict_rate"]),
+                    "regions": list(s.process_regions),
+                    "faults": s.fault_meta,
+                }
+                for s in specs
+            ],
+        }
+        if ck.resume and checkpoint_exists(ck.path):
+            # a stale/corrupted artifact raises here — refusal, not a
+            # silent from-scratch rerun
+            state, loaded_meta = load_sweep_checkpoint(
+                ck.path, signature=sig, ctx=ctx_host,
+                meta_expect={
+                    k: ckpt_meta[k]
+                    for k in (
+                        "lanes", "padded", "max_steps", "segment_steps",
+                        "monitor_keys",
+                    )
+                },
+            )
+            resume_until = int(loaded_meta["until"])
+            mark("checkpoint_load")
 
     sharding = NamedSharding(mesh, PartitionSpec("sweep"))
     put = lambda tree: jax.tree_util.tree_map(
         lambda a: jax.device_put(a, sharding), tree
     )
     runner, alive = _cached_runner(
-        protocol, dims, max_steps, batch_reorder_flag(padded),
-        batch_fault_flags(padded), monitor_keys,
+        protocol, dims, max_steps, reorder_flag,
+        fault_flags, monitor_keys,
     )
     state = put(state)
     ctx = put(ctx)
     mark("device_put")
-    until = 0
-    while until < max_steps:
-        until = min(until + segment_steps, max_steps)
-        state, any_alive = runner(state, ctx, np.int32(until))
-        if not bool(any_alive):
-            break
-        mark(f"segment@{until}")
+
+    # checkpointed runs flush on SIGTERM/SIGINT: the handler only sets
+    # a flag, the save happens at the next segment boundary (segment
+    # calls are bounded by design, so the wait is short)
+    sig_seen = {"num": None}
+    restores = []
+    if ck is not None:
+        import signal as _signal
+
+        def _on_signal(num, _frame):
+            sig_seen["num"] = num
+
+        try:
+            for s in (_signal.SIGTERM, _signal.SIGINT):
+                restores.append((s, _signal.signal(s, _on_signal)))
+        except ValueError:
+            restores = []  # not the main thread: no signal flush
+
+    t_run = _t.perf_counter()
+    until = resume_until
+    segs_done = 0
+    try:
+        while until < max_steps:
+            until = min(until + segment_steps, max_steps)
+            state, any_alive = runner(state, ctx, np.int32(until))
+            segs_done += 1
+            running = bool(any_alive)
+            if ck is not None and running:
+                stop = None
+                if sig_seen["num"] is not None:
+                    stop = f"signal {sig_seen['num']}"
+                elif (
+                    ck.stop_after_segments is not None
+                    and segs_done >= ck.stop_after_segments
+                ):
+                    stop = "segment-limit"
+                elif (
+                    ck.budget_s is not None
+                    and _t.perf_counter() - t_run > ck.budget_s
+                ):
+                    stop = "budget exhausted"
+                if stop is not None or segs_done % ck.every == 0:
+                    save_sweep_checkpoint(
+                        ck.path, state=jax.device_get(state),
+                        ctx=ctx_host, signature=sig, until=until,
+                        meta=ckpt_meta,
+                    )
+                    mark(f"checkpoint@{until}")
+                if stop is not None:
+                    raise SweepInterrupted(ck.path, until, stop)
+            if not running:
+                break
+            mark(f"segment@{until}")
+    finally:
+        if restores:
+            import signal as _signal
+
+            for s, old in restores:
+                _signal.signal(s, old)
+    if sig_seen["num"] is not None:
+        # the signal landed while the FINAL segment completed, so the
+        # flush handler swallowed it without a stop. Re-deliver it now
+        # that the previous handlers are back — and BEFORE the
+        # checkpoint is discarded: a default handler terminates the
+        # process here with the state still durable, and a campaign's
+        # flag handler records it and lets this completed batch's
+        # results flow out before stopping
+        os.kill(os.getpid(), sig_seen["num"])
     mark("segments")
+    if ck is not None and not ck.keep:
+        # the results computed below are the durable output now
+        discard_checkpoint(ck.path)
     # fetch only what result collection reads (protocol metric fields
     # follow the m_* convention) — the full state is ~100 MB per 512
     # lanes and the tunnel moves ~30 MB/s
@@ -302,7 +442,13 @@ def run_sweep(
         fetch["viol_step"] = state["viol_step"]
     final = finish_segmented(jax.device_get(fetch), max_steps)
     mark("device_get")
+    # the tail-padding seam: duplicate lanes were computed, but exactly
+    # the caller's specs come back — never a padded twin's results
     out = collect_results(protocol, dims, final, padded)[: len(specs)]
+    assert len(out) == len(specs), (
+        f"padded sweep returned {len(out)} results for {len(specs)} "
+        f"specs (pad={pad}) — padding must never leak"
+    )
     mark("collect")
     if dbg:
         spans = ", ".join(

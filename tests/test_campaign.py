@@ -1,0 +1,259 @@
+"""Campaign manager (fantoch_tpu/campaign): journal-backed resume.
+
+Default tier: sweep-campaign interrupted-resume writes a results.jsonl
+byte-identical to an uninterrupted control run (the compiled Basic
+runner the suite already shares), campaign-directory refusal rules, and
+fuzz-plan resume determinism (the journaled generator position draws
+the identical remaining plans — host-only, no device). Slow tier: a
+fuzz campaign on the real monitored pipeline, including the
+injected-bug artifact surviving an interruption and replaying after
+resume.
+"""
+
+import json
+import os
+
+import pytest
+
+from fantoch_tpu.campaign import (
+    CampaignError,
+    campaign_from_json,
+    run_campaign,
+)
+from fantoch_tpu.mc.fuzz import (
+    FuzzSpec,
+    draw_plans,
+    plan_rng,
+    point_config,
+    point_protocol,
+    restore_rng,
+    rng_state,
+)
+
+# mirrors tests/test_sweep_sharded.py shapes so the campaign batches
+# reuse the suite's compiled Basic segment runner
+SWEEP_GRID = {
+    "kind": "sweep",
+    "protocols": ["basic"],
+    "ns": [3],
+    "conflicts": [0, 100],
+    "subsets": 2,
+    "commands_per_client": 2,
+    "batch_lanes": 2,
+    "segment_steps": 8,
+}
+
+
+def test_campaign_spec_round_trip_and_validation():
+    spec = campaign_from_json(SWEEP_GRID)
+    assert campaign_from_json(spec.to_json()) == spec
+    with pytest.raises(CampaignError, match="kind"):
+        campaign_from_json({"kind": "nope"})
+    with pytest.raises(CampaignError, match="protocol"):
+        campaign_from_json(dict(SWEEP_GRID, protocols=["nope"]))
+    with pytest.raises(CampaignError, match="field"):
+        campaign_from_json(dict(SWEEP_GRID, bogus=1))
+
+
+def test_sweep_campaign_resume_byte_identical(tmp_path):
+    spec = campaign_from_json(SWEEP_GRID)
+    ctrl = run_campaign(str(tmp_path / "ctrl"), spec)
+    assert ctrl["done"] and ctrl["errors"] == 0
+
+    intr_dir = str(tmp_path / "intr")
+    s1 = run_campaign(intr_dir, spec, stop_after_segments=1)
+    assert not s1["done"] and s1["interrupted"] == "segment-limit"
+    import glob
+
+    assert glob.glob(os.path.join(intr_dir, "ckpt", "*", "manifest.json"))
+    s2 = run_campaign(intr_dir, resume=True)
+    assert s2["done"]
+
+    with open(os.path.join(str(tmp_path / "ctrl"), "results.jsonl"), "rb") as fh:
+        control_bytes = fh.read()
+    with open(os.path.join(intr_dir, "results.jsonl"), "rb") as fh:
+        resumed_bytes = fh.read()
+    assert control_bytes == resumed_bytes
+    assert control_bytes, "results must not be empty"
+
+
+def test_campaign_budget_makes_progress_and_converges(tmp_path):
+    # budget 0 = at least one unit of progress per invocation; repeated
+    # budgeted invocations must converge to done
+    spec = campaign_from_json(SWEEP_GRID)
+    path = str(tmp_path / "c")
+    summary = run_campaign(path, spec, budget_s=0.0)
+    invocations = 1
+    while not summary["done"]:
+        summary = run_campaign(path, resume=True, budget_s=0.0)
+        invocations += 1
+        assert invocations < 50, "budgeted campaign failed to converge"
+    assert summary["batches_done"] == summary["batches_total"] == 2
+    ctrl = run_campaign(str(tmp_path / "ctrl"), spec)
+    with open(os.path.join(path, "results.jsonl"), "rb") as fh:
+        a = fh.read()
+    with open(os.path.join(str(tmp_path / "ctrl"), "results.jsonl"), "rb") as fh:
+        b = fh.read()
+    assert a == b
+
+
+def test_campaign_dir_refusals(tmp_path):
+    with pytest.raises(CampaignError, match="resume"):
+        run_campaign(str(tmp_path / "missing"), resume=True)
+    spec = campaign_from_json(SWEEP_GRID)
+    path = str(tmp_path / "c")
+    run_campaign(path, spec, stop_after_segments=1)
+    other = campaign_from_json(dict(SWEEP_GRID, conflicts=[0, 50]))
+    with pytest.raises(CampaignError, match="different campaign"):
+        run_campaign(path, other)
+    with pytest.raises(CampaignError, match="disagrees"):
+        run_campaign(path, other, resume=True)
+
+
+def test_campaign_journal_tolerates_torn_final_line(tmp_path):
+    spec = campaign_from_json(SWEEP_GRID)
+    path = str(tmp_path / "c")
+    run_campaign(path, spec)
+    # tear the final journal line (a SIGKILL mid-append); the torn unit
+    # simply reruns and the campaign still completes identically
+    jpath = os.path.join(path, "journal.jsonl")
+    with open(jpath) as fh:
+        lines = fh.readlines()
+    with open(jpath, "w") as fh:
+        fh.writelines(lines[:-1])
+        fh.write(lines[-1][: len(lines[-1]) // 2])
+    os.remove(os.path.join(path, "results.jsonl"))
+    summary = run_campaign(path, resume=True)
+    assert summary["done"]
+    ctrl = run_campaign(str(tmp_path / "ctrl"), spec)
+    with open(os.path.join(path, "results.jsonl"), "rb") as fh:
+        a = fh.read()
+    with open(os.path.join(str(tmp_path / "ctrl"), "results.jsonl"), "rb") as fh:
+        b = fh.read()
+    assert a == b
+
+
+def test_campaign_stops_on_sigterm_and_resumes_identically(tmp_path):
+    """A SIGTERM mid-campaign stops at the next boundary with state
+    durable (run_sweep flushes mid-segment; the manager stops between
+    units); resuming completes with byte-identical results."""
+    import signal
+    import threading
+
+    spec = campaign_from_json(SWEEP_GRID)
+    ctrl = run_campaign(str(tmp_path / "ctrl"), spec)
+    assert ctrl["done"]
+
+    path = str(tmp_path / "intr")
+    timer = threading.Timer(
+        0.05, lambda: os.kill(os.getpid(), signal.SIGTERM)
+    )
+    timer.start()
+    try:
+        summary = run_campaign(path, spec)
+    finally:
+        timer.cancel()
+    # wherever the signal landed — mid-segment (SweepInterrupted),
+    # between units, or after the last unit — the campaign either
+    # stopped naming the signal or had already finished; either way
+    # resuming must converge to the identical results
+    if not summary["done"]:
+        assert "signal" in summary["interrupted"], summary
+        summary = run_campaign(path, resume=True)
+    assert summary["done"]
+    with open(os.path.join(path, "results.jsonl"), "rb") as fh:
+        a = fh.read()
+    with open(os.path.join(str(tmp_path / "ctrl"), "results.jsonl"), "rb") as fh:
+        b = fh.read()
+    assert a == b
+
+
+# ----------------------------------------------------------------------
+# fuzz-campaign resume determinism
+# ----------------------------------------------------------------------
+
+
+def test_fuzz_plans_resume_identical_after_journal_round_trip():
+    """The satellite contract: a resumed campaign draws the identical
+    remaining per-lane plans because the root generator's position is
+    journaled (JSON round-trip included), not recomputed."""
+    spec = FuzzSpec(protocol="tempo", n=3, schedules=12, seed=11)
+    config, dev = point_config(spec), point_protocol(spec)
+    reference = draw_plans(spec, config, dev)
+
+    rng = plan_rng(spec)
+    first = draw_plans(spec, config, dev, count=5, rng=rng)
+    journaled = json.loads(json.dumps(rng_state(rng)))  # the journal hop
+    rest = draw_plans(
+        spec, config, dev, count=7, rng=restore_rng(journaled)
+    )
+    assert first + rest == reference
+
+    # and the default (non-resumable) call still draws the same plans
+    assert draw_plans(spec, config, dev) == reference
+
+
+@pytest.mark.slow
+def test_fuzz_campaign_resume_accumulates_coverage(tmp_path):
+    grid = campaign_from_json(
+        {
+            "kind": "fuzz",
+            "protocols": ["tempo"],
+            "ns": [3],
+            "schedules": 8,
+            "chunk": 4,
+            "commands_per_client": 5,
+            "seed": 7,
+            "confirm": False,
+        }
+    )
+    path = str(tmp_path / "c")
+    s1 = run_campaign(path, grid, budget_s=0.0)
+    assert not s1["done"]
+    assert s1["points"]["tempo/n3"]["tried"] == 4
+    s2 = run_campaign(path, resume=True)
+    assert s2["done"]
+    assert s2["points"]["tempo/n3"]["tried"] == 8
+
+    ctrl = run_campaign(str(tmp_path / "ctrl"), grid)
+    assert s2["points"] == ctrl["points"]
+
+
+@pytest.mark.slow
+def test_fuzz_campaign_artifact_survives_interruption(tmp_path):
+    """An artifact confirmed+shrunk before the interruption is already
+    on disk, still present after resume, and replays."""
+    from fantoch_tpu.mc.fuzz import load_artifact, replay_artifact
+
+    grid = campaign_from_json(
+        {
+            "kind": "fuzz",
+            "protocols": ["tempo"],
+            "ns": [3],
+            "schedules": 4,
+            "chunk": 2,
+            "commands_per_client": 5,
+            "seed": 3,
+            "crash_share": 0.0,
+            "drop_share": 0.0,
+            "max_confirm": 1,
+            "shrink_budget": 80,
+            "inject_bug": True,
+        }
+    )
+    path = str(tmp_path / "c")
+    s1 = run_campaign(path, grid, budget_s=0.0)  # exactly one chunk
+    assert not s1["done"]
+    point = s1["points"]["tempo/n3"]
+    assert point["tried"] == 2
+    assert point["confirmed"] >= 1, point
+    arts = point["artifacts"]
+    assert arts, "confirmed violation must persist an artifact"
+    apath = os.path.join(path, arts[0])
+    assert os.path.exists(apath)
+
+    s2 = run_campaign(path, resume=True)
+    assert s2["done"]
+    assert os.path.exists(apath), "artifact lost across resume"
+    rep = replay_artifact(load_artifact(apath))
+    assert rep["reproduced"], rep

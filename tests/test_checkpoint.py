@@ -1,0 +1,280 @@
+"""Durable checkpoint/restore (engine/checkpoint.py + run_sweep
+wiring): artifact-level refusal gates, bit-exact interrupted-resume on
+the segmented sweep path, and the tail-padding seam.
+
+The contract under test: a sweep interrupted at a segment boundary and
+resumed from its checkpoint yields byte-identical ``LaneResults`` to an
+uninterrupted run (serialize both via ``LaneResults.to_json`` under
+``sort_keys`` and compare the strings); a stale checkpoint (signature
+or lane-ctx mismatch) or a corrupted one (truncated payload, unreadable
+manifest) is *refused* with a named error, never silently misloaded.
+The full-protocol × shard-path matrix rides in the slow tier; the
+default tier pins the machinery on the cheap Basic/Tempo runners the
+suite already compiles.
+"""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from fantoch_tpu.core import Config, Planet
+from fantoch_tpu.engine import EngineDims, make_lane
+from fantoch_tpu.engine.checkpoint import (
+    CheckpointCorruptError,
+    CheckpointMismatchError,
+    CheckpointSpec,
+    SweepInterrupted,
+    checkpoint_exists,
+    load_artifact,
+    save_artifact,
+)
+from fantoch_tpu.engine.protocols import (
+    dev_config_kwargs,
+    dev_protocol,
+    partial_dev_protocol,
+)
+from fantoch_tpu.parallel.sweep import make_sweep_specs, run_sweep
+from fantoch_tpu.registry import DEV_PROTOCOLS, PARTIAL_DEV_PROTOCOLS
+
+COMMANDS = 2
+SEG = 8  # segments small enough that every lane spans several
+
+
+def _blob(results) -> str:
+    return json.dumps([r.to_json() for r in results], sort_keys=True)
+
+
+def _specs(name: str, conflicts=(0, 100), subsets=4, shards=1):
+    planet = Planet.new()
+    regions = planet.regions()
+    clients = 3
+    pool = 1
+    total = COMMANDS * clients
+    if shards > 1:
+        # multi-key commands need a shared pool big enough to draw
+        # keys_per_cmd *unique* keys (same shape as the partial diffs)
+        pool = 4
+        dev = partial_dev_protocol(name, clients, shards, pool_size=pool)
+        dims = EngineDims.for_partial(dev, 3, clients, total, regions=3)
+        base = Config(
+            **dev_config_kwargs(name, 3, 1),
+            shard_count=shards,
+            executor_executed_notification_interval_ms=100,
+            executor_cleanup_interval_ms=100,
+        )
+    else:
+        dev = dev_protocol(name, clients)
+        dims = EngineDims.for_protocol(
+            dev, n=3, clients=clients, payload=dev.payload_width(3),
+            total_commands=total, dot_slots=total + 1, regions=3,
+        )
+        base = Config(**dev_config_kwargs(name, 3, 1))
+    specs = make_sweep_specs(
+        dev,
+        planet,
+        region_sets=[regions[i : i + 3] for i in range(subsets)],
+        fs=[1],
+        conflicts=list(conflicts),
+        commands_per_client=COMMANDS,
+        clients_per_region=1,
+        dims=dims,
+        config_base=base,
+        pool_size=pool,
+    )
+    return dev, dims, specs
+
+
+def _interrupt_resume(dev, dims, specs, path, **kw):
+    """Stop after the first segment, then resume to completion."""
+    with pytest.raises(SweepInterrupted) as e:
+        run_sweep(
+            dev, dims, specs, segment_steps=SEG,
+            checkpoint=CheckpointSpec(path=path, stop_after_segments=1),
+            **kw,
+        )
+    assert e.value.reason == "segment-limit"
+    assert checkpoint_exists(path)
+    resumed = run_sweep(
+        dev, dims, specs, segment_steps=SEG,
+        checkpoint=CheckpointSpec(path=path), **kw,
+    )
+    assert not checkpoint_exists(path), (
+        "checkpoint must be discarded once results exist"
+    )
+    return resumed
+
+
+# ----------------------------------------------------------------------
+# artifact-level refusal gates (host only, no engine)
+# ----------------------------------------------------------------------
+
+
+def test_artifact_roundtrip_and_refusals(tmp_path):
+    path = str(tmp_path / "ck")
+    arrays = {
+        "state/x": np.arange(5, dtype=np.int32),
+        "ctx/y": np.ones((2, 2), np.float32),
+    }
+    sig = {"kind": "fantoch-tpu-checkpoint", "protocol": "p", "jax": "x"}
+    save_artifact(path, arrays, sig, {"until": 3})
+    loaded, manifest = load_artifact(path, sig)
+    assert manifest["meta"]["until"] == 3
+    np.testing.assert_array_equal(loaded["state/x"], arrays["state/x"])
+    assert loaded["state/x"].dtype == np.int32
+    assert loaded["ctx/y"].dtype == np.float32
+
+    # a re-save replaces the payload atomically and GCs the old one
+    save_artifact(path, arrays, sig, {"until": 4})
+    assert len(glob.glob(os.path.join(path, "payload-*.npz"))) == 1
+
+    # stale: a tampered signature component is refused BY NAME
+    with pytest.raises(CheckpointMismatchError, match="protocol"):
+        load_artifact(path, dict(sig, protocol="other"))
+
+    # corrupt: a truncated payload fails its recorded sha256
+    payload = glob.glob(os.path.join(path, "payload-*.npz"))[0]
+    blob = open(payload, "rb").read()
+    with open(payload, "wb") as fh:
+        fh.write(blob[: len(blob) // 2])
+    with pytest.raises(CheckpointCorruptError, match="truncated"):
+        load_artifact(path, sig)
+
+    # corrupt: an unreadable manifest
+    with open(os.path.join(path, "manifest.json"), "w") as fh:
+        fh.write("{not json")
+    with pytest.raises(CheckpointCorruptError, match="manifest"):
+        load_artifact(path, sig)
+
+
+# ----------------------------------------------------------------------
+# bit-exact interrupted-resume (default tier: the runners the suite
+# already compiles; full matrix below in the slow tier)
+# ----------------------------------------------------------------------
+
+
+def test_resume_bit_exact_basic(tmp_path):
+    dev, dims, specs = _specs("basic")
+    control = run_sweep(dev, dims, specs, segment_steps=SEG)
+    resumed = _interrupt_resume(dev, dims, specs, str(tmp_path / "ck"))
+    assert _blob(resumed) == _blob(control)
+
+
+def test_resume_bit_exact_both_shard_paths(tmp_path):
+    dev, dims, specs = _specs("basic", subsets=4)
+    for shard in (False, True):
+        control = run_sweep(
+            dev, dims, specs, segment_steps=SEG, shard_lanes=shard
+        )
+        resumed = _interrupt_resume(
+            dev, dims, specs, str(tmp_path / f"ck{shard}"),
+            shard_lanes=shard,
+        )
+        assert _blob(resumed) == _blob(control), f"shard_lanes={shard}"
+
+
+def test_stale_and_wrong_spec_checkpoints_refused(tmp_path):
+    dev, dims, specs = _specs("basic")
+    ck = str(tmp_path / "ck")
+    with pytest.raises(SweepInterrupted):
+        run_sweep(
+            dev, dims, specs, segment_steps=SEG,
+            checkpoint=CheckpointSpec(path=ck, stop_after_segments=1),
+        )
+
+    # resuming with DIFFERENT specs (conflict grid changed) must refuse
+    # on the lane-ctx comparison, not silently misload
+    _dev, _dims, other = _specs("basic", conflicts=(0, 50))
+    with pytest.raises(CheckpointMismatchError, match="ctx"):
+        run_sweep(
+            dev, dims, other, segment_steps=SEG,
+            checkpoint=CheckpointSpec(path=ck),
+        )
+
+    # a tampered signature (stale code/jax) is refused by name
+    mpath = os.path.join(ck, "manifest.json")
+    manifest = json.load(open(mpath))
+    manifest["signature"]["step_jaxpr_sha256"] = "0" * 64
+    with open(mpath, "w") as fh:
+        json.dump(manifest, fh)
+    with pytest.raises(CheckpointMismatchError, match="step_jaxpr"):
+        run_sweep(
+            dev, dims, specs, segment_steps=SEG,
+            checkpoint=CheckpointSpec(path=ck),
+        )
+
+
+# ----------------------------------------------------------------------
+# the tail-padding seam
+# ----------------------------------------------------------------------
+
+
+def test_padding_never_leaks_into_results_or_manifest(tmp_path):
+    import jax
+
+    assert len(jax.devices()) == 8, "conftest must force 8 CPU devices"
+    # 5 specs on the 8-device mesh: 3 padded duplicates are computed
+    dev, dims, specs = _specs("basic", conflicts=(100,), subsets=5)
+    assert len(specs) == 5
+    control = run_sweep(dev, dims, specs, segment_steps=SEG)
+    assert len(control) == 5
+    for lane_spec, res in zip(specs, control):
+        assert res.region_rows == lane_spec.region_rows
+        assert res.completed == COMMANDS * 3
+        assert not res.err
+
+    ck = str(tmp_path / "ck")
+    with pytest.raises(SweepInterrupted):
+        run_sweep(
+            dev, dims, specs, segment_steps=SEG,
+            checkpoint=CheckpointSpec(path=ck, stop_after_segments=1),
+        )
+    manifest = json.load(open(os.path.join(ck, "manifest.json")))
+    # the manifest accounts for exactly the caller's lanes; padded
+    # duplicates are an implementation detail of the payload
+    assert manifest["meta"]["lanes"] == 5
+    assert manifest["meta"]["padded"] == 3
+    assert len(manifest["meta"]["specs"]) == 5
+    resumed = run_sweep(
+        dev, dims, specs, segment_steps=SEG,
+        checkpoint=CheckpointSpec(path=ck),
+    )
+    assert len(resumed) == 5
+    assert _blob(resumed) == _blob(control)
+
+
+# ----------------------------------------------------------------------
+# the full matrix: every full protocol + both partial twins, on both
+# the single-device and shard_lanes=True paths (slow tier: compiles)
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("shard", [False, True])
+@pytest.mark.parametrize("name", DEV_PROTOCOLS)
+def test_resume_bit_exact_full_protocols(tmp_path, name, shard):
+    dev, dims, specs = _specs(name, subsets=2)
+    control = run_sweep(
+        dev, dims, specs, segment_steps=SEG, shard_lanes=shard
+    )
+    resumed = _interrupt_resume(
+        dev, dims, specs, str(tmp_path / "ck"), shard_lanes=shard
+    )
+    assert _blob(resumed) == _blob(control)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("shard", [False, True])
+@pytest.mark.parametrize("name", PARTIAL_DEV_PROTOCOLS)
+def test_resume_bit_exact_partial_twins(tmp_path, name, shard):
+    dev, dims, specs = _specs(name, conflicts=(50, 100), subsets=2,
+                              shards=2)
+    control = run_sweep(
+        dev, dims, specs, segment_steps=SEG, shard_lanes=shard
+    )
+    resumed = _interrupt_resume(
+        dev, dims, specs, str(tmp_path / "ck"), shard_lanes=shard
+    )
+    assert _blob(resumed) == _blob(control)
