@@ -77,6 +77,114 @@ FUZZ_MIN_BUDGET_S = float(
 # env so a host-mesh run still finishes inside the driver budget
 CKPT_LANES = int(_os.environ.get("FANTOCH_BENCH_CKPT_LANES", "512"))
 
+# traffic-schedule self-check shape (fantoch_tpu/traffic): lanes whose
+# epoch tables are timed host-side, and the small tempo sweep measured
+# flat vs diurnal (the diurnal trace is a separate compile, so the
+# delta isolates what the epoch gathers cost per point)
+TRAFFIC_TABLE_LANES = int(
+    _os.environ.get("FANTOCH_BENCH_TRAFFIC_LANES", "512")
+)
+TRAFFIC_SUBSETS = int(_os.environ.get("FANTOCH_BENCH_TRAFFIC_SUBSETS", "2"))
+
+# minimum remaining total budget for the traffic sweep self-check (a
+# cold diurnal-trace compile is minutes on a CPU mesh, like the fuzz
+# runner's)
+TRAFFIC_MIN_BUDGET_S = float(
+    _os.environ.get("FANTOCH_BENCH_TRAFFIC_MIN_BUDGET", "420")
+)
+
+
+def _region_subsets(planet, count: int):
+    """``count`` genuinely-distinct N-region subsets: stride through
+    C(regions, N) so they don't share a long lexicographic prefix —
+    the one enumeration both the main sweep and the traffic self-check
+    must agree on."""
+    regions = planet.regions()
+    combos = list(itertools.combinations(range(len(regions)), N))
+    stride = max(1, len(combos) // count)
+    return [
+        [regions[i] for i in combo] for combo in combos[::stride][:count]
+    ]
+
+
+def _traffic_table_build() -> "float | None":
+    """Host-side cost of compiling one diurnal schedule's epoch tables
+    per lane for a ``TRAFFIC_TABLE_LANES``-lane sweep — the table tax a
+    traffic campaign pays before any device work. Degrades to None
+    (never an exception) like the other auxiliary metrics."""
+    import sys
+
+    try:
+        from fantoch_tpu.traffic.schedule import resolve_traffic
+
+        t0 = time.perf_counter()
+        for i in range(TRAFFIC_TABLE_LANES):
+            sched = resolve_traffic(
+                "diurnal", conflict=(i * 13) % 101, pool_size=1,
+                commands=COMMANDS,
+            )
+            tables = sched.compile(COMMANDS)
+        assert tables["traffic_seq_epoch"].shape[0] == COMMANDS + 2
+        return time.perf_counter() - t0
+    except Exception as e:  # noqa: BLE001
+        print(f"bench: traffic table build unavailable: {e!r}",
+              file=sys.stderr)
+        return None
+
+
+def _traffic_sweep_delta() -> "tuple[float, float] | None":
+    """Measured flat-vs-diurnal sweep rate on a small tempo grid
+    (``TRAFFIC_SUBSETS`` × f × conflicts points, same shape both
+    sides): one warmup + one timed run per schedule, so the reported
+    delta is the per-point cost of the compiled epoch gathers + think
+    arithmetic, not compile time. Returns (flat_pps, diurnal_pps) or
+    None."""
+    import sys
+
+    try:
+        planet = Planet.new()
+        region_sets = _region_subsets(planet, TRAFFIC_SUBSETS)
+        clients = N * CLIENTS_PER_REGION
+        # churn-free presets keep the pool span at pool_size, so the
+        # default key capacity (and therefore dims) matches the flat
+        # side exactly — the measured delta is the schedule, not shapes
+        dev, base = _build("tempo", clients)
+        dims = EngineDims.for_protocol(
+            dev, n=N, clients=clients, payload=dev.payload_width(N),
+            dot_slots=64, regions=N, hist_buckets=2048,
+        )
+
+        def specs(traffic):
+            out = make_sweep_specs(
+                dev, planet, region_sets=region_sets, fs=FS,
+                conflicts=CONFLICTS, commands_per_client=COMMANDS,
+                clients_per_region=CLIENTS_PER_REGION, dims=dims,
+                config_base=base, traffic=traffic,
+            )
+            out.sort(
+                key=lambda s: (s.config.f, int(s.ctx["conflict_rate"]))
+            )
+            return out
+
+        rates = []
+        for traffic in (None, "diurnal"):
+            batch = specs(traffic)
+            run_sweep(dev, dims, batch)  # warmup/compile
+            t0 = time.perf_counter()
+            results = run_sweep(dev, dims, batch)
+            dt = time.perf_counter() - t0
+            bad = [r.err_cause for r in results if r.err]
+            assert not bad, f"traffic self-check failing lanes: {bad[:4]}"
+            rates.append(len(batch) / dt)
+        return rates[0], rates[1]
+    except Exception as e:  # noqa: BLE001
+        import traceback
+
+        traceback.print_exc()
+        print(f"bench: traffic sweep delta unavailable: {e!r}",
+              file=sys.stderr)
+        return None
+
 
 def _checkpoint_roundtrip() -> "float | None":
     """Save + restore + bit-exact compare of a ``CKPT_LANES``-lane
@@ -226,14 +334,7 @@ def main() -> None:
 
     print(f"bench: compile cache at {cache_dir}", file=_sys.stderr)
     planet = Planet.new()
-    regions = planet.regions()
-    # stride through C(20,5) so subsets are genuinely distinct (the
-    # first-256 lexicographic combinations share a long prefix)
-    combos = list(itertools.combinations(range(len(regions)), N))
-    stride = max(1, len(combos) // SUBSETS)
-    region_sets = [
-        [regions[i] for i in combo] for combo in combos[::stride][:SUBSETS]
-    ]
+    region_sets = _region_subsets(planet, SUBSETS)
     clients = N * CLIENTS_PER_REGION
 
     jobs = []  # (name, dev, dims, chunks)
@@ -339,6 +440,29 @@ def main() -> None:
                 flush=True,
             )
 
+    # traffic-schedule tax (fantoch_tpu/traffic): host-side epoch-table
+    # build time, plus the measured flat-vs-diurnal rate delta on a
+    # small tempo grid — both honest-zero when skipped/failed, like the
+    # fuzz self-check (the diurnal trace is its own compile, so the
+    # budget guard protects the already-measured sweep artifact)
+    table_s = _traffic_table_build()
+    traffic_rates, traffic_note = None, None
+    if TOTAL_BUDGET_S - _since_birth() < TRAFFIC_MIN_BUDGET_S:
+        traffic_note = "skipped: insufficient budget for the diurnal compile"
+        print(f"traffic self-check {traffic_note}", file=sys.stderr,
+              flush=True)
+    else:
+        traffic_rates = _traffic_sweep_delta()
+        if traffic_rates is None:
+            traffic_note = "failed (see stderr)"
+        else:
+            print(
+                f"traffic self-check: flat {traffic_rates[0]:.2f}/s vs "
+                f"diurnal {traffic_rates[1]:.2f}/s",
+                file=sys.stderr,
+                flush=True,
+            )
+
     # durability tax: one checkpointed segment's save+restore+compare
     # (device-state fetch excluded — measured on host arrays)
     ckpt_s = _checkpoint_roundtrip()
@@ -381,6 +505,21 @@ def main() -> None:
                     round(ckpt_s, 3) if ckpt_s is not None else 0.0
                 ),
                 "checkpoint_lanes": CKPT_LANES,
+                # epoch-table build time for TRAFFIC_TABLE_LANES lanes
+                # (0.0 = self-check unavailable, see stderr)
+                "traffic_table_build_s": (
+                    round(table_s, 3) if table_s is not None else 0.0
+                ),
+                "traffic_table_lanes": TRAFFIC_TABLE_LANES,
+                # measured flat vs diurnal rate on the small tempo grid
+                # (0.0 = skipped/failed; note carries the reason)
+                "sweep_points_per_sec_flat_small": (
+                    round(traffic_rates[0], 2) if traffic_rates else 0.0
+                ),
+                "sweep_points_per_sec_diurnal": (
+                    round(traffic_rates[1], 2) if traffic_rates else 0.0
+                ),
+                **({"traffic_note": traffic_note} if traffic_note else {}),
                 **(
                     {"static_kernel_cost": static_cost}
                     if static_cost
@@ -529,6 +668,14 @@ def _emit_unreachable(reason: str = "unreachable at startup") -> None:
                 # this last-ditch artifact records an honest zero
                 "checkpoint_roundtrip_s": 0.0,
                 "checkpoint_lanes": CKPT_LANES,
+                # table build is device-free and still measurable here
+                "traffic_table_build_s": (
+                    lambda s: round(s, 3) if s is not None else 0.0
+                )(_traffic_table_build()),
+                "traffic_table_lanes": TRAFFIC_TABLE_LANES,
+                "sweep_points_per_sec_flat_small": 0.0,
+                "sweep_points_per_sec_diurnal": 0.0,
+                "traffic_note": f"sweeps skipped: TPU backend {reason}",
                 **(
                     {"static_kernel_cost": static_cost}
                     if static_cost
@@ -550,6 +697,8 @@ _CPU_FALLBACK_ENV = {
     "FANTOCH_BENCH_CHUNK": "16",
     "FANTOCH_BENCH_FUZZ_SCHEDULES": "8",
     "FANTOCH_BENCH_CKPT_LANES": "64",
+    "FANTOCH_BENCH_TRAFFIC_LANES": "64",
+    "FANTOCH_BENCH_TRAFFIC_SUBSETS": "1",
 }
 
 # below this remaining total budget a CPU fallback run cannot plausibly

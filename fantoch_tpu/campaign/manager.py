@@ -68,8 +68,8 @@ class CampaignError(RuntimeError):
 
 @dataclass(frozen=True)
 class SweepCampaign:
-    """A (protocol × n × f × conflict × fault-plan × region-subset)
-    sweep grid, chunked into resumable batches."""
+    """A (protocol × n × traffic × f × conflict × fault-plan ×
+    region-subset) sweep grid, chunked into resumable batches."""
 
     protocols: Tuple[str, ...]
     ns: Tuple[int, ...] = (3,)
@@ -78,7 +78,16 @@ class SweepCampaign:
     # fault-plan JSON objects (engine/faults.py FaultPlan.from_json);
     # None/{} = fault-free. Every grid point runs once per entry.
     faults: Tuple[Optional[dict], ...] = (None,)
+    # traffic-schedule axis: named presets (registry.TRAFFIC_PRESETS),
+    # one batch group per entry — lanes with and without epoch tables
+    # trace different graphs so they never share a batch. "flat" is the
+    # static path (byte-identical to a traffic-less campaign).
+    traffic: Tuple[str, ...] = ("flat",)
     subsets: int = 1          # region subsets per n
+    # explicit region sets (e.g. bote frontier candidates,
+    # bote/validate.py); overrides the ns × subsets enumeration — each
+    # set's length is its n
+    region_sets: Optional[Tuple[Tuple[str, ...], ...]] = None
     commands_per_client: int = 5
     clients_per_region: int = 1
     pool_size: int = 1
@@ -178,6 +187,22 @@ def campaign_from_json(obj: dict):
         )
     if not spec.protocols:
         raise CampaignError("campaign needs at least one protocol")
+    if kind == "sweep":
+        from ..registry import TRAFFIC_PRESETS
+
+        bad_t = [t for t in spec.traffic if t not in TRAFFIC_PRESETS]
+        if bad_t:
+            raise CampaignError(
+                f"unknown traffic preset(s) {bad_t}; choose from "
+                f"{','.join(TRAFFIC_PRESETS)}"
+            )
+        if not spec.traffic:
+            raise CampaignError(
+                "the traffic axis needs at least one preset "
+                '(use ["flat"] for the static path)'
+            )
+        if spec.region_sets is not None and not spec.region_sets:
+            raise CampaignError("region_sets must not be empty when set")
     return spec
 
 
@@ -268,9 +293,39 @@ def _planet(aws: bool):
 # ----------------------------------------------------------------------
 
 
+def _sweep_groups(spec: SweepCampaign, planet):
+    """The (n → region sets) groups the grid enumerates: either the
+    default first-``subsets`` n-combinations per entry of ``ns``, or —
+    when ``region_sets`` pins explicit sets (bote/validate.py frontier
+    candidates) — the sets grouped by their length."""
+    if spec.region_sets is not None:
+        by_n: Dict[int, list] = {}
+        for rs in spec.region_sets:
+            by_n.setdefault(len(rs), []).append(list(rs))
+        return sorted(by_n.items())
+    all_regions = planet.regions()
+    return [
+        (
+            n,
+            [
+                [all_regions[i] for i in combo]
+                for combo in itertools.islice(
+                    itertools.combinations(range(len(all_regions)), n),
+                    spec.subsets,
+                )
+            ],
+        )
+        for n in spec.ns
+    ]
+
+
 def _sweep_batches(spec: SweepCampaign):
-    """Deterministic batch enumeration: one (protocol, n) group shares
-    a compiled runner; its grid chunks into ``batch_lanes`` units."""
+    """Deterministic batch enumeration: one (protocol, n, traffic)
+    group shares a compiled runner; its grid chunks into
+    ``batch_lanes`` units. Traffic presets get their own groups (and a
+    ``/t<name>`` batch-id segment) because schedule tables change the
+    traced graph — "flat" lanes keep the legacy ids, so pre-traffic
+    journals still resume."""
     from ..engine import EngineDims
     from ..engine.faults import FaultPlan
     from ..engine.protocols import dev_config_kwargs, dev_protocol
@@ -278,7 +333,6 @@ def _sweep_batches(spec: SweepCampaign):
     from ..parallel.sweep import make_sweep_specs
 
     planet = _planet(spec.aws)
-    all_regions = planet.regions()
     plans = [
         None if not entry else FaultPlan.from_json(entry)
         for entry in spec.faults
@@ -286,17 +340,26 @@ def _sweep_batches(spec: SweepCampaign):
     plans = [None if p is not None and p.is_noop() else p for p in plans]
     batches = []
     for proto in spec.protocols:
-        for n in spec.ns:
-            region_sets = [
-                [all_regions[i] for i in combo]
-                for combo in itertools.islice(
-                    itertools.combinations(range(len(all_regions)), n),
-                    spec.subsets,
-                )
-            ]
+        for n, region_sets in _sweep_groups(spec, planet):
             clients = n * spec.clients_per_region
             total = spec.commands_per_client * clients
-            dev = dev_protocol(proto, clients)
+            # key capacity must cover the widest preset's rotated pool
+            # (churn moves the shared pool across [0, pool_span)):
+            # private keys sit at pool_span + client. All of a
+            # (proto, n) group's traffic variants share one capacity so
+            # they share dims; flat-only grids get None and keep the
+            # legacy 1 + clients default, so pre-traffic campaign
+            # journals resume onto bit-identical lane shapes.
+            from ..traffic.schedule import traffic_key_capacity
+
+            keys = traffic_key_capacity(
+                spec.traffic,
+                conflict=spec.conflicts[0],
+                pool_size=spec.pool_size,
+                commands=spec.commands_per_client,
+                clients=clients,
+            )
+            dev = dev_protocol(proto, clients, keys=keys)
             dims = EngineDims.for_protocol(
                 dev,
                 n=n,
@@ -307,29 +370,32 @@ def _sweep_batches(spec: SweepCampaign):
                 regions=n,
             )
             base = Config(**dev_config_kwargs(proto, n, spec.fs[0]))
-            lanes = make_sweep_specs(
-                dev,
-                planet,
-                region_sets=region_sets,
-                fs=list(spec.fs),
-                conflicts=list(spec.conflicts),
-                commands_per_client=spec.commands_per_client,
-                clients_per_region=spec.clients_per_region,
-                dims=dims,
-                config_base=base,
-                extra_time_ms=spec.extra_time_ms,
-                pool_size=spec.pool_size,
-                faults=plans,
-            )
-            for j in range(0, len(lanes), spec.batch_lanes):
-                batches.append(
-                    (
-                        f"{proto}/n{n}/b{j // spec.batch_lanes}",
-                        dev,
-                        dims,
-                        lanes[j : j + spec.batch_lanes],
-                    )
+            for tname in spec.traffic:
+                lanes = make_sweep_specs(
+                    dev,
+                    planet,
+                    region_sets=region_sets,
+                    fs=list(spec.fs),
+                    conflicts=list(spec.conflicts),
+                    commands_per_client=spec.commands_per_client,
+                    clients_per_region=spec.clients_per_region,
+                    dims=dims,
+                    config_base=base,
+                    extra_time_ms=spec.extra_time_ms,
+                    pool_size=spec.pool_size,
+                    faults=plans,
+                    traffic=tname,
                 )
+                tseg = "" if tname == "flat" else f"/t{tname}"
+                for j in range(0, len(lanes), spec.batch_lanes):
+                    batches.append(
+                        (
+                            f"{proto}/n{n}{tseg}/b{j // spec.batch_lanes}",
+                            dev,
+                            dims,
+                            lanes[j : j + spec.batch_lanes],
+                        )
+                    )
     return batches
 
 

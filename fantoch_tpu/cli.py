@@ -34,8 +34,10 @@ ORACLE_PROTOCOLS = ENGINE_PROTOCOLS + ("tempo_atomic",)
 # subcommands that run device computations; everything else is
 # host-only and gets the CPU backend outright so a dead device
 # backend can never hang it ("mc" only fans out on device when
-# fuzzing — artifact replay is host-only and handled in main())
-DEVICE_COMMANDS = ("sweep", "mc", "campaign")
+# fuzzing — artifact replay is host-only and handled in main(); plain
+# "bote" is the closed-form search, but "bote --validate" runs
+# measured device campaigns and is routed as bote-validate)
+DEVICE_COMMANDS = ("sweep", "mc", "campaign", "bote-validate")
 
 # cli.py campaign exit code when a campaign stops with work remaining
 # (budget/signal/segment-limit): state is durably checkpointed, re-run
@@ -111,11 +113,11 @@ def _build_config(name: str, n: int, f: int, args) -> Config:
     return Config(**kw)
 
 
-def _engine_protocol(name: str, clients: int):
+def _engine_protocol(name: str, clients: int, keys: "int | None" = None):
     from .engine.protocols import dev_protocol
 
     try:
-        return dev_protocol(name, clients)
+        return dev_protocol(name, clients, keys=keys)
     except ValueError as e:
         raise SystemExit(str(e))
 
@@ -228,6 +230,32 @@ def cmd_sweep(args) -> None:
         if args.shards > 1:
             raise SystemExit("--faults is single-shard for now")
 
+    traffic = args.traffic if args.traffic not in (None, "flat") else None
+    traffic_keys = None
+    if traffic is not None:
+        from .registry import TRAFFIC_PRESETS
+        from .traffic.schedule import traffic_key_capacity
+
+        if traffic not in TRAFFIC_PRESETS:
+            raise SystemExit(
+                f"unknown traffic preset {traffic!r}; choose from "
+                f"{','.join(TRAFFIC_PRESETS)}"
+            )
+        if args.shards > 1:
+            raise SystemExit("--traffic is single-shard for now")
+        if args.zipf:
+            raise SystemExit(
+                "--traffic drives the ConflictPool generator; drop "
+                "--zipf"
+            )
+        traffic_keys = traffic_key_capacity(
+            [traffic],
+            conflict=args.conflict if args.conflict is not None else 100,
+            pool_size=args.pool_size,
+            commands=args.commands,
+            clients=args.n * args.clients_per_region,
+        )
+
     planet = _planet(args)
     all_regions = planet.regions()
     if args.regions:
@@ -259,7 +287,7 @@ def cmd_sweep(args) -> None:
             dev, args.n, clients, total, dot_slots=args.dot_slots
         )
     else:
-        dev = _engine_protocol(args.protocol, clients)
+        dev = _engine_protocol(args.protocol, clients, keys=traffic_keys)
         dims = EngineDims.for_protocol(
             dev,
             n=args.n,
@@ -300,6 +328,7 @@ def cmd_sweep(args) -> None:
         ),
         pool_size=args.pool_size,
         faults=fault_plans,
+        traffic=traffic,
     )
     results = run_sweep(
         dev, dims, specs, shard_lanes=True if args.shard_lanes else None
@@ -307,6 +336,7 @@ def cmd_sweep(args) -> None:
     errs = sum(1 for r in results if r.err)
     summary = {
         "protocol": args.protocol,
+        "traffic": traffic or "flat",
         "points": len(specs),
         "errors": errs,
         "error_causes": sorted(
@@ -337,6 +367,8 @@ def cmd_sweep(args) -> None:
             }
             if spec.fault_meta is not None:
                 attrs["faults"] = spec.fault_meta
+            if spec.traffic_meta is not None:
+                attrs["traffic"] = spec.traffic_meta
             rows.append((attrs, res))
         save_results(args.out, rows)
         summary["out"] = args.out
@@ -630,6 +662,8 @@ def cmd_lint(args) -> None:
 def cmd_bote(args) -> None:
     from .bote.search import RankingParams, Search
 
+    if args.validate:
+        return cmd_bote_validate(args)
     search = Search(planet=_planet(args))
     params = RankingParams(
         min_mean_fpaxos_improv=args.min_mean_improv,
@@ -646,6 +680,86 @@ def cmd_bote(args) -> None:
             for c in configs[: args.top]
         ]
     print(json.dumps(out, indent=2))
+
+
+def cmd_bote_validate(args) -> None:
+    """Measured validation of the closed-form frontier
+    (bote/validate.py): top-K ranked candidates at --n each get a
+    device sweep campaign (protocols × f × conflict × traffic) over
+    their region sub-matrix, resumable across SIGKILL via the campaign
+    manager; once complete, a frontier artifact compares closed-form
+    vs measured p50/p99 per candidate. --dryrun emits the artifact
+    with measured: null (the CI schema-check path)."""
+    from .bote.search import RankingParams
+    from .bote.validate import frontier_candidates, validate_frontier
+    from .campaign import CampaignError
+    from .engine.checkpoint import CheckpointError
+
+    protocols = args.protocols.split(",")
+    unknown = [p for p in protocols if p not in ENGINE_PROTOCOLS]
+    if unknown:
+        raise SystemExit(
+            f"unknown protocol(s) {unknown}; choose from "
+            f"{','.join(ENGINE_PROTOCOLS)}"
+        )
+    from .registry import TRAFFIC_PRESETS
+
+    traffic = args.traffic.split(",")
+    bad = [t for t in traffic if t not in TRAFFIC_PRESETS]
+    if bad:
+        raise SystemExit(
+            f"unknown traffic preset(s) {bad}; choose from "
+            f"{','.join(TRAFFIC_PRESETS)}"
+        )
+    planet = _planet(args)
+    params = RankingParams(
+        min_mean_fpaxos_improv=args.min_mean_improv,
+        min_fairness_fpaxos_improv=args.min_fairness_improv,
+        min_n=args.n,
+        max_n=args.n,
+        ft_metric=args.metric,
+    )
+    try:
+        candidates = frontier_candidates(
+            planet, args.n, args.top, params=params
+        )
+    except ValueError as e:
+        raise SystemExit(str(e))
+    try:
+        artifact, summary = validate_frontier(
+            args.dir,
+            planet=planet,
+            candidates=candidates,
+            protocols=protocols,
+            fs=args.fs or [1],
+            conflicts=args.conflicts,
+            traffic=traffic,
+            commands=args.commands,
+            clients_per_region=args.clients_per_region,
+            pool_size=args.pool_size,
+            batch_lanes=args.batch_lanes,
+            segment_steps=args.segment_steps,
+            aws=bool(args.aws),
+            resume=args.resume,
+            budget_s=args.budget_s,
+            dryrun=args.dryrun,
+            out=args.out,
+        )
+    except (CheckpointError, CampaignError) as e:
+        print(
+            f"bote validate refused: {type(e).__name__}: {e}",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    print(json.dumps(summary))
+    if artifact is None:
+        print(
+            f"validation interrupted ({summary['interrupted']}); the "
+            "campaign is checkpointed — re-run with --resume to "
+            "continue",
+            file=sys.stderr,
+        )
+        raise SystemExit(EXIT_INTERRUPTED)
 
 
 def cmd_plot(args) -> None:
@@ -926,6 +1040,14 @@ def main(argv=None) -> None:
         '"horizon": 5000}]\' (lossy plans need a horizon)',
     )
     sw.add_argument(
+        "--traffic",
+        default=None,
+        help="time-varying traffic preset applied to every sweep "
+        "point (flat,diurnal,flash,churn — docs/TRAFFIC.md); presets "
+        "compose with each point's conflict rate; flat/omitted = the "
+        "static workload",
+    )
+    sw.add_argument(
         "--shard-lanes",
         action="store_true",
         help="prove the step lane-independent (GL203 taint, a few "
@@ -1050,7 +1172,12 @@ def main(argv=None) -> None:
                     help="include full finding detail in the output")
     ln.set_defaults(fn=cmd_lint)
 
-    bt = sub.add_parser("bote", help="closed-form latency config search")
+    bt = sub.add_parser(
+        "bote",
+        help="closed-form latency config search; --validate runs "
+        "measured device sweeps over the top candidates and emits a "
+        "closed-form-vs-measured frontier artifact (bote/validate.py)",
+    )
     bt.add_argument("--metric", default="f1", choices=["f1", "f1f2"])
     bt.add_argument("--min-mean-improv", type=float, default=0.0)
     bt.add_argument("--min-fairness-improv", type=float, default=0.0)
@@ -1058,6 +1185,38 @@ def main(argv=None) -> None:
     bt.add_argument("--max-n", type=int, default=7)
     bt.add_argument("--top", type=int, default=3)
     bt.add_argument("--aws", action="store_true")
+    bt.add_argument("--validate", action="store_true",
+                    help="validate the top candidates with measured "
+                    "device sweep campaigns (resumable; exits 75 when "
+                    "interrupted — re-run with --resume)")
+    bt.add_argument("--dir", default=None,
+                    help="campaign/artifact directory (required with "
+                    "--validate)")
+    bt.add_argument("--n", type=int, default=5,
+                    help="candidate region-set size to validate")
+    bt.add_argument("--protocols", default="atlas,fpaxos",
+                    help="device protocols for the measured sweeps")
+    bt.add_argument("--fs", type=_ints, default=None)
+    bt.add_argument("--conflicts", type=_ints, default=[0, 100])
+    bt.add_argument("--traffic", default="flat",
+                    help="comma-separated traffic presets "
+                    "(flat,diurnal,flash,churn) — one measured axis "
+                    "per preset")
+    bt.add_argument("--commands", type=int, default=20,
+                    help="commands per client per measured lane")
+    bt.add_argument("--clients-per-region", type=int, default=1)
+    bt.add_argument("--pool-size", type=int, default=1)
+    bt.add_argument("--batch-lanes", type=int, default=64)
+    bt.add_argument("--segment-steps", type=int, default=2048)
+    bt.add_argument("--resume", action="store_true",
+                    help="continue an interrupted validation campaign")
+    bt.add_argument("--budget-s", type=float, default=None)
+    bt.add_argument("--dryrun", action="store_true",
+                    help="skip the device sweeps; emit the frontier "
+                    "artifact with measured: null (schema-check path)")
+    bt.add_argument("--out", default=None,
+                    help="frontier artifact path (default "
+                    "<dir>/frontier.json)")
     bt.set_defaults(fn=cmd_bote)
 
     pr = sub.add_parser(
@@ -1146,6 +1305,13 @@ def main(argv=None) -> None:
         if args.cmd == "mc" and getattr(args, "replay", None)
         else args.cmd
     )
+    if cmd == "bote" and getattr(args, "validate", False):
+        if not args.dir:
+            raise SystemExit("bote --validate needs --dir")
+        # measured validation fans out device sweeps; a dryrun only
+        # emits the artifact and stays host-only
+        if not args.dryrun:
+            cmd = "bote-validate"
     _apply_platform(args.platform, cmd)
     args.fn(args)
 

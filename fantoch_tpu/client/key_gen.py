@@ -54,16 +54,33 @@ class DeviceStream:
     round-1 diff tests were pinned to conflict ∈ {0, 100} because the
     two sides drew from different PRNGs. Keys are the device's integer
     keys stringified: pool keys ``0..pool_size-1`` (or Zipf ranks),
-    private key ``pool_size + client_index``."""
+    private key ``pool_size + client_index``.
+
+    ``traffic`` attaches a time-varying schedule
+    (:class:`fantoch_tpu.traffic.TrafficSchedule`): the stream ctx then
+    carries the schedule's compiled epoch tables, so the host replays
+    the *identical* epoch-indexed ConflictPool draws (conflict rate,
+    pool size, hot-key pool rotation) the device lane makes — keys
+    rotate on the exact command seq, private keys move up to
+    ``pool_span + client``. The per-command read flag is also drawn
+    counter-based (``fold_in(k, 3)``) from the epoch's ``read_pct``;
+    the device engine carries no GET/PUT distinction, so the flag only
+    shapes the mirrored workload's ops (docs/TRAFFIC.md)."""
 
     conflict_rate: int = 100
     pool_size: int = 1
     zipf: Optional[tuple] = None  # (coefficient, total_keys)
     seed: int = 0
+    traffic: Optional[object] = None  # TrafficSchedule (hashable)
 
     def __str__(self) -> str:
         if self.zipf:
             return f"devstream_zipf_{self.zipf[0]:.2f}_{self.zipf[1]}"
+        if self.traffic is not None:
+            return (
+                f"devstream_traffic_{self.traffic.name}_"
+                f"{self.conflict_rate}_{self.pool_size}"
+            )
         return f"devstream_{self.conflict_rate}_{self.pool_size}"
 
 
@@ -96,6 +113,7 @@ class KeyGenState:
         else:
             self._zipf_cum = None
         self._stream: list = []  # DeviceStream key cache
+        self._reads: list = []   # per-seq read flags (traffic mirror)
 
     def gen_cmd_key(self) -> Key:
         kg = self.key_gen
@@ -114,7 +132,11 @@ class KeyGenState:
         """Next key of the device's (client, seq)-counter stream; seqs
         are 1-based like the engine's SUBMIT payloads. Computed in
         batches (one vmapped call per _BATCH keys); the keygen ctx is a
-        pure function of the frozen generator, built once."""
+        pure function of the frozen generator — with a traffic
+        schedule, its epoch tables are (re)compiled to cover the
+        batch's seq range (table entries equal the schedule's unbounded
+        seq → epoch function, so every table length agrees with the
+        device lane's on all seqs within the command budget)."""
         self._cmds_issued = getattr(self, "_cmds_issued", 0) + 1
         while len(self._stream) < self._cmds_issued:
             import jax
@@ -123,8 +145,13 @@ class KeyGenState:
 
             from ..engine.core import gen_key
 
+            lo = len(self._stream) + 1
+            need = lo + self._BATCH + 1
             ctx = getattr(self, "_stream_ctx", None)
-            if ctx is None:
+            if ctx is None or (
+                kg.traffic is not None
+                and ctx["traffic_seq_epoch"].shape[0] < need
+            ):
                 if kg.zipf is None:
                     ctx = {
                         "key_gen_kind": jnp.int32(0),
@@ -146,15 +173,47 @@ class KeyGenState:
                     conflict_rate=jnp.int32(kg.conflict_rate),
                     pool_size=jnp.int32(kg.pool_size),
                 )
+                if kg.traffic is not None:
+                    ctx.update(
+                        {
+                            k: jnp.asarray(v)
+                            for k, v in kg.traffic.compile(need).items()
+                        }
+                    )
                 self._stream_ctx = ctx
-            lo = len(self._stream) + 1
             seqs = jnp.arange(lo, lo + self._BATCH, dtype=jnp.int32)
             client_index = self.client_id - 1
             batch = np.asarray(
                 jax.vmap(lambda s: gen_key(ctx, client_index, s))(seqs)
             )
             self._stream.extend(int(k) for k in batch)
+            if kg.traffic is not None:
+                # the schedule's read mix, drawn from the same counter
+                # stream (fold 3; gen_key uses folds 0..2) so which
+                # commands are reads is a pure function of
+                # (seed, client, seq) on both sides
+                def read_one(s):
+                    k = jr.fold_in(
+                        jr.fold_in(ctx["rng_key"], client_index), s
+                    )
+                    tbl = ctx["traffic_seq_epoch"]
+                    e = tbl[jnp.minimum(s, tbl.shape[0] - 1)]
+                    pct = ctx["traffic_read_pct"][e]
+                    return jr.randint(jr.fold_in(k, 3), (), 0, 100) < pct
+
+                reads = np.asarray(jax.vmap(read_one)(seqs))
+                self._reads.extend(bool(x) for x in reads)
         return str(self._stream[self._cmds_issued - 1])
+
+    def traffic_read_only(self) -> Optional[bool]:
+        """The schedule-driven read flag of the most recently drawn
+        key's command (None without a traffic DeviceStream — the
+        workload then falls back to its own ``read_only_percentage``
+        draw). Counter-based, so it never consumes host RNG state."""
+        kg = self.key_gen
+        if not (isinstance(kg, DeviceStream) and kg.traffic is not None):
+            return None
+        return bool(self._reads[self._cmds_issued - 1])
 
 
 def true_if_random_is_less_than(

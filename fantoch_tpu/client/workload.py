@@ -72,9 +72,15 @@ class Workload:
         """workload.rs:142-186."""
         rifl = rifl_gen.next_id()
         keys = self._gen_unique_keys(key_gen_state)
-        read_only = true_if_random_is_less_than(
-            self.read_only_percentage, key_gen_state.rng
-        )
+        # a traffic-scheduled DeviceStream drives the read mix from its
+        # per-epoch read_pct via the counter-based stream (bit-exact
+        # with the schedule spec); otherwise the workload's own
+        # read_only_percentage draw applies (workload.rs:148-150)
+        read_only = key_gen_state.traffic_read_only()
+        if read_only is None:
+            read_only = true_if_random_is_less_than(
+                self.read_only_percentage, key_gen_state.rng
+            )
         shard_to_ops: Dict[ShardId, Dict[Key, list]] = {}
         target_shard: Optional[ShardId] = None
         for key in keys:

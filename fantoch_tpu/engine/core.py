@@ -331,11 +331,41 @@ def gen_key(ctx, client, cmd_seq):
     (encoded as pool_size + client). Zipf (key_gen.rs:62-77,113-119):
     inverse-CDF sampling over the precomputed weight table in
     ``ctx["zipf_cum"]``. ``ctx["key_gen_kind"]`` selects (0 = pool,
-    1 = zipf)."""
+    1 = zipf).
+
+    Time-varying traffic (fantoch_tpu/traffic, docs/TRAFFIC.md) is
+    structure-gated like ``key_table``/``cmd_target``: when the lane
+    carries compiled schedule tables, the ConflictPool parameters come
+    from the command's epoch (seq → epoch index, then per-epoch knob
+    gathers) and the shared pool rotates with ``pool_base`` — hot-key
+    churn with the boundary on the exact command seq. A schedule-less
+    (or flat) lane takes the branchless static path below and traces
+    the bit-identical jaxpr."""
     k = jr.fold_in(jr.fold_in(ctx["rng_key"], client), cmd_seq)
-    conflict = jr.randint(k, (), 0, 100) < ctx["conflict_rate"]
-    pool_key = jr.randint(jr.fold_in(k, 1), (), 0, jnp.maximum(ctx["pool_size"], 1))
-    pool = jnp.where(conflict, pool_key, ctx["pool_size"] + client)
+    if "traffic_seq_epoch" in ctx:
+        tbl = ctx["traffic_seq_epoch"]
+        e = oh_take(
+            tbl,
+            jnp.minimum(jnp.asarray(cmd_seq, I32), tbl.shape[0] - 1),
+        )
+        conflict = (
+            jr.randint(k, (), 0, 100) < oh_take(ctx["traffic_conflict"], e)
+        )
+        pool_key = oh_take(ctx["traffic_pool_base"], e) + jr.randint(
+            jr.fold_in(k, 1), (), 0,
+            jnp.maximum(oh_take(ctx["traffic_pool_size"], e), 1),
+        )
+        # private keys sit above EVERY epoch's pool so churn rotation
+        # can never alias a client's private key
+        pool = jnp.where(
+            conflict, pool_key, ctx["traffic_pool_span"] + client
+        )
+    else:
+        conflict = jr.randint(k, (), 0, 100) < ctx["conflict_rate"]
+        pool_key = jr.randint(
+            jr.fold_in(k, 1), (), 0, jnp.maximum(ctx["pool_size"], 1)
+        )
+        pool = jnp.where(conflict, pool_key, ctx["pool_size"] + client)
     u = jr.uniform(jr.fold_in(k, 2), ())
     # clamp: float32 rounding can leave cum[-1] < 1.0, and a draw at or
     # above it would index one past the table
@@ -357,6 +387,29 @@ KEYGEN_CTX_FIELDS = (
     "key_gen_kind",
     "zipf_cum",
 )
+
+# traffic-schedule tables (fantoch_tpu/traffic; present only on lanes
+# with a non-flat schedule — structure-gating keeps static traces
+# bit-identical). traffic_think/traffic_read_pct ride in ctx for the
+# step/mirror but do not feed gen_key.
+TRAFFIC_CTX_FIELDS = (
+    "traffic_seq_epoch",
+    "traffic_conflict",
+    "traffic_pool_base",
+    "traffic_pool_size",
+    "traffic_pool_span",
+)
+
+
+def keygen_ctx_fields(ctx) -> tuple:
+    """The ctx keys :func:`gen_key` reads for this lane's structure —
+    the base generator fields plus, when the lane carries a traffic
+    schedule, its epoch tables. Every caller that slices a keygen ctx
+    (key tables, lane-state init, the host DeviceStream mirror) must
+    use this so schedule-driven keys stay bit-identical everywhere."""
+    if "traffic_seq_epoch" in ctx:
+        return KEYGEN_CTX_FIELDS + TRAFFIC_CTX_FIELDS
+    return KEYGEN_CTX_FIELDS
 
 
 def first_keys_fn(C: int):
@@ -434,14 +487,23 @@ def init_lane_state(
     # device uses for subsequent commands
     if first_keys is None:
         keyctx = {
-            k: jnp.asarray(ctx_np[k]) for k in KEYGEN_CTX_FIELDS
+            k: jnp.asarray(ctx_np[k]) for k in keygen_ctx_fields(ctx_np)
         }
         first_keys = np.asarray(first_keys_fn(C)(keyctx))
+    # time-varying traffic: the first SUBMIT leaves after the first
+    # command's epoch think delay (the oracle schedules start_clients
+    # submits with the same extra distance)
+    if "traffic_think" in ctx_np:
+        think0 = int(
+            ctx_np["traffic_think"][int(ctx_np["traffic_seq_epoch"][1])]
+        )
+    else:
+        think0 = 0
     slot = 0
     for c in range(C):
         if not live[c]:
             continue
-        pool[slot, PA] = ctx_np["client_delay"][c, attach[c]]
+        pool[slot, PA] = ctx_np["client_delay"][c, attach[c]] + think0
         # each client's first SUBMIT is emission #1 on its channel
         pool[slot, PKS] = N + c
         pool[slot, PKC] = 1
@@ -844,8 +906,17 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False,
     src = jnp.where(is_client, N + c, emitter)
     src = jnp.where(out["src"] >= 0, out["src"], src)
     # the next SUBMIT leaves at the command's completion time (the
-    # latest part's arrival, == t_arr for single-part commands)
-    base = jnp.where(issue, done_t[c], ep_e)
+    # latest part's arrival, == t_arr for single-part commands); a
+    # traffic schedule adds the issued command's epoch think delay —
+    # diurnal load — which the oracle mirrors as extra submit distance
+    # (structure-gated: schedule-less lanes trace the exact line below)
+    if "traffic_think" in ctx:
+        tbl = ctx["traffic_seq_epoch"]
+        e_next = oh_take(tbl, jnp.minimum(next_seq, tbl.shape[0] - 1))
+        think = oh_take(ctx["traffic_think"], e_next)
+        base = jnp.where(issue, done_t[c] + think, ep_e)
+    else:
+        base = jnp.where(issue, done_t[c], ep_e)
     overridden = out["delay"] >= 0  # requeues: fixed delay, never scaled
     delay = jnp.where(
         issue,

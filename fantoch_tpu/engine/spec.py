@@ -44,6 +44,9 @@ class LaneSpec:
     # NO_FAULTS / None for fault-free lanes
     fault_flags: FaultFlags = NO_FAULTS
     fault_meta: "dict | None" = None
+    # traffic-schedule metadata (fantoch_tpu/traffic); None for static
+    # lanes AND for flat schedules (which collapse to the static path)
+    traffic_meta: "dict | None" = None
 
 
 def _sorted_indices(planet: Planet, process_regions: Sequence[str]) -> np.ndarray:
@@ -75,6 +78,7 @@ def make_lane(
     seed: int = 0,
     reorder: bool = False,
     faults: "FaultPlan | None" = None,
+    traffic=None,
 ) -> LaneSpec:
     """``zipf=(coefficient, total_keys)`` switches the workload from the
     ConflictPool generator to Zipf sampling over ``total_keys`` keys
@@ -99,13 +103,50 @@ def make_lane(
     crash-stop processes, link-degradation/partition windows, and
     probabilistic drops. Lanes with and without plans can share one
     batch; the runner must be built with the batch's fault-flag union
-    (``run_lanes``/``run_sweep`` derive it automatically)."""
+    (``run_lanes``/``run_sweep`` derive it automatically).
+
+    ``traffic`` attaches a time-varying traffic schedule
+    (fantoch_tpu/traffic, docs/TRAFFIC.md): a
+    :class:`~fantoch_tpu.traffic.TrafficSchedule`, a preset name from
+    ``registry.TRAFFIC_PRESETS`` (resolved against this lane's
+    ``conflict_rate``/``pool_size``/``commands_per_client``), a JSON
+    schedule dict, or None. A **flat** schedule collapses to the static
+    ctx path right here — same ctx fields, bit-identical traced jaxpr,
+    byte-identical ``LaneResults`` — so the seed-warmed XLA cache and
+    the GL005 gating pin survive; only non-flat schedules add the
+    ``traffic_*`` epoch tables (structure-gated in engine/core.py).
+    Lanes of one batch must agree on having (or not having) tables —
+    ``stack_lanes`` refuses a mix."""
     n = config.n
     S = config.shard_count
     assert len(process_regions) == n
     assert S * n <= dims.N
     N, C = dims.N, dims.C
     total = S * n  # live process rows; row = shard * n + region index
+
+    from ..traffic.schedule import resolve_traffic
+
+    traffic = resolve_traffic(
+        traffic, conflict=conflict_rate, pool_size=pool_size,
+        commands=commands_per_client,
+    )
+    if traffic is not None and traffic.is_flat():
+        # flat collapse: the schedule IS the static path; its single
+        # effective phase becomes the lane's scalar knobs and no tables
+        # are emitted, so the step traces the bit-identical jaxpr
+        phase0 = traffic.phases[0]
+        conflict_rate, pool_size = phase0.conflict_rate, phase0.pool_size
+        traffic = None
+    traffic_meta = None
+    if traffic is not None:
+        assert zipf is None, (
+            "traffic schedules drive the ConflictPool generator; Zipf "
+            "lanes take the static path"
+        )
+        assert S == 1 and getattr(protocol, "KPC", 1) == 1, (
+            "traffic schedules are single-shard/single-key for now"
+        )
+        traffic_meta = traffic.meta()
 
     if faults is not None and faults.is_noop():
         faults = None
@@ -263,6 +304,18 @@ def make_lane(
         "periodic_intervals": intervals,
         "extra_time": np.int32(extra_time_ms),
     }
+    if traffic is not None:
+        # rotated pools must fit the protocol's key capacity: private
+        # keys sit at pool_span + client, so the top key of this lane
+        # is pool_span + (live clients - 1)
+        key_cap = getattr(protocol, "K", None)
+        span = traffic.pool_span()
+        assert key_cap is None or span + c <= key_cap, (
+            f"traffic schedule {traffic.name!r} needs keys up to "
+            f"{span + c - 1} but protocol key capacity is {key_cap}; "
+            "out-of-range keys would be silently dropped"
+        )
+        ctx.update(traffic.compile(commands_per_client))
     ctx.update(fault_ctx(faults, dims))
     ctx["fault_unavail"] = np.int32(1 if unavail else 0)
     if S > 1 or getattr(protocol, "KPC", 1) > 1:
@@ -287,6 +340,7 @@ def make_lane(
             if faults is not None
             else None
         ),
+        traffic_meta=traffic_meta,
     )
 
 
@@ -396,6 +450,18 @@ def _partial_tables(
 
 
 def stack_lanes(specs: Sequence[LaneSpec]) -> Dict[str, np.ndarray]:
-    """Stack per-lane ctx dicts into one batched ctx (leading lane axis)."""
+    """Stack per-lane ctx dicts into one batched ctx (leading lane axis).
+
+    Every lane must carry the same ctx fields: a batch compiles ONE
+    step function, and structure-gated extensions (traffic tables, the
+    partial-replication cmd tables) change the traced graph — mixing
+    them would silently stack mismatched trees, so refuse loudly."""
     keys = specs[0].ctx.keys()
+    for i, s in enumerate(specs[1:], start=1):
+        assert s.ctx.keys() == keys, (
+            f"lane {i} ctx fields differ from lane 0 "
+            f"({sorted(set(s.ctx) ^ set(keys))}); lanes with and "
+            "without traffic tables (or other structure-gated ctx) "
+            "cannot share a batch"
+        )
     return {k: np.stack([s.ctx[k] for s in specs]) for k in keys}

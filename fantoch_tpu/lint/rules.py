@@ -53,6 +53,8 @@ DEFAULT_PATHS = (
     "fantoch_tpu/engine/checkpoint.py",
     "fantoch_tpu/engine/protocols",
     "fantoch_tpu/campaign",
+    "fantoch_tpu/traffic",
+    "fantoch_tpu/bote/validate.py",
 )
 
 OUTBOX_KEYS = {"valid", "dst", "mtype", "payload"}

@@ -37,12 +37,12 @@ from ..engine.checkpoint import (
     step_signature,
 )
 from ..engine.core import (
-    KEYGEN_CTX_FIELDS,
     build_runner,
     build_segment_runner,
     finish_segmented,
     init_lane_state,
     key_table_fn,
+    keygen_ctx_fields,
 )
 from ..engine.driver import batch_reorder_flag
 from ..engine.faults import FaultPlan, batch_fault_flags
@@ -64,10 +64,19 @@ def make_sweep_specs(
     zipf=None,
     pool_size: int = 1,
     faults: "Sequence[FaultPlan | None] | None" = None,
+    traffic=None,
 ) -> List[LaneSpec]:
     """The sweep grid: one lane per (region set, f, conflict) point —
     replicated once per entry of ``faults`` (None = fault-free), so a
-    single compiled sweep mixes fault-free and faulty lanes."""
+    single compiled sweep mixes fault-free and faulty lanes.
+
+    ``traffic`` applies one time-varying schedule to every point: a
+    preset name (``registry.TRAFFIC_PRESETS``) resolved against each
+    point's own conflict rate — so the conflict axis composes with the
+    schedule instead of being overridden — a
+    :class:`~fantoch_tpu.traffic.TrafficSchedule`, or None/"flat" for
+    the static path. One sweep = one schedule; a traffic *axis* is the
+    campaign grid's job (campaign/manager.py)."""
     base = config_base or Config(n=len(region_sets[0]), f=1,
                                  gc_interval_ms=100)
     plans: Sequence["FaultPlan | None"] = faults or [None]
@@ -92,6 +101,7 @@ def make_sweep_specs(
                 extra_time_ms=extra_time_ms,
                 seed=i // len(plans),  # same workload across a point's plans
                 faults=plan,
+                traffic=traffic,
             )
         )
     return specs
@@ -249,7 +259,7 @@ def run_sweep(
     # materialize a lanes × clients × budget table, so past the cap the
     # engine falls back to in-loop gen_key (bit-identical keys).
     T_keys = int(max(2, ctx["cmd_budget"].max() + 2))
-    kctx = {k: ctx[k] for k in KEYGEN_CTX_FIELDS}
+    kctx = {k: ctx[k] for k in keygen_ctx_fields(ctx)}
     if len(padded) * dims.C * T_keys <= KEY_TABLE_LIMIT:
         key_table = np.asarray(_cached_key_table(dims.C, T_keys)(kctx))
         ctx["key_table"] = key_table
@@ -309,6 +319,16 @@ def run_sweep(
             "max_steps": int(max_steps),
             "segment_steps": int(segment_steps),
             "monitor_keys": int(monitor_keys),
+            # schedule names, so a resume onto a different traffic
+            # schedule is refused BY NAME (the epoch tables are also
+            # covered twice over: the step signature hashes the jaxpr
+            # traced over them and the loader bit-compares the ctx)
+            "traffic": sorted(
+                {
+                    (s.traffic_meta or {"name": "flat"})["name"]
+                    for s in specs
+                }
+            ),
             "specs": [
                 {
                     "n": s.config.n,
@@ -316,22 +336,30 @@ def run_sweep(
                     "conflict": int(s.ctx["conflict_rate"]),
                     "regions": list(s.process_regions),
                     "faults": s.fault_meta,
+                    "traffic": s.traffic_meta,
                 }
                 for s in specs
             ],
         }
+        expect_keys = [
+            "lanes", "padded", "max_steps", "segment_steps",
+            "monitor_keys",
+        ]
+        if ckpt_meta["traffic"] != ["flat"]:
+            # by-name schedule check only when this batch actually runs
+            # a schedule: pre-traffic checkpoints have no `traffic` meta
+            # key, and a flat batch is bit-compatible with them (same
+            # signature, same ctx), so demanding the key would refuse a
+            # perfectly resumable legacy checkpoint. Flat-vs-scheduled
+            # mismatches are still refused — by the jaxpr signature and
+            # the ctx field/bit compare.
+            expect_keys.append("traffic")
         if ck.resume and checkpoint_exists(ck.path):
             # a stale/corrupted artifact raises here — refusal, not a
             # silent from-scratch rerun
             state, loaded_meta = load_sweep_checkpoint(
                 ck.path, signature=sig, ctx=ctx_host,
-                meta_expect={
-                    k: ckpt_meta[k]
-                    for k in (
-                        "lanes", "padded", "max_steps", "segment_steps",
-                        "monitor_keys",
-                    )
-                },
+                meta_expect={k: ckpt_meta[k] for k in expect_keys},
             )
             resume_until = int(loaded_meta["until"])
             mark("checkpoint_load")

@@ -18,3 +18,72 @@ DEV_PROTOCOLS = ("basic", "fpaxos", "tempo", "atlas", "epaxos", "caesar")
 
 # the partial-replication twins (engine.protocols.partial_dev_protocol)
 PARTIAL_DEV_PROTOCOLS = ("tempo", "atlas")
+
+# named time-varying traffic presets (fantoch_tpu/traffic, docs/TRAFFIC.md):
+# the campaign grid's `traffic` axis and `sweep --traffic` accept exactly
+# these. Presets are parameterized by the lane's base conflict rate, pool
+# size and command budget so they compose with the sweep's conflict axis
+# instead of overriding it.
+TRAFFIC_PRESETS = ("flat", "diurnal", "flash", "churn")
+
+
+def traffic_preset(name, *, conflict, pool_size=1, commands):
+    """Resolve a preset name to a plain schedule dict (the JSON form
+    ``fantoch_tpu.traffic.TrafficSchedule.from_json`` consumes), or
+    None for ``"flat"`` — the static path by construction.
+
+    Kept jax/numpy-free on purpose: the CLI builds campaign grids from
+    these before any backend initializes (see module docstring).
+
+    * ``flat`` — no schedule; the lane traces the bit-identical static
+      jaxpr (the traffic axis's control point).
+    * ``diurnal`` — one "day" over the command budget in four quarters:
+      off-peak issue delays (think 4 → 1 → 0 → 2 ms) and a shifting
+      read mix (70 → 50 → 30 → 50 %); conflict stays at the base rate.
+    * ``flash`` — a flash crowd: base traffic, then a short
+      100%-conflict zero-think spike over ~a fifth of the budget, then
+      recovery at the base rate.
+    * ``churn`` — hot-key churn: the shared pool's base rotates by
+      ``pool_size`` each quarter of the budget, moving the hot key set
+      four times; conflict/think stay at the base.
+    """
+    if name == "flat":
+        return None
+    assert commands >= 1, "presets scale to the per-client budget"
+    q = max(1, commands // 4)
+    if name == "diurnal":
+        phases = [
+            dict(commands=q, conflict_rate=conflict, pool_size=pool_size,
+                 think_ms=4, read_pct=70),
+            dict(commands=q, conflict_rate=conflict, pool_size=pool_size,
+                 think_ms=1, read_pct=50),
+            dict(commands=q, conflict_rate=conflict, pool_size=pool_size,
+                 think_ms=0, read_pct=30),
+            dict(commands=q, conflict_rate=conflict, pool_size=pool_size,
+                 think_ms=2, read_pct=50),
+        ]
+        return {"name": "diurnal", "cycle": True, "phases": phases}
+    if name == "flash":
+        spike = max(1, commands // 5)
+        pre = max(1, (commands - spike) // 2)
+        phases = [
+            dict(commands=pre, conflict_rate=conflict,
+                 pool_size=pool_size, think_ms=2, read_pct=50),
+            dict(commands=spike, conflict_rate=100, pool_size=pool_size,
+                 think_ms=0, read_pct=10),
+            dict(commands=max(1, commands - pre - spike),
+                 conflict_rate=conflict, pool_size=pool_size, think_ms=2,
+                 read_pct=50),
+        ]
+        return {"name": "flash", "cycle": False, "phases": phases}
+    if name == "churn":
+        phases = [
+            dict(commands=q, conflict_rate=conflict, pool_size=pool_size,
+                 pool_base=i * pool_size, read_pct=30)
+            for i in range(4)
+        ]
+        return {"name": "churn", "cycle": False, "phases": phases}
+    raise ValueError(
+        f"unknown traffic preset {name!r}; choose from "
+        f"{','.join(TRAFFIC_PRESETS)}"
+    )

@@ -83,9 +83,18 @@ class Runner:
         client_regions: List[str],
         seed: int = 0,
         fault_plan: Optional[FaultPlan] = None,
+        traffic=None,
     ):
         assert len(process_regions) == config.n
         assert config.gc_interval_ms is not None
+
+        # traffic-schedule mirror (fantoch_tpu/traffic): the oracle adds
+        # each command's epoch think delay to its SUBMIT's distance —
+        # the bit-exact twin of the engine's `done_t + think` submit
+        # base (engine/core.py step 5). Key/read-mix mirroring rides in
+        # the workload's DeviceStream(traffic=...) generator; pass the
+        # SAME schedule in both places for differential runs.
+        self._traffic = traffic
 
         # fault-plan mirror (engine/faults.py): the oracle applies the
         # exact crash/window/drop model the device engine applies, so
@@ -247,11 +256,19 @@ class Runner:
 
     # ------------------------------------------------------------------
 
+    def _think_ms(self, seq: int) -> int:
+        return 0 if self._traffic is None else self._traffic.think_ms(seq)
+
     def run(
         self, extra_sim_time_ms: Optional[int] = None
     ) -> Tuple[dict, dict, Dict[str, Tuple[int, Histogram]]]:
         for client_id, process_id, cmd in self.simulation.start_clients():
-            self._schedule_submit(("client", client_id), process_id, cmd)
+            # every first command is seq 1 (the engine arms the first
+            # SUBMIT at client_delay + think(1) identically)
+            self._schedule_submit(
+                ("client", client_id), process_id, cmd,
+                extra_delay=self._think_ms(1),
+            )
 
         self._simulation_loop(extra_sim_time_ms)
 
@@ -328,8 +345,17 @@ class Runner:
                 submit = self.simulation.forward_to_client(cmd_result)
                 if submit is not None:
                     process_id, cmd = submit
+                    extra = 0
+                    if self._traffic is not None:
+                        # the workload counter was just bumped by
+                        # cmd_send, so it IS the new command's seq
+                        client, _ = self.simulation.get_client(client_id)
+                        extra = self._think_ms(
+                            client.workload.issued_commands()
+                        )
                     self._schedule_submit(
-                        ("client", client_id), process_id, cmd
+                        ("client", client_id), process_id, cmd,
+                        extra_delay=extra,
                     )
                 else:
                     clients_done += 1
@@ -508,7 +534,8 @@ class Runner:
 
     # -- scheduling (runner.rs:379-557) ---------------------------------
 
-    def _schedule_submit(self, from_region, process_id, cmd) -> None:
+    def _schedule_submit(self, from_region, process_id, cmd,
+                         extra_delay: int = 0) -> None:
         if self.shard_count > 1:
             # client-side aggregation registers before the submit leaves
             # (client_server_task Register, run/task/server/client.rs)
@@ -516,7 +543,8 @@ class Runner:
                 cmd.rifl, cmd.total_key_count()
             )
         self._schedule_message(
-            from_region, ("process", process_id), (_SUBMIT, process_id, cmd)
+            from_region, ("process", process_id),
+            (_SUBMIT, process_id, cmd), extra_delay=extra_delay,
         )
 
     def _schedule_to_client(self, from_region, cmd_result) -> None:
@@ -527,12 +555,17 @@ class Runner:
             (_TO_CLIENT, client_id, cmd_result),
         )
 
-    def _schedule_message(self, from_region, to_region, action) -> None:
+    def _schedule_message(self, from_region, to_region, action,
+                          extra_delay: int = 0) -> None:
         from_ = self._compute_region(from_region)
         to = self._compute_region(to_region)
         distance = self._distance(from_, to)
         if self.reorder_messages:
             distance = int(distance * self.rng.uniform(0.0, 10.0))
+        # traffic think delay (submits only): added AFTER the reorder
+        # scaling, exactly like the engine adds think to the submit's
+        # unscaled base time rather than to its wire delay
+        distance += extra_delay
         # tie-break key: (message, src, emission index on the (src, dst)
         # channel), src-major — the same total order the device engine
         # computes without a global heap. The counter is per channel so

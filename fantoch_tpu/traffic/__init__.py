@@ -1,0 +1,34 @@
+"""Time-varying traffic schedules for the device engine.
+
+The reference evaluates every configuration under a *static* per-client
+workload (one ConflictPool/Zipf draw per command, ``fantoch/src/client/
+key_gen.rs``). Real planet-scale traffic is time-varying — diurnal load
+curves, flash crowds, hot-key churn, shifting read/write mixes — and
+conflict rate dominates tail latency (Atlas, EuroSys'20; Tempo,
+EuroSys'21), so a schedule that moves the conflict structure over a
+lane's lifetime opens a workload class the static draw cannot model.
+
+A :class:`~fantoch_tpu.traffic.schedule.TrafficSchedule` is a piecewise
+sequence of phases over the per-client command sequence axis (the
+closed-loop client's logical clock), compiled into small ``[E]``-shaped
+per-epoch ctx tables plus a command-seq → epoch index that the engine's
+``gen_key``/``_lane_step`` consume as structure-gated extensions
+(engine/core.py). A *flat* schedule compiles to **no tables at all** —
+the lane traces the bit-identical jaxpr of the static path, so the
+seed-warmed XLA cache and the GL005 gating pin survive. The host oracle
+mirrors every schedule bit-exactly (client/key_gen.py ``DeviceStream``
++ sim/runner.py think delays), so the differential tests extend to
+time-varying workloads. See docs/TRAFFIC.md.
+"""
+
+from .schedule import (
+    TrafficPhase,
+    TrafficSchedule,
+    resolve_traffic,
+)
+
+__all__ = [
+    "TrafficPhase",
+    "TrafficSchedule",
+    "resolve_traffic",
+]

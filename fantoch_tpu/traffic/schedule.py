@@ -1,0 +1,271 @@
+"""Traffic schedule spec + compilation to per-epoch ctx tables.
+
+A schedule is piecewise over the per-client *command sequence* axis —
+the closed-loop client's logical clock (seqs are 1-based, exactly the
+engine's SUBMIT payload seq). Each :class:`TrafficPhase` covers a fixed
+number of commands and pins the workload knobs for that span:
+
+* ``conflict_rate`` / ``pool_size`` — the ConflictPool draw parameters
+  (key_gen.rs:96-110), now time-indexed;
+* ``pool_base`` — hot-key churn: the shared pool covers keys
+  ``[pool_base, pool_base + pool_size)``, so rotating the base moves
+  the hot key set. Private keys live above every epoch's pool
+  (``pool_span + client``) so churn never aliases them;
+* ``think_ms`` — diurnal load: a delay between a command's completion
+  and the next SUBMIT leaving the client, lowering the issue rate in
+  off-peak epochs (0 = the closed loop's back-to-back issue);
+* ``read_pct`` — read/write mix. The device engine's conflict
+  detection is key-based, so the mix does not change latency results;
+  the host oracle mirror draws the per-command read flag from the same
+  counter-based stream so both sides agree on which commands are reads
+  (docs/TRAFFIC.md spells out this guarantee boundary).
+
+``compile(commands_per_client)`` lowers a schedule to fixed-shape numpy
+ctx tables: a ``[T]`` command-seq → epoch index (``T = budget + 2``,
+column 0 unused like the key table) plus one ``[E]`` array per knob —
+**per-epoch, not per-seq**, so the in-loop footprint the GL202 VMEM
+gate sees stays bounded by the (small) epoch count, not the command
+budget (docs/PERF.md). Epoch boundaries land on the exact command seq —
+the seq → epoch table is exact by construction, there is no ±1 rounding
+— which the differential tests pin.
+
+A *flat* schedule (one effective phase, no think, no rotation) is
+collapsed by ``make_lane`` into the legacy static ctx path — no tables,
+bit-identical jaxpr — so the seed-warmed XLA cache and the GL005 gating
+pin survive (engine/spec.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple, Union
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class TrafficPhase:
+    """One epoch of the schedule, covering ``commands`` command seqs."""
+
+    commands: int
+    conflict_rate: int
+    pool_size: int = 1
+    pool_base: int = 0
+    think_ms: int = 0
+    read_pct: int = 0
+
+    def __post_init__(self) -> None:
+        assert self.commands >= 1, "a phase must cover >= 1 command"
+        assert 0 <= self.conflict_rate <= 100, self.conflict_rate
+        assert self.pool_size >= 1, self.pool_size
+        assert self.pool_base >= 0, self.pool_base
+        assert self.think_ms >= 0, self.think_ms
+        assert 0 <= self.read_pct <= 100, self.read_pct
+
+    def knobs(self) -> Tuple[int, int, int, int]:
+        """The parameters whose variation makes a schedule non-flat
+        (read_pct rides along in the tables but never reaches the
+        engine's arithmetic, so a read-mix-only schedule is still
+        flat for the device)."""
+        return (
+            self.conflict_rate, self.pool_size, self.pool_base,
+            self.think_ms,
+        )
+
+
+@dataclass(frozen=True)
+class TrafficSchedule:
+    """A named piecewise schedule. ``cycle=True`` repeats the phase
+    pattern over the whole command budget (a diurnal day repeating);
+    ``cycle=False`` extends the last phase forever (a one-shot ramp).
+
+    Hashable by value so it can ride inside the frozen
+    :class:`~fantoch_tpu.client.key_gen.DeviceStream` dataclass."""
+
+    name: str
+    phases: Tuple[TrafficPhase, ...]
+    cycle: bool = False
+
+    def __post_init__(self) -> None:
+        assert self.phases, "a schedule needs at least one phase"
+
+    # -- host helpers (the oracle mirror uses exactly these) -----------
+
+    @property
+    def pattern_len(self) -> int:
+        return sum(p.commands for p in self.phases)
+
+    def epoch_of(self, seq: int) -> int:
+        """Phase index of 1-based command ``seq`` (unbounded axis:
+        cycling or last-phase-extends past the pattern)."""
+        assert seq >= 1, "command seqs are 1-based"
+        idx = (seq - 1) % self.pattern_len if self.cycle else min(
+            seq - 1, self.pattern_len - 1
+        )
+        for e, p in enumerate(self.phases):
+            if idx < p.commands:
+                return e
+            idx -= p.commands
+        return len(self.phases) - 1  # unreachable
+
+    def phase_at(self, seq: int) -> TrafficPhase:
+        return self.phases[self.epoch_of(seq)]
+
+    def think_ms(self, seq: int) -> int:
+        """The submit delay the oracle runner adds for command ``seq``
+        — the bit-exact mirror of the engine's per-epoch think gather
+        (engine/core.py ``_lane_step`` step 5)."""
+        return self.phase_at(seq).think_ms
+
+    def pool_span(self) -> int:
+        """First key above every epoch's shared pool: private client
+        keys are ``pool_span + client`` (the static path's
+        ``pool_size + client`` generalized over rotation)."""
+        return max(p.pool_base + p.pool_size for p in self.phases)
+
+    def is_flat(self) -> bool:
+        """True when the schedule is indistinguishable from the static
+        ConflictPool path: one effective knob tuple, no think delay, no
+        pool rotation. Flat schedules compile to NO ctx tables."""
+        knobs = {p.knobs() for p in self.phases}
+        if len(knobs) != 1:
+            return False
+        (conflict, _size, base, think) = next(iter(knobs))
+        del conflict
+        return base == 0 and think == 0
+
+    # -- device lowering ----------------------------------------------
+
+    def compile(self, commands_per_client: int) -> Dict[str, np.ndarray]:
+        """Lower to the engine's ctx tables. ``traffic_seq_epoch`` is
+        indexed by command seq (1-based; entry 0 mirrors seq 1, like
+        the key table's unused column); length ``budget + 2`` matches
+        the key table so the engine's index clamp never binds for a
+        real command."""
+        E = len(self.phases)
+        T = commands_per_client + 2
+        seq_epoch = np.zeros((T,), np.int32)
+        seq_epoch[0] = self.epoch_of(1)
+        for s in range(1, T):
+            seq_epoch[s] = self.epoch_of(s)
+        return {
+            "traffic_seq_epoch": seq_epoch,
+            "traffic_conflict": np.asarray(
+                [p.conflict_rate for p in self.phases], np.int32
+            ),
+            "traffic_pool_base": np.asarray(
+                [p.pool_base for p in self.phases], np.int32
+            ),
+            "traffic_pool_size": np.asarray(
+                [p.pool_size for p in self.phases], np.int32
+            ),
+            "traffic_think": np.asarray(
+                [p.think_ms for p in self.phases], np.int32
+            ),
+            "traffic_read_pct": np.asarray(
+                [p.read_pct for p in self.phases], np.int32
+            ),
+            "traffic_pool_span": np.int32(self.pool_span()),
+        }
+
+    def meta(self) -> dict:
+        """Compact JSON-able lane metadata (LaneSpec.traffic_meta)."""
+        return {
+            "name": self.name,
+            "epochs": len(self.phases),
+            "cycle": bool(self.cycle),
+            "pattern_commands": self.pattern_len,
+            "pool_span": self.pool_span(),
+        }
+
+    # -- JSON round-trip (campaign grids, repro artifacts) ------------
+
+    def to_json(self) -> dict:
+        return {
+            "name": self.name,
+            "cycle": bool(self.cycle),
+            "phases": [
+                {
+                    "commands": p.commands,
+                    "conflict_rate": p.conflict_rate,
+                    "pool_size": p.pool_size,
+                    "pool_base": p.pool_base,
+                    "think_ms": p.think_ms,
+                    "read_pct": p.read_pct,
+                }
+                for p in self.phases
+            ],
+        }
+
+    @staticmethod
+    def from_json(obj: dict) -> "TrafficSchedule":
+        return TrafficSchedule(
+            name=str(obj["name"]),
+            cycle=bool(obj.get("cycle", False)),
+            phases=tuple(
+                TrafficPhase(**phase) for phase in obj["phases"]
+            ),
+        )
+
+
+TrafficLike = Union[None, str, dict, TrafficSchedule]
+
+
+def resolve_traffic(
+    spec: TrafficLike,
+    *,
+    conflict: int,
+    pool_size: int = 1,
+    commands: int,
+) -> Optional[TrafficSchedule]:
+    """Resolve a traffic spec to a schedule (or None = static path).
+
+    ``spec`` may be a preset name from :data:`fantoch_tpu.registry
+    .TRAFFIC_PRESETS` (parameterized by the lane's base conflict rate /
+    pool size / command budget, so the sweep's conflict axis composes
+    with the traffic axis), a JSON schedule dict, an already-built
+    :class:`TrafficSchedule`, or None. ``"flat"`` resolves to None —
+    the static path, by construction."""
+    if spec is None or isinstance(spec, TrafficSchedule):
+        return spec
+    if isinstance(spec, dict):
+        return TrafficSchedule.from_json(spec)
+    from ..registry import traffic_preset
+
+    obj = traffic_preset(
+        str(spec), conflict=conflict, pool_size=pool_size,
+        commands=commands,
+    )
+    return None if obj is None else TrafficSchedule.from_json(obj)
+
+
+def traffic_key_capacity(
+    specs,
+    *,
+    conflict: int,
+    pool_size: int,
+    commands: int,
+    clients: int,
+) -> Optional[int]:
+    """Protocol key capacity covering every schedule in ``specs`` (an
+    iterable of preset names / schedules / None): private keys sit at
+    ``pool_span + client``, so a rotated pool needs
+    ``max(pool_span) + clients`` keys — the single source of the
+    invariant ``make_lane`` asserts (``span + live_clients <= K``),
+    shared by the CLI sweep and the campaign manager so the two can
+    never drift.
+
+    Returns None when every spec resolves flat: callers then keep
+    their legacy default capacity (``dev_protocol``'s ``1 + clients``),
+    preserving the pre-traffic lane shapes bit-for-bit so old campaign
+    journals and checkpoints resume unchanged."""
+    span: Optional[int] = None
+    for spec in specs:
+        sched = resolve_traffic(
+            spec, conflict=conflict, pool_size=pool_size,
+            commands=commands,
+        )
+        if sched is not None:
+            span = max(span if span is not None else pool_size,
+                       sched.pool_span())
+    return None if span is None else span + clients

@@ -1,0 +1,618 @@
+"""Time-varying traffic schedules (fantoch_tpu/traffic, docs/TRAFFIC.md).
+
+Four contracts are pinned here:
+
+1. **Flat is free** — a flat ``TrafficSchedule`` collapses to the
+   static ctx path: same ctx fields, byte-identical ``LaneResults``,
+   and (GL005-style) an alpha-equivalent traced jaxpr — so the
+   seed-warmed XLA cache and the gating pin survive the subsystem.
+2. **Exact key mirroring** — the device's epoch-indexed key stream and
+   the host ``DeviceStream(traffic=...)`` replay are element-identical
+   at a fixed seed, and a hot-key-churn epoch boundary lands on the
+   exact command seq (not ±1).
+3. **Bit-exact differential** — tempo and fpaxos under fault plans run
+   a time-varying schedule bit-exactly between the vmapped engine and
+   the host oracle (latency distributions + protocol metrics).
+4. **Campaign/bote wiring** — the sweep campaign's ``traffic`` axis
+   runs per-preset batch groups, a resume onto a different schedule is
+   refused *by name* at both the campaign and checkpoint layers, and
+   ``bote/validate.py`` emits a schema-valid frontier artifact.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from fantoch_tpu.client import Workload
+from fantoch_tpu.client.key_gen import DeviceStream, KeyGenState
+from fantoch_tpu.core import Config, Planet
+from fantoch_tpu.engine import (
+    EngineDims,
+    FaultPlan,
+    LinkWindow,
+    make_lane,
+    run_lanes,
+)
+from fantoch_tpu.engine.protocols import FPaxosDev, TempoDev
+from fantoch_tpu.protocol import FPaxos, Tempo
+from fantoch_tpu.protocol.base import ProtocolMetricsKind
+from fantoch_tpu.registry import TRAFFIC_PRESETS, traffic_preset
+from fantoch_tpu.sim import Runner
+from fantoch_tpu.traffic import TrafficPhase, TrafficSchedule, resolve_traffic
+
+COMMANDS = 8
+CPR = 1
+
+
+def _tv_schedule(commands=COMMANDS):
+    """A schedule exercising every knob: conflict shift, pool churn,
+    think curve, read mix."""
+    return TrafficSchedule(
+        "tv",
+        (
+            TrafficPhase(commands=3, conflict_rate=100, pool_size=1,
+                         pool_base=0, think_ms=4, read_pct=60),
+            TrafficPhase(commands=2, conflict_rate=50, pool_size=2,
+                         pool_base=1, think_ms=0, read_pct=20),
+            TrafficPhase(commands=3, conflict_rate=100, pool_size=1,
+                         pool_base=3, think_ms=1, read_pct=40),
+        ),
+    )
+
+
+# ----------------------------------------------------------------------
+# schedule spec
+# ----------------------------------------------------------------------
+
+
+def test_schedule_spec():
+    s = _tv_schedule()
+    assert s.pattern_len == 8
+    assert s.pool_span() == 4
+    assert not s.is_flat()
+    # epoch boundaries on exact seqs (1-based)
+    assert [s.epoch_of(q) for q in range(1, 9)] == [0, 0, 0, 1, 1, 2, 2, 2]
+    # cycle=False: last phase extends
+    assert s.epoch_of(100) == 2
+    cyc = TrafficSchedule("c", s.phases, cycle=True)
+    assert cyc.epoch_of(9) == 0 and cyc.epoch_of(12) == 1
+    # think mirror helper == table content
+    tables = s.compile(COMMANDS)
+    assert tables["traffic_seq_epoch"].shape == (COMMANDS + 2,)
+    for seq in range(1, COMMANDS + 2):
+        e = int(tables["traffic_seq_epoch"][seq])
+        assert e == s.epoch_of(seq)
+        assert int(tables["traffic_think"][e]) == s.think_ms(seq)
+    assert int(tables["traffic_pool_span"]) == 4
+    # JSON round trip preserves value equality
+    assert TrafficSchedule.from_json(s.to_json()) == s
+    # flatness: single knob tuple, no think, no rotation (read-mix-only
+    # variation is still flat for the device)
+    flat = TrafficSchedule(
+        "f",
+        (
+            TrafficPhase(commands=2, conflict_rate=30, read_pct=80),
+            TrafficPhase(commands=2, conflict_rate=30, read_pct=10),
+        ),
+    )
+    assert flat.is_flat()
+    assert not TrafficSchedule(
+        "nf", (TrafficPhase(commands=2, conflict_rate=30, think_ms=1),)
+    ).is_flat()
+    with pytest.raises(AssertionError):
+        TrafficPhase(commands=0, conflict_rate=50)
+    with pytest.raises(AssertionError):
+        TrafficPhase(commands=1, conflict_rate=101)
+
+
+def test_presets_resolve():
+    for name in TRAFFIC_PRESETS:
+        sched = resolve_traffic(
+            name, conflict=40, pool_size=2, commands=20
+        )
+        if name == "flat":
+            assert sched is None
+            continue
+        assert isinstance(sched, TrafficSchedule)
+        assert sched.name == name
+        if name == "churn":
+            # rotation moves the pool each quarter, span covers all
+            bases = {p.pool_base for p in sched.phases}
+            assert len(bases) == 4
+            assert sched.pool_span() == 8
+        if name == "flash":
+            assert max(p.conflict_rate for p in sched.phases) == 100
+        if name == "diurnal":
+            assert sched.cycle
+            assert {p.conflict_rate for p in sched.phases} == {40}
+    with pytest.raises(ValueError):
+        traffic_preset("nope", conflict=0, commands=5)
+
+
+# ----------------------------------------------------------------------
+# flat == static (byte-identical results + alpha-equivalent trace)
+# ----------------------------------------------------------------------
+
+
+def _tempo_setup(commands=COMMANDS, keys_extra=0, n=3):
+    planet = Planet.new()
+    regions = planet.regions()[:n]
+    config = Config(n=n, f=1, gc_interval_ms=100,
+                    tempo_detached_send_interval_ms=100)
+    clients = CPR * n
+    dev = TempoDev(keys=1 + keys_extra + clients)
+    total = commands * clients
+    dims = EngineDims.for_protocol(
+        dev, n=n, clients=clients, payload=dev.payload_width(n),
+        total_commands=total, dot_slots=total + 1, regions=n,
+    )
+    return planet, regions, config, dev, dims
+
+
+def test_flat_schedule_byte_identical():
+    planet, regions, config, dev, dims = _tempo_setup()
+
+    def lane(traffic):
+        return make_lane(
+            dev, planet, config, conflict_rate=100, pool_size=1,
+            commands_per_client=COMMANDS, clients_per_region=CPR,
+            process_regions=regions, client_regions=regions, dims=dims,
+            traffic=traffic,
+        )
+
+    static = lane(None)
+    flat_preset = lane("flat")
+    flat_sched = lane(
+        TrafficSchedule(
+            "myflat", (TrafficPhase(commands=4, conflict_rate=100),)
+        )
+    )
+    for spec in (flat_preset, flat_sched):
+        assert spec.ctx.keys() == static.ctx.keys()
+        assert spec.traffic_meta is None
+        for k in static.ctx:
+            assert np.array_equal(static.ctx[k], spec.ctx[k]), k
+    r0, r1, r2 = run_lanes(dev, dims, [static, flat_preset, flat_sched])
+    a = json.dumps(r0.to_json(), sort_keys=True)
+    assert a == json.dumps(r1.to_json(), sort_keys=True)
+    assert a == json.dumps(r2.to_json(), sort_keys=True)
+
+
+def test_flat_schedule_trace_alpha_equivalent():
+    """GL005-style pin: the flat-schedule step traces a graph
+    alpha-equivalent to HEAD's static trace, and a non-flat schedule
+    traces a genuinely different one (the tables are real)."""
+    from fantoch_tpu.engine.core import init_lane_state
+    from fantoch_tpu.lint.gating import alpha_equivalent
+    from fantoch_tpu.lint.jaxpr import trace_step
+
+    planet, regions, config, dev, dims = _tempo_setup(
+        commands=2, keys_extra=4
+    )
+
+    def trace(traffic, name):
+        spec = make_lane(
+            dev, planet, config, conflict_rate=100, pool_size=1,
+            commands_per_client=2, clients_per_region=CPR,
+            process_regions=regions, client_regions=regions, dims=dims,
+            traffic=traffic,
+        )
+        state = init_lane_state(dev, dims, spec.ctx)
+        return trace_step(dev, dims, state, spec.ctx, name=name)
+
+    static = trace(None, "static")
+    flat = trace("flat", "flat")
+    ok, why = alpha_equivalent(static.closed, flat.closed)
+    assert ok, f"flat schedule changed the traced step: {why}"
+    churn = trace(
+        TrafficSchedule(
+            "churn2",
+            (
+                TrafficPhase(commands=1, conflict_rate=100, pool_base=0),
+                TrafficPhase(commands=1, conflict_rate=100, pool_base=2),
+            ),
+        ),
+        "churn",
+    )
+    ok, _why = alpha_equivalent(static.closed, churn.closed)
+    assert not ok, "a churn schedule must change the traced step"
+
+
+# ----------------------------------------------------------------------
+# device keys == host stream keys, boundary-exact churn
+# ----------------------------------------------------------------------
+
+
+def test_device_keys_match_host_stream_churn_boundary():
+    import jax
+
+    from fantoch_tpu.engine.core import key_table_fn, keygen_ctx_fields
+
+    planet, regions, config, dev, dims = _tempo_setup(keys_extra=4)
+    boundary = 4  # pool rotates AT seq 5 (first seq of phase 2)
+    sched = TrafficSchedule(
+        "churnx",
+        (
+            TrafficPhase(commands=boundary, conflict_rate=100,
+                         pool_size=2, pool_base=0),
+            TrafficPhase(commands=COMMANDS - boundary, conflict_rate=100,
+                         pool_size=2, pool_base=2),
+        ),
+    )
+    seed = 3
+    spec = make_lane(
+        dev, planet, config, conflict_rate=100, pool_size=2,
+        commands_per_client=COMMANDS,
+        clients_per_region=CPR, process_regions=regions,
+        client_regions=regions, dims=dims, seed=seed, traffic=sched,
+    )
+    import jax.numpy as jnp
+
+    C = dims.C
+    keyctx = {
+        k: jnp.asarray(spec.ctx[k]) for k in keygen_ctx_fields(spec.ctx)
+    }
+    table = np.asarray(jax.jit(key_table_fn(C, COMMANDS + 1))(keyctx))
+
+    for client in range(C):
+        # host mirror: the oracle's per-client key stream
+        state = KeyGenState(
+            DeviceStream(conflict_rate=100, pool_size=2, seed=seed,
+                         traffic=sched),
+            shard_count=1,
+            client_id=client + 1,
+        )
+        host = [state.gen_cmd_key() for _ in range(COMMANDS)]
+        device = [str(int(table[client, s])) for s in range(1, COMMANDS + 1)]
+        assert host == device, f"client {client}"
+        # churn boundary exact: conflict=100 ⇒ every key is a pool key;
+        # epoch 0 pool is [0, 2), epoch 1 pool is [2, 4) — the switch
+        # happens AT seq boundary+1, not ±1
+        for s, key in enumerate(device, start=1):
+            lo, hi = (0, 2) if s <= boundary else (2, 4)
+            assert lo <= int(key) < hi, (s, key)
+
+
+# ----------------------------------------------------------------------
+# device vs oracle bit-exact under faults + time-varying schedule
+# ----------------------------------------------------------------------
+
+
+def _run_oracle(protocol_cls, config, regions, sched, plan, seed=0,
+                commands=COMMANDS):
+    planet = Planet.new()
+    workload = Workload(
+        shard_count=1,
+        key_gen=DeviceStream(conflict_rate=100, pool_size=1, seed=seed,
+                             traffic=sched),
+        keys_per_command=1,
+        commands_per_client=commands,
+        payload_size=0,
+    )
+    runner = Runner(
+        protocol_cls, planet, config, workload, CPR, regions,
+        list(regions), seed=seed, fault_plan=plan, traffic=sched,
+    )
+    metrics, _, latencies = runner.run(extra_sim_time_ms=1000)
+    fast = slow = stable = 0
+    for pm, _em in metrics.values():
+        fast += pm.get_aggregated(ProtocolMetricsKind.FAST_PATH) or 0
+        slow += pm.get_aggregated(ProtocolMetricsKind.SLOW_PATH) or 0
+        stable += pm.get_aggregated(ProtocolMetricsKind.STABLE) or 0
+    return latencies, fast, slow, stable
+
+
+def _assert_latencies_equal(res, oracle_lat, regions):
+    for region in regions:
+        dev_done = res.issued(region)
+        if region not in oracle_lat:
+            assert dev_done == 0, region
+            continue
+        _issued, hist = oracle_lat[region]
+        assert dev_done == hist.count(), region
+        if hist.count():
+            assert res.latency_mean(region) == hist.mean(), region
+            assert res.histogram(region).mean() == hist.mean(), region
+
+
+def test_engine_oracle_bitexact_traffic_faults_tempo():
+    """Tempo, crash plan + link-degradation window, time-varying
+    schedule (think + churn + conflict shift): engine ≡ oracle."""
+    n, seed = 3, 0
+    planet = Planet.new()
+    regions = planet.regions()[:n]
+    config = Config(n=n, f=1, gc_interval_ms=100,
+                    tempo_detached_send_interval_ms=100)
+    sched = _tv_schedule()
+    plan = FaultPlan(
+        crashes={2: 260},
+        windows=(LinkWindow(src=0, dst=1, t0=40, t1=220, mult=3),),
+    )
+    clients = CPR * n
+    dev = TempoDev(keys=sched.pool_span() + clients)
+    total = COMMANDS * clients
+    dims = EngineDims.for_protocol(
+        dev, n=n, clients=clients, payload=dev.payload_width(n),
+        total_commands=total, dot_slots=total + 1, regions=n,
+    )
+    spec = make_lane(
+        dev, planet, config, conflict_rate=100, pool_size=1,
+        commands_per_client=COMMANDS, clients_per_region=CPR,
+        process_regions=regions, client_regions=regions, dims=dims,
+        seed=seed, faults=plan, traffic=sched,
+    )
+    res = run_lanes(dev, dims, [spec])[0]
+    assert not res.err, res.err_cause
+    oracle_lat, fast, slow, stable = _run_oracle(
+        Tempo, config, regions, sched, plan, seed=seed
+    )
+    assert int(res.protocol_metrics["fast_path"].sum()) == fast
+    assert int(res.protocol_metrics["slow_path"].sum()) == slow
+    assert int(res.protocol_metrics["stable"].sum()) == stable
+    _assert_latencies_equal(res, oracle_lat, regions)
+
+
+def test_engine_oracle_bitexact_traffic_faults_fpaxos():
+    """FPaxos (leader-based), non-leader crash + window, same
+    time-varying schedule: engine ≡ oracle."""
+    n, seed = 3, 1
+    planet = Planet.new()
+    regions = planet.regions()[:n]
+    config = Config(n=n, f=1, gc_interval_ms=100, leader=1)
+    sched = _tv_schedule()
+    plan = FaultPlan(
+        crashes={2: 300},
+        windows=(LinkWindow(src=1, dst=0, t0=0, t1=150, mult=2),),
+    )
+    clients = CPR * n
+    dev = FPaxosDev
+    total = COMMANDS * clients
+    dims = EngineDims.for_protocol(
+        dev, n=n, clients=clients, payload=dev.payload_width(n),
+        total_commands=total, dot_slots=total + 1, regions=n,
+    )
+    spec = make_lane(
+        dev, planet, config, conflict_rate=100, pool_size=1,
+        commands_per_client=COMMANDS, clients_per_region=CPR,
+        process_regions=regions, client_regions=regions, dims=dims,
+        seed=seed, faults=plan, traffic=sched,
+    )
+    res = run_lanes(dev, dims, [spec])[0]
+    assert not res.err, res.err_cause
+    oracle_lat, fast, slow, stable = _run_oracle(
+        FPaxos, config, regions, sched, plan, seed=seed
+    )
+    assert int(res.protocol_metrics["stable"].sum()) == stable
+    _assert_latencies_equal(res, oracle_lat, regions)
+
+
+def test_traffic_lane_mixing_refused():
+    """Lanes with and without epoch tables trace different graphs and
+    must never share a batch."""
+    planet, regions, config, dev, dims = _tempo_setup(keys_extra=4)
+
+    def lane(traffic):
+        return make_lane(
+            dev, planet, config, conflict_rate=100, pool_size=1,
+            commands_per_client=COMMANDS, clients_per_region=CPR,
+            process_regions=regions, client_regions=regions, dims=dims,
+            traffic=traffic,
+        )
+
+    with pytest.raises(AssertionError, match="traffic tables"):
+        run_lanes(dev, dims, [lane(None), lane(_tv_schedule())])
+
+
+# ----------------------------------------------------------------------
+# campaign traffic axis + refusal by name
+# ----------------------------------------------------------------------
+
+
+def test_campaign_traffic_axis_and_refusals(tmp_path):
+    from fantoch_tpu.campaign import (
+        CampaignError,
+        campaign_from_json,
+        run_campaign,
+    )
+
+    grid = {
+        "kind": "sweep",
+        "protocols": ["basic"],
+        "ns": [3],
+        "conflicts": [100],
+        "subsets": 1,
+        "commands_per_client": 2,
+        "batch_lanes": 2,
+        "segment_steps": 64,
+        "traffic": ["flat", "churn"],
+    }
+    spec = campaign_from_json(grid)
+    path = str(tmp_path / "c1")
+    summary = run_campaign(path, spec)
+    assert summary["done"], summary
+    assert summary["errors"] == 0
+    # per-preset batch groups journaled under traffic-tagged ids
+    ids = set()
+    with open(os.path.join(path, "journal.jsonl")) as fh:
+        for line in fh:
+            ids.add(json.loads(line)["id"])
+    assert any("/tchurn/" in i for i in ids), ids
+    assert any("/tchurn/" not in i for i in ids), ids
+    assert os.path.exists(os.path.join(path, "results.jsonl"))
+
+    # resume onto a different traffic grid: refused by the stored-spec
+    # equality check, by name
+    other = campaign_from_json({**grid, "traffic": ["diurnal"]})
+    with pytest.raises(CampaignError):
+        run_campaign(path, other)
+
+    # unknown preset refused at parse time
+    with pytest.raises(CampaignError, match="traffic preset"):
+        campaign_from_json({**grid, "traffic": ["rush_hour"]})
+
+
+def test_checkpoint_refuses_schedule_swap(tmp_path):
+    """The sweep checkpoint names its schedule: resuming churn lanes
+    onto a diurnal checkpoint raises a CheckpointMismatchError naming
+    `traffic` (the ctx bit-compare would also catch a silent value
+    swap — this pins the by-name layer)."""
+    from fantoch_tpu.engine.checkpoint import (
+        CheckpointMismatchError,
+        CheckpointSpec,
+        SweepInterrupted,
+    )
+    from fantoch_tpu.engine.protocols import BasicDev
+    from fantoch_tpu.parallel.sweep import make_sweep_specs, run_sweep
+
+    planet = Planet.new()
+    regions = planet.regions()[:3]
+    commands = 2
+    clients = 3
+    total = commands * clients
+    dev = BasicDev
+    dims = EngineDims.for_protocol(
+        dev, n=3, clients=clients, payload=dev.payload_width(3),
+        total_commands=total, dot_slots=total + 1, regions=3,
+    )
+
+    def specs(traffic):
+        return make_sweep_specs(
+            dev, planet, region_sets=[regions], fs=[1], conflicts=[100],
+            commands_per_client=commands, clients_per_region=1,
+            dims=dims, traffic=traffic,
+        )
+
+    ck = str(tmp_path / "ck")
+    with pytest.raises(SweepInterrupted):
+        run_sweep(
+            dev, dims, specs("diurnal"), segment_steps=8,
+            checkpoint=CheckpointSpec(
+                path=ck, keep=True, stop_after_segments=1
+            ),
+        )
+    with pytest.raises(CheckpointMismatchError, match="traffic"):
+        run_sweep(
+            dev, dims, specs("churn"), segment_steps=8,
+            checkpoint=CheckpointSpec(path=ck, keep=True),
+        )
+    # the matching schedule resumes fine and completes
+    results = run_sweep(
+        dev, dims, specs("diurnal"), segment_steps=8,
+        checkpoint=CheckpointSpec(path=ck),
+    )
+    assert len(results) == 1 and not results[0].err
+
+    # legacy compatibility: a pre-traffic checkpoint (no `traffic` meta
+    # key at all) must still resume a flat/static run — the by-name
+    # check only applies to scheduled batches (the signature and ctx
+    # compares cover everything else)
+    ck2 = str(tmp_path / "ck_legacy")
+    with pytest.raises(SweepInterrupted):
+        run_sweep(
+            dev, dims, specs(None), segment_steps=8,
+            checkpoint=CheckpointSpec(
+                path=ck2, keep=True, stop_after_segments=1
+            ),
+        )
+    mpath = os.path.join(ck2, "manifest.json")
+    manifest = json.load(open(mpath))
+    assert manifest["meta"].pop("traffic") == ["flat"]
+    with open(mpath, "w") as fh:
+        json.dump(manifest, fh)
+    results = run_sweep(
+        dev, dims, specs(None), segment_steps=8,
+        checkpoint=CheckpointSpec(path=ck2),
+    )
+    assert len(results) == 1 and not results[0].err
+
+
+# ----------------------------------------------------------------------
+# bote frontier validation
+# ----------------------------------------------------------------------
+
+
+def test_bote_validate_dryrun(tmp_path):
+    from fantoch_tpu.bote.validate import (
+        check_frontier_artifact,
+        frontier_candidates,
+        validate_frontier,
+    )
+
+    planet = Planet.new()
+    cands = frontier_candidates(planet, 3, 2)
+    assert len(cands) == 2
+    assert all(len(c.regions) == 3 for c in cands)
+    # closed-form stats carry the model keys + percentiles
+    for c in cands:
+        assert "ff1" in c.closed_form and "e" in c.closed_form
+        assert c.closed_form["af1"]["p99"] >= c.closed_form["af1"]["p50"]
+    artifact, summary = validate_frontier(
+        str(tmp_path / "bote"), planet=planet, candidates=cands,
+        traffic=("flat", "diurnal"), dryrun=True,
+    )
+    assert summary["done"] and summary["dryrun"]
+    check_frontier_artifact(artifact)
+    on_disk = json.load(open(summary["artifact"]))
+    check_frontier_artifact(on_disk)
+    assert on_disk["traffic"] == ["flat", "diurnal"]
+    # a broken artifact fails the schema check
+    bad = json.loads(json.dumps(artifact))
+    del bad["candidates"][0]["closed_form"]["af1"]["p99"]
+    with pytest.raises(AssertionError):
+        check_frontier_artifact(bad)
+
+    # errored measured points must carry nulls + a cause — numeric
+    # percentiles from a failed lane are refused by the gate
+    def measured_artifact(stats):
+        art = json.loads(json.dumps(artifact))
+        art["dryrun"] = False
+        for c in art["candidates"]:
+            c["measured"] = {
+                p: {
+                    "f1": {
+                        t: {str(cf): dict(stats) for cf in art["conflicts"]}
+                        for t in art["traffic"]
+                    }
+                }
+                for p in art["protocols"]
+            }
+        return art
+
+    ok_err = {"mean": None, "p50": None, "p99": None, "count": 0,
+              "lanes": 1, "errors": 1, "error_cause": "pool-overflow"}
+    check_frontier_artifact(measured_artifact(ok_err))
+    fake = {"mean": 0.0, "p50": 0.0, "p99": 0.0, "count": 0,
+            "lanes": 1, "errors": 1}
+    with pytest.raises(AssertionError):
+        check_frontier_artifact(measured_artifact(fake))
+
+
+@pytest.mark.slow
+def test_bote_validate_measured(tmp_path):
+    """The full measured loop at a tiny shape: campaign per candidate,
+    traffic axis, frontier artifact with measured percentiles."""
+    from fantoch_tpu.bote.validate import (
+        check_frontier_artifact,
+        frontier_candidates,
+        validate_frontier,
+    )
+
+    planet = Planet.new()
+    cands = frontier_candidates(planet, 3, 1)
+    artifact, summary = validate_frontier(
+        str(tmp_path / "bote"), planet=planet, candidates=cands,
+        protocols=("fpaxos",), fs=(1,), conflicts=(100,),
+        traffic=("flat", "churn"), commands=3, batch_lanes=4,
+        segment_steps=512,
+    )
+    assert summary["done"], summary
+    check_frontier_artifact(artifact)
+    cand = artifact["candidates"][0]
+    measured = cand["measured"]["fpaxos"]["f1"]
+    for tname in ("flat", "churn"):
+        stats = measured[tname]["100"]
+        assert stats["count"] == 3 * 3  # commands × clients
+        assert stats["errors"] == 0
+        assert stats["p99"] >= stats["p50"] > 0
