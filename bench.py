@@ -77,6 +77,33 @@ FUZZ_MIN_BUDGET_S = float(
 # env so a host-mesh run still finishes inside the driver budget
 CKPT_LANES = int(_os.environ.get("FANTOCH_BENCH_CKPT_LANES", "512"))
 
+# dispatch-overhead self-check shape (parallel/pipeline.py): a fixed
+# small tempo grid run serial (pipeline_depth=1) vs pipelined (K=2)
+# with deliberately small segments so the per-call dispatch tax
+# dominates and the delta isolates what the in-flight window buys;
+# byte-identity of the two results is asserted in the same breath
+DISPATCH_SUBSETS = int(_os.environ.get("FANTOCH_BENCH_DISPATCH_SUBSETS", "2"))
+DISPATCH_SEGMENT = int(
+    _os.environ.get("FANTOCH_BENCH_DISPATCH_SEGMENT", "64")
+)
+DISPATCH_MIN_BUDGET_S = float(
+    _os.environ.get("FANTOCH_BENCH_DISPATCH_MIN_BUDGET", "300")
+)
+
+# ms/step shapes: the documented ~512-lane sweet spot plus the
+# 2048-lane bandwidth-bound regime docs/PERF.md measured at 30 vs
+# 230 ms/step — the two points the narrowing pass targets. The 512
+# shape reuses the main sweep's cached runner; 2048 is one extra
+# compile, so it rides behind the same budget guard as the other
+# self-checks.
+MSSTEP_LANES = tuple(
+    int(x)
+    for x in _os.environ.get(
+        "FANTOCH_BENCH_MSSTEP_LANES", "512,2048"
+    ).split(",")
+)
+MSSTEP_STEPS = int(_os.environ.get("FANTOCH_BENCH_MSSTEP_STEPS", "128"))
+
 # traffic-schedule self-check shape (fantoch_tpu/traffic): lanes whose
 # epoch tables are timed host-side, and the small tempo sweep measured
 # flat vs diurnal (the diurnal trace is a separate compile, so the
@@ -182,6 +209,166 @@ def _traffic_sweep_delta() -> "tuple[float, float] | None":
 
         traceback.print_exc()
         print(f"bench: traffic sweep delta unavailable: {e!r}",
+              file=sys.stderr)
+        return None
+
+
+def _bench_dims(dev):
+    """The one dims construction every tempo self-check shares with the
+    main sweep job, so the cached segment runner compiles once."""
+    clients = N * CLIENTS_PER_REGION
+    return EngineDims.for_protocol(
+        dev, n=N, clients=clients, payload=dev.payload_width(N),
+        dot_slots=64, regions=N, hist_buckets=2048,
+    )
+
+
+def _dispatch_overhead() -> "tuple[float, float, str | None] | None":
+    """Serial-vs-pipelined wall time on a fixed small tempo grid
+    (``DISPATCH_SUBSETS`` × f × conflicts points, ``DISPATCH_SEGMENT``-
+    step segments so each run makes many device calls): the delta is
+    the dispatch tax the in-flight window (parallel/pipeline.py)
+    amortizes. Both runs share one compiled runner (warmup excluded)
+    and their results are compared byte-for-byte — the live twin of
+    the tests/test_pipeline.py pin, and the only one that runs on the
+    real backend. Returns ``(serial_s, pipelined_s, None)``; a byte
+    divergence returns ``(0, 0, "IDENTITY VIOLATION: ...")`` so the
+    artifact flags a correctness bug DISTINGUISHABLY from the
+    transient-skip notes; other failures return None."""
+    import json as _json
+    import sys
+
+    try:
+        planet = Planet.new()
+        region_sets = _region_subsets(planet, DISPATCH_SUBSETS)
+        dev, base = _build("tempo", N * CLIENTS_PER_REGION)
+        dims = _bench_dims(dev)
+        specs = make_sweep_specs(
+            dev, planet, region_sets=region_sets, fs=FS,
+            conflicts=CONFLICTS, commands_per_client=COMMANDS,
+            clients_per_region=CLIENTS_PER_REGION, dims=dims,
+            config_base=base,
+        )
+        specs.sort(key=lambda s: (s.config.f, int(s.ctx["conflict_rate"])))
+
+        def timed(depth):
+            # min of 3: single-shot wall times on a shared 2-core host
+            # swing by seconds (docs/PERF.md warns ±50% run-to-run even
+            # on the tunnel); the minimum is the run least disturbed by
+            # unrelated load, which is what the overhead delta needs
+            best, best_out = None, None
+            for _ in range(3):
+                t0 = time.perf_counter()
+                out = run_sweep(
+                    dev, dims, specs, segment_steps=DISPATCH_SEGMENT,
+                    pipeline_depth=depth,
+                )
+                dt = time.perf_counter() - t0
+                if best is None or dt < best:
+                    best, best_out = dt, out
+            return best, best_out
+
+        timed(1)  # warmup/compile (this batch shape is its own compile)
+        serial_s, serial = timed(1)
+        piped_s, piped = timed(2)
+        a = _json.dumps([r.to_json() for r in serial], sort_keys=True)
+        b = _json.dumps([r.to_json() for r in piped], sort_keys=True)
+        if a != b:
+            # a real divergence on this backend is a correctness bug,
+            # not a degraded measurement — it must never hide behind
+            # the same note a transient compile failure produces
+            print(
+                "bench: IDENTITY VIOLATION: pipelined sweep results "
+                "diverged from serial on this backend",
+                file=sys.stderr,
+            )
+            return 0.0, 0.0, (
+                "IDENTITY VIOLATION: pipelined sweep diverged from "
+                "serial on this backend — correctness bug, not a "
+                "transient skip (see stderr)"
+            )
+        bad = [r.err_cause for r in serial if r.err]
+        assert not bad, f"dispatch self-check failing lanes: {bad[:4]}"
+        return serial_s, piped_s, None
+    except Exception as e:  # noqa: BLE001
+        import traceback
+
+        traceback.print_exc()
+        print(f"bench: dispatch overhead unavailable: {e!r}",
+              file=sys.stderr)
+        return None
+
+
+def _ms_per_step(lanes: int) -> "float | None":
+    """Measured ms/step of the tempo segment runner at ``lanes`` lanes
+    (one lane's state stacked, so host-side lane construction stays out
+    of the way): one warmup segment (compile + first dispatch), then
+    one timed ``MSSTEP_STEPS``-step segment in the lanes' steady state.
+    Shares ``run_sweep``'s runner cache — at the 512-lane main-sweep
+    shape this is compile-free. Degrades to None, never an
+    exception."""
+    import sys
+
+    try:
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        from fantoch_tpu.engine import make_lane
+        from fantoch_tpu.engine.core import (
+            cast_state_planes,
+            donation_safe,
+            init_lane_state,
+        )
+        from fantoch_tpu.engine.faults import NO_FAULTS
+        from fantoch_tpu.engine.spec import narrow_spec, stack_lanes
+        from fantoch_tpu.parallel.sweep import _cached_runner
+
+        planet = Planet.new()
+        regions = planet.regions()[:N]
+        dev, base = _build("tempo", N)
+        dims = _bench_dims(dev)
+        lane = make_lane(
+            dev, planet, base, conflict_rate=100,
+            commands_per_client=COMMANDS, clients_per_region=1,
+            process_regions=regions, client_regions=regions, dims=dims,
+        )
+        state0 = init_lane_state(dev, dims, lane.ctx)
+        state = jax.tree_util.tree_map(
+            lambda x: np.stack([np.asarray(x)] * lanes), state0
+        )
+        ctx = stack_lanes([lane] * lanes)
+        nspec = narrow_spec(dev, ctx)
+        state = cast_state_planes(state, nspec, store=True)
+        runner, _alive = _cached_runner(
+            dev, dims, 1 << 22, False, NO_FAULTS, 0, nspec,
+            donation_safe(),
+        )
+        mesh = Mesh(np.asarray(jax.devices()), ("sweep",))
+        sharding = NamedSharding(mesh, PartitionSpec("sweep"))
+        put = lambda tree: jax.tree_util.tree_map(  # noqa: E731
+            lambda a: jax.device_put(a, sharding), tree
+        )
+        state, ctx = put(state), put(ctx)
+        # warmup: compile + advance into the steady state; the timed
+        # segment then runs [MSSTEP_STEPS, 2*MSSTEP_STEPS), where every
+        # lane is still live (COMMANDS budgets run for hundreds of
+        # steps — docs/PERF.md round-3 measurements)
+        state, alive = runner(state, ctx, np.int32(MSSTEP_STEPS))
+        jax.block_until_ready(state)
+        assert bool(alive), (
+            "ms/step window overran the lanes; raise COMMANDS or lower "
+            "MSSTEP_STEPS"
+        )
+        t0 = time.perf_counter()
+        state, _a = runner(state, ctx, np.int32(2 * MSSTEP_STEPS))
+        jax.block_until_ready(state)
+        dt = time.perf_counter() - t0
+        return dt * 1000.0 / MSSTEP_STEPS
+    except Exception as e:  # noqa: BLE001
+        import traceback
+
+        traceback.print_exc()
+        print(f"bench: ms/step@{lanes} unavailable: {e!r}",
               file=sys.stderr)
         return None
 
@@ -463,6 +650,45 @@ def main() -> None:
                 flush=True,
             )
 
+    # dispatch tax (parallel/pipeline.py): serial vs pipelined on the
+    # small tempo grid, plus measured ms/step at the 512/2048-lane
+    # shapes. Budget-guarded like the other self-checks — the small
+    # grid and the 2048-lane batch are their own compiles — and
+    # honest-zero on skip/failure so the sweep artifact survives.
+    dispatch, dispatch_note = None, None
+    msstep: dict = {}
+    if TOTAL_BUDGET_S - _since_birth() < DISPATCH_MIN_BUDGET_S:
+        dispatch_note = (
+            "skipped: insufficient budget for the pipeline self-check"
+        )
+        print(f"dispatch self-check {dispatch_note}", file=sys.stderr,
+              flush=True)
+    else:
+        dispatch = _dispatch_overhead()
+        if dispatch is None:
+            dispatch_note = "failed (see stderr)"
+        elif dispatch[2] is not None:
+            # the byte-identity tripwire fired: surface the violation
+            # note verbatim and zero the measurement
+            dispatch_note, dispatch = dispatch[2], None
+        else:
+            print(
+                f"dispatch self-check: serial {dispatch[0]:.2f}s vs "
+                f"pipelined {dispatch[1]:.2f}s "
+                f"(overhead {dispatch[0] - dispatch[1]:+.2f}s, "
+                "byte-identical results)",
+                file=sys.stderr,
+                flush=True,
+            )
+        for lanes in MSSTEP_LANES:
+            msstep[lanes] = _ms_per_step(lanes)
+            if msstep[lanes] is not None:
+                print(
+                    f"ms/step @ {lanes} lanes: {msstep[lanes]:.2f}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+
     # durability tax: one checkpointed segment's save+restore+compare
     # (device-state fetch excluded — measured on host arrays)
     ckpt_s = _checkpoint_roundtrip()
@@ -520,6 +746,42 @@ def main() -> None:
                     round(traffic_rates[1], 2) if traffic_rates else 0.0
                 ),
                 **({"traffic_note": traffic_note} if traffic_note else {}),
+                # serial-minus-pipelined wall time on the fixed small
+                # tempo grid (positive = the in-flight window wins;
+                # 0.0 = skipped/failed, note carries the reason)
+                "dispatch_overhead_s": (
+                    round(dispatch[0] - dispatch[1], 3) if dispatch
+                    else 0.0
+                ),
+                "dispatch_serial_s": (
+                    round(dispatch[0], 3) if dispatch else 0.0
+                ),
+                "dispatch_pipelined_s": (
+                    round(dispatch[1], 3) if dispatch else 0.0
+                ),
+                **(
+                    {"dispatch_note": dispatch_note}
+                    if dispatch_note
+                    else {}
+                ),
+                # measured segment-runner ms/step, self-describing:
+                # every measured shape lands under its ACTUAL lane
+                # count (a CPU-fallback round never masquerades as the
+                # documented shapes), and the canonical 512/2048 keys
+                # are non-zero only when measured at exactly those
+                # shapes (0.0 = unavailable at that shape this round)
+                "ms_per_step_512": (
+                    lambda v: round(v, 3) if v is not None else 0.0
+                )(msstep.get(512)),
+                "ms_per_step_2048": (
+                    lambda v: round(v, 3) if v is not None else 0.0
+                )(msstep.get(2048)),
+                "ms_per_step_measured": {
+                    str(lanes): round(v, 3)
+                    for lanes, v in sorted(msstep.items())
+                    if v is not None
+                },
+                "msstep_lanes": list(MSSTEP_LANES),
                 **(
                     {"static_kernel_cost": static_cost}
                     if static_cost
@@ -676,6 +938,14 @@ def _emit_unreachable(reason: str = "unreachable at startup") -> None:
                 "sweep_points_per_sec_flat_small": 0.0,
                 "sweep_points_per_sec_diurnal": 0.0,
                 "traffic_note": f"sweeps skipped: TPU backend {reason}",
+                "dispatch_overhead_s": 0.0,
+                "dispatch_serial_s": 0.0,
+                "dispatch_pipelined_s": 0.0,
+                "dispatch_note": f"skipped: TPU backend {reason}",
+                "ms_per_step_512": 0.0,
+                "ms_per_step_2048": 0.0,
+                "ms_per_step_measured": {},
+                "msstep_lanes": list(MSSTEP_LANES),
                 **(
                     {"static_kernel_cost": static_cost}
                     if static_cost
@@ -699,6 +969,13 @@ _CPU_FALLBACK_ENV = {
     "FANTOCH_BENCH_CKPT_LANES": "64",
     "FANTOCH_BENCH_TRAFFIC_LANES": "64",
     "FANTOCH_BENCH_TRAFFIC_SUBSETS": "1",
+    "FANTOCH_BENCH_DISPATCH_SUBSETS": "1",
+    # measured on the 2-core CPU mesh: 4-step segments make the
+    # per-call dispatch tax a visible fraction (serial 4.8s vs
+    # pipelined 3.9s on the tune grid); 8+ steps wash it out
+    "FANTOCH_BENCH_DISPATCH_SEGMENT": "4",
+    "FANTOCH_BENCH_MSSTEP_LANES": "16,64",
+    "FANTOCH_BENCH_MSSTEP_STEPS": "32",
 }
 
 # below this remaining total budget a CPU fallback run cannot plausibly

@@ -96,6 +96,13 @@ class SweepCampaign:
     segment_steps: int = 2048
     max_steps: int = 1 << 22
     checkpoint_every: int = 1  # segments between in-flight saves
+    # segments kept in flight per batch (parallel/pipeline.py): the
+    # dispatch tax overlaps device execution between checkpoint
+    # boundaries (raise checkpoint_every past 1 to let the window
+    # breathe); 1 = the serial reference loop. Either setting resumes
+    # the other's checkpoints — saves always happen on drained,
+    # determinate boundaries.
+    pipeline_depth: int = 2
     shard_lanes: Optional[bool] = None
     aws: bool = False
 
@@ -452,6 +459,7 @@ def _run_sweep_campaign(path: str, spec: SweepCampaign, deadline,
                 segment_steps=spec.segment_steps,
                 shard_lanes=spec.shard_lanes,
                 checkpoint=ck,
+                pipeline_depth=spec.pipeline_depth,
             )
         except SweepInterrupted as e:
             interrupted = e.reason

@@ -331,7 +331,9 @@ def cmd_sweep(args) -> None:
         traffic=traffic,
     )
     results = run_sweep(
-        dev, dims, specs, shard_lanes=True if args.shard_lanes else None
+        dev, dims, specs,
+        shard_lanes=True if args.shard_lanes else None,
+        pipeline_depth=args.pipeline_depth,
     )
     errs = sum(1 for r in results if r.err)
     summary = {
@@ -1053,6 +1055,14 @@ def main(argv=None) -> None:
         help="prove the step lane-independent (GL203 taint, a few "
         "seconds once per protocol) before sharding lanes over the "
         "mesh; refuses to run if the proof fails",
+    )
+    sw.add_argument(
+        "--pipeline-depth",
+        type=int,
+        default=2,
+        help="segments kept in flight by the sweep driver "
+        "(parallel/pipeline.py): dispatch overlaps device execution; "
+        "1 = the serial reference loop (byte-identical results)",
     )
     sw.add_argument("--out", default=None, help="results JSONL path")
     sw.set_defaults(fn=cmd_sweep)

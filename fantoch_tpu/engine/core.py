@@ -570,6 +570,55 @@ def init_lane_state(
 
 
 # ----------------------------------------------------------------------
+# dtype-narrowed storage planes
+#
+# The steady-state sweep is bandwidth-bound past ~512 lanes
+# (docs/PERF.md "cost model"): every while-loop iteration writes the
+# whole carried state back to HBM, so bytes-in-the-carry is the tax the
+# narrowing pass attacks. Cold i32 planes whose values provably stay
+# tiny for the batch at hand — command counters bounded by the batch's
+# host-known command budget, result-part counts bounded by the cmd
+# tables, per-command protocol metric counters the protocols declare —
+# are *stored* as i16/i8 in the carry and widened back to i32 at the
+# top of each step, so every handler computes in exactly the arithmetic
+# GL001 audited and results stay bit-identical (tests/test_pipeline.py
+# pins narrow ≡ wide byte-for-byte). The spec is static per batch
+# (engine/spec.py narrow_spec), keyed into the runner cache.
+# ----------------------------------------------------------------------
+
+
+def cast_state_planes(state, narrow, *, store: bool):
+    """Cast the planes named by ``narrow`` (a tuple of
+    ``("clients/issued", "int16")``-style entries from
+    :func:`~fantoch_tpu.engine.spec.narrow_spec`) to their storage
+    dtype (``store=True``) or back to i32 (``store=False``). Works on
+    numpy trees (host-side init/fetch) and tracers (inside the jitted
+    runner) alike; an empty spec returns the tree untouched, so the
+    narrow-free trace is bit-identical to the pre-narrowing graph.
+    Paths missing from ``state`` are skipped — result fetches carry
+    only a sub-tree of the full state."""
+    if not narrow:
+        return state
+    out = dict(state)
+    for path, dtname in narrow:
+        parts = path.split("/")
+        node = out
+        ok = True
+        for p in parts[:-1]:
+            if not isinstance(node.get(p), dict):
+                ok = False
+                break
+            node[p] = dict(node[p])
+            node = node[p]
+        if not ok or parts[-1] not in node:
+            continue
+        node[parts[-1]] = node[parts[-1]].astype(
+            dtname if store else jnp.int32
+        )
+    return out
+
+
+# ----------------------------------------------------------------------
 # the step function
 # ----------------------------------------------------------------------
 
@@ -1215,10 +1264,35 @@ def build_runner(
     return jax.jit(jax.vmap(run_lane))
 
 
+def donation_safe() -> bool:
+    """Whether ``donate_argnums`` buffer donation is safe in THIS
+    process: donation and the persistent XLA compile cache are
+    mutually exclusive on the current jaxlib (0.4.x, observed on
+    0.4.37 CPU): once a process has deserialized ANY executable from
+    the persistent cache, running a donated executable — even one
+    compiled fresh in-process — flakily segfaults or silently corrupts
+    the aliased state (reproduced: cache-free processes are bit-correct
+    across every run; warm-cache processes return garbage counters or
+    abort in malloc). Silent corruption is disqualifying, so donation
+    auto-engages exactly when the persistent cache is off for this
+    process, and ``FANTOCH_SWEEP_DONATE=0/1`` forces it either way
+    (docs/PERF.md "Pipelined dispatch & donation" carries the repro
+    notes)."""
+    import os
+
+    env = os.environ.get("FANTOCH_SWEEP_DONATE")
+    if env is not None:
+        return env != "0"
+    return not (
+        jax.config.jax_enable_compilation_cache
+        and jax.config.jax_compilation_cache_dir
+    )
+
+
 def build_segment_runner(
     protocol, dims: EngineDims, max_steps: int = 1 << 22,
     reorder: bool = False, faults: FaultFlags = NO_FAULTS,
-    monitor_keys: int = 0,
+    monitor_keys: int = 0, narrow: tuple = (), donate: bool = False,
 ):
     """Like :func:`build_runner` but each device call advances every
     still-running lane by at most ``until - steps`` steps and returns,
@@ -1231,18 +1305,52 @@ def build_segment_runner(
     tunnel's per-call overhead every segment) and ``alive(state, ctx)``
     serves callers resuming saved states; drive ``until`` up in fixed
     increments until the flag is false, then apply truncation via
-    ``finish_segmented``."""
+    ``finish_segmented``.
+
+    A finished batch is a fixed point: every lane's running predicate
+    is already false, so the while loop never runs and the state comes
+    back bit-identical. The pipelined sweep driver
+    (parallel/pipeline.py) leans on this — segments dispatched
+    speculatively past the batch's end are byte-exact no-ops.
+
+    ``narrow`` (engine/spec.py :func:`~fantoch_tpu.engine.spec
+    .narrow_spec`) selects state planes stored as i16/i8 in the
+    while-loop carry; the body widens them to i32 before the step and
+    re-narrows its output, so handler arithmetic is untouched and only
+    the bytes the carry moves through HBM shrink. The runner's
+    input/output state uses the same storage dtypes (host init must
+    pre-narrow via :func:`cast_state_planes`).
+
+    ``donate=True`` donates the input state to each call
+    (``donate_argnums``, the pjit donation pattern): a segment updates
+    the lane state in place instead of allocating a second full copy
+    per call and round-tripping it through HBM. Callers must treat the
+    state they pass in as consumed — ``run_sweep`` rebinds the output
+    every segment and takes an explicit host copy (``device_get``)
+    before a checkpoint save, the only boundary where the pre-segment
+    state is still needed. Do NOT donate in a process that uses the
+    persistent compile cache: gate on :func:`donation_safe` (the sweep
+    driver does) — the current jaxlib corrupts donated state in
+    warm-cache processes."""
 
     _check_monitorable(protocol, monitor_keys)
 
     def run_lane(st, ctx, until):
         lim = jnp.minimum(until, max_steps)
+
+        def body(s):
+            wide = cast_state_planes(s, narrow, store=False)
+            out = _lane_step(
+                protocol, dims, wide, ctx, reorder, faults, monitor_keys
+            )
+            return cast_state_planes(out, narrow, store=True)
+
+        # the loop condition reads only per-lane scalars (done_time,
+        # now, err, steps) — never a narrowed plane
         out = jax.lax.while_loop(
             lambda s: _lane_running(dims, s, ctx, max_steps, faults)
             & (s["steps"] < lim),
-            lambda s: _lane_step(
-                protocol, dims, s, ctx, reorder, faults, monitor_keys
-            ),
+            body,
             st,
         )
         running = _lane_running(dims, out, ctx, max_steps, faults)
@@ -1250,9 +1358,11 @@ def build_segment_runner(
             # idempotent per segment: a finished lane's state is frozen,
             # so re-running the end-of-lane reduction only re-derives
             # the same bits; running lanes keep their in-run bits
-            out = monitor.finalize_lane(
-                protocol, dims, out, ctx, faults, running=running
+            wide = cast_state_planes(out, narrow, store=False)
+            wide = monitor.finalize_lane(
+                protocol, dims, wide, ctx, faults, running=running
             )
+            out = cast_state_planes(wide, narrow, store=True)
         return out, running
 
     def run_batch(st, ctx, until):
@@ -1264,7 +1374,9 @@ def build_segment_runner(
         # once per segment
         return out, jnp.any(alive)
 
-    runner = jax.jit(run_batch)
+    runner = jax.jit(
+        run_batch, donate_argnums=(0,) if donate else ()
+    )
     alive = jax.jit(
         lambda st, ctx: jnp.any(
             jax.vmap(

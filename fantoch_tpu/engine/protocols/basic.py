@@ -49,6 +49,11 @@ class BasicDev(DevIdentity):
     # no cross-process order, so only the exactly-once counters are
     # checked (all executions share monitor key 0)
     MONITOR_ORDER = False
+    # per-command counters the sweep driver may store narrowed
+    # (engine/spec.py narrow_spec): each increments at most once per
+    # command per process (fast-path decision at commit, stability at
+    # GC), so a lane's total command budget bounds every entry
+    NARROW_METRICS = ("m_fast_path", "m_stable")
 
     # -- host-side builders -------------------------------------------
 

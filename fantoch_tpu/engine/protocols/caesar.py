@@ -86,6 +86,12 @@ class CaesarDev(DevIdentity):
 
     PERIODIC_ROWS = 2  # [garbage collection, executed notification]
     MONITORED = True  # mon_exec hook at the predecessors-executor scan
+    # per-command counters the sweep driver may store narrowed
+    # (engine/spec.py narrow_spec): m_fast/m_slow increment once per
+    # command at its coordinator's commit decision, m_stable once per
+    # command per process when it leaves the exec scan fully executed —
+    # a lane's total command budget bounds every entry
+    NARROW_METRICS = ("m_fast", "m_slow", "m_stable")
 
     def __init__(
         self,

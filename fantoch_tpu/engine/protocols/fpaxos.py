@@ -51,6 +51,11 @@ class FPaxosDev(DevIdentity):
 
     PERIODIC_ROWS = 1  # garbage collection
     MONITORED = True  # mon_exec hook at the slot executor's frontier
+    # per-command counter the sweep driver may store narrowed
+    # (engine/spec.py narrow_spec): m_stable counts slots GC'd, at most
+    # once per command per process — a lane's total command budget
+    # bounds every entry
+    NARROW_METRICS = ("m_stable",)
 
     # -- host-side builders -------------------------------------------
 

@@ -93,6 +93,13 @@ class _DepDev(DevIdentity):
 
     PERIODIC_ROWS = 1  # garbage collection
     MONITORED = True  # mon_exec hook at the graph executor's drain
+    # per-command counters the sweep driver may store narrowed
+    # (engine/spec.py narrow_spec): m_fast/m_slow increment once per
+    # command at its coordinator, m_stable once per command per process
+    # at GC — a lane's total command budget bounds every entry (Atlas,
+    # EPaxos and the partial twin all inherit the same fields and
+    # increment discipline)
+    NARROW_METRICS = ("m_fast", "m_slow", "m_stable")
 
     def __init__(self, keys: int, gap_slots: int = 8):
         self.K = keys

@@ -71,6 +71,13 @@ class TempoDev(DevIdentity):
 
     PERIODIC_ROWS = 3  # [garbage collection, clock bump, send detached]
     MONITORED = True  # mon_exec hook at the table executor's drain
+    # per-command counters the sweep driver may store narrowed
+    # (engine/spec.py narrow_spec): m_fast/m_slow increment once per
+    # command at its coordinator, m_stable once per command per process
+    # at GC — a lane's total command budget bounds every entry (the
+    # partial twin inherits this: same fields, same per-command
+    # increments)
+    NARROW_METRICS = ("m_fast", "m_slow", "m_stable")
 
     def __init__(
         self,

@@ -449,6 +449,76 @@ def _partial_tables(
     }
 
 
+def _storage_dtype(bound: int) -> "str | None":
+    """Smallest signed storage dtype that exactly holds [0, bound], or
+    None when nothing below i32 does (the plane stays wide)."""
+    if bound <= np.iinfo(np.int8).max:
+        return "int8"
+    if bound <= np.iinfo(np.int16).max:
+        return "int16"
+    return None
+
+
+def narrow_spec(protocol, ctx: Dict[str, np.ndarray]) -> tuple:
+    """The dtype-narrowing spec for one batch: a static tuple of
+    ``(state path, storage dtype)`` entries naming cold i32 planes the
+    segment runner stores as i16/i8 (engine/core.py
+    ``cast_state_planes``; docs/PERF.md "Pipelined dispatch &
+    donation").
+
+    A plane is narrowed only when its value bound — already established
+    by the GL001 interval family as a monotone per-command counter, and
+    tightened here with the batch's *host-known* command budget — fits
+    the storage dtype for the whole run:
+
+    * ``clients/issued`` / ``clients/completed`` count a client's own
+      commands: bounded by the batch's max per-client budget.
+    * ``clients/parts`` counts one in-flight command's result parts and
+      resets on completion: bounded by the cmd tables' max part count
+      (1 on single-shard lanes).
+    * ``metrics/hist`` / ``metrics/lat_count`` count completions per
+      (region, bucket) / region: bounded by a lane's total commands.
+    * protocol planes named by the protocol's ``NARROW_METRICS``
+      declaration — per-process counters the owning module asserts
+      increment at most once per command per process (fast/slow-path
+      and stability counters): bounded by a lane's total commands.
+
+    ``ctx`` is the stacked (or single-lane) numpy ctx; the bounds take
+    the max over the batch. The tuple is hashable — it keys the cached
+    runner — and deterministic (sorted by path)."""
+    budget = np.asarray(ctx["cmd_budget"])
+    budget_max = int(budget.max()) if budget.size else 0
+    # max total commands of any one lane (the per-lane completion count)
+    lane_total = int(
+        budget.sum(axis=-1).max() if budget.ndim > 1 else budget.sum()
+    )
+    parts_max = (
+        int(np.asarray(ctx["cmd_parts"]).max()) if "cmd_parts" in ctx
+        else 1
+    )
+    candidates = {
+        "clients/issued": budget_max,
+        "clients/completed": budget_max,
+        "clients/parts": parts_max,
+        "metrics/hist": lane_total,
+        "metrics/lat_count": lane_total,
+    }
+    for field in getattr(protocol, "NARROW_METRICS", ()):
+        candidates[f"ps/{field}"] = lane_total
+    out = []
+    for path, bound in sorted(candidates.items()):
+        # 2x headroom on every bound: the engine planes hit their bound
+        # exactly (issue/complete guards), but fuzzing runs deliberately
+        # broken protocol twins (mc/fuzz.py --inject-bug) that inherit
+        # NARROW_METRICS — a counter a seeded bug overshoots by a few
+        # must still be exact in storage so the monitors see the true
+        # value. Budgets anywhere near the i16 range keep planes wide.
+        dt = _storage_dtype(2 * bound)
+        if dt is not None:
+            out.append((path, dt))
+    return tuple(out)
+
+
 def stack_lanes(specs: Sequence[LaneSpec]) -> Dict[str, np.ndarray]:
     """Stack per-lane ctx dicts into one batched ctx (leading lane axis).
 

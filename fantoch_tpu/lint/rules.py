@@ -55,6 +55,10 @@ DEFAULT_PATHS = (
     "fantoch_tpu/campaign",
     "fantoch_tpu/traffic",
     "fantoch_tpu/bote/validate.py",
+    # the sweep driver + its pipelined segment window (host-side by
+    # design; the scan proves the dispatch loop never grows raw
+    # emissions, tracer branching, or host-sync ops)
+    "fantoch_tpu/parallel",
 )
 
 OUTBOX_KEYS = {"valid", "dst", "mtype", "payload"}

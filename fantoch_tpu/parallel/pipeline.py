@@ -1,0 +1,79 @@
+"""The K-deep in-flight segment window of the pipelined sweep driver.
+
+The serial sweep loop forced a device sync per segment: it resolved
+``bool(any_alive)`` immediately after every runner call, so the host
+could not dispatch segment i+1 until segment i had fully executed —
+and over the tunneled runtime each dispatch costs ~1 s (docs/PERF.md
+"cost model"), serializing dispatch with execution. The window here is
+the host half of the fix: ``run_sweep`` dispatches segment i+1
+immediately (jax dispatch is asynchronous — the runner call returns
+array futures) and resolves segment i−K+1's liveness flag only when
+its slot is reused, so up to ``depth`` segments are in flight and the
+per-call dispatch tax overlaps device execution.
+
+Why speculative dispatch is safe: the segment runner is a fixed point
+on a finished batch (engine/core.py ``build_segment_runner``) — once
+every lane's running predicate is false the while loop body never
+executes and the state comes back bit-identical — so the at-most
+``depth − 1`` segments dispatched past the batch's actual end are
+byte-exact no-ops and the final state equals the serial loop's.
+``depth=1`` degenerates to exactly the serial loop (dispatch, resolve,
+repeat), which is the reference path the pipelined one is pinned
+against (tests/test_pipeline.py).
+
+Durability boundaries (checkpoint saves, signal flushes) call
+:meth:`SegmentWindow.drain` first: every in-flight flag resolves, the
+newest state becomes determinate, and the save sees exactly what a
+serial run would have saved — a kill mid-window therefore loses at
+most the in-flight window of device work, never durability.
+
+Liveness flags are monotone — lanes only ever finish, so once one
+segment's ``any_alive`` is False every later segment's is too. The
+window exploits this: the first False short-circuits ``running`` and
+no younger flag needs resolving.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+
+class SegmentWindow:
+    """Host-side bookkeeping for up to ``depth`` dispatched-but-
+    unresolved segments. Not thread-safe (the sweep loop is single-
+    threaded); holds only liveness flags — the state futures themselves
+    ride in the caller's single ``state`` binding."""
+
+    def __init__(self, depth: int):
+        self.depth = max(1, int(depth))
+        self._flags: deque = deque()
+        #: False once any resolved segment reported the batch finished
+        self.running = True
+
+    @property
+    def in_flight(self) -> int:
+        return len(self._flags)
+
+    def push(self, any_alive) -> None:
+        """Record a freshly dispatched segment's (unresolved) liveness
+        flag — a device scalar future, not a bool."""
+        self._flags.append(any_alive)
+
+    def poll(self) -> bool:
+        """Resolve just enough old flags to keep at most ``depth − 1``
+        in flight (the slot-reuse rule: blocking on segment i−K+1 while
+        segments i−K+2 … i+1 are already enqueued overlaps the wait
+        with their execution). Returns the batch's running verdict as
+        of the oldest resolved segment."""
+        while self.running and len(self._flags) >= self.depth:
+            self.running = bool(self._flags.popleft())
+        return self.running
+
+    def drain(self) -> bool:
+        """Resolve every in-flight flag (a durability boundary or the
+        end of the sweep): afterwards the caller's newest state is
+        determinate. Returns the final running verdict."""
+        while self.running and self._flags:
+            self.running = bool(self._flags.popleft())
+        self._flags.clear()
+        return self.running

@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import functools
 import itertools
+import os
+import time as _t
 from typing import List, Optional, Sequence
 
 import jax
@@ -28,6 +30,7 @@ from ..engine import (
     make_lane,
 )
 from ..engine.checkpoint import (
+    CheckpointMismatchError,
     CheckpointSpec,
     SweepInterrupted,
     checkpoint_exists,
@@ -39,6 +42,8 @@ from ..engine.checkpoint import (
 from ..engine.core import (
     build_runner,
     build_segment_runner,
+    cast_state_planes,
+    donation_safe,
     finish_segmented,
     init_lane_state,
     key_table_fn,
@@ -46,7 +51,8 @@ from ..engine.core import (
 )
 from ..engine.driver import batch_reorder_flag
 from ..engine.faults import FaultPlan, batch_fault_flags
-from ..engine.spec import stack_lanes
+from ..engine.spec import narrow_spec, stack_lanes
+from .pipeline import SegmentWindow
 
 
 def make_sweep_specs(
@@ -174,18 +180,24 @@ def _prove_lane_independent(protocol, dims: EngineDims, reorder: bool,
 
 @functools.lru_cache(maxsize=None)
 def _cached_runner(protocol, dims: EngineDims, max_steps: int,
-                   reorder: bool, faults, monitor_keys: int = 0):
+                   reorder: bool, faults, monitor_keys: int = 0,
+                   narrow: tuple = (), donate: bool = False):
     """One compiled segmented runner per (protocol value, dims,
-    max_steps, fault flags, monitor capacity): ``build_segment_runner``
-    returns fresh ``jax.jit`` closures, so without the cache every
-    ``run_sweep`` call would retrace and recompile. Device protocols
-    have value identity (protocols/identity.py), so fresh instances
-    with equal shape bounds share one compiled runner; a batch mixing
-    fault-free and faulty lanes shares one too (its flags are the
-    union). ``monitor_keys`` is part of the key — a monitored fuzz
-    runner never aliases an unmonitored sweep runner."""
+    max_steps, fault flags, monitor capacity, narrowing spec):
+    ``build_segment_runner`` returns fresh ``jax.jit`` closures, so
+    without the cache every ``run_sweep`` call would retrace and
+    recompile. Device protocols have value identity
+    (protocols/identity.py), so fresh instances with equal shape bounds
+    share one compiled runner; a batch mixing fault-free and faulty
+    lanes shares one too (its flags are the union). ``monitor_keys``
+    is part of the key — a monitored fuzz runner never aliases an
+    unmonitored sweep runner — and so are ``narrow`` (engine/spec.py
+    ``narrow_spec``; batches whose storage dtypes differ trace
+    different graphs) and ``donate`` (the state-donating executable is
+    a different compile from the copying one)."""
     return build_segment_runner(protocol, dims, max_steps, reorder,
-                                faults, monitor_keys)
+                                faults, monitor_keys, narrow=narrow,
+                                donate=donate)
 
 
 def run_sweep(
@@ -198,6 +210,8 @@ def run_sweep(
     monitor_keys: int = 0,
     shard_lanes: "bool | None" = None,
     checkpoint: "CheckpointSpec | str | None" = None,
+    pipeline_depth: int = 2,
+    narrow: bool = True,
 ) -> List[LaneResults]:
     """Run a sweep batch, sharded over ``mesh`` (default: all local
     devices on one axis). The device loop runs in ``segment_steps``
@@ -206,6 +220,32 @@ def run_sweep(
     ``monitor_keys > 0`` compiles the on-device safety monitors in
     (engine/monitor.py) and surfaces per-lane violation bitmasks
     through ``LaneResults`` — the schedule-fuzzing subsystem's path.
+
+    ``pipeline_depth`` keeps up to that many segments in flight
+    (parallel/pipeline.py): segment i+1 is dispatched immediately and
+    segment i−K+1's liveness flag is resolved only when its slot is
+    reused, so the per-call dispatch tax (~1 s over the tunnel,
+    docs/PERF.md) overlaps device execution instead of serializing with
+    it. ``pipeline_depth=1`` is the serial reference path — byte-
+    identical results, pinned in tests/test_pipeline.py. Checkpoint
+    boundaries and signal flushes drain the window before saving, so
+    durability semantics are unchanged and a kill mid-window loses at
+    most the in-flight window of device work.
+
+    ``narrow`` applies the dtype-narrowing pass (engine/spec.py
+    ``narrow_spec``): cold i32 state planes whose bounds the batch's
+    host-known command budget proves tiny are *stored* as i16/i8
+    between steps and widened inside the step, shrinking the bytes
+    every while-loop iteration moves through HBM (and every checkpoint
+    moves over the tunnel) without touching handler arithmetic —
+    results stay bit-identical to ``narrow=False``.
+
+    Buffer donation (the segment updating lane state in place instead
+    of allocating a second full copy per call) engages automatically
+    whenever the process is donation-safe — cache-free, see
+    engine/core.py :func:`~fantoch_tpu.engine.core.donation_safe` for
+    the jaxlib incompatibility it guards, ``FANTOCH_SWEEP_DONATE``
+    to force — and is byte-invisible in results either way.
 
     ``shard_lanes`` selects the lane-sharding contract:
 
@@ -230,9 +270,6 @@ def run_sweep(
     :class:`~fantoch_tpu.engine.checkpoint.SweepInterrupted` with the
     state saved; docs/CAMPAIGN.md covers cadence and guarantees.
     """
-    import os
-    import time as _t
-
     dbg = os.environ.get("FANTOCH_SWEEP_DEBUG")
     marks = [("start", _t.perf_counter())]
 
@@ -240,6 +277,29 @@ def run_sweep(
         if dbg:
             marks.append((label, _t.perf_counter()))
 
+    try:
+        return _run_sweep(
+            protocol, dims, specs, mesh, max_steps, segment_steps,
+            monitor_keys, shard_lanes, checkpoint, pipeline_depth,
+            narrow, mark,
+        )
+    finally:
+        # the per-phase timings land on EVERY exit path — an early
+        # interrupt (SweepInterrupted, a checkpoint refusal, a lane-
+        # mixing refusal) used to collect marks and then silently drop
+        # them with the normal-return print
+        if dbg and len(marks) > 1:
+            spans = ", ".join(
+                f"{label}={t1 - t0:.2f}s"
+                for (_, t0), (label, t1) in zip(marks, marks[1:])
+            )
+            print(f"[run_sweep {len(specs)} lanes] {spans}", flush=True)
+
+
+def _run_sweep(
+    protocol, dims, specs, mesh, max_steps, segment_steps, monitor_keys,
+    shard_lanes, checkpoint, pipeline_depth, narrow, mark,
+) -> List[LaneResults]:
     if mesh is None:
         devices = jax.devices()
         if shard_lanes is False:
@@ -280,6 +340,17 @@ def run_sweep(
 
     reorder_flag = batch_reorder_flag(padded)
     fault_flags = batch_fault_flags(padded)
+
+    # dtype narrowing (engine/spec.py): storage-narrow the cold counter
+    # planes the batch's host-known budgets bound, BEFORE the proof /
+    # signature / device_put — every consumer below sees one consistent
+    # storage format. The GL203 proof and the checkpoint signature
+    # still run on the wide per-lane state: they cover the step
+    # function, which computes in i32 either way.
+    nspec = narrow_spec(protocol, ctx) if narrow else ()
+    if nspec:
+        state = cast_state_planes(state, nspec, store=True)
+        mark("narrow")
 
     if shard_lanes:
         # the verified multichip path: refuse to shard a step that
@@ -329,6 +400,12 @@ def run_sweep(
                     for s in specs
                 }
             ),
+            # the storage-dtype spec of the saved state planes: a
+            # resume whose narrowing disagrees (different budgets, a
+            # narrow=False run, a pre-narrowing checkpoint) is refused
+            # BY NAME instead of dying on a carry-dtype mismatch deep
+            # inside the runner trace
+            "narrow": [list(e) for e in nspec],
             "specs": [
                 {
                     "n": s.config.n,
@@ -361,6 +438,19 @@ def run_sweep(
                 ck.path, signature=sig, ctx=ctx_host,
                 meta_expect={k: ckpt_meta[k] for k in expect_keys},
             )
+            # two-way narrowing compare (a pre-narrowing checkpoint's
+            # meta lacks the key and reads as un-narrowed — compatible
+            # with exactly an un-narrowed run): a disagreement in
+            # EITHER direction means the saved planes' storage dtypes
+            # are not what this runner's carry expects, so refuse by
+            # name instead of crashing in the trace
+            saved_narrow = loaded_meta.get("narrow") or []
+            if ckpt_meta["narrow"] != saved_narrow:
+                raise CheckpointMismatchError(
+                    f"checkpoint narrowing {saved_narrow!r} does not "
+                    f"match the current run's {ckpt_meta['narrow']!r} "
+                    "— resume with matching narrow settings/budgets"
+                )
             resume_until = int(loaded_meta["until"])
             mark("checkpoint_load")
 
@@ -368,9 +458,14 @@ def run_sweep(
     put = lambda tree: jax.tree_util.tree_map(
         lambda a: jax.device_put(a, sharding), tree
     )
+    # buffer donation engages whenever the process is donation-safe
+    # (cache-free — engine/core.py donation_safe; FANTOCH_SWEEP_DONATE
+    # overrides): segments then update the lane state in place instead
+    # of allocating + round-tripping a second full copy per call
+    donate = donation_safe()
     runner, alive = _cached_runner(
         protocol, dims, max_steps, reorder_flag,
-        fault_flags, monitor_keys,
+        fault_flags, monitor_keys, nspec, donate,
     )
     state = put(state)
     ctx = put(ctx)
@@ -393,16 +488,26 @@ def run_sweep(
         except ValueError:
             restores = []  # not the main thread: no signal flush
 
+    # the pipelined segment loop (parallel/pipeline.py): runner calls
+    # dispatch asynchronously, so up to `pipeline_depth` segments ride
+    # in flight and the per-call dispatch tax overlaps execution. When
+    # donation is engaged the runner consumes its input state on
+    # dispatch, so ONLY the freshly returned binding is live — the one
+    # consumer of a boundary state, the checkpoint save, takes an
+    # explicit undonated host copy (device_get) at a drained boundary
+    # before the next segment is dispatched, which keeps the loop
+    # correct under either donation setting.
     t_run = _t.perf_counter()
     until = resume_until
     segs_done = 0
+    window = SegmentWindow(pipeline_depth)
     try:
-        while until < max_steps:
+        while window.running and until < max_steps:
             until = min(until + segment_steps, max_steps)
             state, any_alive = runner(state, ctx, np.int32(until))
+            window.push(any_alive)
             segs_done += 1
-            running = bool(any_alive)
-            if ck is not None and running:
+            if ck is not None:
                 stop = None
                 if sig_seen["num"] is not None:
                     stop = f"signal {sig_seen['num']}"
@@ -417,17 +522,26 @@ def run_sweep(
                 ):
                     stop = "budget exhausted"
                 if stop is not None or segs_done % ck.every == 0:
+                    # durability boundary: drain the window so the
+                    # saved state is the determinate boundary state —
+                    # checkpoint semantics are identical to the serial
+                    # loop's, whatever the pipeline depth
+                    if not window.drain():
+                        continue  # batch just finished: nothing to save
                     save_sweep_checkpoint(
                         ck.path, state=jax.device_get(state),
                         ctx=ctx_host, signature=sig, until=until,
                         meta=ckpt_meta,
                     )
                     mark(f"checkpoint@{until}")
-                if stop is not None:
-                    raise SweepInterrupted(ck.path, until, stop)
-            if not running:
-                break
-            mark(f"segment@{until}")
+                    if stop is not None:
+                        raise SweepInterrupted(ck.path, until, stop)
+                    continue
+            # steady state: resolve only the flag whose slot the next
+            # dispatch needs — never block on the segment just issued
+            if window.poll():
+                mark(f"segment@{until}")
+        window.drain()
     finally:
         if restores:
             import signal as _signal
@@ -469,6 +583,10 @@ def run_sweep(
         fetch["viol"] = state["viol"]
         fetch["viol_step"] = state["viol_step"]
     final = finish_segmented(jax.device_get(fetch), max_steps)
+    # undo the storage narrowing on whatever narrowed planes the fetch
+    # carries: results are ALWAYS the wide i32 arrays the collectors
+    # and the byte-identity contracts predate narrowing with
+    final = cast_state_planes(final, nspec, store=False)
     mark("device_get")
     # the tail-padding seam: duplicate lanes were computed, but exactly
     # the caller's specs come back — never a padded twin's results
@@ -478,10 +596,4 @@ def run_sweep(
         f"specs (pad={pad}) — padding must never leak"
     )
     mark("collect")
-    if dbg:
-        spans = ", ".join(
-            f"{label}={t1 - t0:.2f}s"
-            for (_, t0), (label, t1) in zip(marks, marks[1:])
-        )
-        print(f"[run_sweep {len(specs)} lanes] {spans}", flush=True)
     return out

@@ -1,0 +1,361 @@
+"""Pipelined sweep segments + buffer donation + dtype narrowing
+(parallel/pipeline.py, run_sweep(pipeline_depth=, narrow=),
+engine/core.py build_segment_runner(donate=, narrow=)).
+
+The contracts under test:
+
+* pipelined dispatch (K segments in flight, liveness resolved on slot
+  reuse) produces **byte-identical** ``LaneResults`` to the serial
+  reference loop (``pipeline_depth=1``) — speculative segments past
+  the batch's end are fixed-point no-ops;
+* the dtype-narrowing pass (i16/i8 storage planes widened inside the
+  step) is invisible in results — ``narrow=True`` ≡ ``narrow=False``
+  byte-for-byte — and actually narrows something at the test shapes;
+* the segment runner really donates its input state (the buffer is
+  consumed, no silent fallback copy);
+* a checkpoint written under pipelining resumes bit-exactly (under
+  either depth), loses at most the in-flight window, and a narrowing
+  disagreement between writer and resumer is refused by name.
+
+Tier-1 pins tempo + basic; the full protocol matrix × both shard
+paths rides in the slow tier.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from fantoch_tpu.core import Config, Planet
+from fantoch_tpu.engine import EngineDims
+from fantoch_tpu.engine.checkpoint import (
+    CheckpointMismatchError,
+    CheckpointSpec,
+    SweepInterrupted,
+    checkpoint_exists,
+)
+from fantoch_tpu.engine.protocols import (
+    dev_config_kwargs,
+    dev_protocol,
+    partial_dev_protocol,
+)
+from fantoch_tpu.engine.spec import narrow_spec
+from fantoch_tpu.parallel.sweep import make_sweep_specs, run_sweep
+from fantoch_tpu.registry import DEV_PROTOCOLS, PARTIAL_DEV_PROTOCOLS
+
+COMMANDS = 2
+SEG = 8  # segments small enough that every lane spans several
+
+
+def _blob(results) -> str:
+    return json.dumps([r.to_json() for r in results], sort_keys=True)
+
+
+def _specs(name: str, conflicts=(0, 100), subsets=4, shards=1):
+    planet = Planet.new()
+    regions = planet.regions()
+    clients = 3
+    pool = 1
+    total = COMMANDS * clients
+    if shards > 1:
+        pool = 4
+        dev = partial_dev_protocol(name, clients, shards, pool_size=pool)
+        dims = EngineDims.for_partial(dev, 3, clients, total, regions=3)
+        base = Config(
+            **dev_config_kwargs(name, 3, 1),
+            shard_count=shards,
+            executor_executed_notification_interval_ms=100,
+            executor_cleanup_interval_ms=100,
+        )
+    else:
+        dev = dev_protocol(name, clients)
+        dims = EngineDims.for_protocol(
+            dev, n=3, clients=clients, payload=dev.payload_width(3),
+            total_commands=total, dot_slots=total + 1, regions=3,
+        )
+        base = Config(**dev_config_kwargs(name, 3, 1))
+    specs = make_sweep_specs(
+        dev,
+        planet,
+        region_sets=[regions[i : i + 3] for i in range(subsets)],
+        fs=[1],
+        conflicts=list(conflicts),
+        commands_per_client=COMMANDS,
+        clients_per_region=1,
+        dims=dims,
+        config_base=base,
+        pool_size=pool,
+    )
+    return dev, dims, specs
+
+
+# ----------------------------------------------------------------------
+# narrow-spec unit behavior (host only)
+# ----------------------------------------------------------------------
+
+
+def test_narrow_spec_bounds_pick_storage_dtypes():
+    dev, _dims, _specs_ = _specs("basic", subsets=1)
+    # tiny budgets: every candidate plane narrows to i8
+    ctx = {"cmd_budget": np.full((4, 3), 2, np.int32)}
+    spec = dict(narrow_spec(dev, ctx))
+    assert spec["clients/issued"] == "int8"
+    assert spec["metrics/hist"] == "int8"
+    assert spec["ps/m_fast_path"] == "int8"
+    assert spec["ps/m_stable"] == "int8"
+    # mid-size budgets: per-client counters fit i16 (2x headroom) but
+    # the lane total (3 x 12000, doubled) passes the i16 range, so the
+    # completion-count planes stay wide
+    ctx = {"cmd_budget": np.full((4, 3), 12_000, np.int32)}
+    spec = dict(narrow_spec(dev, ctx))
+    assert spec["clients/issued"] == "int16"
+    assert "metrics/hist" not in spec
+    assert "ps/m_stable" not in spec
+    # budgets past the i16 range (with headroom) keep every counter
+    # wide; only the budget-independent parts plane (bound = max cmd
+    # parts, 1 on single-shard lanes) still narrows
+    ctx = {"cmd_budget": np.full((4, 3), 20_000, np.int32)}
+    assert dict(narrow_spec(dev, ctx)) == {"clients/parts": "int8"}
+
+
+# ----------------------------------------------------------------------
+# pipelined ≡ serial, narrowed ≡ wide (tier-1: tempo + basic)
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", ["basic", "tempo"])
+def test_pipelined_and_narrowed_match_serial(name):
+    dev, dims, specs = _specs(name)
+    serial = run_sweep(
+        dev, dims, specs, segment_steps=SEG, pipeline_depth=1
+    )
+    ref = _blob(serial)
+    assert serial[0].completed == COMMANDS * 3 and not serial[0].err
+    for depth in (2, 3):
+        piped = run_sweep(
+            dev, dims, specs, segment_steps=SEG, pipeline_depth=depth
+        )
+        assert _blob(piped) == ref, f"pipeline_depth={depth} diverged"
+    wide = run_sweep(
+        dev, dims, specs, segment_steps=SEG, pipeline_depth=2,
+        narrow=False,
+    )
+    assert _blob(wide) == ref, "narrow=False diverged"
+
+
+# ----------------------------------------------------------------------
+# donation: the input state buffer is consumed, never fallback-copied
+# ----------------------------------------------------------------------
+
+# Donation and the persistent compile cache are mutually exclusive on
+# the current jaxlib (engine/core.py donation_safe): a warm-cache
+# process running a donated executable flakily corrupts the aliased
+# state. This pytest process enables the cache (conftest), so the
+# donated path is exercised in a CACHE-FREE SUBPROCESS — exactly how a
+# donation-safe production process would run it.
+_DONATION_SCRIPT = r"""
+import json
+import warnings
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from fantoch_tpu.core import Config, Planet
+from fantoch_tpu.engine import EngineDims
+from fantoch_tpu.engine.core import (
+    cast_state_planes,
+    donation_safe,
+    init_lane_state,
+)
+from fantoch_tpu.engine.faults import NO_FAULTS
+from fantoch_tpu.engine.protocols import dev_config_kwargs, dev_protocol
+from fantoch_tpu.engine.spec import narrow_spec, stack_lanes
+from fantoch_tpu.parallel.sweep import (
+    _cached_runner,
+    make_sweep_specs,
+    run_sweep,
+)
+
+assert donation_safe(), "cache-free subprocess must be donation-safe"
+
+planet = Planet.new()
+regions = planet.regions()
+clients = 3
+COMMANDS = 2
+dev = dev_protocol("basic", clients)
+total = COMMANDS * clients
+dims = EngineDims.for_protocol(
+    dev, n=3, clients=clients, payload=dev.payload_width(3),
+    total_commands=total, dot_slots=total + 1, regions=3,
+)
+base = Config(**dev_config_kwargs("basic", 3, 1))
+specs = make_sweep_specs(
+    dev, planet, region_sets=[regions[i:i + 3] for i in range(4)],
+    fs=[1], conflicts=[0, 100], commands_per_client=COMMANDS,
+    clients_per_region=1, dims=dims, config_base=base,
+)
+
+# 1) the donated runner really consumes its input (no fallback copy)
+ctx = stack_lanes(specs)
+nspec = narrow_spec(dev, ctx)
+assert nspec, "test shape must actually narrow something"
+states = [init_lane_state(dev, dims, s.ctx) for s in specs]
+state = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *states)
+state = cast_state_planes(state, nspec, store=True)
+mesh = Mesh(np.asarray(jax.devices()), ("sweep",))
+sharding = NamedSharding(mesh, PartitionSpec("sweep"))
+put = lambda t: jax.tree_util.tree_map(
+    lambda a: jax.device_put(a, sharding), t
+)
+state, ctx = put(state), put(ctx)
+probe = state["pool"]
+assert str(state["metrics"]["hist"].dtype) == "int8", "storage dtype"
+runner, _alive = _cached_runner(
+    dev, dims, 1 << 22, False, NO_FAULTS, 0, nspec, True
+)
+with warnings.catch_warnings(record=True) as caught:
+    warnings.simplefilter("always")
+    out, _running = runner(state, ctx, np.int32(8))
+    jax.block_until_ready(out)
+bad = [str(w.message) for w in caught if "donat" in str(w.message).lower()]
+assert not bad, f"donation fell back to a copy: {bad}"
+assert probe.is_deleted(), "input state survived the segment call"
+assert str(out["metrics"]["hist"].dtype) == "int8"
+
+# 2) donated + pipelined + narrowed run_sweep == undonated serial,
+#    byte for byte
+blob = lambda rs: json.dumps([r.to_json() for r in rs], sort_keys=True)
+donated = run_sweep(dev, dims, specs, segment_steps=8, pipeline_depth=2)
+import os
+os.environ["FANTOCH_SWEEP_DONATE"] = "0"
+undonated = run_sweep(dev, dims, specs, segment_steps=8, pipeline_depth=1)
+assert blob(donated) == blob(undonated), "donated path diverged"
+assert donated[0].completed == COMMANDS * 3 and not donated[0].err
+print("DONATION-OK")
+"""
+
+
+def test_segment_runner_donates_state_cache_free_subprocess():
+    import os
+    import subprocess
+    import sys
+
+    import fantoch_tpu
+
+    repo = os.path.dirname(os.path.dirname(fantoch_tpu.__file__))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    # no enable_compile_cache in the child and no cache env: the
+    # process stays cache-free, so donation_safe() engages
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    env.pop("FANTOCH_SWEEP_DONATE", None)
+    if "xla_force_host_platform_device_count" not in env.get(
+        "XLA_FLAGS", ""
+    ):
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    out = subprocess.run(
+        [sys.executable, "-c", _DONATION_SCRIPT],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        env=env,
+    )
+    assert out.returncode == 0, (out.stdout, out.stderr[-2000:])
+    assert "DONATION-OK" in out.stdout, (out.stdout, out.stderr[-2000:])
+
+
+# ----------------------------------------------------------------------
+# checkpoint under pipelining
+# ----------------------------------------------------------------------
+
+
+def test_checkpoint_under_pipeline_resumes_bit_exact(tmp_path):
+    dev, dims, specs = _specs("basic")
+    control = run_sweep(
+        dev, dims, specs, segment_steps=SEG, pipeline_depth=1
+    )
+    ck = str(tmp_path / "ck")
+    # kill (deterministically) mid-window: stop after ONE counted
+    # segment while a second rides in flight (depth 2). The save drains
+    # the window first, so the artifact records a determinate boundary…
+    with pytest.raises(SweepInterrupted) as e:
+        run_sweep(
+            dev, dims, specs, segment_steps=SEG, pipeline_depth=2,
+            checkpoint=CheckpointSpec(path=ck, stop_after_segments=1),
+        )
+    assert e.value.reason == "segment-limit"
+    assert checkpoint_exists(ck)
+    # …and loses at most the in-flight window: the saved boundary is
+    # within pipeline_depth segments of the stop point
+    until = e.value.until
+    assert until <= 2 * SEG, until
+    # resume under the OTHER depth — drained boundaries are depth-
+    # agnostic, so checkpoints interchange freely
+    resumed = run_sweep(
+        dev, dims, specs, segment_steps=SEG, pipeline_depth=3,
+        checkpoint=CheckpointSpec(path=ck),
+    )
+    assert not checkpoint_exists(ck)
+    assert _blob(resumed) == _blob(control)
+
+
+def test_narrowing_disagreement_refused_by_name(tmp_path):
+    dev, dims, specs = _specs("basic")
+    ck = str(tmp_path / "ck")
+    with pytest.raises(SweepInterrupted):
+        run_sweep(
+            dev, dims, specs, segment_steps=SEG,
+            checkpoint=CheckpointSpec(path=ck, stop_after_segments=1),
+        )
+    # a narrow-saved checkpoint must not resume into an un-narrowed
+    # runner (the saved planes are i8/i16; the carry would mismatch) —
+    # refusal, by name, not a trace error
+    with pytest.raises(CheckpointMismatchError, match="narrow"):
+        run_sweep(
+            dev, dims, specs, segment_steps=SEG, narrow=False,
+            checkpoint=CheckpointSpec(path=ck),
+        )
+
+
+# ----------------------------------------------------------------------
+# the full matrix (slow tier: compiles)
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("shard", [False, True])
+@pytest.mark.parametrize("name", DEV_PROTOCOLS)
+def test_pipelined_matches_serial_full_protocols(name, shard):
+    dev, dims, specs = _specs(name, subsets=2)
+    serial = run_sweep(
+        dev, dims, specs, segment_steps=SEG, pipeline_depth=1,
+        shard_lanes=shard,
+    )
+    for depth in (2, 3):
+        piped = run_sweep(
+            dev, dims, specs, segment_steps=SEG, pipeline_depth=depth,
+            shard_lanes=shard,
+        )
+        assert _blob(piped) == _blob(serial), (name, shard, depth)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("shard", [False, True])
+@pytest.mark.parametrize("name", PARTIAL_DEV_PROTOCOLS)
+def test_pipelined_matches_serial_partial_twins(name, shard):
+    dev, dims, specs = _specs(name, conflicts=(50, 100), subsets=2,
+                              shards=2)
+    serial = run_sweep(
+        dev, dims, specs, segment_steps=SEG, pipeline_depth=1,
+        shard_lanes=shard,
+    )
+    for depth in (2, 3):
+        piped = run_sweep(
+            dev, dims, specs, segment_steps=SEG, pipeline_depth=depth,
+            shard_lanes=shard,
+        )
+        assert _blob(piped) == _blob(serial), (name, shard, depth)
