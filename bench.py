@@ -104,6 +104,28 @@ MSSTEP_LANES = tuple(
 )
 MSSTEP_STEPS = int(_os.environ.get("FANTOCH_BENCH_MSSTEP_STEPS", "128"))
 
+# mesh_shard self-check shape (parallel/partition.py): the small tempo
+# grid run through the explicit shard_map partitioning —
+# sweep_points_per_sec at the same shape, different execution layout.
+# The shard_map runner is its own compile, so it rides behind a budget
+# guard like the other self-checks.
+MESH_SUBSETS = int(_os.environ.get("FANTOCH_BENCH_MESH_SUBSETS", "2"))
+MESH_MIN_BUDGET_S = float(
+    _os.environ.get("FANTOCH_BENCH_MESH_MIN_BUDGET", "300")
+)
+
+# fleet self-check shape (fantoch_tpu/fleet): a small tempo campaign
+# grid (2 subsets × 2 conflicts, batch_lanes=1 → 4 lease units) drained
+# by subprocess fleet workers — 2-worker vs 1-worker units/sec, with
+# one untimed 1-worker pass first so the persistent compile cache is
+# warm and the timed runs measure orchestration, not XLA
+FLEET_COMMANDS = int(_os.environ.get("FANTOCH_BENCH_FLEET_COMMANDS", "10"))
+FLEET_SEGMENT = int(_os.environ.get("FANTOCH_BENCH_FLEET_SEGMENT", "2048"))
+FLEET_UNITS = 4
+FLEET_MIN_BUDGET_S = float(
+    _os.environ.get("FANTOCH_BENCH_FLEET_MIN_BUDGET", "420")
+)
+
 # traffic-schedule self-check shape (fantoch_tpu/traffic): lanes whose
 # epoch tables are timed host-side, and the small tempo sweep measured
 # flat vs diurnal (the diurnal trace is a separate compile, so the
@@ -370,6 +392,129 @@ def _ms_per_step(lanes: int) -> "float | None":
         traceback.print_exc()
         print(f"bench: ms/step@{lanes} unavailable: {e!r}",
               file=sys.stderr)
+        return None
+
+
+def _mesh_shard_rate() -> "float | None":
+    """sweep_points_per_sec through the explicit shard_map partition
+    layout (run_sweep(mesh_shard=True)) on a small tempo grid: one
+    warmup (compile + GL203 proof) then one timed run. Degrades to
+    None, never an exception — a LaneMixingError here is a real
+    finding and lands on stderr."""
+    import sys
+
+    try:
+        from fantoch_tpu.parallel.sweep import run_sweep as _run
+
+        planet = Planet.new()
+        region_sets = _region_subsets(planet, MESH_SUBSETS)
+        clients = N * CLIENTS_PER_REGION
+        dev, base = _build("tempo", clients)
+        dims = _bench_dims(dev)
+        specs = make_sweep_specs(
+            dev, planet, region_sets=region_sets, fs=FS,
+            conflicts=CONFLICTS, commands_per_client=COMMANDS,
+            clients_per_region=CLIENTS_PER_REGION, dims=dims,
+            config_base=base,
+        )
+        specs.sort(key=lambda s: (s.config.f, int(s.ctx["conflict_rate"])))
+        _run(dev, dims, specs, mesh_shard=True)  # warmup + proof
+        t0 = time.perf_counter()
+        results = _run(dev, dims, specs, mesh_shard=True)
+        dt = time.perf_counter() - t0
+        bad = [r.err_cause for r in results if r.err]
+        assert not bad, f"mesh_shard self-check failing lanes: {bad[:4]}"
+        return len(specs) / dt
+    except Exception as e:  # noqa: BLE001
+        import traceback
+
+        traceback.print_exc()
+        print(f"bench: mesh_shard rate unavailable: {e!r}",
+              file=sys.stderr)
+        return None
+
+
+def _fleet_units() -> "tuple[float, float, str | None] | None":
+    """Fleet orchestration throughput (fantoch_tpu/fleet): drain a
+    FLEET_UNITS-unit tempo campaign with subprocess fleet workers —
+    (1-worker units/s, 2-worker units/s, identity-note). One untimed
+    1-worker pass warms the persistent compile cache first; the merged
+    2-worker results must be byte-identical to the 1-worker control
+    (a divergence surfaces as a distinguishable IDENTITY-VIOLATION
+    note, not a silent number)."""
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+
+    try:
+        grid = json.dumps(
+            {
+                "kind": "sweep",
+                "protocols": ["tempo"],
+                "ns": [3],
+                "conflicts": [0, 100],
+                "subsets": 2,
+                "commands_per_client": FLEET_COMMANDS,
+                "batch_lanes": 1,
+                "segment_steps": FLEET_SEGMENT,
+            }
+        )
+        platform = (
+            "cpu" if _os.environ.get("JAX_PLATFORMS") == "cpu" else "auto"
+        )
+        tmp = tempfile.mkdtemp(prefix="fantoch_fleet_bench_")
+
+        def drain(dirname: str, workers: int) -> float:
+            d = _os.path.join(tmp, dirname)
+            cmd = [
+                sys.executable, "-m", "fantoch_tpu",
+                "--platform", platform, "fleet", "--dir", d,
+                "--grid", grid, "--workers", str(workers),
+            ]
+            t0 = time.perf_counter()
+            res = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=900
+            )
+            dt = time.perf_counter() - t0
+            if res.returncode != 0:
+                raise RuntimeError(
+                    f"{workers}-worker fleet rc={res.returncode}: "
+                    f"{res.stderr[-400:]}"
+                )
+            return dt
+
+        try:
+            drain("warm", 1)  # compile-cache warmup, untimed
+            t_solo = drain("solo", 1)
+            t_duo = drain("duo", 2)
+            from fantoch_tpu.fleet import merge_campaign
+
+            note = None
+            for dirname in ("solo", "duo"):
+                m = merge_campaign(_os.path.join(tmp, dirname))
+                assert m["merged"] and m["errors"] == 0, m
+            with open(
+                _os.path.join(tmp, "solo", "results.jsonl"), "rb"
+            ) as fh:
+                solo_bytes = fh.read()
+            with open(
+                _os.path.join(tmp, "duo", "results.jsonl"), "rb"
+            ) as fh:
+                duo_bytes = fh.read()
+            if solo_bytes != duo_bytes:
+                note = (
+                    "IDENTITY-VIOLATION: 2-worker merged results "
+                    "diverged from the 1-worker control"
+                )
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+        return FLEET_UNITS / t_solo, FLEET_UNITS / t_duo, note
+    except Exception as e:  # noqa: BLE001
+        import traceback
+
+        traceback.print_exc()
+        print(f"bench: fleet units/s unavailable: {e!r}", file=sys.stderr)
         return None
 
 
@@ -689,6 +834,52 @@ def main() -> None:
                     flush=True,
                 )
 
+    # mesh partitioning (parallel/partition.py): the same small-grid
+    # rate through the explicit shard_map layout; budget-guarded (the
+    # partitioned runner is its own compile), honest-zero on skip/fail
+    mesh_rate, mesh_note = None, None
+    if TOTAL_BUDGET_S - _since_birth() < MESH_MIN_BUDGET_S:
+        mesh_note = "skipped: insufficient budget for the mesh_shard compile"
+        print(f"mesh_shard self-check {mesh_note}", file=sys.stderr,
+              flush=True)
+    else:
+        mesh_rate = _mesh_shard_rate()
+        if mesh_rate is None:
+            mesh_note = "failed (see stderr)"
+        else:
+            print(
+                f"mesh_shard self-check: {mesh_rate:.2f} points/s "
+                f"({len(jax.devices())}-device shard_map)",
+                file=sys.stderr,
+                flush=True,
+            )
+
+    # fleet orchestration (fantoch_tpu/fleet): 1- vs 2-worker
+    # subprocess drains of a small campaign grid; budget-guarded (two
+    # extra subprocess runs + a possible cold compile), honest-zero on
+    # skip/fail, byte-identity tripwire like the dispatch self-check
+    fleet_rates, fleet_note = None, None
+    if TOTAL_BUDGET_S - _since_birth() < FLEET_MIN_BUDGET_S:
+        fleet_note = (
+            "skipped: insufficient budget for the fleet subprocess runs"
+        )
+        print(f"fleet self-check {fleet_note}", file=sys.stderr,
+              flush=True)
+    else:
+        fleet_rates = _fleet_units()
+        if fleet_rates is None:
+            fleet_note = "failed (see stderr)"
+        elif fleet_rates[2] is not None:
+            fleet_note, fleet_rates = fleet_rates[2], None
+        else:
+            print(
+                f"fleet self-check: {fleet_rates[0]:.2f} units/s solo "
+                f"vs {fleet_rates[1]:.2f} units/s 2-worker "
+                "(merged byte-identical)",
+                file=sys.stderr,
+                flush=True,
+            )
+
     # durability tax: one checkpointed segment's save+restore+compare
     # (device-state fetch excluded — measured on host arrays)
     ckpt_s = _checkpoint_roundtrip()
@@ -782,6 +973,24 @@ def main() -> None:
                     if v is not None
                 },
                 "msstep_lanes": list(MSSTEP_LANES),
+                # the explicit shard_map layout at the small-grid shape
+                # (0.0 = skipped/failed; note carries the reason)
+                "sweep_points_per_sec_mesh_shard": (
+                    round(mesh_rate, 2) if mesh_rate is not None else 0.0
+                ),
+                **({"mesh_shard_note": mesh_note} if mesh_note else {}),
+                # subprocess fleet drain of a FLEET_UNITS-unit campaign
+                # (0.0 = skipped/failed; note carries the reason — an
+                # IDENTITY-VIOLATION note means the 2-worker merge
+                # diverged from the 1-worker control)
+                "fleet_units_per_sec": (
+                    round(fleet_rates[1], 3) if fleet_rates else 0.0
+                ),
+                "fleet_units_per_sec_single": (
+                    round(fleet_rates[0], 3) if fleet_rates else 0.0
+                ),
+                "fleet_units": FLEET_UNITS,
+                **({"fleet_note": fleet_note} if fleet_note else {}),
                 **(
                     {"static_kernel_cost": static_cost}
                     if static_cost
@@ -946,6 +1155,12 @@ def _emit_unreachable(reason: str = "unreachable at startup") -> None:
                 "ms_per_step_2048": 0.0,
                 "ms_per_step_measured": {},
                 "msstep_lanes": list(MSSTEP_LANES),
+                "sweep_points_per_sec_mesh_shard": 0.0,
+                "mesh_shard_note": f"skipped: TPU backend {reason}",
+                "fleet_units_per_sec": 0.0,
+                "fleet_units_per_sec_single": 0.0,
+                "fleet_units": FLEET_UNITS,
+                "fleet_note": f"skipped: TPU backend {reason}",
                 **(
                     {"static_kernel_cost": static_cost}
                     if static_cost
@@ -976,6 +1191,13 @@ _CPU_FALLBACK_ENV = {
     "FANTOCH_BENCH_DISPATCH_SEGMENT": "4",
     "FANTOCH_BENCH_MSSTEP_LANES": "16,64",
     "FANTOCH_BENCH_MSSTEP_STEPS": "32",
+    # fleet + mesh_shard self-checks on the host mesh: tiny units (the
+    # subprocess workers pay CLI + jax startup per run, so the unit
+    # compute must not dominate the orchestration being measured) and
+    # a single-subset mesh grid
+    "FANTOCH_BENCH_FLEET_COMMANDS": "5",
+    "FANTOCH_BENCH_FLEET_SEGMENT": "256",
+    "FANTOCH_BENCH_MESH_SUBSETS": "1",
 }
 
 # below this remaining total budget a CPU fallback run cannot plausibly

@@ -104,6 +104,13 @@ class SweepCampaign:
     # determinate boundaries.
     pipeline_depth: int = 2
     shard_lanes: Optional[bool] = None
+    # explicit shard_map partitioning of each unit's lane batch over
+    # the named device mesh (parallel/partition.py; GL203-gated like
+    # shard_lanes). Like pipeline_depth, NOT a checkpoint meta key —
+    # a unit checkpointed under one layout resumes under the other
+    # bit-exactly, so fleet workers on heterogeneous device counts
+    # still interchange units.
+    mesh_shard: Optional[bool] = None
     aws: bool = False
 
     kind = "sweep"
@@ -225,8 +232,10 @@ def _append_journal(path: str, entry: dict) -> None:
         os.fsync(fh.fileno())
 
 
-def _read_journal(path: str) -> List[dict]:
-    jpath = os.path.join(path, _JOURNAL)
+def _read_journal_file(jpath: str) -> List[dict]:
+    """One journal file's entries, tolerating a torn FINAL line (the
+    shared crash contract of the single-process journal and every
+    fleet worker journal — fleet/worker.py reads each through this)."""
     if not os.path.exists(jpath):
         return []
     entries: List[dict] = []
@@ -244,10 +253,14 @@ def _read_journal(path: str) -> List[dict]:
                 # real problem and must surface
                 break
             raise CampaignError(
-                f"campaign journal corrupted at line {i + 1} (only the "
-                "final line may be torn)"
+                f"campaign journal {jpath} corrupted at line {i + 1} "
+                "(only the final line may be torn)"
             )
     return entries
+
+
+def _read_journal(path: str) -> List[dict]:
+    return _read_journal_file(os.path.join(path, _JOURNAL))
 
 
 def _atomic_write(path: str, text: str) -> None:
@@ -458,6 +471,7 @@ def _run_sweep_campaign(path: str, spec: SweepCampaign, deadline,
                 max_steps=spec.max_steps,
                 segment_steps=spec.segment_steps,
                 shard_lanes=spec.shard_lanes,
+                mesh_shard=bool(spec.mesh_shard),
                 checkpoint=ck,
                 pipeline_depth=spec.pipeline_depth,
             )

@@ -37,7 +37,7 @@ ORACLE_PROTOCOLS = ENGINE_PROTOCOLS + ("tempo_atomic",)
 # fuzzing — artifact replay is host-only and handled in main(); plain
 # "bote" is the closed-form search, but "bote --validate" runs
 # measured device campaigns and is routed as bote-validate)
-DEVICE_COMMANDS = ("sweep", "mc", "campaign", "bote-validate")
+DEVICE_COMMANDS = ("sweep", "mc", "campaign", "fleet", "bote-validate")
 
 # cli.py campaign exit code when a campaign stops with work remaining
 # (budget/signal/segment-limit): state is durably checkpointed, re-run
@@ -330,11 +330,20 @@ def cmd_sweep(args) -> None:
         faults=fault_plans,
         traffic=traffic,
     )
-    results = run_sweep(
-        dev, dims, specs,
-        shard_lanes=True if args.shard_lanes else None,
-        pipeline_depth=args.pipeline_depth,
-    )
+    from .parallel.sweep import LaneMixingError
+
+    try:
+        results = run_sweep(
+            dev, dims, specs,
+            shard_lanes=True if args.shard_lanes else None,
+            mesh_shard=args.mesh_shard,
+            pipeline_depth=args.pipeline_depth,
+        )
+    except LaneMixingError as e:
+        # the GL203 gate: a step that mixes lanes must never be
+        # partitioned — refusal, not a wrong answer
+        print(f"sweep refused: {e}", file=sys.stderr)
+        raise SystemExit(2)
     errs = sum(1 for r in results if r.err)
     summary = {
         "protocol": args.protocol,
@@ -536,6 +545,138 @@ def cmd_campaign(args) -> None:
             "is checkpointed — re-run with --resume to continue",
             file=sys.stderr,
         )
+        raise SystemExit(EXIT_INTERRUPTED)
+
+
+def _spawn_fleet_workers(args, grid_text) -> "tuple[bool, bool]":
+    """The ``--workers N`` convenience mode: N subprocess workers
+    drain the campaign concurrently, re-spawned in rounds while they
+    make progress (a round where a worker dies or exits with units
+    still leased leaves reclaimable work for the next). Returns
+    ``(done, refused)``."""
+    import subprocess
+    import sys as _sys
+
+    base = [
+        _sys.executable, "-m", "fantoch_tpu",
+        "--platform", args.platform, "fleet", "--dir", args.dir,
+    ]
+    if args.ttl_s is not None:
+        base += ["--ttl-s", str(args.ttl_s)]
+    if args.budget_s is not None:
+        base += ["--budget-s", str(args.budget_s)]
+    done = False
+    for round_no in range(5):
+        cmds = []
+        for i in range(args.workers):
+            cmd = list(base) + ["--worker-id", f"w{i}"]
+            # only the first touch needs the grid; later rounds (and
+            # late-starting workers) resume the stored campaign.json
+            if grid_text and round_no == 0:
+                cmd += ["--grid", grid_text]
+            cmds.append(cmd)
+        procs = [subprocess.Popen(c) for c in cmds]
+        rcs = [p.wait() for p in procs]
+        print(
+            f"fleet round {round_no + 1}: worker exits {rcs}",
+            file=sys.stderr,
+        )
+        if any(rc == 2 for rc in rcs):
+            return False, True
+        if any(rc == 0 for rc in rcs):
+            done = True
+            break
+        if all(rc not in (0, EXIT_INTERRUPTED) for rc in rcs):
+            # every worker crashed outright — re-spawning would loop
+            return False, True
+    return done, False
+
+
+def cmd_fleet(args) -> None:
+    """Lease-sharded multi-worker campaigns (fantoch_tpu/fleet,
+    docs/FLEET.md): workers claim grid units from a shared campaign
+    dir via atomic-rename leases with heartbeat TTLs, journal into
+    worker-scoped journals, and any worker resumes any abandoned
+    unit's signed checkpoint; ``--merge`` writes the deterministic
+    merged output (byte-identical to a 1-worker control). Exits 0
+    done, EXIT_INTERRUPTED (75) with work remaining, 2 refused."""
+    from .campaign import CampaignError, campaign_from_json
+    from .engine.checkpoint import CheckpointError
+    from .fleet import (
+        DEFAULT_TTL_S,
+        FleetError,
+        merge_campaign,
+        run_fleet_worker,
+    )
+
+    grid_text = None
+    spec = None
+    if args.grid:
+        grid_text = args.grid
+        if grid_text.startswith("@"):
+            with open(grid_text[1:]) as fh:
+                grid_text = fh.read()
+        try:
+            spec = campaign_from_json(json.loads(grid_text))
+        except (ValueError, CampaignError) as e:
+            raise SystemExit(f"bad --grid spec: {e}")
+    if args.workers and args.worker_id:
+        raise SystemExit("--workers spawns its own worker ids; drop "
+                         "--worker-id")
+    if not (args.workers or args.worker_id or args.merge):
+        raise SystemExit("fleet needs --workers N, --worker-id ID, "
+                         "and/or --merge")
+
+    done = True
+    try:
+        if args.worker_id:
+            summary = run_fleet_worker(
+                args.dir,
+                spec,
+                worker_id=args.worker_id,
+                budget_s=args.budget_s,
+                ttl_s=(
+                    args.ttl_s if args.ttl_s is not None
+                    else DEFAULT_TTL_S
+                ),
+                stop_after_units=args.stop_after_units,
+                stop_after_segments=args.stop_after_segments,
+            )
+            print(json.dumps(summary))
+            done = summary["done"]
+            if not done:
+                reason = summary["interrupted"] or "units leased elsewhere"
+                print(
+                    f"fleet worker stopped ({reason}); every completed "
+                    "unit is journaled — re-run (any worker id) to "
+                    "continue",
+                    file=sys.stderr,
+                )
+        elif args.workers:
+            done, refused = _spawn_fleet_workers(args, grid_text)
+            if refused:
+                print(
+                    "fleet refused or crashed in a worker (see above)",
+                    file=sys.stderr,
+                )
+                raise SystemExit(2)
+        if args.merge:
+            merged = merge_campaign(args.dir)
+            print(json.dumps(merged))
+            if not merged["merged"]:
+                print(
+                    "fleet merge incomplete: units missing — run more "
+                    "workers, then --merge again",
+                    file=sys.stderr,
+                )
+                raise SystemExit(EXIT_INTERRUPTED)
+            return
+    except (CheckpointError, CampaignError, FleetError, ValueError) as e:
+        # refusal, not recovery: stale/corrupt checkpoints, campaign
+        # disagreements, bad worker ids, conflicting journals — named
+        print(f"fleet refused: {type(e).__name__}: {e}", file=sys.stderr)
+        raise SystemExit(2)
+    if not done:
         raise SystemExit(EXIT_INTERRUPTED)
 
 
@@ -1057,6 +1198,15 @@ def main(argv=None) -> None:
         "mesh; refuses to run if the proof fails",
     )
     sw.add_argument(
+        "--mesh-shard",
+        action="store_true",
+        help="explicit shard_map partitioning of the lane batch over "
+        "the named device mesh (parallel/partition.py): the lane-axis "
+        "split is part of the program, gated by the same GL203 "
+        "lane-independence proof as --shard-lanes and bit-identical "
+        "to the single-device reference (refuses mixing steps, exit 2)",
+    )
+    sw.add_argument(
         "--pipeline-depth",
         type=int,
         default=2,
@@ -1140,6 +1290,53 @@ def main(argv=None) -> None:
                     help="deterministic-interruption test hook: "
                     "checkpoint and exit 75 after N sweep segments")
     ca.set_defaults(fn=cmd_campaign)
+
+    fl = sub.add_parser(
+        "fleet",
+        help="lease-sharded multi-worker campaigns over one shared "
+        "campaign dir (docs/FLEET.md): preemptible workers claim, "
+        "checkpoint, resume and journal units; --merge writes the "
+        "deterministic merged results",
+    )
+    fl.add_argument("--dir", required=True,
+                    help="shared campaign directory (spec, leases, "
+                    "worker journals, checkpoints, merged results)")
+    fl.add_argument("--grid", default=None,
+                    help="campaign spec: JSON object or @file (same "
+                    "schema as `campaign --grid`, incl. sweep-grid "
+                    '"mesh_shard": true); required on first touch, '
+                    "optional-but-verified afterwards")
+    fl.add_argument("--worker-id", default=None,
+                    help="run ONE worker loop in this process under "
+                    "this id ([A-Za-z0-9_-], docs/FLEET.md worker-id "
+                    "rules); exits 0 when the whole grid is journaled, "
+                    "75 with work remaining")
+    fl.add_argument("--workers", type=int, default=None,
+                    help="convenience mode: spawn N subprocess workers "
+                    "(ids w0..wN-1) and wait; re-spawns in rounds "
+                    "while progress is possible")
+    fl.add_argument("--budget-s", type=float, default=None,
+                    help="per-worker wall-clock budget: at least one "
+                    "unit of progress, then checkpoint + release at "
+                    "the next boundary")
+    fl.add_argument("--ttl-s", type=float, default=None,
+                    help="lease TTL seconds (default "
+                    "fleet.DEFAULT_TTL_S); a dead worker's unit is "
+                    "reclaimable once its lease mtime is older than "
+                    "this — heartbeats refresh it at TTL/4")
+    fl.add_argument("--merge", action="store_true",
+                    help="after any workers finish: merge every worker "
+                    "journal into the canonical results.jsonl/"
+                    "summary.json (byte-identical to a 1-worker "
+                    "control); exits 75 if units are missing")
+    fl.add_argument("--stop-after-units", type=int, default=None,
+                    help="test hook: stop this worker after N "
+                    "completed units")
+    fl.add_argument("--stop-after-segments", type=int, default=None,
+                    help="test hook: interrupt each claimed sweep unit "
+                    "after N segments (checkpoint durable, lease "
+                    "released — the unit returns to the pool)")
+    fl.set_defaults(fn=cmd_fleet)
 
     ln = sub.add_parser(
         "lint",

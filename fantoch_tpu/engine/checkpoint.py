@@ -243,8 +243,12 @@ def atomic_write(path: str, data: "bytes | str") -> None:
     fsync, then atomic rename. The one implementation every durable
     artifact in the repo shares (checkpoint payload/manifest, campaign
     journal side-files, fuzz repro artifacts) so a crash-safety fix
-    lands everywhere at once."""
-    tmp = path + ".tmp"
+    lands everywhere at once. The temp name is pid-unique: concurrent
+    fleet workers first-touching one campaign dir (fleet/worker.py)
+    race this write with IDENTICAL bytes, and a shared temp name would
+    let one writer rename the other's half-written file into place (or
+    crash on the vanished temp)."""
+    tmp = f"{path}.{os.getpid()}.tmp"
     mode = "wb" if isinstance(data, bytes) else "w"
     with open(tmp, mode) as fh:
         fh.write(data)

@@ -1289,6 +1289,55 @@ def donation_safe() -> bool:
     )
 
 
+def segment_lane_fn(
+    protocol, dims: EngineDims, max_steps: int = 1 << 22,
+    reorder: bool = False, faults: FaultFlags = NO_FAULTS,
+    monitor_keys: int = 0, narrow: tuple = (),
+):
+    """The per-lane bounded-segment function the batched runners share:
+    ``run_lane(st, ctx, until) -> (state, running)`` advances ONE lane
+    by at most ``until - steps`` steps. :func:`build_segment_runner`
+    vmaps it under plain ``jax.jit`` (single-device / NamedSharding),
+    and ``parallel/partition.py`` vmaps the identical function per
+    shard inside a ``shard_map`` over a named device mesh — both paths
+    therefore trace the exact same per-lane step, which is what keeps
+    the checkpoint signature (engine/checkpoint.py hashes this very
+    trace) and the GL005 gating pin stable across execution layouts."""
+    _check_monitorable(protocol, monitor_keys)
+
+    def run_lane(st, ctx, until):
+        lim = jnp.minimum(until, max_steps)
+
+        def body(s):
+            wide = cast_state_planes(s, narrow, store=False)
+            out = _lane_step(
+                protocol, dims, wide, ctx, reorder, faults, monitor_keys
+            )
+            return cast_state_planes(out, narrow, store=True)
+
+        # the loop condition reads only per-lane scalars (done_time,
+        # now, err, steps) — never a narrowed plane
+        out = jax.lax.while_loop(
+            lambda s: _lane_running(dims, s, ctx, max_steps, faults)
+            & (s["steps"] < lim),
+            body,
+            st,
+        )
+        running = _lane_running(dims, out, ctx, max_steps, faults)
+        if monitor_keys:
+            # idempotent per segment: a finished lane's state is frozen,
+            # so re-running the end-of-lane reduction only re-derives
+            # the same bits; running lanes keep their in-run bits
+            wide = cast_state_planes(out, narrow, store=False)
+            wide = monitor.finalize_lane(
+                protocol, dims, wide, ctx, faults, running=running
+            )
+            out = cast_state_planes(wide, narrow, store=True)
+        return out, running
+
+    return run_lane
+
+
 def build_segment_runner(
     protocol, dims: EngineDims, max_steps: int = 1 << 22,
     reorder: bool = False, faults: FaultFlags = NO_FAULTS,
@@ -1333,37 +1382,10 @@ def build_segment_runner(
     driver does) — the current jaxlib corrupts donated state in
     warm-cache processes."""
 
-    _check_monitorable(protocol, monitor_keys)
-
-    def run_lane(st, ctx, until):
-        lim = jnp.minimum(until, max_steps)
-
-        def body(s):
-            wide = cast_state_planes(s, narrow, store=False)
-            out = _lane_step(
-                protocol, dims, wide, ctx, reorder, faults, monitor_keys
-            )
-            return cast_state_planes(out, narrow, store=True)
-
-        # the loop condition reads only per-lane scalars (done_time,
-        # now, err, steps) — never a narrowed plane
-        out = jax.lax.while_loop(
-            lambda s: _lane_running(dims, s, ctx, max_steps, faults)
-            & (s["steps"] < lim),
-            body,
-            st,
-        )
-        running = _lane_running(dims, out, ctx, max_steps, faults)
-        if monitor_keys:
-            # idempotent per segment: a finished lane's state is frozen,
-            # so re-running the end-of-lane reduction only re-derives
-            # the same bits; running lanes keep their in-run bits
-            wide = cast_state_planes(out, narrow, store=False)
-            wide = monitor.finalize_lane(
-                protocol, dims, wide, ctx, faults, running=running
-            )
-            out = cast_state_planes(wide, narrow, store=True)
-        return out, running
+    run_lane = segment_lane_fn(
+        protocol, dims, max_steps, reorder, faults, monitor_keys,
+        narrow=narrow,
+    )
 
     def run_batch(st, ctx, until):
         out, alive = jax.vmap(run_lane, in_axes=(0, 0, None))(

@@ -1,0 +1,136 @@
+"""Deterministic merge of fleet worker journals.
+
+Completion order across workers is racy by nature; the merge erases
+it. Completed units are collected from **every** journal in the
+campaign dir (legacy single-process journal included), validated —
+duplicate completions of one unit must agree exactly, or the merge
+refuses (``FleetError``) — and written in the **canonical unit
+enumeration order** (the same deterministic ``_sweep_batches`` /
+point enumeration the workers claimed from). The result:
+
+* a sweep campaign's merged ``results.jsonl`` is **byte-identical**
+  between a 1-worker control and any N-worker, any-interleaving,
+  any-kill-pattern fleet run — and byte-identical to the
+  single-process ``cli.py campaign`` output for the same grid, since
+  both write the same lines in the same order;
+* a fuzz campaign's merged ``summary.json`` carries each point's
+  final cumulative state (counters, artifacts, violations — no
+  wall-clock fields), equally worker-count-invariant.
+
+What the merge does NOT guarantee: it never *completes* work (missing
+units ⇒ ``merged: False`` and no results file — run more workers), it
+cannot merge across campaign specs (the stored ``campaign.json`` is
+the single source of the unit enumeration), and it inherits the
+checkpoint layer's version posture — journals written under a
+different protocol/engine build are not detectable here (the refusal
+happened earlier, at unit resume time, via the signed checkpoints).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List
+
+from .worker import (
+    fuzz_point_progress,
+    fuzz_points,
+    read_all_journals,
+    sweep_done_units,
+)
+
+
+def _merge_sweep(path: str, spec) -> dict:
+    from ..campaign.manager import _RESULTS, _atomic_write, _sweep_batches
+
+    batches = _sweep_batches(spec)
+    done = sweep_done_units(read_all_journals(path))
+    missing = [key for key, *_ in batches if key not in done]
+    summary = {
+        "kind": "sweep",
+        "units_total": len(batches),
+        "units_done": len(batches) - len(missing),
+        "merged": not missing,
+        "dir": path,
+    }
+    if missing:
+        summary["missing_units"] = missing[:8]
+        return summary
+    lines: List[str] = []
+    for key, *_ in batches:
+        for lane, res in enumerate(done[key]):
+            lines.append(
+                json.dumps(
+                    {"batch": key, "lane": lane, "result": res},
+                    sort_keys=True,
+                )
+            )
+    _atomic_write(
+        os.path.join(path, _RESULTS), "".join(x + "\n" for x in lines)
+    )
+    summary["results"] = os.path.join(path, _RESULTS)
+    summary["lanes"] = sum(len(done[k]) for k, *_ in batches)
+    summary["errors"] = sum(
+        1 for k, *_ in batches for res in done[k] if res["err"]
+    )
+    return summary
+
+
+def _merge_fuzz(path: str, spec) -> dict:
+    from ..campaign.manager import _SUMMARY, _atomic_write
+
+    points = fuzz_points(spec)
+    progress = fuzz_point_progress(read_all_journals(path))
+    missing = [
+        f"{p}/n{n}"
+        for p, n in points
+        if int(progress.get(f"{p}/n{n}", {}).get("tried", 0))
+        < spec.schedules
+    ]
+    summary = {
+        "kind": "fuzz",
+        "points_total": len(points),
+        "points_done": len(points) - len(missing),
+        "merged": not missing,
+        "dir": path,
+    }
+    if missing:
+        summary["missing_points"] = missing[:8]
+        return summary
+    # the merged artifact: per-point final cumulative state in
+    # canonical point order, minus the generator position (internal)
+    # and minus any path that would vary by campaign dir — everything
+    # left is deterministic across worker counts and interleavings
+    merged = {
+        "kind": "fuzz",
+        "points": {
+            key: {
+                k: v
+                for k, v in progress[key].items()
+                if k not in ("kind", "point", "rng_state")
+            }
+            for key in (f"{p}/n{n}" for p, n in points)
+        },
+    }
+    _atomic_write(
+        os.path.join(path, _SUMMARY),
+        json.dumps(merged, indent=2, sort_keys=True),
+    )
+    summary["summary"] = os.path.join(path, _SUMMARY)
+    return summary
+
+
+def merge_campaign(path: str) -> dict:
+    """Merge the campaign in ``path``. Returns a summary dict with
+    ``merged: True`` and the output path when every unit is journaled;
+    ``merged: False`` (plus what's missing) otherwise. Conflicting
+    duplicate unit completions raise :class:`FleetError`."""
+    from ..campaign.manager import _CAMPAIGN, CampaignError, campaign_from_json
+
+    cpath = os.path.join(path, _CAMPAIGN)
+    if not os.path.exists(cpath):
+        raise CampaignError(f"nothing to merge: no {_CAMPAIGN} in {path}")
+    spec = campaign_from_json(json.load(open(cpath)))
+    if spec.kind == "sweep":
+        return _merge_sweep(path, spec)
+    return _merge_fuzz(path, spec)

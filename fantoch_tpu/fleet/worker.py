@@ -1,0 +1,469 @@
+"""The fleet worker loop: claim units, run them, journal them.
+
+One worker = one process = one worker-scoped journal
+(``journals/<worker_id>.jsonl``). The single-process campaign manager
+appends + fsyncs one shared ``journal.jsonl`` (campaign/manager.py)
+— that file cannot be shared between writers (interleaved appends tear
+each other), so each fleet worker owns its journal exclusively and
+readers union all of them (plus the legacy single-process journal, so
+a campaign started under ``cli.py campaign`` can be *finished* by a
+fleet).
+
+Unit execution is exactly the manager's: a sweep unit runs through
+``run_sweep(checkpoint=...)`` with a per-unit checkpoint dir under the
+shared campaign dir, so when a worker dies (or a budget stop raises
+``SweepInterrupted``) the unit's durable state is already where the
+NEXT claimer will look — any worker resumes any unit, and the signed
+checkpoint manifest (engine/checkpoint.py) refuses a resume across
+protocol/dims/jax drift by name. Fuzz units lease a whole
+(protocol, n) point (chunks within a point are sequential by
+construction — chunk k's plans depend on the generator position after
+chunk k−1) and persist the cumulative point state per chunk.
+
+Budget semantics mirror the manager's: at least one unit of progress
+per invocation, then stop at the next boundary; SIGTERM/SIGINT stop
+at the next boundary with the in-flight sweep unit checkpoint-flushed
+by ``run_sweep``'s own handlers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .leases import DEFAULT_TTL_S, FleetError, claim_unit
+
+JOURNALS_DIR = "journals"
+_LEGACY_JOURNAL = "journal.jsonl"
+
+
+def worker_journal_path(path: str, worker: str) -> str:
+    return os.path.join(path, JOURNALS_DIR, f"{worker}.jsonl")
+
+
+def append_worker_journal(path: str, worker: str, entry: dict) -> None:
+    """Append-fsync one entry to the worker's own journal (the same
+    torn-final-line crash contract as the manager's journal)."""
+    os.makedirs(os.path.join(path, JOURNALS_DIR), exist_ok=True)
+    with open(worker_journal_path(path, worker), "a") as fh:
+        fh.write(json.dumps(entry, sort_keys=True) + "\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+
+
+def read_all_journals(path: str) -> List[dict]:
+    """Union of every journal in the campaign dir: the legacy
+    single-process ``journal.jsonl`` plus every worker journal, each
+    read with the per-file torn-final-line tolerance. Order: legacy
+    first, then workers sorted by id — readers must not depend on
+    cross-journal order (completion order is racy by nature); the
+    deterministic order comes from the canonical unit enumeration at
+    merge time."""
+    from ..campaign.manager import _read_journal_file
+
+    entries: List[dict] = []
+    legacy = os.path.join(path, _LEGACY_JOURNAL)
+    if os.path.exists(legacy):
+        entries.extend(_read_journal_file(legacy))
+    jdir = os.path.join(path, JOURNALS_DIR)
+    if os.path.isdir(jdir):
+        for name in sorted(os.listdir(jdir)):
+            if name.endswith(".jsonl"):
+                entries.extend(
+                    _read_journal_file(os.path.join(jdir, name))
+                )
+    return entries
+
+
+def sweep_done_units(entries: List[dict]) -> Dict[str, List[dict]]:
+    """Completed sweep units across all journals. Duplicate entries
+    for one unit (two workers both completed it — possible when a
+    lease expired under a live-but-slow worker) must carry identical
+    results: unit execution is deterministic, so a mismatch means the
+    determinism contract itself is broken and the merge must refuse
+    rather than pick a winner."""
+    done: Dict[str, List[dict]] = {}
+    for entry in entries:
+        if entry.get("kind") != "batch":
+            continue
+        key, rows = entry["id"], entry["results"]
+        if key in done and done[key] != rows:
+            raise FleetError(
+                f"unit {key!r} was journaled twice with DIFFERING "
+                "results — unit execution must be deterministic; "
+                "refusing to merge"
+            )
+        done.setdefault(key, rows)
+    return done
+
+
+def fuzz_point_progress(entries: List[dict]) -> Dict[str, dict]:
+    """Latest fuzz state per point across all journals: entries are
+    cumulative, so the one with the highest ``tried`` wins (ties are
+    identical by determinism — same plans, same counters)."""
+    progress: Dict[str, dict] = {}
+    for entry in entries:
+        if entry.get("kind") != "fuzz":
+            continue
+        key = entry["point"]
+        prev = progress.get(key)
+        if prev is None or int(entry["tried"]) > int(prev["tried"]):
+            progress[key] = entry
+    return progress
+
+
+def fuzz_points(spec) -> List[Tuple[str, int]]:
+    return [(p, n) for p in spec.protocols for n in spec.ns]
+
+
+def _run_sweep_units(path, spec, worker_id, deadline, stop_flag,
+                     ttl_s, stop_after_units, stop_after_segments):
+    from ..campaign.manager import _CKPT, _sweep_batches
+    from ..engine.checkpoint import (
+        CheckpointSpec,
+        SweepInterrupted,
+        discard_checkpoint,
+    )
+    from ..parallel.sweep import run_sweep
+
+    batches = _sweep_batches(spec)
+    interrupted = None
+    completed = 0
+    skipped_held = 0
+    # repeated passes over the grid: a unit leased elsewhere on pass k
+    # may be journaled, abandoned (checkpointed + released), or
+    # expired by pass k+1 — the worker keeps sweeping as long as it
+    # makes progress, and exits 75 (not blocks) once a full pass
+    # completes nothing, leaving any still-held units to their holders
+    # (or to the next invocation after their TTL)
+    while True:
+        pass_completed = 0
+        pass_held = 0
+        # one journal scan per pass (a per-unit rescan would make the
+        # claim loop O(units² × journal bytes)); the done-set then
+        # grows incrementally from this worker's own completions, and
+        # is re-read in full only on the rare event that matters — a
+        # successful claim of a unit someone else may just have
+        # finished
+        done = sweep_done_units(read_all_journals(path))
+        for key, dev, dims, lanes in batches:
+            if stop_flag["sig"] is not None:
+                interrupted = f"signal {stop_flag['sig']}"
+                break
+            if stop_after_units is not None and (
+                completed >= stop_after_units
+            ):
+                interrupted = "unit-limit"
+                break
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 and completed:
+                    interrupted = "budget exhausted"
+                    break
+                remaining = max(remaining, 0.0)
+            if key in done:
+                continue
+            lease = claim_unit(path, key, worker_id, ttl_s)
+            if lease is None:
+                pass_held += 1
+                continue
+            try:
+                # the unit may have been journaled between the pass
+                # scan and the claim (its previous holder finishing):
+                # refresh and never re-run
+                done = sweep_done_units(read_all_journals(path))
+                if key in done:
+                    continue
+                ckpt_path = os.path.join(
+                    path, _CKPT, key.replace("/", "_")
+                )
+                ck = CheckpointSpec(
+                    path=ckpt_path,
+                    every=spec.checkpoint_every,
+                    budget_s=remaining,
+                    stop_after_segments=stop_after_segments,
+                    keep=True,  # durable until the journal append lands
+                )
+                try:
+                    with lease.heartbeater():
+                        results = run_sweep(
+                            dev,
+                            dims,
+                            lanes,
+                            max_steps=spec.max_steps,
+                            segment_steps=spec.segment_steps,
+                            shard_lanes=spec.shard_lanes,
+                            mesh_shard=bool(
+                                getattr(spec, "mesh_shard", None)
+                            ),
+                            checkpoint=ck,
+                            pipeline_depth=spec.pipeline_depth,
+                        )
+                except SweepInterrupted as e:
+                    # the unit's state is durably checkpointed under
+                    # the SHARED dir: releasing the lease (the finally
+                    # below) puts it straight back into the pool for
+                    # any worker
+                    interrupted = e.reason
+                    break
+                rows = [r.to_json() for r in results]
+                append_worker_journal(
+                    path, worker_id,
+                    {"kind": "batch", "id": key, "results": rows},
+                )
+                done[key] = rows
+                discard_checkpoint(ckpt_path)
+                completed += 1
+                pass_completed += 1
+            finally:
+                lease.release()
+            if stop_flag["sig"] is not None:
+                interrupted = f"signal {stop_flag['sig']}"
+                break
+        done = sweep_done_units(read_all_journals(path))
+        if interrupted or not pass_completed or all(
+            k in done for k, *_ in batches
+        ):
+            skipped_held = pass_held
+            break
+
+    return {
+        "kind": "sweep",
+        "worker": worker_id,
+        "units_total": len(batches),
+        "units_done": sum(1 for k, *_ in batches if k in done),
+        "units_completed_here": completed,
+        "units_held_elsewhere": skipped_held,
+        "done": all(k in done for k, *_ in batches),
+        "interrupted": interrupted,
+        "dir": path,
+    }
+
+
+def _run_fuzz_units(path, spec, worker_id, deadline, stop_flag, ttl_s,
+                    stop_after_units):
+    from ..campaign.manager import (
+        _ARTIFACTS,
+        _fuzz_point_spec,
+        _merge_counts,
+        _planet,
+    )
+    from ..mc.fuzz import (
+        draw_plans,
+        plan_rng,
+        point_config,
+        point_protocol,
+        restore_rng,
+        rng_state,
+        run_fuzz_point,
+    )
+
+    planet = _planet(spec.aws)
+    points = fuzz_points(spec)
+    interrupted = None
+    chunks_done = 0
+    completed_points = 0
+    # the same pass discipline as the sweep loop: keep sweeping while
+    # progressing, exit (not block) once a pass advances nothing
+    while True:
+        pass_chunks = chunks_done
+        for proto, n in points:
+            if interrupted:
+                break
+            if stop_after_units is not None and (
+                completed_points >= stop_after_units
+            ):
+                interrupted = "unit-limit"
+                break
+            key = f"{proto}/n{n}"
+            prev = fuzz_point_progress(read_all_journals(path)).get(key)
+            if prev and int(prev["tried"]) >= spec.schedules:
+                continue
+            lease = claim_unit(path, key, worker_id, ttl_s)
+            if lease is None:
+                continue
+            try:
+                # re-read under the lease: the previous holder may
+                # have advanced (or finished) the point before
+                # releasing
+                prev = fuzz_point_progress(
+                    read_all_journals(path)
+                ).get(key)
+                tried = int(prev["tried"]) if prev else 0
+                if tried >= spec.schedules:
+                    completed_points += 1
+                    continue
+                # the journaled generator position — restored, never
+                # recomputed, so the remaining plan stream is
+                # identical whichever worker draws it
+                rng = (
+                    restore_rng(prev["rng_state"])
+                    if prev
+                    else plan_rng(
+                        _fuzz_point_spec(spec, proto, n, spec.chunk)
+                    )
+                )
+                with lease.heartbeater():
+                    while tried < spec.schedules:
+                        if stop_flag["sig"] is not None:
+                            interrupted = f"signal {stop_flag['sig']}"
+                            break
+                        if (
+                            deadline is not None
+                            and time.monotonic() > deadline
+                            and chunks_done
+                        ):
+                            interrupted = "budget exhausted"
+                            break
+                        size = min(spec.chunk, spec.schedules - tried)
+                        pspec = _fuzz_point_spec(spec, proto, n, size)
+                        plans = draw_plans(
+                            pspec, point_config(pspec),
+                            point_protocol(pspec), count=size, rng=rng,
+                        )
+                        res = run_fuzz_point(
+                            pspec,
+                            planet=planet,
+                            confirm=spec.confirm,
+                            max_confirmations=spec.max_confirm,
+                            shrink_budget=spec.shrink_budget,
+                            strict_missing=spec.strict_missing,
+                            plans=plans,
+                            lane_offset=tried,
+                            artifact_dir=os.path.join(path, _ARTIFACTS),
+                        )
+                        tried += size
+                        entry = {
+                            "kind": "fuzz",
+                            "point": key,
+                            "tried": tried,
+                            "rng_state": rng_state(rng),
+                            "flagged": (
+                                (prev["flagged"] if prev else 0)
+                                + res.flagged
+                            ),
+                            "confirmed": (
+                                (prev["confirmed"] if prev else 0)
+                                + res.confirmed
+                            ),
+                            "unprocessed": (
+                                (prev.get("unprocessed", 0) if prev else 0)
+                                + res.unprocessed
+                            ),
+                            "engine_errors": _merge_counts(
+                                prev.get("engine_errors", {})
+                                if prev else {},
+                                res.engine_errors,
+                            ),
+                            "artifacts": sorted(
+                                set(
+                                    prev.get("artifacts", [])
+                                    if prev else []
+                                )
+                                | {
+                                    os.path.relpath(f.artifact_path, path)
+                                    for f in res.findings
+                                    if f.artifact_path
+                                }
+                            ),
+                            "violations": (
+                                (prev.get("violations", []) if prev else [])
+                                + res.summary()["violations"]
+                            ),
+                        }
+                        append_worker_journal(path, worker_id, entry)
+                        prev = entry
+                        chunks_done += 1
+                    else:
+                        completed_points += 1
+            finally:
+                lease.release()
+        progress = fuzz_point_progress(read_all_journals(path))
+        all_done = all(
+            int(progress.get(f"{p}/n{n}", {}).get("tried", 0))
+            >= spec.schedules
+            for p, n in points
+        )
+        if interrupted or all_done or chunks_done == pass_chunks:
+            break
+
+    progress = fuzz_point_progress(read_all_journals(path))
+    done = all(
+        int(progress.get(f"{p}/n{n}", {}).get("tried", 0))
+        >= spec.schedules
+        for p, n in points
+    )
+    return {
+        "kind": "fuzz",
+        "worker": worker_id,
+        "points_total": len(points),
+        "points_done": sum(
+            1
+            for p, n in points
+            if int(progress.get(f"{p}/n{n}", {}).get("tried", 0))
+            >= spec.schedules
+        ),
+        "done": done,
+        "interrupted": interrupted,
+        "dir": path,
+    }
+
+
+def run_fleet_worker(
+    path: str,
+    spec=None,
+    *,
+    worker_id: str,
+    budget_s: Optional[float] = None,
+    ttl_s: float = DEFAULT_TTL_S,
+    stop_after_units: Optional[int] = None,
+    stop_after_segments: Optional[int] = None,
+) -> dict:
+    """Run one fleet worker over the campaign in ``path`` until the
+    grid is drained (``done: True`` — every unit journaled by
+    *someone*), the budget/signal stops it, or only leased-elsewhere
+    units remain. ``spec=None`` resumes the stored campaign (like
+    ``campaign --resume``); passing a spec creates the campaign dir on
+    first touch — concurrent first touches write the identical bytes,
+    so worker start order never matters.
+
+    ``stop_after_units`` / ``stop_after_segments`` are the
+    deterministic-interruption test hooks (the latter is threaded into
+    the per-unit :class:`~fantoch_tpu.engine.checkpoint
+    .CheckpointSpec`, stopping mid-unit with the checkpoint durable)."""
+    from ..campaign.manager import _load_or_init_spec
+    from ..registry import check_worker_id
+
+    check_worker_id(worker_id)
+    spec = _load_or_init_spec(path, spec, resume=spec is None)
+    deadline = (
+        time.monotonic() + budget_s if budget_s is not None else None
+    )
+    stop_flag = {"sig": None}
+    restores = []
+    import signal as _signal
+
+    def _on_signal(num, _frame):
+        stop_flag["sig"] = num
+
+    try:
+        for s in (_signal.SIGTERM, _signal.SIGINT):
+            restores.append((s, _signal.signal(s, _on_signal)))
+    except ValueError:
+        restores = []  # not the main thread: unit-boundary stops only
+    try:
+        if spec.kind == "sweep":
+            return _run_sweep_units(
+                path, spec, worker_id, deadline, stop_flag, ttl_s,
+                stop_after_units, stop_after_segments,
+            )
+        return _run_fuzz_units(
+            path, spec, worker_id, deadline, stop_flag, ttl_s,
+            stop_after_units,
+        )
+    finally:
+        for s, old in restores:
+            _signal.signal(s, old)

@@ -55,10 +55,14 @@ DEFAULT_PATHS = (
     "fantoch_tpu/campaign",
     "fantoch_tpu/traffic",
     "fantoch_tpu/bote/validate.py",
-    # the sweep driver + its pipelined segment window (host-side by
-    # design; the scan proves the dispatch loop never grows raw
-    # emissions, tracer branching, or host-sync ops)
+    # the sweep driver + its pipelined segment window + the shard_map
+    # partition layer (host-side orchestration by design; the scan
+    # proves the dispatch loop never grows raw emissions, tracer
+    # branching, or host-sync ops)
     "fantoch_tpu/parallel",
+    # fleet campaigns: leases/worker/merge are pure host-side file
+    # protocol — the scan proves they stay that way
+    "fantoch_tpu/fleet",
 )
 
 OUTBOX_KEYS = {"valid", "dst", "mtype", "payload"}

@@ -4,6 +4,10 @@ The reference sweeps configurations with one rayon thread per config
 (fantoch_ps/src/bin/simulation.rs:165-217); here the batch axis of the
 vmapped engine shards across a ``jax.sharding.Mesh`` of TPU chips —
 each chip advances its shard of lanes, and results gather back to host.
+Two layouts share one per-lane trace: the implicit ``jit`` +
+``NamedSharding`` path, and ``partition.py``'s explicit ``shard_map``
+partitioning (``run_sweep(mesh_shard=True)``, docs/PERF.md
+§ "Mesh-partitioned megabatches").
 """
 
 from .sweep import make_sweep_specs, run_sweep

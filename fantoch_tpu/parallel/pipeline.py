@@ -77,3 +77,76 @@ class SegmentWindow:
             self.running = bool(self._flags.popleft())
         self._flags.clear()
         return self.running
+
+
+class CheckpointBuffer:
+    """Double-buffered checkpoint saves: overlap the save's
+    device→host fetch (and the npz write) with the next in-flight
+    window instead of serializing with it.
+
+    The serial save path drains the window, blocks on
+    ``jax.device_get`` of the full batched state (~100 MB per 512
+    lanes — minutes over the tunnel, docs/PERF.md), writes the npz,
+    and only then dispatches the next segment: the device sits idle
+    for the whole fetch+write. Here the boundary instead *begins* a
+    save — ``copy_to_host_async`` starts the D2H transfer on every
+    leaf and the (still-device) boundary state is parked — and the
+    blocking ``device_get`` + artifact write happen on the next
+    :meth:`flush`, which ``run_sweep`` calls right after the next
+    segment's dispatch: the transfer and the file write then overlap
+    device execution of the new window.
+
+    Correctness invariants:
+
+    * saves stay on **determinate boundaries** — ``begin`` is only
+      called on a drained window, and the parked state is exactly the
+      boundary state (undonated input buffers are immutable, so later
+      dispatches cannot touch it); the bytes written equal a serial
+      save's, pinned in tests/test_pipeline.py.
+    * resume stays **bit-exact** — nothing about the artifact changes,
+      only when its bytes land on disk.
+    * a kill between ``begin`` and the deferred write loses that
+      boundary's save and leaves the *previous* checkpoint — the same
+      "≤ one cadence window of device work" loss bound as before,
+      shifted by at most one segment.
+    * the overlap never engages under buffer donation — the next
+      dispatch would consume the parked state's buffers — nor for a
+      stopping save (``SweepInterrupted`` must raise with the state
+      already durable); ``run_sweep`` saves synchronously there.
+    """
+
+    def __init__(self):
+        self._state = None
+        self._until = 0
+
+    @property
+    def pending(self) -> bool:
+        return self._state is not None
+
+    def begin(self, state, until: int) -> None:
+        """Park a drained boundary state and start its async D2H
+        transfer. At most one save may be pending (``run_sweep``
+        flushes after the very next dispatch, before any later
+        boundary)."""
+        assert self._state is None, "previous boundary save not flushed"
+        import jax
+
+        for leaf in jax.tree_util.tree_leaves(state):
+            start = getattr(leaf, "copy_to_host_async", None)
+            if start is not None:
+                start()
+        self._state = state
+        self._until = int(until)
+
+    def flush(self, save) -> bool:
+        """Complete a pending save: blocking fetch of the (already
+        in-flight) transfer, then ``save(host_state, until)``. No-op
+        when nothing is pending; returns whether a save was written."""
+        if self._state is None:
+            return False
+        import jax
+
+        state, until = self._state, self._until
+        self._state = None
+        save(jax.device_get(state), until)
+        return True

@@ -52,7 +52,7 @@ from ..engine.core import (
 from ..engine.driver import batch_reorder_flag
 from ..engine.faults import FaultPlan, batch_fault_flags
 from ..engine.spec import narrow_spec, stack_lanes
-from .pipeline import SegmentWindow
+from .pipeline import CheckpointBuffer, SegmentWindow
 
 
 def make_sweep_specs(
@@ -209,6 +209,7 @@ def run_sweep(
     segment_steps: int = 8192,
     monitor_keys: int = 0,
     shard_lanes: "bool | None" = None,
+    mesh_shard: bool = False,
     checkpoint: "CheckpointSpec | str | None" = None,
     pipeline_depth: int = 2,
     narrow: bool = True,
@@ -258,6 +259,21 @@ def run_sweep(
     * ``False`` — the unsharded reference path: a single-device mesh
       (the bit-identical baseline the sharded test compares against).
 
+    ``mesh_shard=True`` is the *explicit* partitioning layout
+    (parallel/partition.py): the batched runner is wrapped in
+    ``shard_map`` over a named all-device mesh, so the lane-axis split
+    is part of the program — each device runs exactly its shard, the
+    only cross-device traffic is the one-scalar liveness ``psum``, and
+    XLA can never silently replicate the lane state. It is gated by
+    the same GL203 lane-independence proof as ``shard_lanes=True``
+    (raising :class:`LaneMixingError` on a mixing step), pinned
+    bit-identical to the single-device reference on the 8-device CPU
+    mesh, and composes with ``pipeline_depth``, donation, ``narrow``
+    and ``checkpoint`` (saves land on drained boundaries; like
+    ``pipeline_depth``, the layout is deliberately NOT a checkpoint
+    meta key — checkpoints interchange across layouts). Incompatible
+    with an explicit ``mesh`` argument and with ``shard_lanes=False``.
+
     ``checkpoint`` (a :class:`~fantoch_tpu.engine.checkpoint
     .CheckpointSpec` or a bare path) makes the run durable: the full
     batched state is saved at segment boundaries (the existing
@@ -280,8 +296,8 @@ def run_sweep(
     try:
         return _run_sweep(
             protocol, dims, specs, mesh, max_steps, segment_steps,
-            monitor_keys, shard_lanes, checkpoint, pipeline_depth,
-            narrow, mark,
+            monitor_keys, shard_lanes, mesh_shard, checkpoint,
+            pipeline_depth, narrow, mark,
         )
     finally:
         # the per-phase timings land on EVERY exit path — an early
@@ -298,9 +314,24 @@ def run_sweep(
 
 def _run_sweep(
     protocol, dims, specs, mesh, max_steps, segment_steps, monitor_keys,
-    shard_lanes, checkpoint, pipeline_depth, narrow, mark,
+    shard_lanes, mesh_shard, checkpoint, pipeline_depth, narrow, mark,
 ) -> List[LaneResults]:
-    if mesh is None:
+    from . import partition
+
+    if mesh_shard:
+        if shard_lanes is False:
+            raise ValueError(
+                "mesh_shard=True explicitly partitions lanes over the "
+                "mesh; it contradicts shard_lanes=False (the single-"
+                "device reference path)"
+            )
+        if mesh is not None:
+            raise ValueError(
+                "mesh_shard=True builds its own named all-device mesh "
+                "(parallel/partition.py); drop the explicit mesh"
+            )
+        mesh = partition.fleet_mesh()
+    elif mesh is None:
         devices = jax.devices()
         if shard_lanes is False:
             devices = devices[:1]
@@ -352,11 +383,13 @@ def _run_sweep(
         state = cast_state_planes(state, nspec, store=True)
         mark("narrow")
 
-    if shard_lanes:
-        # the verified multichip path: refuse to shard a step that
-        # mixes lanes (GL203; one trace + taint per protocol, cached).
-        # The proof runs on the exact per-lane (state, ctx) the batched
-        # runner sees — including the key table when present.
+    if shard_lanes or mesh_shard:
+        # the verified multichip paths: refuse to shard a step that
+        # mixes lanes (GL203; one trace + taint per protocol, cached —
+        # shared between the NamedSharding and shard_map layouts, which
+        # vmap the identical per-lane function). The proof runs on the
+        # exact per-lane (state, ctx) the batched runner sees —
+        # including the key table when present.
         ctx0 = {k: np.asarray(v)[0] for k, v in ctx.items()}
         findings = _prove_lane_independent(
             protocol, dims, reorder_flag,
@@ -369,7 +402,30 @@ def _run_sweep(
     ck = None
     sig = None
     ckpt_meta = None
-    ctx_host = ctx  # the pre-device_put numpy ctx, saved verbatim
+    ctx_host = ctx  # the pre-device_put numpy ctx (padded)
+    # checkpoints carry ONLY the caller's lanes: padding is a property
+    # of the executing mesh, not of the work, and a padded twin's state
+    # is always bit-identical to the last real lane's (identical spec,
+    # identical init, deterministic per-lane step) — so the artifact
+    # slices the pad off at save and re-grows THIS run's own pad at
+    # load, which is what lets a unit checkpointed on an 8-device
+    # mesh_shard worker resume on a single-device one (and vice versa)
+    # whatever the lane count's divisibility
+    unpad = lambda tree: jax.tree_util.tree_map(
+        lambda a: np.asarray(a)[: len(specs)], tree
+    )
+    repad = (
+        (
+            lambda tree: jax.tree_util.tree_map(
+                lambda a: np.concatenate(
+                    [a, np.repeat(a[-1:], pad, axis=0)]
+                ),
+                tree,
+            )
+        )
+        if pad
+        else (lambda tree: tree)
+    )
     resume_until = 0
     if checkpoint is not None:
         ck = (
@@ -382,11 +438,8 @@ def _run_sweep(
             protocol, dims, reorder=reorder_flag, faults=fault_flags,
             monitor_keys=monitor_keys, state=states[0], ctx=ctx0,
         )
-        # padded duplicate lanes ride inside the payload (the batched
-        # state needs them) but never the manifest's lane accounting
         ckpt_meta = {
             "lanes": len(specs),
-            "padded": pad,
             "max_steps": int(max_steps),
             "segment_steps": int(segment_steps),
             "monitor_keys": int(monitor_keys),
@@ -419,8 +472,7 @@ def _run_sweep(
             ],
         }
         expect_keys = [
-            "lanes", "padded", "max_steps", "segment_steps",
-            "monitor_keys",
+            "lanes", "max_steps", "segment_steps", "monitor_keys",
         ]
         if ckpt_meta["traffic"] != ["flat"]:
             # by-name schedule check only when this batch actually runs
@@ -433,11 +485,15 @@ def _run_sweep(
             expect_keys.append("traffic")
         if ck.resume and checkpoint_exists(ck.path):
             # a stale/corrupted artifact raises here — refusal, not a
-            # silent from-scratch rerun
+            # silent from-scratch rerun. Artifacts are pad-free (the
+            # saved ctx compares against the unpadded fresh ctx), so a
+            # checkpoint written under any mesh size resumes here with
+            # this run's own padding re-grown from the last real lane.
             state, loaded_meta = load_sweep_checkpoint(
-                ck.path, signature=sig, ctx=ctx_host,
+                ck.path, signature=sig, ctx=unpad(ctx_host),
                 meta_expect={k: ckpt_meta[k] for k in expect_keys},
             )
+            state = repad(state)
             # two-way narrowing compare (a pre-narrowing checkpoint's
             # meta lacks the key and reads as un-narrowed — compatible
             # with exactly an un-narrowed run): a disagreement in
@@ -454,7 +510,10 @@ def _run_sweep(
             resume_until = int(loaded_meta["until"])
             mark("checkpoint_load")
 
-    sharding = NamedSharding(mesh, PartitionSpec("sweep"))
+    if mesh_shard:
+        sharding = partition.lane_sharding(mesh)
+    else:
+        sharding = NamedSharding(mesh, PartitionSpec("sweep"))
     put = lambda tree: jax.tree_util.tree_map(
         lambda a: jax.device_put(a, sharding), tree
     )
@@ -463,10 +522,17 @@ def _run_sweep(
     # overrides): segments then update the lane state in place instead
     # of allocating + round-tripping a second full copy per call
     donate = donation_safe()
-    runner, alive = _cached_runner(
-        protocol, dims, max_steps, reorder_flag,
-        fault_flags, monitor_keys, nspec, donate,
-    )
+    if mesh_shard:
+        runner, _pmesh = partition.build_partitioned_runner(
+            protocol, dims, max_steps, reorder_flag, fault_flags,
+            monitor_keys, narrow=nspec, donate=donate,
+            devices=tuple(mesh.devices.flat),
+        )
+    else:
+        runner, alive = _cached_runner(
+            protocol, dims, max_steps, reorder_flag,
+            fault_flags, monitor_keys, nspec, donate,
+        )
     state = put(state)
     ctx = put(ctx)
     mark("device_put")
@@ -501,12 +567,34 @@ def _run_sweep(
     until = resume_until
     segs_done = 0
     window = SegmentWindow(pipeline_depth)
+    # double-buffered saves (parallel/pipeline.py CheckpointBuffer):
+    # cadence boundaries park the drained state + start its async D2H
+    # copy, and the blocking fetch + npz write happen right after the
+    # NEXT segment's dispatch so they overlap device execution. Never
+    # under donation (the next dispatch consumes the parked buffers)
+    # and never for a stopping save (SweepInterrupted must raise with
+    # the state already durable) — those save synchronously.
+    ckbuf = CheckpointBuffer()
+    overlap = not donate
+
+    def save_boundary(host_state, at):
+        # pad-free artifact: padded twins are bit-copies of the last
+        # real lane and are re-grown at load for the resuming mesh
+        save_sweep_checkpoint(
+            ck.path, state=unpad(host_state), ctx=unpad(ctx_host),
+            signature=sig, until=at, meta=ckpt_meta,
+        )
+        mark(f"checkpoint@{at}")
+
     try:
         while window.running and until < max_steps:
             until = min(until + segment_steps, max_steps)
             state, any_alive = runner(state, ctx, np.int32(until))
             window.push(any_alive)
             segs_done += 1
+            # the previous boundary's deferred save: the new segment is
+            # dispatched now, so the fetch + write overlap it
+            ckbuf.flush(save_boundary)
             if ck is not None:
                 stop = None
                 if sig_seen["num"] is not None:
@@ -528,14 +616,12 @@ def _run_sweep(
                     # loop's, whatever the pipeline depth
                     if not window.drain():
                         continue  # batch just finished: nothing to save
-                    save_sweep_checkpoint(
-                        ck.path, state=jax.device_get(state),
-                        ctx=ctx_host, signature=sig, until=until,
-                        meta=ckpt_meta,
-                    )
-                    mark(f"checkpoint@{until}")
-                    if stop is not None:
-                        raise SweepInterrupted(ck.path, until, stop)
+                    if stop is not None or not overlap:
+                        save_boundary(jax.device_get(state), until)
+                        if stop is not None:
+                            raise SweepInterrupted(ck.path, until, stop)
+                    else:
+                        ckbuf.begin(state, until)
                     continue
             # steady state: resolve only the flag whose slot the next
             # dispatch needs — never block on the segment just issued
@@ -548,6 +634,14 @@ def _run_sweep(
 
             for s, old in restores:
                 _signal.signal(s, old)
+    if ck is not None and (ck.keep or sig_seen["num"] is not None):
+        # a deferred final-boundary save flushes BEFORE the signal
+        # re-delivery and the discard decision below: if the
+        # re-delivered signal terminates the process, durability must
+        # be exactly what the serial save path would have left. When
+        # the run completed cleanly and the checkpoint is about to be
+        # discarded anyway, a still-pending save is simply dropped.
+        ckbuf.flush(save_boundary)
     if sig_seen["num"] is not None:
         # the signal landed while the FINAL segment completed, so the
         # flush handler swallowed it without a stop. Re-deliver it now

@@ -19,6 +19,44 @@ DEV_PROTOCOLS = ("basic", "fpaxos", "tempo", "atlas", "epaxos", "caesar")
 # the partial-replication twins (engine.protocols.partial_dev_protocol)
 PARTIAL_DEV_PROTOCOLS = ("tempo", "atlas")
 
+# fleet worker ids (fantoch_tpu/fleet, docs/FLEET.md) become lease and
+# journal file names: `leases/<unit>.<worker>` and
+# `journals/<worker>.jsonl`. The rules keep the filenames parseable and
+# collision-free — alphanumerics plus `_`/`-` only (the first `.` in a
+# lease name splits unit from worker, so dots are out), length-bounded,
+# and never the reserved lease suffixes. Kept jax-free here so the CLI
+# validates worker ids before any backend initializes.
+WORKER_ID_MAX = 64
+_WORKER_ID_RESERVED = ("lock", "stale", "tmp")
+
+
+def worker_id_ok(worker) -> bool:
+    if not isinstance(worker, str) or not worker:
+        return False
+    if len(worker) > WORKER_ID_MAX:
+        return False
+    if worker in _WORKER_ID_RESERVED:
+        return False
+    # ascii-only on purpose: isalnum() alone admits non-ASCII letters
+    # and digits, which would leak into lease/journal filenames
+    return all(
+        (c.isascii() and c.isalnum()) or c in "_-" for c in worker
+    )
+
+
+def check_worker_id(worker) -> str:
+    """Validate a fleet worker id, raising ``ValueError`` naming the
+    rule it breaks."""
+    if not worker_id_ok(worker):
+        raise ValueError(
+            f"bad fleet worker id {worker!r}: ids are 1-"
+            f"{WORKER_ID_MAX} chars of [A-Za-z0-9_-], and not one of "
+            f"the reserved lease suffixes {_WORKER_ID_RESERVED} "
+            "(docs/FLEET.md)"
+        )
+    return worker
+
+
 # named time-varying traffic presets (fantoch_tpu/traffic, docs/TRAFFIC.md):
 # the campaign grid's `traffic` axis and `sweep --traffic` accept exactly
 # these. Presets are parameterized by the lane's base conflict rate, pool

@@ -1,0 +1,377 @@
+"""Fleet campaigns (fantoch_tpu/fleet): lease-sharded multi-worker
+execution over one shared campaign dir.
+
+Default tier pins the three core invariants on the suite's shared
+compiled Basic runner (plus a tempo merge group): lease contention
+(exactly one winner, loser moves on), TTL-gated reclaim (never before
+expiry, including across a real ``kill -9`` mid-unit), and the
+determinism headline — an N-worker fleet's merged ``results.jsonl``
+byte-identical to the 1-worker control AND to the single-process
+``campaign`` manager's output. Slow tier widens the merge identity to
+every full protocol and to fuzz campaigns.
+"""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from fantoch_tpu.campaign import campaign_from_json, run_campaign
+from fantoch_tpu.fleet import (
+    FleetError,
+    claim_unit,
+    lease_holder,
+    merge_campaign,
+    run_fleet_worker,
+)
+from fantoch_tpu.fleet.worker import (
+    append_worker_journal,
+    read_all_journals,
+    sweep_done_units,
+)
+from fantoch_tpu.registry import check_worker_id, worker_id_ok
+
+# mirrors tests/test_campaign.py shapes so fleet units reuse the
+# suite's compiled Basic segment runner; batch_lanes=1 gives 4 units —
+# enough for real interleaving between two workers
+SWEEP_GRID = {
+    "kind": "sweep",
+    "protocols": ["basic"],
+    "ns": [3],
+    "conflicts": [0, 100],
+    "subsets": 2,
+    "commands_per_client": 2,
+    "batch_lanes": 1,
+    "segment_steps": 8,
+}
+
+
+def _read(path):
+    with open(path, "rb") as fh:
+        return fh.read()
+
+
+# ----------------------------------------------------------------------
+# worker ids + lease protocol (device-free)
+# ----------------------------------------------------------------------
+
+
+def test_worker_id_rules():
+    assert worker_id_ok("w0") and worker_id_ok("tpu-pod_3")
+    # non-ASCII alphanumerics are refused: ids become filenames
+    for bad in ("", "a.b", "a/b", "lock", "stale", "tmp", "x" * 65,
+                ".hidden", "wé", "٢", None, 7):
+        assert not worker_id_ok(bad), bad
+    with pytest.raises(ValueError, match="worker id"):
+        check_worker_id("a.b")
+
+
+def test_lease_contention_exactly_one_winner(tmp_path):
+    """Two (here: eight) workers race one unit — exactly one wins,
+    every loser gets None and moves on. Repeated rounds, fresh unit
+    each time, all claims released afterwards."""
+    d = str(tmp_path)
+    for rnd in range(10):
+        unit = f"proto/n3/b{rnd}"
+        wins = []
+        barrier = threading.Barrier(8)
+
+        def race(i, unit=unit, wins=wins, barrier=barrier):
+            barrier.wait()
+            lease = claim_unit(d, unit, f"w{i}", ttl_s=30.0)
+            if lease is not None:
+                wins.append(lease)
+
+        threads = [
+            threading.Thread(target=race, args=(i,)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(wins) == 1, f"round {rnd}: {len(wins)} winners"
+        holder = lease_holder(d, unit)
+        assert holder is not None and holder[0] == wins[0].worker
+        wins[0].release()
+        assert lease_holder(d, unit) is None
+
+
+def test_lease_reclaim_only_after_ttl(tmp_path):
+    """The reclaim gate: a live (heartbeated) lease is never stolen;
+    an expired one is reclaimable by exactly one claimant."""
+    d = str(tmp_path)
+    a = claim_unit(d, "u/1", "a", ttl_s=0.6)
+    assert a is not None
+    # live lease: competitor refused outright
+    assert claim_unit(d, "u/1", "b", ttl_s=0.6) is None
+    # heartbeats keep it alive past the original TTL
+    for _ in range(4):
+        time.sleep(0.25)
+        a.heartbeat()
+    assert claim_unit(d, "u/1", "b", ttl_s=0.6) is None, (
+        "reclaim fired on a heartbeated lease"
+    )
+    # dead holder: claim succeeds only once the mtime is older than TTL
+    time.sleep(0.7)
+    b = claim_unit(d, "u/1", "b", ttl_s=0.6)
+    assert b is not None and lease_holder(d, "u/1")[0] == "b"
+    b.release()
+
+
+def test_lease_released_unit_immediately_reclaimable(tmp_path):
+    d = str(tmp_path)
+    a = claim_unit(d, "u/2", "a", ttl_s=30.0)
+    a.release()
+    b = claim_unit(d, "u/2", "b", ttl_s=30.0)
+    assert b is not None
+    b.release()
+
+
+def test_conflicting_duplicate_unit_results_refused(tmp_path):
+    """Two journals completing one unit with DIFFERENT rows break the
+    determinism contract — the merge must refuse, never pick one."""
+    d = str(tmp_path)
+    append_worker_journal(
+        d, "a", {"kind": "batch", "id": "x/b0", "results": [{"err": 0}]}
+    )
+    append_worker_journal(
+        d, "b", {"kind": "batch", "id": "x/b0", "results": [{"err": 1}]}
+    )
+    with pytest.raises(FleetError, match="DIFFERING"):
+        sweep_done_units(read_all_journals(d))
+
+
+# ----------------------------------------------------------------------
+# multi-worker merge determinism (the headline invariant)
+# ----------------------------------------------------------------------
+
+
+def test_two_worker_fleet_merge_byte_identical_to_control(tmp_path):
+    """Interleaved workers (w1 two units, w2 the rest, w1 journals
+    consulted by w2) merge to a results.jsonl byte-identical to BOTH
+    the 1-worker fleet control and the single-process campaign
+    manager's output for the same grid."""
+    spec = campaign_from_json(SWEEP_GRID)
+
+    mgr = str(tmp_path / "mgr")
+    assert run_campaign(mgr, spec)["done"]
+
+    solo = str(tmp_path / "solo")
+    s = run_fleet_worker(solo, spec, worker_id="solo")
+    assert s["done"] and s["units_completed_here"] == 4
+    assert merge_campaign(solo)["merged"]
+
+    fleet = str(tmp_path / "fleet")
+    s1 = run_fleet_worker(fleet, spec, worker_id="w1",
+                          stop_after_units=2)
+    assert s1["interrupted"] == "unit-limit"
+    assert s1["units_completed_here"] == 2 and not s1["done"]
+    s2 = run_fleet_worker(fleet, None, worker_id="w2")
+    assert s2["done"] and s2["units_completed_here"] == 2
+    merged = merge_campaign(fleet)
+    assert merged["merged"] and merged["errors"] == 0
+
+    control = _read(os.path.join(mgr, "results.jsonl"))
+    assert control
+    assert _read(os.path.join(solo, "results.jsonl")) == control
+    assert _read(os.path.join(fleet, "results.jsonl")) == control
+    # worker-scoped journals, not the shared single-process file
+    assert not os.path.exists(os.path.join(fleet, "journal.jsonl"))
+    assert sorted(
+        os.listdir(os.path.join(fleet, "journals"))
+    ) == ["w1.jsonl", "w2.jsonl"]
+
+
+def test_abandoned_unit_resumed_by_other_worker_bit_exact(tmp_path):
+    """Worker a is interrupted mid-unit (deterministic segment-limit
+    stand-in for preemption): the unit's checkpoint is durable in the
+    SHARED dir and its lease released, so worker b resumes it — not
+    from scratch — and the merged results stay byte-identical."""
+    spec = campaign_from_json(SWEEP_GRID)
+    mgr = str(tmp_path / "mgr")
+    run_campaign(mgr, spec)
+
+    fleet = str(tmp_path / "fleet")
+    s1 = run_fleet_worker(fleet, spec, worker_id="a",
+                          stop_after_segments=1)
+    assert s1["interrupted"] == "segment-limit"
+    assert s1["units_completed_here"] == 0
+    # durable checkpoint under the shared dir, lease back in the pool
+    assert glob.glob(os.path.join(fleet, "ckpt", "*", "manifest.json"))
+    assert lease_holder(fleet, "basic/n3/b0") is None
+    s2 = run_fleet_worker(fleet, None, worker_id="b")
+    assert s2["done"]
+    assert merge_campaign(fleet)["merged"]
+    assert _read(os.path.join(fleet, "results.jsonl")) == _read(
+        os.path.join(mgr, "results.jsonl")
+    )
+
+
+def test_merge_refuses_missing_units_and_empty_dir(tmp_path):
+    from fantoch_tpu.campaign import CampaignError
+
+    with pytest.raises(CampaignError, match="nothing to merge"):
+        merge_campaign(str(tmp_path / "missing"))
+    spec = campaign_from_json(SWEEP_GRID)
+    fleet = str(tmp_path / "fleet")
+    run_fleet_worker(fleet, spec, worker_id="w1", stop_after_units=1)
+    merged = merge_campaign(fleet)
+    assert not merged["merged"]
+    assert merged["units_done"] == 1 and merged["missing_units"]
+    assert not os.path.exists(os.path.join(fleet, "results.jsonl"))
+
+
+def test_fleet_worker_killed_mid_unit_reclaimed_bit_exact(tmp_path):
+    """The real preemption shape: a subprocess worker is SIGKILLed
+    mid-unit; its lease expires (short TTL), a second worker reclaims
+    the unit, resumes its checkpoint, and the merged results are
+    byte-identical to the uninterrupted control."""
+    spec = campaign_from_json(SWEEP_GRID)
+    mgr = str(tmp_path / "mgr")
+    run_campaign(mgr, spec)
+
+    fleet = str(tmp_path / "fleet")
+    grid = json.dumps(SWEEP_GRID)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "fantoch_tpu", "--platform", "cpu",
+            "fleet", "--dir", fleet, "--grid", grid,
+            "--worker-id", "doomed", "--ttl-s", "1.5",
+        ],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    try:
+        # wait until the worker holds a lease and has a checkpoint in
+        # flight — i.e. it is genuinely mid-unit — then kill -9
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if glob.glob(
+                os.path.join(fleet, "ckpt", "*", "manifest.json")
+            ) or glob.glob(os.path.join(fleet, "leases", "*.lock")):
+                break
+            if proc.poll() is not None:
+                break
+            time.sleep(0.05)
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+    # the reclaimer: loops like any preempted-pool supervisor would —
+    # a pass can stop while the dead worker's lease is still within
+    # TTL, so retry until the grid drains
+    deadline = time.monotonic() + 120
+    while True:
+        s = run_fleet_worker(fleet, spec, worker_id="reclaimer",
+                             ttl_s=1.5)
+        if s["done"]:
+            break
+        assert time.monotonic() < deadline, s
+        time.sleep(0.5)
+    assert merge_campaign(fleet)["merged"]
+    assert _read(os.path.join(fleet, "results.jsonl")) == _read(
+        os.path.join(mgr, "results.jsonl")
+    )
+
+
+# ----------------------------------------------------------------------
+# fleet × mesh_shard composition
+# ----------------------------------------------------------------------
+
+
+def test_fleet_mesh_shard_campaign_matches_reference(tmp_path):
+    """A fleet whose units run mesh-partitioned (campaign-grid
+    mesh_shard) merges byte-identically to the plain single-device
+    campaign — the layout must be result-invisible end to end."""
+    spec = campaign_from_json(SWEEP_GRID)
+    ref = str(tmp_path / "ref")
+    run_campaign(ref, spec)
+
+    mspec = campaign_from_json(dict(SWEEP_GRID, mesh_shard=True))
+    fleet = str(tmp_path / "fleet")
+    s = run_fleet_worker(fleet, mspec, worker_id="w1")
+    assert s["done"]
+    assert merge_campaign(fleet)["merged"]
+    a = _read(os.path.join(fleet, "results.jsonl"))
+    b = _read(os.path.join(ref, "results.jsonl"))
+    # the results lines differ only in nothing: same batches, same
+    # lanes, same bytes — mesh_shard is not part of the batch ids
+    assert a == b
+
+
+# ----------------------------------------------------------------------
+# slow tier: all protocols + fuzz fleet
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_fleet_merge_identity_all_protocols(tmp_path):
+    grid = {
+        "kind": "sweep",
+        "protocols": [
+            "basic", "fpaxos", "tempo", "atlas", "epaxos", "caesar"
+        ],
+        "ns": [3],
+        "conflicts": [100],
+        "subsets": 1,
+        "commands_per_client": 2,
+        "batch_lanes": 1,
+        "segment_steps": 64,
+    }
+    spec = campaign_from_json(grid)
+    mgr = str(tmp_path / "mgr")
+    assert run_campaign(mgr, spec)["done"]
+    fleet = str(tmp_path / "fleet")
+    run_fleet_worker(fleet, spec, worker_id="w1", stop_after_units=3)
+    s = run_fleet_worker(fleet, None, worker_id="w2")
+    assert s["done"]
+    assert merge_campaign(fleet)["merged"]
+    assert _read(os.path.join(fleet, "results.jsonl")) == _read(
+        os.path.join(mgr, "results.jsonl")
+    )
+
+
+@pytest.mark.slow
+def test_fuzz_fleet_two_workers_summary_identical(tmp_path):
+    """A fuzz campaign's points are fleet units: two workers handing a
+    point's chunks across the journaled generator position must merge
+    to a summary.json byte-identical to the 1-worker control."""
+    grid = {
+        "kind": "fuzz",
+        "protocols": ["tempo"],
+        "ns": [3],
+        "schedules": 8,
+        "chunk": 4,
+        "commands_per_client": 5,
+        "seed": 7,
+        "confirm": False,
+    }
+    spec = campaign_from_json(grid)
+
+    solo = str(tmp_path / "solo")
+    s = run_fleet_worker(solo, spec, worker_id="solo")
+    assert s["done"]
+    assert merge_campaign(solo)["merged"]
+
+    fleet = str(tmp_path / "fleet")
+    # budget 0: at least one chunk of progress, then stop — the point
+    # lease is released with the generator position journaled
+    s1 = run_fleet_worker(fleet, spec, worker_id="w1", budget_s=0.0)
+    assert not s1["done"] and s1["interrupted"] == "budget exhausted"
+    s2 = run_fleet_worker(fleet, None, worker_id="w2")
+    assert s2["done"]
+    assert merge_campaign(fleet)["merged"]
+    assert _read(os.path.join(fleet, "summary.json")) == _read(
+        os.path.join(solo, "summary.json")
+    )

@@ -1,13 +1,18 @@
-"""The verified lane-sharding contract (parallel/sweep.py +
-lint/lanes.py): `run_sweep(shard_lanes=True)` first *proves* the step
-lane-independent (GL203 taint over the batched trace) and then shards
-the lane axis over the 8-device CPU mesh; its results must be
-bit-identical to the unsharded single-device path
+"""The verified lane-sharding contracts (parallel/sweep.py +
+parallel/partition.py + lint/lanes.py): `run_sweep(shard_lanes=True)`
+and `run_sweep(mesh_shard=True)` both first *prove* the step
+lane-independent (GL203 taint over the batched trace) and then split
+the lane axis over the 8-device CPU mesh — implicitly (NamedSharding
+inputs under jit) and explicitly (shard_map) respectively; both must
+be bit-identical to the unsharded single-device path
 (`shard_lanes=False`). This is the empirical pin behind the prover's
 soundness note — vmap's select-masking of batched `while` trip counts
-is accepted as control-only because this test holds bitwise."""
+is accepted as control-only because these tests hold bitwise."""
+
+import json
 
 import numpy as np
+import pytest
 
 from fantoch_tpu.core import Config, Planet
 from fantoch_tpu.engine import EngineDims
@@ -17,10 +22,7 @@ from fantoch_tpu.parallel.sweep import make_sweep_specs, run_sweep
 COMMANDS = 2
 
 
-def test_sharded_sweep_bit_identical_to_unsharded():
-    import jax
-
-    assert len(jax.devices()) == 8, "conftest must force 8 CPU devices"
+def _basic_specs(lanes=8, conflicts=(0, 100)):
     planet = Planet.new()
     regions = planet.regions()
     clients = 3
@@ -33,21 +35,22 @@ def test_sharded_sweep_bit_identical_to_unsharded():
     specs = make_sweep_specs(
         dev,
         planet,
-        region_sets=[regions[i : i + 3] for i in range(4)],
+        region_sets=[
+            regions[i : i + 3] for i in range(lanes // len(conflicts))
+        ],
         fs=[1],
-        conflicts=[0, 100],
+        conflicts=list(conflicts),
         commands_per_client=COMMANDS,
         clients_per_region=1,
         dims=dims,
         config_base=Config(**dev_config_kwargs("basic", 3, 1)),
     )
-    assert len(specs) == 8  # one lane per mesh device when sharded
+    return dev, dims, specs
 
-    sharded = run_sweep(dev, dims, specs, shard_lanes=True)
-    unsharded = run_sweep(dev, dims, specs, shard_lanes=False)
 
-    assert len(sharded) == len(unsharded) == len(specs)
-    for a, b in zip(sharded, unsharded):
+def _assert_results_equal(xs, ys):
+    assert len(xs) == len(ys)
+    for a, b in zip(xs, ys):
         assert a.err == b.err
         assert a.completed == b.completed
         assert a.steps == b.steps
@@ -57,3 +60,113 @@ def test_sharded_sweep_bit_identical_to_unsharded():
                 np.asarray(a.protocol_metrics[key]),
                 np.asarray(b.protocol_metrics[key]),
             )
+
+
+def test_sharded_sweep_bit_identical_to_unsharded():
+    import jax
+
+    assert len(jax.devices()) == 8, "conftest must force 8 CPU devices"
+    dev, dims, specs = _basic_specs()
+    assert len(specs) == 8  # one lane per mesh device when sharded
+
+    sharded = run_sweep(dev, dims, specs, shard_lanes=True)
+    unsharded = run_sweep(dev, dims, specs, shard_lanes=False)
+    _assert_results_equal(sharded, unsharded)
+
+
+def test_mesh_shard_bit_identical_to_unsharded():
+    """The explicit shard_map layout: byte-identical LaneResults on
+    the 8-device mesh, including the non-divisible tail (5 lanes pad
+    to 8 — padding must never leak)."""
+    dev, dims, specs = _basic_specs()
+    meshed = run_sweep(dev, dims, specs, mesh_shard=True)
+    reference = run_sweep(dev, dims, specs, shard_lanes=False)
+    _assert_results_equal(meshed, reference)
+    a = [json.dumps(r.to_json(), sort_keys=True) for r in meshed]
+    b = [json.dumps(r.to_json(), sort_keys=True) for r in reference]
+    assert a == b, "mesh_shard serialized results diverged"
+
+    # the tail-padding seam under shard_map: 5 specs on 8 devices
+    tail = specs[:5]
+    meshed5 = run_sweep(dev, dims, tail, mesh_shard=True)
+    _assert_results_equal(meshed5, reference[:5])
+
+
+def test_mesh_shard_rejects_contradictory_arguments():
+    dev, dims, specs = _basic_specs(lanes=2, conflicts=(0, 100))
+    with pytest.raises(ValueError, match="shard_lanes=False"):
+        run_sweep(dev, dims, specs, mesh_shard=True, shard_lanes=False)
+    from jax.sharding import Mesh
+
+    import jax
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("sweep",))
+    with pytest.raises(ValueError, match="explicit mesh"):
+        run_sweep(dev, dims, specs, mesh_shard=True, mesh=mesh)
+
+
+def test_mesh_shard_refuses_lane_mixing_step(monkeypatch):
+    """The GL203 gate guards the shard_map layout exactly like the
+    NamedSharding one: a mixing step raises LaneMixingError instead
+    of partitioning."""
+    from fantoch_tpu.lint.report import Finding
+    from fantoch_tpu.parallel import sweep as sweep_mod
+    from fantoch_tpu.parallel.sweep import LaneMixingError
+
+    monkeypatch.setattr(
+        "fantoch_tpu.lint.lanes.prove_step_lane_independent",
+        lambda *a, **k: [
+            Finding("GL203", "syn", "x:y:reduce_sum", "cross-lane")
+        ],
+    )
+    sweep_mod._LANE_PROOFS.clear()
+    dev, dims, specs = _basic_specs(lanes=2, conflicts=(0, 100))
+    try:
+        with pytest.raises(LaneMixingError, match="GL203"):
+            run_sweep(dev, dims, specs, mesh_shard=True)
+    finally:
+        sweep_mod._LANE_PROOFS.clear()
+
+
+def test_mesh_shard_checkpoint_interchanges_with_reference(tmp_path):
+    """Composition pin: a run interrupted under mesh_shard resumes
+    under the single-device reference layout (and vice versa) —
+    bit-exactly, because saves land on drained determinate boundaries,
+    the layout is deliberately not a checkpoint meta key, and the
+    artifact is pad-free. The NON-divisible 5-lane case is the sharp
+    edge: the 8-device mesh pads 5→8 while the single-device reference
+    pads 5→5, so a padded payload could never interchange — the
+    artifact carries exactly the caller's lanes and each layout
+    re-grows its own padding from the bit-identical last real lane."""
+    from fantoch_tpu.engine.checkpoint import (
+        CheckpointSpec,
+        SweepInterrupted,
+    )
+
+    dev, dims, all_specs = _basic_specs()
+    specs = all_specs[:5]  # 5 lanes: pad 3 on the mesh, 0 single-device
+    reference = run_sweep(dev, dims, specs, shard_lanes=False)
+
+    ck = CheckpointSpec(path=str(tmp_path / "ck"), every=1,
+                        stop_after_segments=2)
+    with pytest.raises(SweepInterrupted):
+        run_sweep(dev, dims, specs, mesh_shard=True, segment_steps=8,
+                  checkpoint=ck)
+    resumed = run_sweep(
+        dev, dims, specs, shard_lanes=False, segment_steps=8,
+        checkpoint=CheckpointSpec(path=str(tmp_path / "ck")),
+    )
+    _assert_results_equal(resumed, reference)
+
+    # and the reverse hop: reference-layout checkpoint resumed under
+    # the 8-device mesh_shard partitioning
+    ck2 = CheckpointSpec(path=str(tmp_path / "ck2"), every=1,
+                         stop_after_segments=2)
+    with pytest.raises(SweepInterrupted):
+        run_sweep(dev, dims, specs, shard_lanes=False, segment_steps=8,
+                  checkpoint=ck2)
+    resumed2 = run_sweep(
+        dev, dims, specs, mesh_shard=True, segment_steps=8,
+        checkpoint=CheckpointSpec(path=str(tmp_path / "ck2")),
+    )
+    _assert_results_equal(resumed2, reference)
