@@ -72,6 +72,17 @@ FUZZ_MIN_BUDGET_S = float(
     _os.environ.get("FANTOCH_BENCH_FUZZ_MIN_BUDGET", "420")
 )
 
+# coverage-discovery self-check shape (mc/coverage.py): blind vs
+# coverage-steered distinct-bucket discovery over the SAME chunked
+# schedule budget on a fixed-seed tempo n=3 point, in one process (the
+# two modes share the compiled COV_CHUNK-lane monitored runner, so the
+# delta isolates what seed mutation buys, not a compile)
+COV_CHUNK = int(_os.environ.get("FANTOCH_BENCH_COV_CHUNK", "32"))
+COV_CHUNKS = int(_os.environ.get("FANTOCH_BENCH_COV_CHUNKS", "4"))
+COV_MIN_BUDGET_S = float(
+    _os.environ.get("FANTOCH_BENCH_COV_MIN_BUDGET", "420")
+)
+
 # checkpoint-roundtrip self-check shape (engine/checkpoint.py): the
 # documented 512-lane tempo sweep state, reduced by the CPU-fallback
 # env so a host-mesh run still finishes inside the driver budget
@@ -654,6 +665,54 @@ def _fuzz_selfcheck() -> float:
     return res.schedules_per_sec
 
 
+def _fuzz_coverage() -> "tuple[float, float]":
+    """Blind vs coverage-steered bucket discovery per 1000 schedules
+    (mc/coverage.py) on a fixed-seed tempo n=3 point: both modes spend
+    the identical chunked budget (COV_CHUNKS chunks of COV_CHUNK
+    schedules) in this process, the steered mode feeding each chunk's
+    new-bucket plans back through the seed mutators. Returns
+    (blind, steered) buckets/ksched."""
+    from fantoch_tpu.mc import coverage as cov
+    from fantoch_tpu.mc.fuzz import (
+        FuzzSpec,
+        draw_plans,
+        plan_rng,
+        point_config,
+        point_protocol,
+        run_fuzz_point,
+    )
+
+    spec = FuzzSpec(
+        protocol="tempo",
+        n=3,
+        f=1,
+        schedules=COV_CHUNK,
+        commands_per_client=5,
+        seed=0xC0F,
+    )
+    config = point_config(spec)
+    dev = point_protocol(spec)
+    total = COV_CHUNK * COV_CHUNKS
+
+    def run(steered: bool) -> float:
+        rng = plan_rng(spec)
+        cmap, pool, mrng = cov.restore_steering(spec, None)
+        for _ in range(COV_CHUNKS):
+            if steered:
+                plans = cov.draw_steered(
+                    spec, config, dev, COV_CHUNK, rng, mrng, pool
+                )
+            else:
+                plans = draw_plans(
+                    spec, config, dev, count=COV_CHUNK, rng=rng
+                )
+            res = run_fuzz_point(spec, confirm=False, plans=plans)
+            cov.fold_chunk(cmap, pool, res.digests, plans)
+        return cmap.bucket_count * 1000.0 / total
+
+    return run(False), run(True)
+
+
 def main() -> None:
     # smoke runs (JAX_PLATFORMS=cpu) force the CPU backend even under
     # the axon site hook; driver runs leave the env unset and get the
@@ -769,6 +828,36 @@ def main() -> None:
             fuzz_note = f"failed: {type(e).__name__}: {e}"[:300]
             print(
                 f"fuzz self-check {fuzz_note}", file=sys.stderr,
+                flush=True,
+            )
+
+    # coverage-discovery rates (mc/coverage.py): blind vs steered
+    # buckets per 1000 schedules over the same chunked budget — its
+    # COV_CHUNK-lane monitored runner is one more compile, so it rides
+    # behind the same budget guard as the other self-checks
+    cov_rates, cov_note = None, None
+    if TOTAL_BUDGET_S - _since_birth() < COV_MIN_BUDGET_S:
+        cov_note = "skipped: insufficient budget for the coverage compile"
+        print(f"coverage self-check {cov_note}", file=sys.stderr,
+              flush=True)
+    else:
+        try:
+            cov_rates = _fuzz_coverage()
+            print(
+                f"coverage self-check: {COV_CHUNK * COV_CHUNKS} "
+                f"schedules, {cov_rates[0]:.1f} blind vs "
+                f"{cov_rates[1]:.1f} steered buckets/ksched",
+                file=sys.stderr,
+                flush=True,
+            )
+        except Exception as e:  # noqa: BLE001
+            import traceback
+
+            traceback.print_exc()
+            cov_rates = None
+            cov_note = f"failed: {type(e).__name__}: {e}"[:300]
+            print(
+                f"coverage self-check {cov_note}", file=sys.stderr,
                 flush=True,
             )
 
@@ -916,6 +1005,17 @@ def main() -> None:
                 "vs_baseline": round(points_per_sec / per_chip_target, 3),
                 "fuzz_schedules_per_sec": round(fuzz_sps, 2),
                 **({"fuzz_note": fuzz_note} if fuzz_note else {}),
+                # distinct coverage buckets per 1000 schedules on the
+                # fixed-seed tempo n=3 point, same in-process budget
+                # (0.0 = skipped/failed; note carries the reason)
+                "fuzz_buckets_per_ksched": (
+                    round(cov_rates[1], 2) if cov_rates else 0.0
+                ),
+                "fuzz_buckets_per_ksched_blind": (
+                    round(cov_rates[0], 2) if cov_rates else 0.0
+                ),
+                "fuzz_cov_schedules": COV_CHUNK * COV_CHUNKS,
+                **({"fuzz_cov_note": cov_note} if cov_note else {}),
                 # save + restore + bit-exact compare of a CKPT_LANES
                 # tempo state (0.0 = self-check unavailable, see stderr)
                 "checkpoint_roundtrip_s": (
@@ -1134,6 +1234,12 @@ def _emit_unreachable(reason: str = "unreachable at startup") -> None:
                 "platform": "none",
                 "vs_baseline": 0.0,
                 "fuzz_schedules_per_sec": 0.0,
+                # coverage discovery needs the monitored device runner
+                # too — honest zeros with the shared reason
+                "fuzz_buckets_per_ksched": 0.0,
+                "fuzz_buckets_per_ksched_blind": 0.0,
+                "fuzz_cov_schedules": COV_CHUNK * COV_CHUNKS,
+                "fuzz_cov_note": f"skipped: TPU backend {reason}",
                 # the roundtrip needs a live (CPU) jax backend to build
                 # the tempo state; the CPU-fallback path measures it,
                 # this last-ditch artifact records an honest zero
@@ -1181,6 +1287,8 @@ _CPU_FALLBACK_ENV = {
     "FANTOCH_BENCH_COMMANDS": "10",
     "FANTOCH_BENCH_CHUNK": "16",
     "FANTOCH_BENCH_FUZZ_SCHEDULES": "8",
+    "FANTOCH_BENCH_COV_CHUNK": "8",
+    "FANTOCH_BENCH_COV_CHUNKS": "3",
     "FANTOCH_BENCH_CKPT_LANES": "64",
     "FANTOCH_BENCH_TRAFFIC_LANES": "64",
     "FANTOCH_BENCH_TRAFFIC_SUBSETS": "1",

@@ -145,6 +145,21 @@ class FuzzCampaign:
     strict_missing: bool = False
     inject_bug: bool = False
     aws: bool = False
+    # coverage-guided mode (mc/coverage.py, docs/MC.md): bucket every
+    # lane's interleaving digest into a journaled persistent map, seed
+    # host-replayable mutators from plans that open new buckets, and
+    # steer each chunk of budget toward the point with the highest
+    # recent bucket-discovery rate. False = the blind root-PRNG
+    # stream: the exact pre-coverage plan sequence and point order
+    # (pre-coverage journals resume seamlessly), though entries now
+    # record `first_confirmed_at` on confirmation and summaries carry
+    # the journal-derived `schedules_tried` total.
+    coverage: bool = False
+    # chunks of history the per-point discovery rate averages over
+    steer_window: int = 4
+    # starvation floor: every incomplete point is kept within this
+    # share of the most-fuzzed point's schedule count
+    min_share: float = 0.25
 
     kind = "fuzz"
 
@@ -553,8 +568,24 @@ def _fuzz_point_spec(spec: FuzzCampaign, proto: str, n: int, chunk: int):
     )
 
 
-def _run_fuzz_campaign(path: str, spec: FuzzCampaign, deadline,
-                       stop_flag) -> dict:
+# journal-entry keys that never reach summaries: internal generator
+# positions and the raw seed pool (the coverage map itself DOES reach
+# the summary — it is the merged, worker-count-invariant artifact the
+# fleet and resume byte-identity contracts pin)
+_FUZZ_INTERNAL_KEYS = ("kind", "point", "rng_state", "mrng_state", "seeds")
+
+
+def _fuzz_chunk(spec: FuzzCampaign, proto: str, n: int,
+                prev: Optional[dict], planet, path: str) -> dict:
+    """Draw, run and fold ONE chunk of (proto, n) into a new cumulative
+    journal entry, continuing exactly from ``prev`` (None = fresh
+    point). This is the single shared chunk engine of the
+    single-process manager AND every fleet worker (fleet/worker.py):
+    chunk k's plans depend only on the journaled state after chunk
+    k−1 — the root generator position, and in coverage mode the map,
+    seed pool and mutator position — so the plan stream is identical
+    whichever process draws it, and chunked ≡ one-shot stays true
+    across SIGKILL and worker handoffs."""
     from ..mc.fuzz import (
         draw_plans,
         plan_rng,
@@ -565,6 +596,146 @@ def _run_fuzz_campaign(path: str, spec: FuzzCampaign, deadline,
         run_fuzz_point,
     )
 
+    key = f"{proto}/n{n}"
+    tried = int(prev["tried"]) if prev else 0
+    size = min(spec.chunk, spec.schedules - tried)
+    pspec = _fuzz_point_spec(spec, proto, n, size)
+    config = point_config(pspec)
+    dev = point_protocol(pspec)
+    # the journaled generator position — restored, never recomputed
+    # from the root seed, so the remaining plan sequence is identical
+    # to what an uninterrupted session would have drawn
+    rng = (
+        restore_rng(prev["rng_state"])
+        if prev
+        else plan_rng(_fuzz_point_spec(spec, proto, n, spec.chunk))
+    )
+    cmap = pool = mrng = None
+    if spec.coverage:
+        from ..mc import coverage as cov
+
+        # the map/pool/mutator-position travel the journal like the
+        # root PRNG position; a map journaled under a different point
+        # signature refuses by name (CoverageMismatchError)
+        cmap, pool, mrng = cov.restore_steering(pspec, prev)
+        plans = cov.draw_steered(
+            pspec, config, dev, size, rng, mrng, pool
+        )
+    else:
+        plans = draw_plans(pspec, config, dev, count=size, rng=rng)
+    res = run_fuzz_point(
+        pspec,
+        planet=planet,
+        confirm=spec.confirm,
+        max_confirmations=spec.max_confirm,
+        shrink_budget=spec.shrink_budget,
+        strict_missing=spec.strict_missing,
+        plans=plans,
+        lane_offset=tried,
+        artifact_dir=os.path.join(path, _ARTIFACTS),
+    )
+    tried += size
+    entry = {
+        "kind": "fuzz",
+        "point": key,
+        "tried": tried,
+        "rng_state": rng_state(rng),
+        "flagged": (prev["flagged"] if prev else 0) + res.flagged,
+        "confirmed": (
+            (prev["confirmed"] if prev else 0) + res.confirmed
+        ),
+        "unprocessed": (
+            (prev.get("unprocessed", 0) if prev else 0)
+            + res.unprocessed
+        ),
+        "engine_errors": _merge_counts(
+            prev.get("engine_errors", {}) if prev else {},
+            res.engine_errors,
+        ),
+        "artifacts": sorted(
+            set(prev.get("artifacts", []) if prev else [])
+            | {
+                os.path.relpath(f.artifact_path, path)
+                for f in res.findings
+                if f.artifact_path
+            }
+        ),
+        "violations": (
+            (prev.get("violations", []) if prev else [])
+            + res.summary()["violations"]
+        ),
+    }
+    # schedules-until-first-confirmation (exact: lane indices are
+    # campaign-global via lane_offset) — what the CI injected-bug
+    # self-check compares steered vs blind on
+    first = prev.get("first_confirmed_at") if prev else None
+    confirmed_lanes = [f.lane for f in res.findings if f.confirmed]
+    if first is None and confirmed_lanes:
+        first = min(confirmed_lanes) + 1
+    if first is not None:
+        entry["first_confirmed_at"] = int(first)
+    if spec.coverage:
+        from ..mc.coverage import fold_chunk
+
+        fresh = fold_chunk(cmap, pool, res.digests, plans)
+        recent = list(prev.get("cov_recent", []) if prev else [])
+        recent.append([size, len(fresh)])
+        entry["coverage"] = cmap.to_json()
+        entry["seeds"] = pool.to_json()
+        entry["mrng_state"] = rng_state(mrng)
+        entry["cov_recent"] = recent[-max(int(spec.steer_window), 1):]
+        entry["cov_buckets"] = cmap.bucket_count
+    return entry
+
+
+def _fuzz_summary(path: str, spec: FuzzCampaign, points, progress,
+                  interrupted) -> dict:
+    done = interrupted is None and all(
+        int(progress.get(f"{p}/n{n}", {}).get("tried", 0))
+        >= spec.schedules
+        for p, n in points
+    )
+    summary = {
+        "kind": "fuzz",
+        "points_total": len(points),
+        "done": done,
+        "interrupted": interrupted,
+        "dir": path,
+        # total schedules actually run, read from the JOURNALED
+        # per-point counters — never re-derived from chunk sizes, so a
+        # budget-truncated campaign (or a final chunk smaller than
+        # `chunk` when schedules % chunk != 0) is never over-counted
+        "schedules_tried": sum(
+            int(e.get("tried", 0)) for e in progress.values()
+        ),
+        "points": {
+            key: {
+                k: v
+                for k, v in progress[key].items()
+                if k not in _FUZZ_INTERNAL_KEYS
+            }
+            for key in sorted(progress)
+        },
+    }
+    if done:
+        # the persisted artifact is dir-invariant (no absolute paths),
+        # so a control campaign and a SIGKILLed+resumed one in ANOTHER
+        # directory produce byte-identical summary.json — the resume
+        # determinism contract tests/CI cmp against
+        _atomic_write(
+            os.path.join(path, _SUMMARY),
+            json.dumps(
+                {k: v for k, v in summary.items() if k != "dir"},
+                indent=2,
+                sort_keys=True,
+            ),
+        )
+        summary["summary"] = os.path.join(path, _SUMMARY)
+    return summary
+
+
+def _run_fuzz_campaign(path: str, spec: FuzzCampaign, deadline,
+                       stop_flag) -> dict:
     planet = _planet(spec.aws)
     points = [(p, n) for p in spec.protocols for n in spec.ns]
     progress: Dict[str, dict] = {}
@@ -574,109 +745,47 @@ def _run_fuzz_campaign(path: str, spec: FuzzCampaign, deadline,
 
     interrupted = None
     progressed = 0
-    for proto, n in points:
-        key = f"{proto}/n{n}"
-        prev = progress.get(key)
-        tried = int(prev["tried"]) if prev else 0
-        # the journaled generator position — restored, never recomputed
-        # from the root seed, so the remaining plan sequence is
-        # identical to what an uninterrupted session would have drawn
-        rng = (
-            restore_rng(prev["rng_state"])
-            if prev
-            else plan_rng(_fuzz_point_spec(spec, proto, n, spec.chunk))
-        )
-        while tried < spec.schedules:
-            if stop_flag["sig"] is not None:
-                interrupted = f"signal {stop_flag['sig']}"
-                break
-            if (
-                deadline is not None
-                and time.monotonic() > deadline
-                and progressed
-            ):
-                interrupted = "budget exhausted"
-                break
-            size = min(spec.chunk, spec.schedules - tried)
-            pspec = _fuzz_point_spec(spec, proto, n, size)
-            plans = draw_plans(
-                pspec, point_config(pspec), point_protocol(pspec),
-                count=size, rng=rng,
-            )
-            res = run_fuzz_point(
-                pspec,
-                planet=planet,
-                confirm=spec.confirm,
-                max_confirmations=spec.max_confirm,
-                shrink_budget=spec.shrink_budget,
-                strict_missing=spec.strict_missing,
-                plans=plans,
-                lane_offset=tried,
-                artifact_dir=os.path.join(path, _ARTIFACTS),
-            )
-            tried += size
-            entry = {
-                "kind": "fuzz",
-                "point": key,
-                "tried": tried,
-                "rng_state": rng_state(rng),
-                "flagged": (prev["flagged"] if prev else 0) + res.flagged,
-                "confirmed": (
-                    (prev["confirmed"] if prev else 0) + res.confirmed
-                ),
-                "unprocessed": (
-                    (prev.get("unprocessed", 0) if prev else 0)
-                    + res.unprocessed
-                ),
-                "engine_errors": _merge_counts(
-                    prev.get("engine_errors", {}) if prev else {},
-                    res.engine_errors,
-                ),
-                "artifacts": sorted(
-                    set(prev.get("artifacts", []) if prev else [])
-                    | {
-                        os.path.relpath(f.artifact_path, path)
-                        for f in res.findings
-                        if f.artifact_path
-                    }
-                ),
-                "violations": (
-                    (prev.get("violations", []) if prev else [])
-                    + res.summary()["violations"]
-                ),
-            }
-            _append_journal(path, entry)
-            progress[key] = prev = entry
-            progressed += 1
-        if interrupted:
+    while True:
+        if stop_flag["sig"] is not None:
+            interrupted = f"signal {stop_flag['sig']}"
             break
+        if (
+            deadline is not None
+            and time.monotonic() > deadline
+            and progressed
+        ):
+            interrupted = "budget exhausted"
+            break
+        # next chunk's point: the coverage allocator's pick (recent
+        # bucket-discovery rate with the starvation floor), or — blind
+        # — the first incomplete point of the canonical enumeration,
+        # which reproduces the legacy point-by-point order exactly
+        if spec.coverage:
+            from ..mc.coverage import rank_points
 
-    done = interrupted is None and all(
-        progress.get(f"{p}/n{n}", {}).get("tried", 0) >= spec.schedules
-        for p, n in points
-    )
-    summary = {
-        "kind": "fuzz",
-        "points_total": len(points),
-        "done": done,
-        "interrupted": interrupted,
-        "dir": path,
-        "points": {
-            key: {
-                k: v
-                for k, v in progress[key].items()
-                if k not in ("kind", "point", "rng_state")
-            }
-            for key in sorted(progress)
-        },
-    }
-    if done:
-        _atomic_write(
-            os.path.join(path, _SUMMARY),
-            json.dumps(summary, indent=2, sort_keys=True),
+            order = rank_points(
+                points, progress, spec.schedules,
+                min_share=spec.min_share,
+            )
+        else:
+            order = [
+                f"{p}/n{n}"
+                for p, n in points
+                if int(progress.get(f"{p}/n{n}", {}).get("tried", 0))
+                < spec.schedules
+            ]
+        if not order:
+            break
+        key = order[0]
+        proto, n = key.rsplit("/n", 1)
+        entry = _fuzz_chunk(
+            spec, proto, int(n), progress.get(key), planet, path
         )
-        summary["summary"] = os.path.join(path, _SUMMARY)
-    return summary
+        _append_journal(path, entry)
+        progress[key] = entry
+        progressed += 1
+
+    return _fuzz_summary(path, spec, points, progress, interrupted)
 
 
 def _merge_counts(a: dict, b: dict) -> dict:

@@ -390,14 +390,25 @@ def cmd_mc(args) -> None:
     """Stochastic model checking (mc/fuzz.py): fan out perturbed
     schedules with on-device safety monitors over a (protocol x n)
     grid, host-confirm flagged lanes, shrink confirmed violations to
-    replayable repro artifacts; ``--replay`` re-executes one."""
+    replayable repro artifacts; ``--replay`` re-executes one.
+    ``--coverage-dir`` makes repeated invocations coverage-guided
+    (mc/coverage.py): each point's AFL-style bucket map, seed pool and
+    generator positions persist in the directory, so every session
+    mutates the seeds the previous ones discovered instead of
+    restarting from blind sampling — a stored map whose point
+    signature disagrees is refused (exit 2), like checkpoints."""
     import os
     import time
 
     from .mc.fuzz import (
         FuzzSpec,
         load_artifact,
+        plan_rng,
+        point_config,
+        point_protocol,
         replay_artifact,
+        restore_rng,
+        rng_state,
         run_fuzz_point,
     )
 
@@ -448,6 +459,34 @@ def cmd_mc(args) -> None:
             aws=bool(args.aws),
             inject_bug=args.inject_bug,
         )
+        plans = None
+        lane_offset = 0
+        cov_state = None
+        if args.coverage_dir:
+            from .mc import coverage as cov
+
+            try:
+                stored = cov.load_point_state(args.coverage_dir, spec)
+                cmap, pool, mrng = cov.restore_steering(spec, stored)
+            except cov.CoverageError as e:
+                # refusal, not recovery: a map from a different point
+                # signature (or digest version) must never be mixed in
+                print(
+                    f"mc refused: {type(e).__name__}: {e}",
+                    file=sys.stderr,
+                )
+                raise SystemExit(2)
+            config = point_config(spec)
+            dev = point_protocol(spec)
+            rng = (
+                restore_rng(stored["rng_state"]) if stored
+                else plan_rng(spec)
+            )
+            lane_offset = int(stored["tried"]) if stored else 0
+            plans = cov.draw_steered(
+                spec, config, dev, spec.schedules, rng, mrng, pool
+            )
+            cov_state = (cov, cmap, pool, rng, mrng)
         res = run_fuzz_point(
             spec,
             planet=planet,
@@ -455,8 +494,30 @@ def cmd_mc(args) -> None:
             max_confirmations=args.max_confirm,
             shrink_budget=args.shrink_budget,
             strict_missing=args.strict_missing,
+            plans=plans,
+            lane_offset=lane_offset,
         )
         point = res.summary()
+        if cov_state is not None:
+            cov, cmap, pool, rng, mrng = cov_state
+            fresh = cov.fold_chunk(cmap, pool, res.digests, plans)
+            tried_total = lane_offset + res.schedules
+            cov.save_point_state(
+                args.coverage_dir,
+                spec,
+                {
+                    "kind": cov.COVERAGE_KIND,
+                    "version": cov.COVERAGE_VERSION,
+                    "tried": tried_total,
+                    "rng_state": rng_state(rng),
+                    "mrng_state": rng_state(mrng),
+                    "coverage": cmap.to_json(),
+                    "seeds": pool.to_json(),
+                },
+            )
+            point["coverage_buckets"] = cmap.bucket_count
+            point["new_buckets"] = len(fresh)
+            point["tried_total"] = tried_total
         if args.out:
             os.makedirs(args.out, exist_ok=True)
             for finding in res.findings:
@@ -1254,6 +1315,14 @@ def main(argv=None) -> None:
                     help="fuzz the deliberately broken Tempo twin "
                     "(pipeline self-check)")
     mc.add_argument("--aws", action="store_true")
+    mc.add_argument(
+        "--coverage-dir", default=None,
+        help="persist per-point coverage maps + seed pools here and "
+             "draw coverage-steered plans (mc/coverage.py): repeated "
+             "invocations accumulate distinct-interleaving coverage "
+             "instead of re-sampling blindly; a stored map whose "
+             "point signature disagrees is refused (exit 2)",
+    )
     mc.add_argument("--out", default=None,
                     help="directory for repro artifacts")
     mc.add_argument("--replay", default=None,
@@ -1275,7 +1344,9 @@ def main(argv=None) -> None:
         '\'{"kind": "sweep", "protocols": ["tempo"], "ns": [3, 5], '
         '"conflicts": [0, 100], "subsets": 4}\' or '
         '\'{"kind": "fuzz", "protocols": ["tempo"], "ns": [3], '
-        '"schedules": 2048, "chunk": 256}\' '
+        '"schedules": 2048, "chunk": 256}\'; fuzz grids take '
+        '"coverage": true for coverage-guided steering (plus '
+        '"steer_window"/"min_share" knobs — docs/MC.md) '
         "(required for a new campaign; optional-but-verified with "
         "--resume)",
     )
@@ -1304,8 +1375,9 @@ def main(argv=None) -> None:
     fl.add_argument("--grid", default=None,
                     help="campaign spec: JSON object or @file (same "
                     "schema as `campaign --grid`, incl. sweep-grid "
-                    '"mesh_shard": true); required on first touch, '
-                    "optional-but-verified afterwards")
+                    '"mesh_shard": true and fuzz-grid "coverage": '
+                    "true for fleet-steered budgets); required on "
+                    "first touch, optional-but-verified afterwards")
     fl.add_argument("--worker-id", default=None,
                     help="run ONE worker loop in this process under "
                     "this id ([A-Za-z0-9_-], docs/FLEET.md worker-id "

@@ -1166,7 +1166,11 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False,
         err = err | ERR_UNAVAIL * (ctx["fault_unavail"] != 0)
 
     out_mon = (
-        dict(mon, viol=viol, viol_step=viol_step) if monitor_keys else {}
+        # cov rides the carry untouched: the digest is derived once per
+        # lane by monitor.finalize_lane, never inside the step
+        dict(mon, viol=viol, viol_step=viol_step, cov=st["cov"])
+        if monitor_keys
+        else {}
     )
     return {
         **out_mon,

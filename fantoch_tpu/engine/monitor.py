@@ -42,6 +42,7 @@ What the order hash does and does not prove is documented in
 
 from __future__ import annotations
 
+import functools
 from typing import Dict
 
 import jax.numpy as jnp
@@ -104,7 +105,46 @@ def mon_init(dims, monitor_keys: int) -> Dict[str, np.ndarray]:
         "mon_flags": np.zeros((N,), np.int32),
         "viol": np.int32(0),
         "viol_step": np.int32(INF),
+        # the lane's coverage digest (cov_digest at lane end; 0 while
+        # the lane is still mid-flight under the segmented runner)
+        "cov": np.int32(0),
     }
+
+
+@functools.lru_cache(maxsize=None)
+def _digest_weights(length: int) -> np.ndarray:
+    """``[length + 1]`` position weights for :func:`cov_digest`: the
+    closed form of the rolling fold ``h ← h·HASH_MUL + x`` started from
+    1 — ``MUL^L + Σ x_i · MUL^(L-1-i)`` mod 2^32 — computed host-side
+    in exact integers, then reinterpreted as wrapping i32 (trace-time
+    constants; the device does one multiply-add per element)."""
+    powers = [1]
+    for _ in range(length):
+        powers.append((powers[-1] * HASH_MUL) & 0xFFFFFFFF)
+    # weights[0] = MUL^L (the leading "1" term), weights[1 + i] =
+    # MUL^(L-1-i) for flat element i
+    w = np.asarray([powers[length]] + powers[length - 1 :: -1], np.uint32)
+    return w.astype(np.int32)
+
+
+def cov_digest(hashes, cnts):
+    """Fold a lane's final ``[N, K]`` order-hash + count matrices into
+    one i32 coverage digest — the AFL-style "which interleaving was
+    this" signature (mc/coverage.py buckets it). Equals the rolling
+    hash ``1 → fold(h·MUL + x)`` over the row-major concatenation of
+    hashes then counts, in wrapping i32 (i32 multiply/add wrap two's
+    complement under XLA, the modulus the weights are computed in).
+    Order-sensitive by position weighting, and starting from 1 keeps an
+    all-zero matrix (a lane that executed nothing) from aliasing the
+    "unmonitored" zero. A pure function of frozen lane state, so
+    re-running it per segment on a finished lane is idempotent."""
+    flat = jnp.concatenate(
+        [jnp.reshape(hashes, (-1,)), jnp.reshape(cnts, (-1,))]
+    )
+    w = _digest_weights(int(flat.shape[0]))
+    return jnp.asarray(w[0], I32) + jnp.sum(
+        flat.astype(I32) * jnp.asarray(w[1:], I32), dtype=I32
+    )
 
 
 def mon_exec(ps, key, src, seq, enable, premature=False):
@@ -237,4 +277,9 @@ def finalize_lane(protocol, dims, st, ctx, faults, running):
     viol_step = jnp.where(
         (viol != 0) & (st["viol_step"] >= INF), st["steps"], st["viol_step"]
     )
-    return dict(st, viol=viol, viol_step=viol_step)
+    # coverage digest: the interleaving signature the fuzzer buckets
+    # (mc/coverage.py). Computed only once the lane's state is frozen —
+    # a mid-flight lane keeps 0 and the final segment's re-run derives
+    # the same digest idempotently, like the checks above.
+    cov = jnp.where(running, st["cov"], cov_digest(hashes, cnts))
+    return dict(st, viol=viol, viol_step=viol_step, cov=cov)

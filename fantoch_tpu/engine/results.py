@@ -41,6 +41,10 @@ class LaneResults:
     # violation bitmask (VIOL_*) and the first violating engine step
     violation: int = 0
     violation_step: int = INF
+    # the lane's interleaving coverage digest (monitor.cov_digest,
+    # folded on device in finalize_lane; 0 on unmonitored runs) — what
+    # mc/coverage.py buckets AFL-style across sessions
+    coverage: int = 0
 
     @property
     def err_cause(self) -> str:
@@ -93,6 +97,7 @@ class LaneResults:
             "dropped": int(self.dropped),
             "violation": int(self.violation),
             "violation_step": int(self.violation_step),
+            "coverage": int(self.coverage),
         }
 
     @staticmethod
@@ -115,6 +120,7 @@ class LaneResults:
             dropped=int(obj.get("dropped", 0)),
             violation=int(obj.get("violation", 0)),
             violation_step=int(obj.get("violation_step", INF)),
+            coverage=int(obj.get("coverage", 0)),
         )
 
 
@@ -152,6 +158,9 @@ def collect_results(
                 violation_step=(
                     int(st["viol_step"][lane]) if "viol_step" in st
                     else INF
+                ),
+                coverage=(
+                    int(st["cov"][lane]) if "cov" in st else 0
                 ),
             )
         )

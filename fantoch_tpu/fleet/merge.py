@@ -97,17 +97,29 @@ def _merge_fuzz(path: str, spec) -> dict:
     if missing:
         summary["missing_points"] = missing[:8]
         return summary
+    from ..campaign.manager import _FUZZ_INTERNAL_KEYS
+
     # the merged artifact: per-point final cumulative state in
-    # canonical point order, minus the generator position (internal)
-    # and minus any path that would vary by campaign dir — everything
-    # left is deterministic across worker counts and interleavings
+    # canonical point order, minus the generator positions and raw
+    # seed pool (internal steering state) and minus any path that
+    # would vary by campaign dir — everything left, the coverage maps
+    # included, is deterministic across worker counts and
+    # interleavings (the union of per-worker journals always converges
+    # to the same cumulative per-point entries)
     merged = {
         "kind": "fuzz",
+        # total schedules run, from the JOURNALED counters — never
+        # chunk-count × chunk-size, which would over-count a final
+        # chunk smaller than `chunk`
+        "schedules_tried": sum(
+            int(progress[f"{p}/n{n}"].get("tried", 0))
+            for p, n in points
+        ),
         "points": {
             key: {
                 k: v
                 for k, v in progress[key].items()
-                if k not in ("kind", "point", "rng_state")
+                if k not in _FUZZ_INTERNAL_KEYS
             }
             for key in (f"{p}/n{n}" for p, n in points)
         },

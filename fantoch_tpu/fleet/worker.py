@@ -31,12 +31,28 @@ from __future__ import annotations
 import json
 import os
 import time
-from typing import Dict, List, Optional, Tuple
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from .leases import DEFAULT_TTL_S, FleetError, claim_unit
 
 JOURNALS_DIR = "journals"
 _LEGACY_JOURNAL = "journal.jsonl"
+
+
+def worker_scan_order(keys: Sequence[str], worker_id: str) -> List[str]:
+    """Lease-aware work-stealing scan order: rotate the canonical unit
+    enumeration by a worker-id-derived offset so concurrent workers
+    start their claim scans at *different* units instead of all racing
+    unit 0 and cascading down the list one contended claim at a time.
+    Purely a throughput hint — which worker runs which unit was never
+    part of any contract; the deterministic output order still comes
+    from the canonical enumeration at merge time (fleet/merge.py), so
+    merge order and byte-identity guarantees are untouched."""
+    if not keys:
+        return list(keys)
+    off = zlib.crc32(worker_id.encode("utf-8")) % len(keys)
+    return list(keys[off:]) + list(keys[:off])
 
 
 def worker_journal_path(path: str, worker: str) -> str:
@@ -129,6 +145,14 @@ def _run_sweep_units(path, spec, worker_id, deadline, stop_flag,
     from ..parallel.sweep import run_sweep
 
     batches = _sweep_batches(spec)
+    by_key = {key: (dev, dims, lanes) for key, dev, dims, lanes in batches}
+    # work-stealing scan: each worker walks the SAME unit set in a
+    # worker-id-rotated order, so early canonical units stop being a
+    # contention hot spot (every claim miss is a wasted lease-dir
+    # round trip); completion/merge order is unaffected
+    scan_keys = worker_scan_order(
+        [key for key, *_ in batches], worker_id
+    )
     interrupted = None
     completed = 0
     skipped_held = 0
@@ -148,7 +172,8 @@ def _run_sweep_units(path, spec, worker_id, deadline, stop_flag,
         # successful claim of a unit someone else may just have
         # finished
         done = sweep_done_units(read_all_journals(path))
-        for key, dev, dims, lanes in batches:
+        for key in scan_keys:
+            dev, dims, lanes = by_key[key]
             if stop_flag["sig"] is not None:
                 interrupted = f"signal {stop_flag['sig']}"
                 break
@@ -245,24 +270,12 @@ def _run_sweep_units(path, spec, worker_id, deadline, stop_flag,
 
 def _run_fuzz_units(path, spec, worker_id, deadline, stop_flag, ttl_s,
                     stop_after_units):
-    from ..campaign.manager import (
-        _ARTIFACTS,
-        _fuzz_point_spec,
-        _merge_counts,
-        _planet,
-    )
-    from ..mc.fuzz import (
-        draw_plans,
-        plan_rng,
-        point_config,
-        point_protocol,
-        restore_rng,
-        rng_state,
-        run_fuzz_point,
-    )
+    from ..campaign.manager import _fuzz_chunk, _planet
 
     planet = _planet(spec.aws)
     points = fuzz_points(spec)
+    keys = [f"{p}/n{n}" for p, n in points]
+    steered = bool(spec.coverage)
     interrupted = None
     chunks_done = 0
     completed_points = 0
@@ -270,7 +283,33 @@ def _run_fuzz_units(path, spec, worker_id, deadline, stop_flag, ttl_s,
     # progressing, exit (not block) once a pass advances nothing
     while True:
         pass_chunks = chunks_done
-        for proto, n in points:
+        progress = fuzz_point_progress(read_all_journals(path))
+        if steered:
+            # fleet-steered budgets: every worker ranks the SAME
+            # union-of-journals state (mc/coverage.py rank_points —
+            # recent bucket-discovery rate + starvation floor), so the
+            # fleet collectively pushes budget where coverage still
+            # climbs; the lease layer resolves two workers picking the
+            # same point
+            from ..mc.coverage import rank_points
+
+            scan = rank_points(
+                points, progress, spec.schedules,
+                min_share=spec.min_share,
+            )
+        else:
+            # blind mode: the canonical enumeration, rotated per
+            # worker like the sweep unit scan
+            scan = worker_scan_order(
+                [
+                    k
+                    for k in keys
+                    if int(progress.get(k, {}).get("tried", 0))
+                    < spec.schedules
+                ],
+                worker_id,
+            )
+        for key in scan:
             if interrupted:
                 break
             if stop_after_units is not None and (
@@ -278,17 +317,16 @@ def _run_fuzz_units(path, spec, worker_id, deadline, stop_flag, ttl_s,
             ):
                 interrupted = "unit-limit"
                 break
-            key = f"{proto}/n{n}"
-            prev = fuzz_point_progress(read_all_journals(path)).get(key)
-            if prev and int(prev["tried"]) >= spec.schedules:
-                continue
+            proto, n = key.rsplit("/n", 1)
             lease = claim_unit(path, key, worker_id, ttl_s)
             if lease is None:
                 continue
             try:
                 # re-read under the lease: the previous holder may
                 # have advanced (or finished) the point before
-                # releasing
+                # releasing — the journaled cumulative state (root +
+                # mutator generator positions, coverage map, seed
+                # pool) crosses workers through the journals
                 prev = fuzz_point_progress(
                     read_all_journals(path)
                 ).get(key)
@@ -296,16 +334,6 @@ def _run_fuzz_units(path, spec, worker_id, deadline, stop_flag, ttl_s,
                 if tried >= spec.schedules:
                     completed_points += 1
                     continue
-                # the journaled generator position — restored, never
-                # recomputed, so the remaining plan stream is
-                # identical whichever worker draws it
-                rng = (
-                    restore_rng(prev["rng_state"])
-                    if prev
-                    else plan_rng(
-                        _fuzz_point_spec(spec, proto, n, spec.chunk)
-                    )
-                )
                 with lease.heartbeater():
                     while tried < spec.schedules:
                         if stop_flag["sig"] is not None:
@@ -318,69 +346,25 @@ def _run_fuzz_units(path, spec, worker_id, deadline, stop_flag, ttl_s,
                         ):
                             interrupted = "budget exhausted"
                             break
-                        size = min(spec.chunk, spec.schedules - tried)
-                        pspec = _fuzz_point_spec(spec, proto, n, size)
-                        plans = draw_plans(
-                            pspec, point_config(pspec),
-                            point_protocol(pspec), count=size, rng=rng,
+                        entry = _fuzz_chunk(
+                            spec, proto, int(n), prev, planet, path
                         )
-                        res = run_fuzz_point(
-                            pspec,
-                            planet=planet,
-                            confirm=spec.confirm,
-                            max_confirmations=spec.max_confirm,
-                            shrink_budget=spec.shrink_budget,
-                            strict_missing=spec.strict_missing,
-                            plans=plans,
-                            lane_offset=tried,
-                            artifact_dir=os.path.join(path, _ARTIFACTS),
-                        )
-                        tried += size
-                        entry = {
-                            "kind": "fuzz",
-                            "point": key,
-                            "tried": tried,
-                            "rng_state": rng_state(rng),
-                            "flagged": (
-                                (prev["flagged"] if prev else 0)
-                                + res.flagged
-                            ),
-                            "confirmed": (
-                                (prev["confirmed"] if prev else 0)
-                                + res.confirmed
-                            ),
-                            "unprocessed": (
-                                (prev.get("unprocessed", 0) if prev else 0)
-                                + res.unprocessed
-                            ),
-                            "engine_errors": _merge_counts(
-                                prev.get("engine_errors", {})
-                                if prev else {},
-                                res.engine_errors,
-                            ),
-                            "artifacts": sorted(
-                                set(
-                                    prev.get("artifacts", [])
-                                    if prev else []
-                                )
-                                | {
-                                    os.path.relpath(f.artifact_path, path)
-                                    for f in res.findings
-                                    if f.artifact_path
-                                }
-                            ),
-                            "violations": (
-                                (prev.get("violations", []) if prev else [])
-                                + res.summary()["violations"]
-                            ),
-                        }
                         append_worker_journal(path, worker_id, entry)
                         prev = entry
+                        tried = int(entry["tried"])
                         chunks_done += 1
+                        if steered and tried < spec.schedules:
+                            # one chunk per claim: re-rank against the
+                            # fleet's fresh journals instead of
+                            # draining the point while its coverage
+                            # curve may have gone cold
+                            break
                     else:
                         completed_points += 1
             finally:
                 lease.release()
+            if steered:
+                break  # re-rank after every claimed chunk
         progress = fuzz_point_progress(read_all_journals(path))
         all_done = all(
             int(progress.get(f"{p}/n{n}", {}).get("tried", 0))

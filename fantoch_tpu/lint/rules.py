@@ -63,6 +63,11 @@ DEFAULT_PATHS = (
     # fleet campaigns: leases/worker/merge are pure host-side file
     # protocol — the scan proves they stay that way
     "fantoch_tpu/fleet",
+    # coverage-guided fuzzing: map/mutation/steering are host-side by
+    # design (the digest itself lives in engine/monitor.py, already
+    # scanned) — the scan proves the feedback loop never grows traced
+    # code paths
+    "fantoch_tpu/mc/coverage.py",
 )
 
 OUTBOX_KEYS = {"valid", "dst", "mtype", "payload"}

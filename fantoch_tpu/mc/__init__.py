@@ -17,6 +17,13 @@ halves of the state-space-exploration trade-off are working code:
   (``engine/monitor.py``); flagged schedules replay through the host
   oracle for confirmation and shrink to minimal, replayable repro
   artifacts (``python -m fantoch_tpu mc``; semantics in docs/MC.md).
+
+The fuzzer additionally closes the greybox loop (``coverage.py``):
+each lane's on-device interleaving digest feeds an AFL-style
+persistent coverage map, plans that open new buckets seed host-side
+mutators for the next chunk, and campaigns steer their schedule
+budget toward points whose coverage curve is still climbing
+(docs/MC.md "Coverage-guided fuzzing").
 """
 
 from .checker import CheckResult, ModelChecker
@@ -33,7 +40,18 @@ _FUZZ_EXPORTS = (
     "run_fuzz_point",
 )
 
-__all__ = ["CheckResult", "ModelChecker", *_FUZZ_EXPORTS]
+# coverage.py pulls in engine.faults (jax-free at import, but part of
+# the engine package) — re-exported lazily like the fuzzer
+_COVERAGE_EXPORTS = (
+    "CoverageError",
+    "CoverageMap",
+    "CoverageMismatchError",
+    "SeedPool",
+)
+
+__all__ = [
+    "CheckResult", "ModelChecker", *_FUZZ_EXPORTS, *_COVERAGE_EXPORTS
+]
 
 
 def __getattr__(name):
@@ -41,4 +59,8 @@ def __getattr__(name):
         from . import fuzz
 
         return getattr(fuzz, name)
+    if name in _COVERAGE_EXPORTS:
+        from . import coverage
+
+        return getattr(coverage, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

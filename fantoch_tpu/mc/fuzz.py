@@ -37,6 +37,14 @@ too few before declaring a timestamp stable, so a command can execute
 before every lower-timestamp conflict is known) used by the regression
 test and CI smoke job to prove the whole pipeline catches, confirms
 and shrinks a real ordering bug; see docs/MC.md.
+
+Step 2 additionally ships home each lane's interleaving coverage
+digest (``FuzzPointResult.digests``; engine/monitor.py ``cov_digest``)
+— the signal ``mc/coverage.py`` buckets AFL-style to make campaigns
+coverage-guided (seeded mutation + budget steering, docs/MC.md
+"Coverage-guided fuzzing"). Pass ``plans=`` from
+``coverage.draw_steered`` to fuzz a steered chunk; this module stays
+policy-free.
 """
 
 from __future__ import annotations
@@ -454,6 +462,10 @@ class FuzzPointResult:
     flagged: int = 0
     confirmed: int = 0
     unprocessed: int = 0  # flagged lanes skipped by the budget guard
+    # per-lane interleaving coverage digests in plan order
+    # (engine/monitor.py cov_digest via LaneResults.coverage) — what
+    # coverage-guided callers feed to mc/coverage.py CoverageMap
+    digests: List[int] = field(default_factory=list)
 
     def summary(self) -> dict:
         return {
@@ -568,6 +580,7 @@ def run_fuzz_point(
         schedules=len(lane_specs),
         elapsed_s=elapsed,
         schedules_per_sec=len(lane_specs) / max(elapsed, 1e-9),
+        digests=[int(r.coverage) for r in results],
     )
     for r in results:
         if r.err:

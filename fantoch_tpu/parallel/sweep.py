@@ -672,10 +672,12 @@ def _run_sweep(
         },
     }
     if monitor_keys:
-        # the monitor reduction already ran on device: two scalars per
-        # lane ride home instead of [N, K] hash/count planes
+        # the monitor reduction already ran on device: three scalars
+        # per lane (violation bits + first violating step + coverage
+        # digest) ride home instead of [N, K] hash/count planes
         fetch["viol"] = state["viol"]
         fetch["viol_step"] = state["viol_step"]
+        fetch["cov"] = state["cov"]
     final = finish_segmented(jax.device_get(fetch), max_steps)
     # undo the storage narrowing on whatever narrowed planes the fetch
     # carries: results are ALWAYS the wide i32 arrays the collectors
