@@ -101,6 +101,19 @@ DISPATCH_MIN_BUDGET_S = float(
     _os.environ.get("FANTOCH_BENCH_DISPATCH_MIN_BUDGET", "300")
 )
 
+# AOT cold-start self-check shape (parallel/aot.py): a fresh
+# subprocess builds a small tempo grid and acquires its sweep runner
+# twice — once with an empty artifact dir (trace + compile) and once
+# against the executable the first run serialized (load) — so
+# `aot_load_s` vs `trace_compile_s` measures exactly what a fleet
+# worker stops paying per process. Budget-guarded (the first child IS
+# a deliberate cold compile), shapes shrunk in _CPU_FALLBACK_ENV.
+AOT_COMMANDS = int(_os.environ.get("FANTOCH_BENCH_AOT_COMMANDS", "10"))
+AOT_SUBSETS = int(_os.environ.get("FANTOCH_BENCH_AOT_SUBSETS", "1"))
+AOT_MIN_BUDGET_S = float(
+    _os.environ.get("FANTOCH_BENCH_AOT_MIN_BUDGET", "300")
+)
+
 # ms/step shapes: the documented ~512-lane sweet spot plus the
 # 2048-lane bandwidth-bound regime docs/PERF.md measured at 30 vs
 # 230 ms/step — the two points the narrowing pass targets. The 512
@@ -256,20 +269,31 @@ def _bench_dims(dev):
     )
 
 
-def _dispatch_overhead() -> "tuple[float, float, str | None] | None":
-    """Serial-vs-pipelined wall time on a fixed small tempo grid
-    (``DISPATCH_SUBSETS`` × f × conflicts points, ``DISPATCH_SEGMENT``-
-    step segments so each run makes many device calls): the delta is
-    the dispatch tax the in-flight window (parallel/pipeline.py)
-    amortizes. Both runs share one compiled runner (warmup excluded)
-    and their results are compared byte-for-byte — the live twin of
-    the tests/test_pipeline.py pin, and the only one that runs on the
-    real backend. Returns ``(serial_s, pipelined_s, None)``; a byte
-    divergence returns ``(0, 0, "IDENTITY VIOLATION: ...")`` so the
-    artifact flags a correctness bug DISTINGUISHABLY from the
-    transient-skip notes; other failures return None."""
+def _dispatch_overhead() -> (
+    "tuple[float, float, float, dict, str | None] | None"
+):
+    """Serial vs pipelined vs scan-fused wall time on a fixed small
+    tempo grid (``DISPATCH_SUBSETS`` × f × conflicts points,
+    ``DISPATCH_SEGMENT``-step segments so each run makes many device
+    calls): serial-minus-pipelined is the dispatch tax the in-flight
+    window (parallel/pipeline.py) amortizes, and the scan-fused run
+    (``scan_window`` default, parallel/sweep.py) shows what is left
+    once host round-trips drop to one per window. All three runs'
+    results are compared byte-for-byte — the live twin of the
+    tests/test_pipeline.py and tests/test_scan_window.py pins, and the
+    only one that runs on the real backend. The returned
+    ``window_roundtrips`` dict carries each variant's measured host
+    dispatch count (``parallel.sweep.LAST_STATS``): the segment loop
+    pays one per segment, the scan-fused loop one per window. Returns
+    ``(serial_s, pipelined_s, fused_s, window_roundtrips, None)``; a
+    byte divergence returns a tuple whose note starts with
+    ``IDENTITY VIOLATION`` so the artifact flags a correctness bug
+    DISTINGUISHABLY from the transient-skip notes; other failures
+    return None."""
     import json as _json
     import sys
+
+    from fantoch_tpu.parallel.sweep import LAST_STATS
 
     try:
         planet = Planet.new()
@@ -284,45 +308,61 @@ def _dispatch_overhead() -> "tuple[float, float, str | None] | None":
         )
         specs.sort(key=lambda s: (s.config.f, int(s.ctx["conflict_rate"])))
 
-        def timed(depth):
+        def timed(depth, win):
             # min of 3: single-shot wall times on a shared 2-core host
             # swing by seconds (docs/PERF.md warns ±50% run-to-run even
             # on the tunnel); the minimum is the run least disturbed by
             # unrelated load, which is what the overhead delta needs
-            best, best_out = None, None
+            best, best_out, calls = None, None, 0
             for _ in range(3):
                 t0 = time.perf_counter()
                 out = run_sweep(
                     dev, dims, specs, segment_steps=DISPATCH_SEGMENT,
-                    pipeline_depth=depth,
+                    pipeline_depth=depth, scan_window=win,
                 )
                 dt = time.perf_counter() - t0
                 if best is None or dt < best:
                     best, best_out = dt, out
-            return best, best_out
+                calls = LAST_STATS["device_calls"]
+            return best, best_out, calls
 
-        timed(1)  # warmup/compile (this batch shape is its own compile)
-        serial_s, serial = timed(1)
-        piped_s, piped = timed(2)
+        timed(1, 1)  # warmup/compile (this batch shape is its own compile)
+        serial_s, serial, serial_calls = timed(1, 1)
+        piped_s, piped, _piped_calls = timed(2, 1)
+        # the scan-fused window flavor is its own compile; warm it up
+        # outside the timed window like the segment flavor
+        timed(2, None)
+        fused_s, fused, fused_calls = timed(2, None)
+        fused_win = LAST_STATS["scan_window"]
+        roundtrips = {
+            # host dispatch round-trips for the whole grid: the
+            # segment loop pays scan_window of them per checkpoint
+            # window, the scan-fused loop exactly one
+            "scan_window": fused_win,
+            "segment_loop": serial_calls,
+            "scan_fused": fused_calls,
+        }
         a = _json.dumps([r.to_json() for r in serial], sort_keys=True)
-        b = _json.dumps([r.to_json() for r in piped], sort_keys=True)
-        if a != b:
-            # a real divergence on this backend is a correctness bug,
-            # not a degraded measurement — it must never hide behind
-            # the same note a transient compile failure produces
-            print(
-                "bench: IDENTITY VIOLATION: pipelined sweep results "
-                "diverged from serial on this backend",
-                file=sys.stderr,
-            )
-            return 0.0, 0.0, (
-                "IDENTITY VIOLATION: pipelined sweep diverged from "
-                "serial on this backend — correctness bug, not a "
-                "transient skip (see stderr)"
-            )
+        for label, out in (("pipelined", piped), ("scan-fused", fused)):
+            b = _json.dumps([r.to_json() for r in out], sort_keys=True)
+            if a != b:
+                # a real divergence on this backend is a correctness
+                # bug, not a degraded measurement — it must never hide
+                # behind the same note a transient compile failure
+                # produces
+                print(
+                    f"bench: IDENTITY VIOLATION: {label} sweep results "
+                    "diverged from serial on this backend",
+                    file=sys.stderr,
+                )
+                return 0.0, 0.0, 0.0, {}, (
+                    f"IDENTITY VIOLATION: {label} sweep diverged from "
+                    "serial on this backend — correctness bug, not a "
+                    "transient skip (see stderr)"
+                )
         bad = [r.err_cause for r in serial if r.err]
         assert not bad, f"dispatch self-check failing lanes: {bad[:4]}"
-        return serial_s, piped_s, None
+        return serial_s, piped_s, fused_s, roundtrips, None
     except Exception as e:  # noqa: BLE001
         import traceback
 
@@ -526,6 +566,131 @@ def _fleet_units() -> "tuple[float, float, str | None] | None":
 
         traceback.print_exc()
         print(f"bench: fleet units/s unavailable: {e!r}", file=sys.stderr)
+        return None
+
+
+# the AOT cold-start child: a fresh process acquiring the sweep runner
+# for a small tempo grid through run_sweep(aot=...). The printed
+# `seconds` is parallel/aot.py's runner-acquisition time — trace +
+# compile (+ serialize) on the first run, deserialize + load on the
+# second — exactly the per-process tax the serialized executable
+# removes; interpreter/jax startup is identical either way and
+# excluded on purpose.
+_AOT_CHILD = r"""
+import json
+
+from fantoch_tpu.core import Config, Planet
+from fantoch_tpu.engine import EngineDims
+from fantoch_tpu.engine.protocols import dev_config_kwargs, dev_protocol
+from fantoch_tpu.parallel.sweep import (
+    LAST_STATS,
+    make_sweep_specs,
+    run_sweep,
+)
+
+planet = Planet.new()
+regions = planet.regions()
+clients = {clients}
+dev = dev_protocol("tempo", clients)
+total = {commands} * clients
+dims = EngineDims.for_protocol(
+    dev, n=3, clients=clients, payload=dev.payload_width(3),
+    total_commands=total, dot_slots=total + 1, regions=3,
+)
+specs = make_sweep_specs(
+    dev, planet,
+    region_sets=[regions[i:i + 3] for i in range({subsets})],
+    fs=[1], conflicts=[0, 100], commands_per_client={commands},
+    clients_per_region=1, dims=dims,
+    config_base=Config(**dev_config_kwargs("tempo", 3, 1)),
+)
+results = run_sweep(
+    dev, dims, specs, segment_steps={segment}, aot={aot_dir!r}
+)
+blob = json.dumps([r.to_json() for r in results], sort_keys=True)
+print("AOT-COLD " + json.dumps(
+    dict(LAST_STATS["aot"], blob_sha=__import__("hashlib").sha256(
+        blob.encode()).hexdigest())
+))
+"""
+
+
+def _aot_cold_start() -> "tuple[float, float, str | None] | None":
+    """Fresh-subprocess cold-start cost with and without a serialized
+    sweep executable (parallel/aot.py): child 1 starts against an
+    empty artifact dir and pays the full trace + compile (serializing
+    the result), child 2 starts against that artifact and loads it.
+    Returns ``(trace_compile_s, aot_load_s, note)`` — the two runner-
+    acquisition times a fleet respawn round pays per worker, byte
+    identity of the two children's results asserted via sha256; an
+    identity violation rides in the note like the dispatch
+    self-check's, other failures return None."""
+    import subprocess
+    import sys
+    import tempfile
+
+    try:
+        tmp = tempfile.mkdtemp(prefix="fantoch_aot_bench_")
+        script = _AOT_CHILD.format(
+            clients=3 * CLIENTS_PER_REGION,
+            commands=AOT_COMMANDS,
+            subsets=AOT_SUBSETS,
+            segment=DISPATCH_SEGMENT,
+            aot_dir=_os.path.join(tmp, "aot"),
+        )
+        env = dict(_os.environ)
+        # the children must measure what a REAL cold worker pays: no
+        # persistent compile cache (it would hide the trace+compile
+        # the artifact exists to remove, and bench's own cache dir is
+        # per-machine, not per-campaign)
+        env.pop("FANTOCH_COMPILE_CACHE", None)
+        env.pop("JAX_COMPILATION_CACHE_DIR", None)
+
+        def child():
+            out = subprocess.run(
+                [sys.executable, "-c", script],
+                capture_output=True, text=True, timeout=600, env=env,
+            )
+            if out.returncode != 0:
+                raise RuntimeError(
+                    f"aot cold-start child failed: {out.stderr[-1500:]}"
+                )
+            line = [
+                ln for ln in out.stdout.splitlines()
+                if ln.startswith("AOT-COLD ")
+            ][0]
+            return json.loads(line[len("AOT-COLD "):])
+
+        first = child()
+        second = child()
+        if (
+            first["source"] != "trace-compile"
+            or second["source"] != "aot-load"
+        ):
+            raise RuntimeError(
+                f"unexpected aot provenance: {first['source']} then "
+                f"{second['source']}"
+            )
+        if first["blob_sha"] != second["blob_sha"]:
+            print(
+                "bench: IDENTITY VIOLATION: loaded AOT executable "
+                "results diverged from the traced control",
+                file=sys.stderr,
+            )
+            return 0.0, 0.0, (
+                "IDENTITY VIOLATION: loaded AOT executable diverged "
+                "from the traced control — correctness bug, not a "
+                "transient skip (see stderr)"
+            )
+        return float(first["seconds"]), float(second["seconds"]), None
+    except Exception as e:  # noqa: BLE001
+        import traceback
+
+        traceback.print_exc()
+        print(
+            f"bench: aot cold-start unavailable: {e!r}",
+            file=sys.stderr,
+        )
         return None
 
 
@@ -901,16 +1066,19 @@ def main() -> None:
         dispatch = _dispatch_overhead()
         if dispatch is None:
             dispatch_note = "failed (see stderr)"
-        elif dispatch[2] is not None:
+        elif dispatch[4] is not None:
             # the byte-identity tripwire fired: surface the violation
             # note verbatim and zero the measurement
-            dispatch_note, dispatch = dispatch[2], None
+            dispatch_note, dispatch = dispatch[4], None
         else:
             print(
                 f"dispatch self-check: serial {dispatch[0]:.2f}s vs "
-                f"pipelined {dispatch[1]:.2f}s "
-                f"(overhead {dispatch[0] - dispatch[1]:+.2f}s, "
-                "byte-identical results)",
+                f"pipelined {dispatch[1]:.2f}s vs scan-fused "
+                f"{dispatch[2]:.2f}s "
+                f"(overhead {dispatch[0] - dispatch[1]:+.2f}s piped, "
+                f"{dispatch[0] - dispatch[2]:+.2f}s fused; host "
+                f"round-trips {dispatch[3]['segment_loop']} -> "
+                f"{dispatch[3]['scan_fused']}; byte-identical results)",
                 file=sys.stderr,
                 flush=True,
             )
@@ -965,6 +1133,34 @@ def main() -> None:
                 f"fleet self-check: {fleet_rates[0]:.2f} units/s solo "
                 f"vs {fleet_rates[1]:.2f} units/s 2-worker "
                 "(merged byte-identical)",
+                file=sys.stderr,
+                flush=True,
+            )
+
+    # AOT cold start (parallel/aot.py): two fresh subprocesses acquire
+    # the same sweep runner — trace+compile+serialize, then load — so
+    # `trace_compile_s` vs `aot_load_s` is the per-worker tax the
+    # fleet-shared executable removes; budget-guarded (the first child
+    # IS a deliberate cold compile), honest-zero on skip/fail,
+    # byte-identity tripwire like the dispatch self-check
+    aot_times, aot_note = None, None
+    if TOTAL_BUDGET_S - _since_birth() < AOT_MIN_BUDGET_S:
+        aot_note = (
+            "skipped: insufficient budget for the aot cold-start "
+            "subprocess runs"
+        )
+        print(f"aot cold-start {aot_note}", file=sys.stderr, flush=True)
+    else:
+        aot_times = _aot_cold_start()
+        if aot_times is None:
+            aot_note = "failed (see stderr)"
+        elif aot_times[2] is not None:
+            aot_note, aot_times = aot_times[2], None
+        else:
+            print(
+                f"aot cold start: trace+compile {aot_times[0]:.2f}s vs "
+                f"serialized load {aot_times[1]:.2f}s "
+                "(byte-identical results)",
                 file=sys.stderr,
                 flush=True,
             )
@@ -1050,6 +1246,14 @@ def main() -> None:
                 "dispatch_pipelined_s": (
                     round(dispatch[1], 3) if dispatch else 0.0
                 ),
+                # the scan-fused window run of the same grid, and each
+                # variant's measured host dispatch count (empty dict =
+                # skipped/failed) — the segment loop pays scan_window
+                # round-trips per checkpoint window, the fused loop one
+                "dispatch_fused_s": (
+                    round(dispatch[2], 3) if dispatch else 0.0
+                ),
+                "window_roundtrips": dispatch[3] if dispatch else {},
                 **(
                     {"dispatch_note": dispatch_note}
                     if dispatch_note
@@ -1091,6 +1295,19 @@ def main() -> None:
                 ),
                 "fleet_units": FLEET_UNITS,
                 **({"fleet_note": fleet_note} if fleet_note else {}),
+                # fresh-subprocess runner acquisition with vs without a
+                # serialized executable (parallel/aot.py): the
+                # per-worker cold-start tax fleet-shared AOT artifacts
+                # remove (0.0 = skipped/failed; note carries the
+                # reason — an IDENTITY-VIOLATION note means the loaded
+                # executable diverged from the traced control)
+                "trace_compile_s": (
+                    round(aot_times[0], 3) if aot_times else 0.0
+                ),
+                "aot_load_s": (
+                    round(aot_times[1], 3) if aot_times else 0.0
+                ),
+                **({"aot_note": aot_note} if aot_note else {}),
                 **(
                     {"static_kernel_cost": static_cost}
                     if static_cost
@@ -1256,6 +1473,8 @@ def _emit_unreachable(reason: str = "unreachable at startup") -> None:
                 "dispatch_overhead_s": 0.0,
                 "dispatch_serial_s": 0.0,
                 "dispatch_pipelined_s": 0.0,
+                "dispatch_fused_s": 0.0,
+                "window_roundtrips": {},
                 "dispatch_note": f"skipped: TPU backend {reason}",
                 "ms_per_step_512": 0.0,
                 "ms_per_step_2048": 0.0,
@@ -1267,6 +1486,11 @@ def _emit_unreachable(reason: str = "unreachable at startup") -> None:
                 "fleet_units_per_sec_single": 0.0,
                 "fleet_units": FLEET_UNITS,
                 "fleet_note": f"skipped: TPU backend {reason}",
+                # the aot cold-start children need a live backend to
+                # compile against — honest zeros with the shared reason
+                "trace_compile_s": 0.0,
+                "aot_load_s": 0.0,
+                "aot_note": f"skipped: TPU backend {reason}",
                 **(
                     {"static_kernel_cost": static_cost}
                     if static_cost
@@ -1306,6 +1530,11 @@ _CPU_FALLBACK_ENV = {
     "FANTOCH_BENCH_FLEET_COMMANDS": "5",
     "FANTOCH_BENCH_FLEET_SEGMENT": "256",
     "FANTOCH_BENCH_MESH_SUBSETS": "1",
+    # aot cold-start children: each pays a full cold compile by design,
+    # so the unit shape must be the smallest real sweep (one subset,
+    # few commands) for two subprocess compiles to fit the budget
+    "FANTOCH_BENCH_AOT_COMMANDS": "5",
+    "FANTOCH_BENCH_AOT_SUBSETS": "1",
 }
 
 # below this remaining total budget a CPU fallback run cannot plausibly

@@ -95,7 +95,9 @@ class SweepCampaign:
     batch_lanes: int = 64     # lanes per journal unit
     segment_steps: int = 2048
     max_steps: int = 1 << 22
-    checkpoint_every: int = 1  # segments between in-flight saves
+    # checkpoint WINDOWS between in-flight saves (a window is one
+    # host round-trip of the sweep loop — scan_window segments)
+    checkpoint_every: int = 1
     # segments kept in flight per batch (parallel/pipeline.py): the
     # dispatch tax overlaps device execution between checkpoint
     # boundaries (raise checkpoint_every past 1 to let the window
@@ -111,6 +113,18 @@ class SweepCampaign:
     # bit-exactly, so fleet workers on heterogeneous device counts
     # still interchange units.
     mesh_shard: Optional[bool] = None
+    # segments scan-fused into one device call (parallel/sweep.py
+    # scan_window): host round-trips drop from per-segment to
+    # per-window, results stay byte-identical. None = the
+    # segment_steps-derived default; 1 = the serial segment loop. Like
+    # pipeline_depth, NOT a checkpoint meta key — units checkpointed
+    # under one window size resume under another bit-exactly.
+    scan_window: Optional[int] = None
+    # serialize the sweep executable into <dir>/aot and load it
+    # instead of tracing on later invocations / other fleet workers
+    # (parallel/aot.py; signature drift refused by name). The first
+    # worker pays the one trace+compile, the fleet shares it.
+    aot: bool = False
     aws: bool = False
 
     kind = "sweep"
@@ -232,6 +246,13 @@ def campaign_from_json(obj: dict):
             )
         if spec.region_sets is not None and not spec.region_sets:
             raise CampaignError("region_sets must not be empty when set")
+        if spec.aot and spec.mesh_shard:
+            raise CampaignError(
+                "aot serializes the jit window runner; the shard_map "
+                "mesh_shard layout is not serializable — drop one"
+            )
+        if spec.scan_window is not None and int(spec.scan_window) < 1:
+            raise CampaignError("scan_window must be >= 1 when set")
     return spec
 
 
@@ -313,6 +334,19 @@ def _load_or_init_spec(path: str, spec, resume: bool):
         cpath, json.dumps(spec.to_json(), indent=2, sort_keys=True)
     )
     return spec
+
+
+def campaign_aot_dir(path: str, spec) -> "str | None":
+    """Where a campaign's serialized sweep executables live
+    (``<dir>/aot``, parallel/aot.py) when the spec opts in — shared by
+    the single-process manager and every fleet worker, so the first
+    process to compile a unit shape serializes it and the rest load
+    instead of trace."""
+    if not getattr(spec, "aot", False):
+        return None
+    from ..parallel.aot import AOT_DIR
+
+    return os.path.join(path, AOT_DIR)
 
 
 def _planet(aws: bool):
@@ -489,6 +523,8 @@ def _run_sweep_campaign(path: str, spec: SweepCampaign, deadline,
                 mesh_shard=bool(spec.mesh_shard),
                 checkpoint=ck,
                 pipeline_depth=spec.pipeline_depth,
+                scan_window=spec.scan_window,
+                aot=campaign_aot_dir(path, spec),
             )
         except SweepInterrupted as e:
             interrupted = e.reason

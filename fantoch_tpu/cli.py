@@ -330,6 +330,7 @@ def cmd_sweep(args) -> None:
         faults=fault_plans,
         traffic=traffic,
     )
+    from .parallel.aot import AotMismatchError
     from .parallel.sweep import LaneMixingError
 
     try:
@@ -338,11 +339,18 @@ def cmd_sweep(args) -> None:
             shard_lanes=True if args.shard_lanes else None,
             mesh_shard=args.mesh_shard,
             pipeline_depth=args.pipeline_depth,
+            scan_window=args.scan_window,
+            aot=args.aot_dir,
         )
-    except LaneMixingError as e:
-        # the GL203 gate: a step that mixes lanes must never be
-        # partitioned — refusal, not a wrong answer
-        print(f"sweep refused: {e}", file=sys.stderr)
+    except (LaneMixingError, AotMismatchError, ValueError) as e:
+        # the GL203 gate (a step that mixes lanes must never be
+        # partitioned), the AOT identity gate (a stale/corrupted
+        # serialized executable must never run), and run_sweep's own
+        # flag-combination refusals (aot + mesh_shard) — refusal, not
+        # a wrong answer; ValueError rides here like cmd_fleet's
+        print(
+            f"sweep refused: {type(e).__name__}: {e}", file=sys.stderr
+        )
         raise SystemExit(2)
     errs = sum(1 for r in results if r.err)
     summary = {
@@ -572,6 +580,7 @@ def cmd_campaign(args) -> None:
     disagreement is refused."""
     from .campaign import CampaignError, campaign_from_json, run_campaign
     from .engine.checkpoint import CheckpointError
+    from .parallel.aot import AotMismatchError
 
     spec = None
     if args.grid:
@@ -591,9 +600,10 @@ def cmd_campaign(args) -> None:
             budget_s=args.budget_s,
             stop_after_segments=args.stop_after_segments,
         )
-    except (CheckpointError, CampaignError) as e:
+    except (CheckpointError, CampaignError, AotMismatchError) as e:
         # refusal, not recovery: name the reason and exit non-zero so
-        # CI's corrupted-manifest self-check can pin the gate
+        # CI's corrupted-manifest and corrupted-executable self-checks
+        # can pin the gate
         print(
             f"campaign refused: {type(e).__name__}: {e}",
             file=sys.stderr,
@@ -669,6 +679,7 @@ def cmd_fleet(args) -> None:
         merge_campaign,
         run_fleet_worker,
     )
+    from .parallel.aot import AotMismatchError
 
     grid_text = None
     spec = None
@@ -732,9 +743,11 @@ def cmd_fleet(args) -> None:
                 )
                 raise SystemExit(EXIT_INTERRUPTED)
             return
-    except (CheckpointError, CampaignError, FleetError, ValueError) as e:
+    except (CheckpointError, CampaignError, FleetError,
+            AotMismatchError, ValueError) as e:
         # refusal, not recovery: stale/corrupt checkpoints, campaign
-        # disagreements, bad worker ids, conflicting journals — named
+        # disagreements, bad worker ids, conflicting journals,
+        # stale/corrupted serialized executables — named
         print(f"fleet refused: {type(e).__name__}: {e}", file=sys.stderr)
         raise SystemExit(2)
     if not done:
@@ -1275,6 +1288,23 @@ def main(argv=None) -> None:
         "(parallel/pipeline.py): dispatch overlaps device execution; "
         "1 = the serial reference loop (byte-identical results)",
     )
+    sw.add_argument(
+        "--scan-window",
+        type=int,
+        default=None,
+        help="segments scan-fused into ONE device call "
+        "(parallel/sweep.py): host round-trips drop from per-segment "
+        "to per-window, byte-identical results; default derives from "
+        "segment_steps, 1 = the serial segment loop",
+    )
+    sw.add_argument(
+        "--aot-dir",
+        default=None,
+        help="serialize the sweep executable here and load it instead "
+        "of tracing on later invocations (parallel/aot.py): signature "
+        "drift or a corrupted artifact is refused by name (exit 2); "
+        "incompatible with --mesh-shard",
+    )
     sw.add_argument("--out", default=None, help="results JSONL path")
     sw.set_defaults(fn=cmd_sweep)
 
@@ -1344,7 +1374,10 @@ def main(argv=None) -> None:
         '\'{"kind": "sweep", "protocols": ["tempo"], "ns": [3, 5], '
         '"conflicts": [0, 100], "subsets": 4}\' or '
         '\'{"kind": "fuzz", "protocols": ["tempo"], "ns": [3], '
-        '"schedules": 2048, "chunk": 256}\'; fuzz grids take '
+        '"schedules": 2048, "chunk": 256}\'; sweep grids take '
+        '"scan_window" (segments per device call, docs/PERF.md) and '
+        '"aot": true (serialize + share sweep executables under '
+        "<dir>/aot); fuzz grids take "
         '"coverage": true for coverage-guided steering (plus '
         '"steer_window"/"min_share" knobs — docs/MC.md) '
         "(required for a new campaign; optional-but-verified with "
@@ -1375,7 +1408,9 @@ def main(argv=None) -> None:
     fl.add_argument("--grid", default=None,
                     help="campaign spec: JSON object or @file (same "
                     "schema as `campaign --grid`, incl. sweep-grid "
-                    '"mesh_shard": true and fuzz-grid "coverage": '
+                    '"mesh_shard": true, "aot": true — workers load '
+                    "the fleet-shared serialized executable instead "
+                    'of tracing — and fuzz-grid "coverage": '
                     "true for fleet-steered budgets); required on "
                     "first touch, optional-but-verified afterwards")
     fl.add_argument("--worker-id", default=None,

@@ -97,10 +97,13 @@ class CheckpointSpec:
     path
         artifact directory (created on first save).
     every
-        segments between saves (1 = every boundary). Each save fetches
-        the full batched state to host (~100 MB per 512 lanes), so
-        raise this when the segment cost dwarfs the work between
-        boundaries — docs/PERF.md "checkpoint cadence".
+        checkpoint *windows* between saves (1 = every boundary). A
+        window is one host round-trip of the sweep loop — ``run_sweep
+        (scan_window=W)`` fuses W segments into it, so cadence counts
+        device calls, not raw segments (docs/CAMPAIGN.md). Each save
+        fetches the full batched state to host (~100 MB per 512
+        lanes), so raise this when the window cost dwarfs the work
+        between boundaries — docs/PERF.md "checkpoint cadence".
     resume
         load an existing valid checkpoint at ``path`` before running
         (a stale/corrupt one is refused loudly, never ignored).
@@ -110,11 +113,13 @@ class CheckpointSpec:
     budget_s
         wall-clock budget measured from the ``run_sweep`` call; once
         exceeded the run saves and raises :class:`SweepInterrupted` at
-        the next segment boundary.
+        the next window boundary.
     stop_after_segments
-        stop (save + raise) after this many completed segments — the
-        deterministic interruption hook the tests and the CI smoke
-        job's corrupted-manifest self-check drive.
+        stop (save + raise) after this many completed checkpoint
+        windows (the name predates scan fusion; with ``scan_window=1``
+        a window IS one segment) — the deterministic interruption hook
+        the tests and the CI smoke job's corrupted-manifest self-check
+        drive.
     """
 
     path: str

@@ -1268,29 +1268,81 @@ def build_runner(
     return jax.jit(jax.vmap(run_lane))
 
 
+#: first jaxlib where executable deserialization preserves donation
+#: aliasing, killing the donation-vs-deserialization corruption class
+#: for good. On the current 0.4.x pin the bug is REAL and re-measured
+#: (docs/PERF.md "Pipelined dispatch & donation"): a process that has
+#: deserialized any executable from the persistent compile cache
+#: corrupts donated state, and the AOT serialization surface
+#: (parallel/aot.py) reproduces the purest form — a donated
+#: executable loaded via ``serialize_executable`` returns garbage
+#: counters in ANY process, cache or no cache. Donation therefore
+#: stays version-gated: old jaxlib → the old cache-free-process rule
+#: (and never on deserialized AOT executables); once the pin moves to
+#: or past this version the exclusions retire themselves with no code
+#: change.
+DONATION_CACHE_FIX_JAXLIB = (0, 5, 0)
+
+
+def _jaxlib_version() -> tuple:
+    import jaxlib
+
+    parts = []
+    for p in jaxlib.__version__.split("."):
+        digits = "".join(ch for ch in p if ch.isdigit())
+        if not digits:
+            break
+        parts.append(int(digits))
+    return tuple(parts)
+
+
 def donation_safe() -> bool:
     """Whether ``donate_argnums`` buffer donation is safe in THIS
-    process: donation and the persistent XLA compile cache are
-    mutually exclusive on the current jaxlib (0.4.x, observed on
-    0.4.37 CPU): once a process has deserialized ANY executable from
-    the persistent cache, running a donated executable — even one
-    compiled fresh in-process — flakily segfaults or silently corrupts
-    the aliased state (reproduced: cache-free processes are bit-correct
-    across every run; warm-cache processes return garbage counters or
-    abort in malloc). Silent corruption is disqualifying, so donation
+    process — a *version gate* around the jaxlib
+    donation-vs-deserialization corruption
+    (:data:`DONATION_CACHE_FIX_JAXLIB`).
+
+    On the pinned 0.4.x jaxlib, donation and the persistent compile
+    cache are mutually exclusive at process granularity: once a
+    process has deserialized ANY executable from the cache, running a
+    donated executable — even one compiled fresh in-process — flakily
+    segfaults or silently corrupts the aliased state (reproduced:
+    cache-free processes are bit-correct across every run; warm-cache
+    processes return garbage counters or abort in malloc; docs/PERF.md
+    carries the repro notes, re-confirmed while building the AOT
+    path). Silent corruption is disqualifying, so donation
     auto-engages exactly when the persistent cache is off for this
-    process, and ``FANTOCH_SWEEP_DONATE=0/1`` forces it either way
-    (docs/PERF.md "Pipelined dispatch & donation" carries the repro
-    notes)."""
+    process. On jaxlib >= the fix version the exclusion retires itself
+    and donation engages unconditionally.
+    ``FANTOCH_SWEEP_DONATE=0/1`` forces it either way (the repro
+    knob); serialized AOT executables are gated separately and harder
+    — :func:`aot_donation_safe` ignores the env override because a
+    donated deserialized executable is *known* to corrupt."""
     import os
 
     env = os.environ.get("FANTOCH_SWEEP_DONATE")
     if env is not None:
         return env != "0"
+    if _jaxlib_version() >= DONATION_CACHE_FIX_JAXLIB:
+        return True
     return not (
         jax.config.jax_enable_compilation_cache
         and jax.config.jax_compilation_cache_dir
     )
+
+
+def aot_donation_safe() -> bool:
+    """Whether an executable that round-trips through
+    ``jax.experimental.serialize_executable`` (parallel/aot.py) may
+    donate its input state. On the pinned jaxlib the answer is a hard
+    no — deserialization drops the donation aliasing and the loaded
+    executable reads freed buffers (measured: garbage counters on the
+    very first donated call, cache-free process included), so
+    ``run_sweep(aot=...)`` compiles and serializes *undonated*
+    runners, whatever ``FANTOCH_SWEEP_DONATE`` says — this is a
+    known-corruption gate, not a preference. Retires itself at
+    :data:`DONATION_CACHE_FIX_JAXLIB` like :func:`donation_safe`."""
+    return _jaxlib_version() >= DONATION_CACHE_FIX_JAXLIB
 
 
 def segment_lane_fn(
@@ -1402,6 +1454,89 @@ def build_segment_runner(
 
     runner = jax.jit(
         run_batch, donate_argnums=(0,) if donate else ()
+    )
+    alive = jax.jit(
+        lambda st, ctx: jnp.any(
+            jax.vmap(
+                lambda s, c: _lane_running(dims, s, c, max_steps, faults)
+            )(st, ctx)
+        )
+    )
+    return runner, alive
+
+
+def window_batch_fn(
+    protocol, dims: EngineDims, max_steps: int = 1 << 22,
+    reorder: bool = False, faults: FaultFlags = NO_FAULTS,
+    monitor_keys: int = 0, narrow: tuple = (),
+):
+    """The un-jitted scan-fused window body both execution layouts
+    share: ``run_window(st, ctx, untils) -> (state, any_alive)``
+    advances the whole batch through ``len(untils)`` consecutive
+    segments in ONE device call — a ``lax.scan`` whose body is exactly
+    the batched segment step (the vmapped :func:`segment_lane_fn`, the
+    same per-lane trace the checkpoint signature hashes and GL203
+    proves), so the host pays its dispatch round-trip once per
+    *window* instead of once per segment.
+
+    Safety is the segment runner's fixed-point property: a finished
+    batch re-running a segment is a byte-exact no-op, so the dead tail
+    iterations of the window a batch finishes inside change nothing —
+    scan-fused results are byte-identical to the serial segment loop
+    (pinned in tests/test_scan_window.py). Liveness is *carried
+    through the scan* and comes home once per window: the flag
+    returned is the last segment's ``any(running)`` verdict, exactly
+    the value the segment loop would have resolved there.
+
+    ``jax.jit`` (:func:`build_window_runner`) serves the single-device
+    / NamedSharding layout; ``parallel/partition.py`` runs the same
+    scan per shard inside ``shard_map`` with one liveness ``psum``
+    after the scan."""
+    run_lane = segment_lane_fn(
+        protocol, dims, max_steps, reorder, faults, monitor_keys,
+        narrow=narrow,
+    )
+
+    def run_window(st, ctx, untils):
+        def seg(carry, until):
+            s, _alive = carry
+            out, running = jax.vmap(run_lane, in_axes=(0, 0, None))(
+                s, ctx, until
+            )
+            return (out, jnp.any(running)), ()
+
+        # the initial alive flag is immediately overwritten by the
+        # first segment (every window runs >= 1 segment)
+        (out, alive), _ = jax.lax.scan(
+            seg, (st, jnp.asarray(True)), untils
+        )
+        return out, alive
+
+    return run_window
+
+
+def build_window_runner(
+    protocol, dims: EngineDims, max_steps: int = 1 << 22,
+    reorder: bool = False, faults: FaultFlags = NO_FAULTS,
+    monitor_keys: int = 0, narrow: tuple = (), donate: bool = False,
+):
+    """Like :func:`build_segment_runner` but one device call advances
+    a whole checkpoint *window* of segments:
+    ``runner(state, ctx, untils) -> (state, any_alive)`` where
+    ``untils`` is the window's ``[W]`` i32 segment-boundary ladder
+    (values past ``max_steps`` clamp inside the per-lane step, so the
+    tail window just passes a clipped ladder). The window length is
+    static (it is the scan's trip count — part of the compiled
+    executable, like the batch shape); the boundary *values* are
+    runtime arguments, so one compiled runner serves every window of a
+    sweep. ``donate=True`` has exactly the segment runner's contract:
+    the input state is consumed per call."""
+    run_window = window_batch_fn(
+        protocol, dims, max_steps, reorder, faults, monitor_keys,
+        narrow=narrow,
+    )
+    runner = jax.jit(
+        run_window, donate_argnums=(0,) if donate else ()
     )
     alive = jax.jit(
         lambda st, ctx: jnp.any(

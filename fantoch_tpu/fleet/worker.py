@@ -136,13 +136,25 @@ def fuzz_points(spec) -> List[Tuple[str, int]]:
 
 def _run_sweep_units(path, spec, worker_id, deadline, stop_flag,
                      ttl_s, stop_after_units, stop_after_segments):
-    from ..campaign.manager import _CKPT, _sweep_batches
+    from ..campaign.manager import (
+        _CKPT,
+        _sweep_batches,
+        campaign_aot_dir,
+    )
     from ..engine.checkpoint import (
         CheckpointSpec,
         SweepInterrupted,
         discard_checkpoint,
     )
     from ..parallel.sweep import run_sweep
+
+    # load-instead-of-trace (parallel/aot.py): with the campaign's
+    # `aot` flag set, the first claimer of a unit shape AOT-compiles
+    # and serializes the sweep executable under the SHARED campaign
+    # dir; every other worker (and every respawn) loads it and skips
+    # the per-process trace+compile entirely. Signature drift between
+    # workers is refused by name, never silently retraced.
+    aot_dir = campaign_aot_dir(path, spec)
 
     batches = _sweep_batches(spec)
     by_key = {key: (dev, dims, lanes) for key, dev, dims, lanes in batches}
@@ -226,6 +238,10 @@ def _run_sweep_units(path, spec, worker_id, deadline, stop_flag,
                             ),
                             checkpoint=ck,
                             pipeline_depth=spec.pipeline_depth,
+                            scan_window=getattr(
+                                spec, "scan_window", None
+                            ),
+                            aot=aot_dir,
                         )
                 except SweepInterrupted as e:
                     # the unit's state is durably checkpointed under

@@ -56,7 +56,8 @@ DEFAULT_PATHS = (
     "fantoch_tpu/traffic",
     "fantoch_tpu/bote/validate.py",
     # the sweep driver + its pipelined segment window + the shard_map
-    # partition layer (host-side orchestration by design; the scan
+    # partition layer + the AOT executable serialization layer
+    # (parallel/aot.py — host-side orchestration by design; the scan
     # proves the dispatch loop never grows raw emissions, tracer
     # branching, or host-sync ops)
     "fantoch_tpu/parallel",

@@ -1,0 +1,383 @@
+"""Fleet-shared AOT sweep executables.
+
+Every fleet worker used to pay a full trace + compile before its first
+unit — minutes per process for the bigger protocol steps (docs/PERF.md
+round-3 table), multiplied by every worker in a fleet and every
+respawn round. The persistent XLA compile cache removes the *compile*
+re-pay but not the trace, and is keyed per machine, not per campaign.
+This module removes both: the sweep runner is AOT-lowered once
+(``jax.jit(...).lower(...).compile()`` — the pjit/``donate_argnums``
+lowering surface), serialized with
+``jax.experimental.serialize_executable`` into the shared campaign
+directory, and every later worker *loads* the executable instead of
+tracing (``fleet/worker.py`` passes the campaign's ``aot/`` dir through
+``run_sweep(aot=...)``).
+
+Identity and refusal rules mirror the checkpoint contract
+(engine/checkpoint.py): the artifact manifest records an **executable
+signature** — the per-lane step signature (protocol identity +
+``EngineDims`` + jax version + sha256 of the step jaxpr) extended with
+everything the *batched, windowed* executable additionally bakes in:
+lane count, scan window, donation, the narrowing spec, jaxlib version,
+backend platform and device count. Artifacts are *named* by the
+drift-free subset of that signature (the unit slot: a campaign dir
+legitimately holds one executable per protocol group / batch shape /
+window / backend), while the code-and-toolchain components — jax and
+jaxlib versions, the step-jaxpr sha256 — are verified inside the
+manifest: a worker whose code drifted finds the same slot file and is
+*refused* with :class:`AotMismatchError` naming the drift, never left
+to silently trace a divergent executable beside it. A payload whose
+bytes fail the recorded sha256 (truncation, tampering) is refused the
+same way.
+
+Trust model: the serialized payload is an XLA executable wrapped in
+pickle (the upstream ``serialize_executable`` format), so loading one
+executes code from the artifact. Load only from campaign directories
+you would already trust for checkpoints; the sha256 gate catches
+corruption, not malice. See docs/PERF.md § "Scan-fused windows & AOT
+executables".
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from dataclasses import dataclass
+from typing import Dict
+
+AOT_KIND = "fantoch-tpu-aot-executable"
+AOT_VERSION = 1
+
+#: campaign-dir subdirectory fleet workers share executables through
+AOT_DIR = "aot"
+
+
+class AotMismatchError(RuntimeError):
+    """A serialized sweep executable could not be used: its signature
+    disagrees with the runner this process needs (protocol / dims /
+    jax / jaxlib / lane count / window / narrowing / donation drift),
+    or its payload bytes fail the recorded sha256. Refused by name —
+    the caller falls back to trace+compile only for a *missing*
+    artifact, never a wrong one."""
+
+
+@dataclass(frozen=True)
+class AotSpec:
+    """How ``run_sweep`` should use AOT executables.
+
+    dir
+        artifact directory (the campaign's shared ``aot/`` dir).
+    save
+        serialize a freshly compiled executable into ``dir`` so later
+        processes load instead of trace.
+    load
+        load a matching serialized executable when one exists (a
+        present-but-mismatched artifact is refused, never ignored).
+    """
+
+    dir: str
+    save: bool = True
+    load: bool = True
+
+
+#: how the last ``get_runner`` call in this process obtained its
+#: executable — ``{"source": "aot-load" | "trace-compile",
+#: "seconds": float, "path": str | None}``. bench.py's cold-start
+#: metrics and the AOT tests read this; purely observational.
+LAST_AOT: dict = {}
+
+
+#: signature components that describe the *code and toolchain*, not
+#: the unit: a disagreement here on an artifact for the same unit is
+#: DRIFT (refused by name), whereas a disagreement on any other
+#: component simply identifies a different executable slot (a campaign
+#: dir legitimately holds one artifact per batch shape / protocol
+#: group / window / backend — fleet grids have many)
+DRIFT_KEYS = ("jax", "jaxlib", "step_jaxpr_sha256")
+
+
+def _slot_hash(signature: Dict[str, str]) -> str:
+    """The artifact's *file* identity: every signature component except
+    the drift-prone ones, so a worker whose code/toolchain drifted
+    still looks at the SAME file as the worker that wrote it — and
+    then fails the in-manifest signature check by name, instead of
+    silently tracing its own divergent executable next to it."""
+    slot = {k: v for k, v in signature.items() if k not in DRIFT_KEYS}
+    blob = json.dumps(slot, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+def executable_signature(step_sig: Dict[str, str], *, lanes: int,
+                         window: int, donate: bool, narrow: tuple,
+                         sharding: str = "",
+                         ) -> Dict[str, str]:
+    """The full identity of one batched sweep executable. ``step_sig``
+    is the checkpoint-layer per-lane signature
+    (engine/checkpoint.py ``step_signature``) — protocol identity,
+    dims, jax version, trace flags, step-jaxpr sha256; the rest is what
+    the batched AOT artifact additionally specializes on (the
+    executable is compiled for exact input shapes/dtypes and a fixed
+    device set, unlike a checkpoint). ``sharding`` is the input
+    state's placement (the repr of its first leaf's sharding): a
+    ``shard_lanes=False`` single-device run and a lane-sharded run of
+    the same padded lane count compile genuinely different
+    executables, so they must occupy different slots rather than
+    mis-load each other's artifact."""
+    import jax
+    import jaxlib
+
+    return dict(
+        step_sig,
+        kind=AOT_KIND,
+        lanes=repr(int(lanes)),
+        scan_window=repr(int(window)),
+        donate=repr(bool(donate)),
+        narrow=repr(tuple(tuple(e) for e in narrow)),
+        sharding=str(sharding),
+        jaxlib=jaxlib.__version__,
+        platform=jax.default_backend(),
+        device_count=repr(jax.device_count()),
+    )
+
+
+def _paths(dir_: str, signature: Dict[str, str]) -> "tuple[str, str]":
+    key = _slot_hash(signature)[:16]
+    return (
+        os.path.join(dir_, f"exe-{key}.json"),
+        os.path.join(dir_, f"exe-{key}.bin"),
+    )
+
+
+def save_executable(dir_: str, signature: Dict[str, str],
+                    compiled) -> str:
+    """Serialize a compiled sweep executable into ``dir_``. Crash-safe
+    like every durable artifact (payload renamed into place before the
+    manifest referencing it); concurrent fleet workers racing the first
+    compile write identical bytes under pid-unique temp names, so the
+    winner is irrelevant. Returns the manifest path."""
+    from jax.experimental import serialize_executable as _se
+
+    from ..engine.checkpoint import atomic_write
+
+    os.makedirs(dir_, exist_ok=True)
+    payload, _in_tree, _out_tree = _se.serialize(compiled)
+    # the pytrees are NOT stored: the loader reconstructs them from its
+    # own freshly built (state, ctx, untils) arguments, and a structure
+    # drift is already a signature mismatch (the step signature hashes
+    # the state/ctx tree the jaxpr was traced over)
+    mpath, ppath = _paths(dir_, signature)
+    atomic_write(ppath, bytes(payload))
+    manifest = {
+        "kind": AOT_KIND,
+        "version": AOT_VERSION,
+        "signature": signature,
+        "payload": os.path.basename(ppath),
+        "payload_sha256": hashlib.sha256(bytes(payload)).hexdigest(),
+    }
+    atomic_write(mpath, json.dumps(manifest, indent=2, sort_keys=True))
+    return mpath
+
+
+def load_executable(dir_: str, signature: Dict[str, str],
+                    example_args: tuple, example_out):
+    """Load + verify a serialized executable for ``signature``.
+
+    Returns the loaded callable, or ``None`` when no artifact for this
+    signature exists (the caller traces, compiles and — under
+    ``AotSpec.save`` — serializes one). A *present* artifact that
+    cannot be used is refused with :class:`AotMismatchError` naming the
+    drifted component or the corruption; missing-vs-wrong is the same
+    distinction the checkpoint loader draws.
+
+    ``example_args``/``example_out`` carry the caller's own freshly
+    built argument/output trees — the pytree structure the executable
+    was compiled for is reconstructed locally from them instead of
+    trusting structure stored in the artifact.
+    """
+    import jax
+    from jax.experimental import serialize_executable as _se
+
+    mpath, ppath = _paths(dir_, signature)
+    if not os.path.exists(mpath):
+        # nothing serialized for this unit slot yet (artifacts are
+        # named by the drift-free slot hash, so code/toolchain drift
+        # can never land here — it finds the manifest and fails the
+        # signature check below instead)
+        return None
+    try:
+        with open(mpath) as fh:
+            manifest = json.load(fh)
+    except (OSError, ValueError) as e:
+        raise AotMismatchError(
+            f"AOT manifest unreadable at {mpath}: {e}"
+        ) from e
+    if manifest.get("kind") != AOT_KIND or (
+        manifest.get("version") != AOT_VERSION
+    ):
+        raise AotMismatchError(
+            f"not a {AOT_KIND} v{AOT_VERSION} artifact: "
+            f"kind={manifest.get('kind')!r} "
+            f"version={manifest.get('version')!r}"
+        )
+    saved = manifest.get("signature") or {}
+    bad = sorted(
+        k for k in signature if saved.get(k) != signature[k]
+    )
+    if bad:
+        detail = "; ".join(
+            f"{k}: saved {str(saved.get(k))[:80]!r} != current "
+            f"{str(signature[k])[:80]!r}"
+            for k in bad
+        )
+        raise AotMismatchError(
+            f"stale AOT executable refused ({', '.join(bad)} changed "
+            f"since it was serialized): {detail}"
+        )
+    if not os.path.exists(ppath):
+        raise AotMismatchError(
+            f"AOT payload {os.path.basename(ppath)!r} missing from "
+            f"{dir_}"
+        )
+    with open(ppath, "rb") as fh:
+        payload = fh.read()
+    digest = hashlib.sha256(payload).hexdigest()
+    if digest != manifest.get("payload_sha256"):
+        raise AotMismatchError(
+            f"AOT payload {os.path.basename(ppath)} truncated or "
+            f"corrupted: sha256 {digest[:12]}... != recorded "
+            f"{str(manifest.get('payload_sha256'))[:12]}..."
+        )
+    in_tree = jax.tree_util.tree_structure((tuple(example_args), {}))
+    out_tree = jax.tree_util.tree_structure(example_out)
+    try:
+        return _se.deserialize_and_load(payload, in_tree, out_tree)
+    except Exception as e:  # noqa: BLE001 — upstream raises variously
+        raise AotMismatchError(
+            f"AOT executable {os.path.basename(ppath)} failed to "
+            f"deserialize on this jax/jaxlib/backend: {e}"
+        ) from e
+
+
+def _compile_self_contained(build, state, ctx, untils, *,
+                            serialize: bool):
+    """AOT-lower + compile the windowed runner
+    (``jax.jit(...).lower(...).compile()``). When the executable is
+    about to be *serialized*, the persistent compile cache is disabled
+    for the duration of the compile: a cache-served (or
+    kernel-cache-assisted — ``jax_persistent_cache_enable_xla_caches``)
+    executable references JIT kernel symbols that live in the
+    machine-local cache, and a fresh process loading its serialized
+    form dies with ``Symbols not found`` (measured on the pinned
+    jaxlib). The fleet-shared artifact must be self-contained, so the
+    serializing compile always runs cold — that one compile is exactly
+    the cost the artifact saves every OTHER process.
+
+    Flipping the config knobs alone is NOT enough: jax memoizes
+    "is the cache used" per process (``compilation_cache
+    .is_cache_used`` checks once and latches), so a process that
+    already compiled anything through the persistent cache would
+    *still* serve this compile from disk — including an entry some
+    earlier run compiled WITH the kernel cache, whose re-serialized
+    form is exactly the non-self-contained payload this function
+    exists to prevent (the cache key strips the kernel-cache path, so
+    poisoned and clean compiles share an entry). ``reset_cache()``
+    around the compile drops that latch so the disabled config
+    actually takes effect and the compile is a true
+    ``backend_compile``; the second reset lets later compiles
+    re-latch the cache back on."""
+    import jax
+
+    if not serialize:
+        return build().lower(state, ctx, untils).compile()
+
+    def _reset_cache_latch():
+        # private surface, guarded like the knob loop below: on a jax
+        # where it moved, the knobs alone still disable the cache for
+        # processes that have not compiled through it yet, and a
+        # non-self-contained artifact is caught downstream — the
+        # loader refuses a payload that fails to deserialize
+        # (AotMismatchError), and CI's aot-smoke loads every artifact
+        # it serializes in a fresh process
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:
+            pass
+
+    restore = []
+    for knob, off in (
+        ("jax_enable_compilation_cache", False),
+        ("jax_persistent_cache_enable_xla_caches", "none"),
+    ):
+        try:
+            restore.append((knob, getattr(jax.config, knob)))
+            jax.config.update(knob, off)
+        except Exception:  # knob absent on this jax version
+            pass
+    _reset_cache_latch()
+    try:
+        return build().lower(state, ctx, untils).compile()
+    finally:
+        for knob, old in restore:
+            jax.config.update(knob, old)
+        # drop the cache-disabled latch too, so post-serialize compiles
+        # in this process go back to the persistent cache
+        _reset_cache_latch()
+
+
+def get_runner(spec: "AotSpec", step_sig: Dict[str, str], *,
+               build, state, ctx, untils, window: int, donate: bool,
+               narrow: tuple):
+    """The one entry point ``run_sweep`` uses: return a windowed sweep
+    runner ``(state, ctx, untils) -> (state, any_alive)`` for this
+    exact batch, loading a serialized executable when the campaign dir
+    has a matching one and AOT-compiling (+ serializing) otherwise.
+
+    ``build()`` must return the *traceable* jitted runner (the
+    ``build_window_runner`` closure); ``state``/``ctx``/``untils`` are
+    the exact device arguments of the first call — the lowering
+    specializes on their shapes/dtypes/shardings, which is why the
+    lane count rides in the signature.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    leaf = jax.tree_util.tree_leaves(state)[0]
+    signature = executable_signature(
+        step_sig, lanes=int(leaf.shape[0]), window=window,
+        donate=donate, narrow=narrow,
+        # the device layout the lowering specializes on (state is
+        # already device_put by the caller); NamedSharding reprs are
+        # stable across processes for the same mesh topology
+        sharding=repr(getattr(leaf, "sharding", "")),
+    )
+    example_out = (state, jnp.asarray(True))
+    t0 = time.perf_counter()
+    if spec.load:
+        loaded = load_executable(
+            spec.dir, signature, (state, ctx, untils), example_out
+        )
+        if loaded is not None:
+            LAST_AOT.clear()
+            LAST_AOT.update(
+                source="aot-load",
+                seconds=time.perf_counter() - t0,
+                path=_paths(spec.dir, signature)[1],
+            )
+            return loaded
+    compiled = _compile_self_contained(
+        build, state, ctx, untils, serialize=spec.save
+    )
+    path = None
+    if spec.save:
+        path = save_executable(spec.dir, signature, compiled)
+    LAST_AOT.clear()
+    LAST_AOT.update(
+        source="trace-compile",
+        seconds=time.perf_counter() - t0,
+        path=path,
+    )
+    return compiled

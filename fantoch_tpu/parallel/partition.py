@@ -58,29 +58,48 @@ def lane_sharding(mesh: Mesh) -> NamedSharding:
 @functools.lru_cache(maxsize=None)
 def _cached_mesh_runner(protocol, dims, max_steps: int, reorder: bool,
                         faults, monitor_keys: int, narrow: tuple,
-                        donate: bool, devices: tuple):
-    """One compiled shard_map runner per (runner key, device tuple) —
-    the same memoization contract as ``parallel/sweep.py
-    _cached_runner`` (device protocols have value identity), extended
-    with the mesh's device tuple so a test meshing a device subset
-    never aliases the all-device runner."""
-    from ..engine.core import segment_lane_fn
+                        donate: bool, devices: tuple, window: int):
+    """One compiled shard_map runner per (runner key, device tuple,
+    scan window) — the same memoization contract as
+    ``parallel/sweep.py _cached_runner`` (device protocols have value
+    identity), extended with the mesh's device tuple so a test meshing
+    a device subset never aliases the all-device runner. ``window=1``
+    is the per-segment runner (``until`` scalar); ``window>1`` runs
+    the scan-fused window body (``engine/core.py window_batch_fn``)
+    per shard and pays the one liveness ``psum`` once per *window*."""
+    from ..engine.core import segment_lane_fn, window_batch_fn
 
     mesh = fleet_mesh(devices)
-    run_lane = segment_lane_fn(
-        protocol, dims, max_steps, reorder, faults, monitor_keys,
-        narrow=narrow,
-    )
-
-    def run_shard(st, ctx, until):
-        out, alive = jax.vmap(run_lane, in_axes=(0, 0, None))(
-            st, ctx, until
+    if window > 1:
+        run_window = window_batch_fn(
+            protocol, dims, max_steps, reorder, faults, monitor_keys,
+            narrow=narrow,
         )
-        # per-shard liveness reduces locally; one scalar psum makes the
-        # verdict replicated (out_specs demands a full-size value) — the
-        # ONLY cross-device communication in the whole segment
-        local = jnp.any(alive).astype(jnp.int32)
-        return out, jax.lax.psum(local, MESH_AXIS) > 0
+
+        def run_shard(st, ctx, untils):
+            out, alive = run_window(st, ctx, untils)
+            # per-shard liveness reduced locally by the scan; one
+            # scalar psum per WINDOW makes the verdict replicated —
+            # still the only cross-device communication
+            local = alive.astype(jnp.int32)
+            return out, jax.lax.psum(local, MESH_AXIS) > 0
+
+    else:
+        run_lane = segment_lane_fn(
+            protocol, dims, max_steps, reorder, faults, monitor_keys,
+            narrow=narrow,
+        )
+
+        def run_shard(st, ctx, until):
+            out, alive = jax.vmap(run_lane, in_axes=(0, 0, None))(
+                st, ctx, until
+            )
+            # per-shard liveness reduces locally; one scalar psum makes
+            # the verdict replicated (out_specs demands a full-size
+            # value) — the ONLY cross-device communication in the
+            # whole segment
+            local = jnp.any(alive).astype(jnp.int32)
+            return out, jax.lax.psum(local, MESH_AXIS) > 0
 
     part = shard_map(
         run_shard,
@@ -103,10 +122,12 @@ def _cached_mesh_runner(protocol, dims, max_steps: int, reorder: bool,
 def build_partitioned_runner(protocol, dims, max_steps: int,
                              reorder: bool, faults, monitor_keys: int,
                              narrow: tuple = (), donate: bool = False,
-                             devices=None):
+                             devices=None, window: int = 1):
     """The ``run_sweep(mesh_shard=True)`` runner:
     ``runner(state, ctx, until) -> (state, any_alive)`` with the lane
-    axis explicitly partitioned over the mesh. Drop-in for the
+    axis explicitly partitioned over the mesh (``window > 1``: the
+    scan-fused form, ``runner(state, ctx, untils[W])`` — one device
+    call and one psum per checkpoint window). Drop-in for the
     NamedSharding runner — same signature, same per-lane trace, byte-
     identical results (pinned) — composing with pipeline depth
     (liveness flags are device scalars the ``SegmentWindow`` resolves
@@ -116,5 +137,5 @@ def build_partitioned_runner(protocol, dims, max_steps: int,
     devs = tuple(devices) if devices is not None else tuple(jax.devices())
     return _cached_mesh_runner(
         protocol, dims, max_steps, reorder, faults, monitor_keys,
-        tuple(narrow), bool(donate), devs,
+        tuple(narrow), bool(donate), devs, int(window),
     )

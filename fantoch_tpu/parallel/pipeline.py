@@ -21,6 +21,15 @@ byte-exact no-ops and the final state equals the serial loop's.
 repeat), which is the reference path the pipelined one is pinned
 against (tests/test_pipeline.py).
 
+Scan-fused windows (``run_sweep(scan_window=W)``, parallel/sweep.py)
+change the window's *unit*, not its logic: each slot now holds one
+checkpoint window's flag — a ``lax.scan`` over W segments whose
+liveness comes home once per window — so the flags are
+window-granular, drain resolves in-flight *windows*, and the
+early-exit overshoot bound becomes ≤ W fixed-point no-op segments per
+in-flight slot instead of ≤ depth − 1 segments total (pinned via the
+``LAST_STATS`` device-call cap in tests/test_scan_window.py).
+
 Durability boundaries (checkpoint saves, signal flushes) call
 :meth:`SegmentWindow.drain` first: every in-flight flag resolves, the
 newest state becomes determinate, and the save sees exactly what a

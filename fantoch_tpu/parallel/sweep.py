@@ -40,8 +40,10 @@ from ..engine.checkpoint import (
     step_signature,
 )
 from ..engine.core import (
+    aot_donation_safe,
     build_runner,
     build_segment_runner,
+    build_window_runner,
     cast_state_planes,
     donation_safe,
     finish_segmented,
@@ -118,6 +120,53 @@ def make_sweep_specs(
 # (a [512, 50, 10k] table would be ~1 GB over a ~30 MB/s tunnel)
 KEY_TABLE_LIMIT = 1 << 24
 
+# scan-fused checkpoint windows: how many segments one device call
+# covers when the caller does not pin `scan_window`. The default packs
+# segments into a window of roughly SCAN_WINDOW_TARGET_STEPS engine
+# steps (at the documented 8192-step segment: 4 segments/window) so
+# the per-call dispatch tax — ~1 s over the tunnel, docs/PERF.md —
+# is paid once per window, capped at SCAN_WINDOW_MAX so a window stays
+# a bounded device execution (the same transport/watchdog argument
+# that bounds segments) and the early-exit overshoot a finished batch
+# pays stays at <= SCAN_WINDOW_MAX fixed-point no-op segments.
+SCAN_WINDOW_TARGET_STEPS = 1 << 15
+SCAN_WINDOW_MAX = 8
+
+
+def default_scan_window(segment_steps: int) -> int:
+    """The `scan_window=None` resolution rule (documented above)."""
+    return max(
+        1,
+        min(
+            SCAN_WINDOW_MAX,
+            SCAN_WINDOW_TARGET_STEPS // max(1, int(segment_steps)),
+        ),
+    )
+
+
+def _window_untils(base: int, segment_steps: int, window: int,
+                   max_steps: int) -> np.ndarray:
+    """One window's `[W]` i32 segment-boundary ladder. Values past
+    `max_steps` clamp to it — the per-lane step clips `until` against
+    `max_steps` anyway, so the tail window's repeated boundaries are
+    fixed-point no-ops and the array shape (the compiled scan's trip
+    count) never changes."""
+    return np.minimum(
+        base + segment_steps * np.arange(1, window + 1, dtype=np.int64),
+        max_steps,
+    ).astype(np.int32)
+
+
+#: observational stats of the most recent `run_sweep` call in this
+#: process (updated in place as the sweep progresses, so an
+#: interrupted run still reports its partial counts): lane count,
+#: resolved `scan_window`, `device_calls` (host dispatch round-trips),
+#: `windows` completed, and — when AOT executables are in play — a
+#: copy of `parallel/aot.py LAST_AOT`. bench.py's `window_roundtrips`
+#: metric and the scan-window tests read this; it is NOT part of any
+#: result or durability contract.
+LAST_STATS: dict = {}
+
 @functools.lru_cache(maxsize=None)
 def _cached_key_table(C: int, T: int):
     return jax.jit(jax.vmap(key_table_fn(C, T)))
@@ -181,7 +230,8 @@ def _prove_lane_independent(protocol, dims: EngineDims, reorder: bool,
 @functools.lru_cache(maxsize=None)
 def _cached_runner(protocol, dims: EngineDims, max_steps: int,
                    reorder: bool, faults, monitor_keys: int = 0,
-                   narrow: tuple = (), donate: bool = False):
+                   narrow: tuple = (), donate: bool = False,
+                   windowed: bool = False):
     """One compiled segmented runner per (protocol value, dims,
     max_steps, fault flags, monitor capacity, narrowing spec):
     ``build_segment_runner`` returns fresh ``jax.jit`` closures, so
@@ -194,10 +244,14 @@ def _cached_runner(protocol, dims: EngineDims, max_steps: int,
     unmonitored sweep runner — and so are ``narrow`` (engine/spec.py
     ``narrow_spec``; batches whose storage dtypes differ trace
     different graphs) and ``donate`` (the state-donating executable is
-    a different compile from the copying one)."""
-    return build_segment_runner(protocol, dims, max_steps, reorder,
-                                faults, monitor_keys, narrow=narrow,
-                                donate=donate)
+    a different compile from the copying one). ``windowed`` selects
+    the scan-fused window flavor (``build_window_runner`` — takes a
+    ``[W]`` boundary ladder instead of a scalar); one cached windowed
+    runner serves every window length, since the scan trip count comes
+    from the ladder's shape and ``jax.jit`` specializes per shape."""
+    build = build_window_runner if windowed else build_segment_runner
+    return build(protocol, dims, max_steps, reorder,
+                 faults, monitor_keys, narrow=narrow, donate=donate)
 
 
 def run_sweep(
@@ -213,6 +267,8 @@ def run_sweep(
     checkpoint: "CheckpointSpec | str | None" = None,
     pipeline_depth: int = 2,
     narrow: bool = True,
+    scan_window: "int | None" = None,
+    aot=None,
 ) -> List[LaneResults]:
     """Run a sweep batch, sharded over ``mesh`` (default: all local
     devices on one axis). The device loop runs in ``segment_steps``
@@ -274,9 +330,39 @@ def run_sweep(
     meta key — checkpoints interchange across layouts). Incompatible
     with an explicit ``mesh`` argument and with ``shard_lanes=False``.
 
+    ``scan_window`` fuses that many consecutive segments into ONE
+    device call — a ``lax.scan`` over the segment body
+    (engine/core.py ``build_window_runner``), liveness carried through
+    the scan and fetched once per *window* — so host round-trips drop
+    from per-segment to per-window (``None`` resolves via
+    :func:`default_scan_window` from ``segment_steps``; ``1`` is the
+    serial segment-loop reference, byte-identical results pinned in
+    tests/test_scan_window.py). Checkpoint boundaries remain
+    host-visible drained states, but cadence is now window-granular:
+    ``CheckpointSpec.every`` and ``stop_after_segments`` count
+    *windows*, a kill mid-window loses at most one window of device
+    work per in-flight slot, and a finished batch overshoots by at
+    most ``scan_window`` fixed-point no-op segments per in-flight
+    window (the segment loop's bound was the ``pipeline_depth − 1``
+    speculative segments). Like ``pipeline_depth`` and ``mesh_shard``,
+    the window is deliberately NOT a checkpoint meta key — checkpoints
+    interchange across ``scan_window`` sizes.
+
+    ``aot`` (a :class:`~fantoch_tpu.parallel.aot.AotSpec` or a bare
+    directory path) turns on fleet-shared AOT executables
+    (parallel/aot.py): the windowed runner is AOT-lowered and
+    serialized into the directory keyed by the checkpoint-layer step
+    signature plus the batch's lane count/window/narrowing/donation
+    and the jax/jaxlib/backend identity, and a later ``run_sweep`` —
+    typically a fresh fleet worker process — *loads* the executable
+    instead of tracing. Signature drift or a corrupted payload is
+    refused by name (:class:`~fantoch_tpu.parallel.aot
+    .AotMismatchError`), never silently misloaded. Incompatible with
+    ``mesh_shard`` (the shard_map layout is not serialized).
+
     ``checkpoint`` (a :class:`~fantoch_tpu.engine.checkpoint
     .CheckpointSpec` or a bare path) makes the run durable: the full
-    batched state is saved at segment boundaries (the existing
+    batched state is saved at window boundaries (the existing
     host-resume choke point), flushed on SIGTERM/SIGINT, and — when a
     valid checkpoint already exists at the path — the run resumes
     exactly where it stopped, producing byte-identical results to an
@@ -293,11 +379,20 @@ def run_sweep(
         if dbg:
             marks.append((label, _t.perf_counter()))
 
+    LAST_STATS.clear()
+    LAST_STATS.update(
+        lanes=len(specs),
+        scan_window=None,
+        device_calls=0,
+        segments_covered=0,
+        segment_steps=int(segment_steps),
+        aot=None,
+    )
     try:
         return _run_sweep(
             protocol, dims, specs, mesh, max_steps, segment_steps,
             monitor_keys, shard_lanes, mesh_shard, checkpoint,
-            pipeline_depth, narrow, mark,
+            pipeline_depth, narrow, scan_window, aot, mark,
         )
     finally:
         # the per-phase timings land on EVERY exit path — an early
@@ -314,9 +409,35 @@ def run_sweep(
 
 def _run_sweep(
     protocol, dims, specs, mesh, max_steps, segment_steps, monitor_keys,
-    shard_lanes, mesh_shard, checkpoint, pipeline_depth, narrow, mark,
+    shard_lanes, mesh_shard, checkpoint, pipeline_depth, narrow,
+    scan_window, aot, mark,
 ) -> List[LaneResults]:
+    from . import aot as aot_mod
     from . import partition
+
+    win = (
+        default_scan_window(segment_steps)
+        if scan_window is None
+        else max(1, int(scan_window))
+    )
+    LAST_STATS["scan_window"] = win
+    aot_spec = None
+    if aot is not None:
+        aot_spec = (
+            aot
+            if isinstance(aot, aot_mod.AotSpec)
+            else aot_mod.AotSpec(dir=str(aot))
+        )
+        if mesh_shard:
+            raise ValueError(
+                "aot serializes the jit window runner; the shard_map "
+                "mesh_shard layout is not serializable — drop one"
+            )
+    # the scan-fused flavor: W > 1, or any AOT run (the serialized
+    # executable is always the window runner so one artifact format
+    # serves every window size — W = 1 is a trip-count-1 scan, byte-
+    # identical to the segment loop)
+    windowed = win > 1 or aot_spec is not None
 
     if mesh_shard:
         if shard_lanes is False:
@@ -427,16 +548,20 @@ def _run_sweep(
         else (lambda tree: tree)
     )
     resume_until = 0
+    if checkpoint is not None or aot_spec is not None:
+        # the per-lane step signature serves double duty: checkpoint
+        # staleness refusal AND the AOT executable identity
+        # (parallel/aot.py extends it with the batch-level components)
+        ctx0 = {k: np.asarray(v)[0] for k, v in ctx.items()}
+        sig = step_signature(
+            protocol, dims, reorder=reorder_flag, faults=fault_flags,
+            monitor_keys=monitor_keys, state=states[0], ctx=ctx0,
+        )
     if checkpoint is not None:
         ck = (
             checkpoint
             if isinstance(checkpoint, CheckpointSpec)
             else CheckpointSpec(path=str(checkpoint))
-        )
-        ctx0 = {k: np.asarray(v)[0] for k, v in ctx.items()}
-        sig = step_signature(
-            protocol, dims, reorder=reorder_flag, faults=fault_flags,
-            monitor_keys=monitor_keys, state=states[0], ctx=ctx0,
         )
         ckpt_meta = {
             "lanes": len(specs),
@@ -522,20 +647,50 @@ def _run_sweep(
     # overrides): segments then update the lane state in place instead
     # of allocating + round-tripping a second full copy per call
     donate = donation_safe()
+    if aot_spec is not None and not aot_donation_safe():
+        # serialized executables lose donation aliasing on the pinned
+        # jaxlib (engine/core.py aot_donation_safe — a donated loaded
+        # executable reads freed buffers); the AOT path trades the
+        # in-place update for the zero-trace start until the pin moves
+        donate = False
     if mesh_shard:
         runner, _pmesh = partition.build_partitioned_runner(
             protocol, dims, max_steps, reorder_flag, fault_flags,
             monitor_keys, narrow=nspec, donate=donate,
-            devices=tuple(mesh.devices.flat),
+            devices=tuple(mesh.devices.flat), window=win,
         )
-    else:
+    elif aot_spec is None:
         runner, alive = _cached_runner(
             protocol, dims, max_steps, reorder_flag,
-            fault_flags, monitor_keys, nspec, donate,
+            fault_flags, monitor_keys, nspec, donate, windowed,
         )
     state = put(state)
     ctx = put(ctx)
     mark("device_put")
+    if aot_spec is not None:
+        # load a fleet-shared serialized executable (or AOT-compile +
+        # serialize one): the lowering specializes on the exact device
+        # arguments, so this happens after device_put. Refusals
+        # (AotMismatchError) propagate — a wrong executable is never
+        # silently replaced by a fresh trace.
+        runner = aot_mod.get_runner(
+            aot_spec,
+            sig,
+            build=lambda: build_window_runner(
+                protocol, dims, max_steps, reorder_flag, fault_flags,
+                monitor_keys, narrow=nspec, donate=donate,
+            )[0],
+            state=state,
+            ctx=ctx,
+            untils=_window_untils(
+                resume_until, segment_steps, win, max_steps
+            ),
+            window=win,
+            donate=donate,
+            narrow=nspec,
+        )
+        LAST_STATS["aot"] = dict(aot_mod.LAST_AOT)
+        mark(f"aot_{aot_mod.LAST_AOT.get('source', '?')}")
 
     # checkpointed runs flush on SIGTERM/SIGINT: the handler only sets
     # a flag, the save happens at the next segment boundary (segment
@@ -588,10 +743,21 @@ def _run_sweep(
 
     try:
         while window.running and until < max_steps:
-            until = min(until + segment_steps, max_steps)
-            state, any_alive = runner(state, ctx, np.int32(until))
+            if windowed:
+                # one device call per WINDOW: the scan advances `win`
+                # segments and brings one liveness flag home
+                untils = _window_untils(
+                    until, segment_steps, win, max_steps
+                )
+                until = int(untils[-1])
+                state, any_alive = runner(state, ctx, untils)
+            else:
+                until = min(until + segment_steps, max_steps)
+                state, any_alive = runner(state, ctx, np.int32(until))
             window.push(any_alive)
             segs_done += 1
+            LAST_STATS["device_calls"] += 1
+            LAST_STATS["segments_covered"] += win if windowed else 1
             # the previous boundary's deferred save: the new segment is
             # dispatched now, so the fetch + write overlap it
             ckbuf.flush(save_boundary)
@@ -624,9 +790,16 @@ def _run_sweep(
                         ckbuf.begin(state, until)
                     continue
             # steady state: resolve only the flag whose slot the next
-            # dispatch needs — never block on the segment just issued
+            # dispatch needs — never block on the window just issued.
+            # Debug marks are window-granular like the liveness: one
+            # mark per device call, labelled with the window's last
+            # segment boundary (so the span a mark covers is the whole
+            # `win`-segment window, not one segment)
             if window.poll():
-                mark(f"segment@{until}")
+                mark(
+                    f"window@{until}" if windowed
+                    else f"segment@{until}"
+                )
         window.drain()
     finally:
         if restores:

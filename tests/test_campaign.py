@@ -31,7 +31,11 @@ from fantoch_tpu.mc.fuzz import (
 )
 
 # mirrors tests/test_sweep_sharded.py shapes so the campaign batches
-# reuse the suite's compiled Basic segment runner
+# reuse the suite's compiled Basic segment runner. scan_window=1 pins
+# the per-segment ladder the stop_after_segments interruption tests
+# count on (the default window would finish these tiny batches before
+# the first boundary); window-granular campaigns are pinned in
+# tests/test_scan_window.py.
 SWEEP_GRID = {
     "kind": "sweep",
     "protocols": ["basic"],
@@ -41,6 +45,7 @@ SWEEP_GRID = {
     "commands_per_client": 2,
     "batch_lanes": 2,
     "segment_steps": 8,
+    "scan_window": 1,
 }
 
 

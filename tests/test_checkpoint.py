@@ -88,10 +88,15 @@ def _specs(name: str, conflicts=(0, 100), subsets=4, shards=1):
 
 
 def _interrupt_resume(dev, dims, specs, path, **kw):
-    """Stop after the first segment, then resume to completion."""
+    """Stop after the first segment, then resume to completion.
+    ``scan_window=1`` pins the serial segment ladder this file's
+    segment-granular contracts are written against (the default window
+    would cover the whole tiny batch before the first boundary);
+    window-granular checkpointing rides in tests/test_scan_window.py,
+    including cross-window-size resume of these very artifacts."""
     with pytest.raises(SweepInterrupted) as e:
         run_sweep(
-            dev, dims, specs, segment_steps=SEG,
+            dev, dims, specs, segment_steps=SEG, scan_window=1,
             checkpoint=CheckpointSpec(path=path, stop_after_segments=1),
             **kw,
         )
@@ -180,7 +185,7 @@ def test_stale_and_wrong_spec_checkpoints_refused(tmp_path):
     ck = str(tmp_path / "ck")
     with pytest.raises(SweepInterrupted):
         run_sweep(
-            dev, dims, specs, segment_steps=SEG,
+            dev, dims, specs, segment_steps=SEG, scan_window=1,
             checkpoint=CheckpointSpec(path=ck, stop_after_segments=1),
         )
 
@@ -228,7 +233,7 @@ def test_padding_never_leaks_into_results_or_manifest(tmp_path):
     ck = str(tmp_path / "ck")
     with pytest.raises(SweepInterrupted):
         run_sweep(
-            dev, dims, specs, segment_steps=SEG,
+            dev, dims, specs, segment_steps=SEG, scan_window=1,
             checkpoint=CheckpointSpec(path=ck, stop_after_segments=1),
         )
     manifest = json.load(open(os.path.join(ck, "manifest.json")))

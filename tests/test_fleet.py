@@ -39,7 +39,11 @@ from fantoch_tpu.registry import check_worker_id, worker_id_ok
 
 # mirrors tests/test_campaign.py shapes so fleet units reuse the
 # suite's compiled Basic segment runner; batch_lanes=1 gives 4 units —
-# enough for real interleaving between two workers
+# enough for real interleaving between two workers. scan_window=1
+# pins the per-segment ladder the stop_after_segments interruption
+# tests count on (the default window would finish these tiny units
+# before the first boundary); window-granular + AOT fleets are pinned
+# in tests/test_scan_window.py.
 SWEEP_GRID = {
     "kind": "sweep",
     "protocols": ["basic"],
@@ -49,6 +53,7 @@ SWEEP_GRID = {
     "commands_per_client": 2,
     "batch_lanes": 1,
     "segment_steps": 8,
+    "scan_window": 1,
 }
 
 

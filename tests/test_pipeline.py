@@ -19,6 +19,12 @@ The contracts under test:
 
 Tier-1 pins tempo + basic; the full protocol matrix × both shard
 paths rides in the slow tier.
+
+Every sweep here runs ``scan_window=1`` — this file is the *segment-
+loop* reference suite (per-segment dispatch, per-segment liveness,
+segment-granular checkpoint cadence). The scan-fused window path that
+replaces it as the production default is pinned against these same
+contracts in tests/test_scan_window.py.
 """
 
 import json
@@ -127,17 +133,17 @@ def test_narrow_spec_bounds_pick_storage_dtypes():
 def test_pipelined_and_narrowed_match_serial(name):
     dev, dims, specs = _specs(name)
     serial = run_sweep(
-        dev, dims, specs, segment_steps=SEG, pipeline_depth=1
+        dev, dims, specs, segment_steps=SEG, scan_window=1, pipeline_depth=1
     )
     ref = _blob(serial)
     assert serial[0].completed == COMMANDS * 3 and not serial[0].err
     for depth in (2, 3):
         piped = run_sweep(
-            dev, dims, specs, segment_steps=SEG, pipeline_depth=depth
+            dev, dims, specs, segment_steps=SEG, scan_window=1, pipeline_depth=depth
         )
         assert _blob(piped) == ref, f"pipeline_depth={depth} diverged"
     wide = run_sweep(
-        dev, dims, specs, segment_steps=SEG, pipeline_depth=2,
+        dev, dims, specs, segment_steps=SEG, scan_window=1, pipeline_depth=2,
         narrow=False,
     )
     assert _blob(wide) == ref, "narrow=False diverged"
@@ -148,11 +154,15 @@ def test_pipelined_and_narrowed_match_serial(name):
 # ----------------------------------------------------------------------
 
 # Donation and the persistent compile cache are mutually exclusive on
-# the current jaxlib (engine/core.py donation_safe): a warm-cache
-# process running a donated executable flakily corrupts the aliased
-# state. This pytest process enables the cache (conftest), so the
-# donated path is exercised in a CACHE-FREE SUBPROCESS — exactly how a
-# donation-safe production process would run it.
+# the pinned jaxlib (engine/core.py donation_safe — now a VERSION
+# GATE: the exclusion retires itself at DONATION_CACHE_FIX_JAXLIB; it
+# was re-confirmed real on this pin while building the AOT path): a
+# warm-cache process running a donated executable flakily corrupts the
+# aliased state. This pytest process enables the cache (conftest), so
+# the donated path is exercised in a CACHE-FREE SUBPROCESS — exactly
+# how a donation-safe production process would run it. The donated
+# run below uses the default scan window, so it doubles as a
+# donated-windowed ≡ undonated-serial cross-flavor identity pin.
 _DONATION_SCRIPT = r"""
 import json
 import warnings
@@ -229,7 +239,7 @@ blob = lambda rs: json.dumps([r.to_json() for r in rs], sort_keys=True)
 donated = run_sweep(dev, dims, specs, segment_steps=8, pipeline_depth=2)
 import os
 os.environ["FANTOCH_SWEEP_DONATE"] = "0"
-undonated = run_sweep(dev, dims, specs, segment_steps=8, pipeline_depth=1)
+undonated = run_sweep(dev, dims, specs, segment_steps=8, pipeline_depth=1, scan_window=1)
 assert blob(donated) == blob(undonated), "donated path diverged"
 assert donated[0].completed == COMMANDS * 3 and not donated[0].err
 print("DONATION-OK")
@@ -276,7 +286,7 @@ def test_segment_runner_donates_state_cache_free_subprocess():
 def test_checkpoint_under_pipeline_resumes_bit_exact(tmp_path):
     dev, dims, specs = _specs("basic")
     control = run_sweep(
-        dev, dims, specs, segment_steps=SEG, pipeline_depth=1
+        dev, dims, specs, segment_steps=SEG, scan_window=1, pipeline_depth=1
     )
     ck = str(tmp_path / "ck")
     # kill (deterministically) mid-window: stop after ONE counted
@@ -284,7 +294,7 @@ def test_checkpoint_under_pipeline_resumes_bit_exact(tmp_path):
     # the window first, so the artifact records a determinate boundary…
     with pytest.raises(SweepInterrupted) as e:
         run_sweep(
-            dev, dims, specs, segment_steps=SEG, pipeline_depth=2,
+            dev, dims, specs, segment_steps=SEG, scan_window=1, pipeline_depth=2,
             checkpoint=CheckpointSpec(path=ck, stop_after_segments=1),
         )
     assert e.value.reason == "segment-limit"
@@ -296,7 +306,7 @@ def test_checkpoint_under_pipeline_resumes_bit_exact(tmp_path):
     # resume under the OTHER depth — drained boundaries are depth-
     # agnostic, so checkpoints interchange freely
     resumed = run_sweep(
-        dev, dims, specs, segment_steps=SEG, pipeline_depth=3,
+        dev, dims, specs, segment_steps=SEG, scan_window=1, pipeline_depth=3,
         checkpoint=CheckpointSpec(path=ck),
     )
     assert not checkpoint_exists(ck)
@@ -308,7 +318,7 @@ def test_narrowing_disagreement_refused_by_name(tmp_path):
     ck = str(tmp_path / "ck")
     with pytest.raises(SweepInterrupted):
         run_sweep(
-            dev, dims, specs, segment_steps=SEG,
+            dev, dims, specs, segment_steps=SEG, scan_window=1,
             checkpoint=CheckpointSpec(path=ck, stop_after_segments=1),
         )
     # a narrow-saved checkpoint must not resume into an un-narrowed
@@ -316,7 +326,7 @@ def test_narrowing_disagreement_refused_by_name(tmp_path):
     # refusal, by name, not a trace error
     with pytest.raises(CheckpointMismatchError, match="narrow"):
         run_sweep(
-            dev, dims, specs, segment_steps=SEG, narrow=False,
+            dev, dims, specs, segment_steps=SEG, scan_window=1, narrow=False,
             checkpoint=CheckpointSpec(path=ck),
         )
 
@@ -375,12 +385,12 @@ def test_overlapped_saves_resume_bit_exact(tmp_path):
     the uninterrupted control byte-for-byte."""
     dev, dims, specs = _specs("basic")
     control = run_sweep(
-        dev, dims, specs, segment_steps=SEG, pipeline_depth=1
+        dev, dims, specs, segment_steps=SEG, scan_window=1, pipeline_depth=1
     )
     ck = str(tmp_path / "ck")
     with pytest.raises(SweepInterrupted) as e:
         run_sweep(
-            dev, dims, specs, segment_steps=SEG, pipeline_depth=2,
+            dev, dims, specs, segment_steps=SEG, scan_window=1, pipeline_depth=2,
             checkpoint=CheckpointSpec(
                 path=ck, every=1, stop_after_segments=3
             ),
@@ -388,7 +398,7 @@ def test_overlapped_saves_resume_bit_exact(tmp_path):
     assert e.value.reason == "segment-limit"
     assert checkpoint_exists(ck)
     resumed = run_sweep(
-        dev, dims, specs, segment_steps=SEG,
+        dev, dims, specs, segment_steps=SEG, scan_window=1,
         checkpoint=CheckpointSpec(path=ck),
     )
     assert _blob(resumed) == _blob(control)
@@ -406,7 +416,7 @@ def test_deferred_saves_land_on_determinate_boundaries(tmp_path):
     for depth, name in ((1, "k1"), (3, "k3")):
         ck = str(tmp_path / name)
         run_sweep(
-            dev, dims, specs, segment_steps=SEG, pipeline_depth=depth,
+            dev, dims, specs, segment_steps=SEG, scan_window=1, pipeline_depth=depth,
             checkpoint=CheckpointSpec(path=ck, every=1, keep=True),
         )
         manifest = _json.load(open(str(tmp_path / name / "manifest.json")))
@@ -426,12 +436,12 @@ def test_deferred_saves_land_on_determinate_boundaries(tmp_path):
 def test_pipelined_matches_serial_full_protocols(name, shard):
     dev, dims, specs = _specs(name, subsets=2)
     serial = run_sweep(
-        dev, dims, specs, segment_steps=SEG, pipeline_depth=1,
+        dev, dims, specs, segment_steps=SEG, scan_window=1, pipeline_depth=1,
         shard_lanes=shard,
     )
     for depth in (2, 3):
         piped = run_sweep(
-            dev, dims, specs, segment_steps=SEG, pipeline_depth=depth,
+            dev, dims, specs, segment_steps=SEG, scan_window=1, pipeline_depth=depth,
             shard_lanes=shard,
         )
         assert _blob(piped) == _blob(serial), (name, shard, depth)
@@ -444,12 +454,12 @@ def test_pipelined_matches_serial_partial_twins(name, shard):
     dev, dims, specs = _specs(name, conflicts=(50, 100), subsets=2,
                               shards=2)
     serial = run_sweep(
-        dev, dims, specs, segment_steps=SEG, pipeline_depth=1,
+        dev, dims, specs, segment_steps=SEG, scan_window=1, pipeline_depth=1,
         shard_lanes=shard,
     )
     for depth in (2, 3):
         piped = run_sweep(
-            dev, dims, specs, segment_steps=SEG, pipeline_depth=depth,
+            dev, dims, specs, segment_steps=SEG, scan_window=1, pipeline_depth=depth,
             shard_lanes=shard,
         )
         assert _blob(piped) == _blob(serial), (name, shard, depth)

@@ -1,0 +1,385 @@
+"""Scan-fused checkpoint windows + fleet-shared AOT executables
+(parallel/sweep.py scan_window, engine/core.py build_window_runner,
+parallel/aot.py).
+
+The contracts under test:
+
+* the scan-fused window path (W segments folded into ONE device call,
+  liveness carried through the scan and fetched once per window)
+  produces **byte-identical** ``LaneResults`` to the serial segment
+  loop (``scan_window=1``) — dead tail iterations are fixed-point
+  no-ops — composing with ``pipeline_depth`` and narrowing;
+* host round-trips really drop from per-segment to per-window
+  (``parallel.sweep.LAST_STATS`` device-call accounting — the live
+  twin of bench.py's ``window_roundtrips``), and the early-exit
+  overshoot a finished batch pays is bounded by W no-op segments per
+  in-flight window (the window-granular liveness bound that replaced
+  the segment loop's ``pipeline_depth − 1``);
+* checkpoints are **window-size-free** (like ``pipeline_depth`` and
+  ``mesh_shard``, the window is deliberately not a manifest meta key):
+  a run interrupted under one ``scan_window`` resumes under any other
+  bit-exactly, and a kill mid-window loses at most one window;
+* AOT round-trip: a sweep executable serialized by one process loads
+  in a FRESH subprocess (no trace, ``aot-load`` provenance) and runs
+  byte-identical to the traced control; signature drift and payload
+  corruption are refused by name (``AotMismatchError``), and on the
+  pinned jaxlib the AOT runner is forced undonated
+  (``engine/core.py aot_donation_safe`` — a donated deserialized
+  executable is known to corrupt).
+
+Tier-1 pins tempo + basic; the full protocol matrix rides in the slow
+tier.
+"""
+
+import json
+import math
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from fantoch_tpu.core import Config, Planet
+from fantoch_tpu.engine import EngineDims
+from fantoch_tpu.engine.checkpoint import (
+    CheckpointSpec,
+    SweepInterrupted,
+    checkpoint_exists,
+)
+from fantoch_tpu.engine.protocols import (
+    dev_config_kwargs,
+    dev_protocol,
+    partial_dev_protocol,
+)
+from fantoch_tpu.parallel import aot
+from fantoch_tpu.parallel.sweep import (
+    LAST_STATS,
+    default_scan_window,
+    make_sweep_specs,
+    run_sweep,
+)
+from fantoch_tpu.registry import DEV_PROTOCOLS, PARTIAL_DEV_PROTOCOLS
+
+COMMANDS = 2
+SEG = 8  # segments small enough that every lane spans several windows
+
+
+def _blob(results) -> str:
+    return json.dumps([r.to_json() for r in results], sort_keys=True)
+
+
+def _specs(name: str, conflicts=(0, 100), subsets=4, shards=1):
+    planet = Planet.new()
+    regions = planet.regions()
+    clients = 3
+    pool = 1
+    total = COMMANDS * clients
+    if shards > 1:
+        pool = 4
+        dev = partial_dev_protocol(name, clients, shards, pool_size=pool)
+        dims = EngineDims.for_partial(dev, 3, clients, total, regions=3)
+        base = Config(
+            **dev_config_kwargs(name, 3, 1),
+            shard_count=shards,
+            executor_executed_notification_interval_ms=100,
+            executor_cleanup_interval_ms=100,
+        )
+    else:
+        dev = dev_protocol(name, clients)
+        dims = EngineDims.for_protocol(
+            dev, n=3, clients=clients, payload=dev.payload_width(3),
+            total_commands=total, dot_slots=total + 1, regions=3,
+        )
+        base = Config(**dev_config_kwargs(name, 3, 1))
+    specs = make_sweep_specs(
+        dev,
+        planet,
+        region_sets=[regions[i : i + 3] for i in range(subsets)],
+        fs=[1],
+        conflicts=list(conflicts),
+        commands_per_client=COMMANDS,
+        clients_per_region=1,
+        dims=dims,
+        config_base=base,
+        pool_size=pool,
+    )
+    return dev, dims, specs
+
+
+# ----------------------------------------------------------------------
+# default-window resolution (host only)
+# ----------------------------------------------------------------------
+
+
+def test_default_scan_window_derives_from_segment_steps():
+    # the documented 8192-step segment packs 4 segments per window...
+    assert default_scan_window(8192) == 4
+    # ...tiny debug segments clamp at the max...
+    assert default_scan_window(8) == 8
+    # ...and segments at/past the target run one per call
+    assert default_scan_window(1 << 15) == 1
+    assert default_scan_window(1 << 20) == 1
+
+
+# ----------------------------------------------------------------------
+# scan-fused ≡ segment loop (tier-1: tempo + basic)
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", ["basic", "tempo"])
+def test_scan_fused_matches_segment_loop(name):
+    dev, dims, specs = _specs(name)
+    serial = run_sweep(
+        dev, dims, specs, segment_steps=SEG, scan_window=1,
+        pipeline_depth=1,
+    )
+    serial_calls = LAST_STATS["device_calls"]
+    ref = _blob(serial)
+    assert serial[0].completed == COMMANDS * 3 and not serial[0].err
+    assert serial_calls > 2, "lanes must span several segments"
+    for win, depth in ((2, 1), (4, 2), (8, 2)):
+        fused = run_sweep(
+            dev, dims, specs, segment_steps=SEG, scan_window=win,
+            pipeline_depth=depth,
+        )
+        assert _blob(fused) == ref, f"scan_window={win} diverged"
+        # host round-trips per sweep drop to ceil(segments/W) plus at
+        # most depth−1 speculative windows — the window-granular
+        # liveness bound (each speculative window is W fixed-point
+        # no-op segments, so the early-exit overshoot is ≤ W segments
+        # per in-flight slot, where the segment loop's was ≤ depth−1
+        # SEGMENTS total)
+        assert LAST_STATS["scan_window"] == win
+        cap = math.ceil(serial_calls / win) + (depth - 1)
+        assert LAST_STATS["device_calls"] <= cap, (
+            win, depth, LAST_STATS["device_calls"], serial_calls,
+        )
+        assert LAST_STATS["segments_covered"] <= cap * win
+    # the auto default composes the same way
+    auto = run_sweep(dev, dims, specs, segment_steps=SEG)
+    assert _blob(auto) == ref
+    assert LAST_STATS["scan_window"] == default_scan_window(SEG)
+
+
+# ----------------------------------------------------------------------
+# checkpoints: window-size-free artifacts, ≤ one window lost
+# ----------------------------------------------------------------------
+
+
+def test_checkpoint_interchanges_across_scan_windows(tmp_path):
+    dev, dims, specs = _specs("basic")
+    control = run_sweep(
+        dev, dims, specs, segment_steps=SEG, scan_window=1,
+        pipeline_depth=1,
+    )
+    ck = str(tmp_path / "ck")
+    win = 2
+    with pytest.raises(SweepInterrupted) as e:
+        run_sweep(
+            dev, dims, specs, segment_steps=SEG, scan_window=win,
+            pipeline_depth=2,
+            checkpoint=CheckpointSpec(path=ck, stop_after_segments=1),
+        )
+    assert e.value.reason == "segment-limit"
+    assert checkpoint_exists(ck)
+    # a kill mid-window loses at most ONE window: the stop lands on
+    # the first drained boundary past the request, i.e. exactly the
+    # requested window count — never part-way into a later one
+    assert e.value.until <= win * SEG, e.value.until
+    # the window is a property of the executing loop, not of the work:
+    # no scan_window meta key, exactly like pipeline_depth/mesh_shard
+    manifest = json.load(open(os.path.join(ck, "manifest.json")))
+    assert "scan_window" not in manifest["meta"]
+    # resume under DIFFERENT window sizes — each from its own copy of
+    # the artifact (a successful resume consumes it)
+    for resume_win in (4, 1, None):
+        ck2 = str(tmp_path / f"ck_{resume_win}")
+        shutil.copytree(ck, ck2)
+        resumed = run_sweep(
+            dev, dims, specs, segment_steps=SEG, scan_window=resume_win,
+            checkpoint=CheckpointSpec(path=ck2),
+        )
+        assert not checkpoint_exists(ck2)
+        assert _blob(resumed) == _blob(control), (
+            f"resume under scan_window={resume_win} diverged"
+        )
+
+
+# ----------------------------------------------------------------------
+# AOT executables: serialize → fresh-subprocess load → byte identity
+# ----------------------------------------------------------------------
+
+_AOT_CHILD = r"""
+import json
+import sys
+
+from fantoch_tpu.parallel.sweep import LAST_STATS, run_sweep
+
+sys.path.insert(0, {test_dir!r})
+from test_scan_window import _blob, _specs
+
+dev, dims, specs = _specs("basic")
+results = run_sweep(
+    dev, dims, specs, segment_steps=8, scan_window=4, aot={aot_dir!r}
+)
+assert LAST_STATS["aot"] is not None
+assert LAST_STATS["aot"]["source"] == "aot-load", LAST_STATS["aot"]
+print("AOT-CHILD " + json.dumps(
+    {{"blob": _blob(results), "load_s": LAST_STATS["aot"]["seconds"]}}
+))
+"""
+
+
+def _child_env():
+    import fantoch_tpu
+
+    repo = os.path.dirname(os.path.dirname(fantoch_tpu.__file__))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("FANTOCH_SWEEP_DONATE", None)
+    if "xla_force_host_platform_device_count" not in env.get(
+        "XLA_FLAGS", ""
+    ):
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    return env
+
+
+def test_aot_roundtrip_fresh_subprocess_matches_traced(tmp_path):
+    dev, dims, specs = _specs("basic")
+    control = run_sweep(
+        dev, dims, specs, segment_steps=SEG, scan_window=1,
+        pipeline_depth=1,
+    )
+    d = str(tmp_path / "aot")
+    first = run_sweep(
+        dev, dims, specs, segment_steps=SEG, scan_window=4, aot=d
+    )
+    assert LAST_STATS["aot"]["source"] == "trace-compile"
+    assert _blob(first) == _blob(control)
+    assert any(f.endswith(".bin") for f in os.listdir(d))
+    # a fresh process finds the serialized executable and LOADS it —
+    # no trace, no compile — and its results are byte-identical
+    script = _AOT_CHILD.format(
+        test_dir=os.path.dirname(os.path.abspath(__file__)),
+        aot_dir=d,
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=420, env=_child_env(),
+    )
+    assert out.returncode == 0, (out.stdout, out.stderr[-2000:])
+    line = [
+        ln for ln in out.stdout.splitlines() if ln.startswith("AOT-CHILD ")
+    ][0]
+    child = json.loads(line[len("AOT-CHILD "):])
+    assert child["blob"] == _blob(control), "loaded executable diverged"
+
+
+def test_aot_drift_and_corruption_refused_by_name(tmp_path):
+    dev, dims, specs = _specs("basic")
+    d = str(tmp_path / "aot")
+    run_sweep(dev, dims, specs, segment_steps=SEG, scan_window=4, aot=d)
+    manifests = sorted(
+        f for f in os.listdir(d) if f.endswith(".json")
+    )
+    assert len(manifests) == 1
+    mpath = os.path.join(d, manifests[0])
+    pristine = open(mpath).read()
+
+    # (a) code/toolchain drift: the manifest records a different step
+    # jaxpr than this process traces — refused BY NAME, never
+    # silently re-traced beside it
+    doctored = json.loads(pristine)
+    doctored["signature"]["step_jaxpr_sha256"] = "0" * 64
+    with open(mpath, "w") as fh:
+        json.dump(doctored, fh)
+    with pytest.raises(aot.AotMismatchError, match="step_jaxpr"):
+        run_sweep(
+            dev, dims, specs, segment_steps=SEG, scan_window=4, aot=d
+        )
+    with open(mpath, "w") as fh:
+        fh.write(pristine)
+
+    # (b) a corrupted payload fails its recorded sha256
+    binf = [f for f in os.listdir(d) if f.endswith(".bin")][0]
+    with open(os.path.join(d, binf), "r+b") as fh:
+        fh.seek(16)
+        fh.write(b"\xff\xff\xff\xff")
+    with pytest.raises(aot.AotMismatchError, match="corrupt"):
+        run_sweep(
+            dev, dims, specs, segment_steps=SEG, scan_window=4, aot=d
+        )
+
+    # (c) a DIFFERENT unit shape is not drift: it gets its own slot
+    # and compiles fresh instead of refusing (campaign dirs hold one
+    # executable per batch shape). 8 subsets = 16 padded lanes vs the
+    # original 8 — a genuinely different compiled shape (2 subsets
+    # would pad back to 8 on the 8-device mesh and correctly LOAD the
+    # existing executable).
+    dev2, dims2, specs2 = _specs("basic", conflicts=(0, 100), subsets=8)
+    out = run_sweep(
+        dev2, dims2, specs2, segment_steps=SEG, scan_window=4, aot=d
+    )
+    assert LAST_STATS["aot"]["source"] == "trace-compile"
+    assert len(out) == len(specs2)
+    assert len([f for f in os.listdir(d) if f.endswith(".bin")]) == 2
+
+
+def test_aot_runner_is_undonated_on_pinned_jaxlib(tmp_path):
+    """A donated deserialized executable reads freed buffers on this
+    jaxlib (measured — see engine/core.py aot_donation_safe), so the
+    AOT path must force donation off even where plain sweeps donate,
+    and record that in the executable signature."""
+    from fantoch_tpu.engine.core import aot_donation_safe
+
+    if aot_donation_safe():
+        pytest.skip("jaxlib pin moved past the donation fix")
+    dev, dims, specs = _specs("basic", subsets=2)
+    d = str(tmp_path / "aot")
+    run_sweep(dev, dims, specs, segment_steps=SEG, scan_window=2, aot=d)
+    manifest = json.load(
+        open(os.path.join(d, sorted(
+            f for f in os.listdir(d) if f.endswith(".json")
+        )[0]))
+    )
+    assert manifest["signature"]["donate"] == "False"
+
+
+# ----------------------------------------------------------------------
+# the full matrix (slow tier: compiles)
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", DEV_PROTOCOLS)
+def test_scan_fused_matches_segment_loop_full_protocols(name):
+    dev, dims, specs = _specs(name, subsets=2)
+    serial = run_sweep(
+        dev, dims, specs, segment_steps=SEG, scan_window=1,
+        pipeline_depth=1,
+    )
+    for win in (2, 8):
+        fused = run_sweep(
+            dev, dims, specs, segment_steps=SEG, scan_window=win
+        )
+        assert _blob(fused) == _blob(serial), (name, win)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", PARTIAL_DEV_PROTOCOLS)
+def test_scan_fused_matches_segment_loop_partial_twins(name):
+    dev, dims, specs = _specs(name, conflicts=(50, 100), subsets=2,
+                              shards=2)
+    serial = run_sweep(
+        dev, dims, specs, segment_steps=SEG, scan_window=1,
+        pipeline_depth=1,
+    )
+    for win in (2, 8):
+        fused = run_sweep(
+            dev, dims, specs, segment_steps=SEG, scan_window=win
+        )
+        assert _blob(fused) == _blob(serial), (name, win)

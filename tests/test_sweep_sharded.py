@@ -149,9 +149,14 @@ def test_mesh_shard_checkpoint_interchanges_with_reference(tmp_path):
 
     ck = CheckpointSpec(path=str(tmp_path / "ck"), every=1,
                         stop_after_segments=2)
+    # scan_window=1 pins the per-segment ladder the stop hook counts
+    # (the default window would finish the tiny batch before boundary
+    # 2); the resumes below deliberately run the default window — the
+    # artifact interchanges across window sizes like it does across
+    # layouts
     with pytest.raises(SweepInterrupted):
         run_sweep(dev, dims, specs, mesh_shard=True, segment_steps=8,
-                  checkpoint=ck)
+                  scan_window=1, checkpoint=ck)
     resumed = run_sweep(
         dev, dims, specs, shard_lanes=False, segment_steps=8,
         checkpoint=CheckpointSpec(path=str(tmp_path / "ck")),
@@ -164,7 +169,7 @@ def test_mesh_shard_checkpoint_interchanges_with_reference(tmp_path):
                          stop_after_segments=2)
     with pytest.raises(SweepInterrupted):
         run_sweep(dev, dims, specs, shard_lanes=False, segment_steps=8,
-                  checkpoint=ck2)
+                  scan_window=1, checkpoint=ck2)
     resumed2 = run_sweep(
         dev, dims, specs, mesh_shard=True, segment_steps=8,
         checkpoint=CheckpointSpec(path=str(tmp_path / "ck2")),

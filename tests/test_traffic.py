@@ -485,9 +485,11 @@ def test_checkpoint_refuses_schedule_swap(tmp_path):
         )
 
     ck = str(tmp_path / "ck")
+    # scan_window=1: the interrupt must land mid-batch (the default
+    # window would cover the whole tiny batch in one device call)
     with pytest.raises(SweepInterrupted):
         run_sweep(
-            dev, dims, specs("diurnal"), segment_steps=8,
+            dev, dims, specs("diurnal"), segment_steps=8, scan_window=1,
             checkpoint=CheckpointSpec(
                 path=ck, keep=True, stop_after_segments=1
             ),
@@ -511,7 +513,7 @@ def test_checkpoint_refuses_schedule_swap(tmp_path):
     ck2 = str(tmp_path / "ck_legacy")
     with pytest.raises(SweepInterrupted):
         run_sweep(
-            dev, dims, specs(None), segment_steps=8,
+            dev, dims, specs(None), segment_steps=8, scan_window=1,
             checkpoint=CheckpointSpec(
                 path=ck2, keep=True, stop_after_segments=1
             ),
