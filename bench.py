@@ -803,6 +803,26 @@ def _static_kernel_cost(timeout_s: float = 240.0) -> "dict | None":
         return None
 
 
+def _host_sync_ledger() -> "dict | None":
+    """Device->host sync ledger of the host orchestration layers (the
+    GL301 scan, fantoch_tpu/lint/transfer.py) — per-tier counts of
+    every blocking fetch the sweep drivers perform, the static
+    complement to the measured dispatch_overhead_s numbers. Pure AST
+    in-process (imports no jax), so it is honest even when the device
+    backend is unreachable; degrades to an error record, never an
+    exception."""
+    try:
+        from fantoch_tpu.lint.transfer import ledger_summary
+
+        return ledger_summary()
+    except Exception as e:  # noqa: BLE001
+        import sys as _sys
+
+        print(f"bench: host sync ledger unavailable: {e!r}",
+              file=_sys.stderr)
+        return {"error": repr(e)}
+
+
 def _fuzz_selfcheck() -> float:
     from fantoch_tpu.mc.fuzz import FuzzSpec, run_fuzz_point
 
@@ -1313,6 +1333,10 @@ def main() -> None:
                     if static_cost
                     else {}
                 ),
+                # per-tier device->host sync counts of the host sweep
+                # drivers (GL301 ledger) — static twin of the measured
+                # dispatch_overhead_s above
+                "host_sync_ledger": _host_sync_ledger(),
             }
         )
     )
@@ -1496,6 +1520,9 @@ def _emit_unreachable(reason: str = "unreachable at startup") -> None:
                     if static_cost
                     else {}
                 ),
+                # the sync ledger is pure AST — a real number even in
+                # this dead-backend artifact, not a placeholder zero
+                "host_sync_ledger": _host_sync_ledger(),
             }
         )
     )

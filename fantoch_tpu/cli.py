@@ -757,9 +757,10 @@ def cmd_fleet(args) -> None:
 def cmd_lint(args) -> None:
     """graft-lint (fantoch_tpu/lint): jaxpr interval audits over every
     device protocol's step, the structural gating differ, AST /
-    hook-registry rules, and (``--cost``) the kernel/VMEM/lane cost
-    family. Exits non-zero on any finding not covered by the baseline
-    (docs/LINT.md)."""
+    hook-registry rules, (``--cost``) the kernel/VMEM/lane cost
+    family, and (``--transfer``) the sync-ledger/donation/backend
+    transfer family. Exits non-zero on any finding not covered by the
+    baseline (docs/LINT.md)."""
     from .lint import (
         DEFAULT_BASELINE,
         load_baseline,
@@ -781,6 +782,27 @@ def cmd_lint(args) -> None:
             json.dumps(
                 {
                     "selfcheck": args.cost_selfcheck,
+                    "regressions": len(findings),
+                }
+            )
+        )
+        raise SystemExit(1 if findings else 0)
+
+    if args.transfer_selfcheck:
+        # same contract as --cost-selfcheck for the transfer gate: the
+        # seeded fixture (per-segment .item() sync / use-after-donate)
+        # must produce findings, or the ledger/prover is broken
+        from .lint.transfer import run_transfer_selfcheck
+
+        findings = run_transfer_selfcheck(
+            args.transfer_selfcheck, progress=say
+        )
+        for f in findings:
+            print(f.render(), file=sys.stderr)
+        print(
+            json.dumps(
+                {
+                    "selfcheck": args.transfer_selfcheck,
                     "regressions": len(findings),
                 }
             )
@@ -819,18 +841,58 @@ def cmd_lint(args) -> None:
         )
         return
 
+    if args.write_transfer_baseline:
+        from .lint.transfer import (
+            DEFAULT_TRANSFER_BASELINE,
+            scan_transfer,
+            write_transfer_baseline,
+        )
+
+        if args.paths:
+            raise SystemExit(
+                "refusing to write the transfer baseline from a run "
+                "narrowed by --paths (dropped files would turn their "
+                "ledger entries into CI regressions); run without it"
+            )
+        sites, findings = scan_transfer()
+        if findings:
+            for f in findings:
+                print(f.render(), file=sys.stderr)
+            raise SystemExit(
+                "refusing to write the transfer baseline while the "
+                "scan itself reports structural findings (choke-point "
+                "metadata / tier claims); fix those first"
+            )
+        write_transfer_baseline(DEFAULT_TRANSFER_BASELINE, sites)
+        print(
+            json.dumps(
+                {
+                    "transfer_baseline": DEFAULT_TRANSFER_BASELINE,
+                    "sites": len(sites),
+                }
+            )
+        )
+        return
+
     report = run_lint(
         protocols,
         ast_paths=args.paths or None,
-        jaxpr_audits=not args.no_jaxpr and not args.cost_only,
+        jaxpr_audits=not args.no_jaxpr
+        and not args.cost_only
+        and not args.transfer_only,
         cost=args.cost or args.cost_only,
+        transfer=args.transfer or args.transfer_only,
         progress=say,
     )
 
     baseline_path = args.baseline or DEFAULT_BASELINE
     if args.write_baseline:
         narrowed = (
-            args.no_jaxpr or args.cost_only or protocols or args.paths
+            args.no_jaxpr
+            or args.cost_only
+            or args.transfer_only
+            or protocols
+            or args.paths
         )
         if narrowed and os.path.abspath(baseline_path) == os.path.abspath(
             DEFAULT_BASELINE
@@ -867,6 +929,8 @@ def cmd_lint(args) -> None:
     }
     if report.cost:
         out["cost"] = report.cost
+    if report.transfer:
+        out["transfer"] = report.transfer
     if args.json:
         out["detail"] = report.to_json(baseline)
     for f in regressions:
@@ -1482,6 +1546,22 @@ def main(argv=None) -> None:
     ln.add_argument("--write-cost-baseline", action="store_true",
                     help="regenerate lint/cost_baseline.json from this "
                     "run")
+    ln.add_argument("--transfer", action="store_true",
+                    help="add the transfer family: GL301 device->host "
+                    "sync ledger (vs lint/transfer_baseline.json) + "
+                    "GL302 donation-lifetime prover + GL303 "
+                    "backend-width audit")
+    ln.add_argument("--transfer-only", action="store_true",
+                    help="transfer family without the interval/gating "
+                    "audits (the CI transfer-gate job; device-free)")
+    ln.add_argument("--transfer-selfcheck", default=None,
+                    choices=["sync", "donate"],
+                    help="CI broken-fixture check: scan the named "
+                    "seeded-defect fixture; must exit non-zero")
+    ln.add_argument("--write-transfer-baseline", action="store_true",
+                    help="regenerate lint/transfer_baseline.json from "
+                    "this run (justification reasons are taken from "
+                    "the choke-point call sites)")
     ln.add_argument("--json", action="store_true",
                     help="include full finding detail in the output")
     ln.set_defaults(fn=cmd_lint)

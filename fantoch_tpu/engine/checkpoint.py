@@ -10,7 +10,11 @@ the full batched state tree + lane ctx to a versioned host artifact
 **bit-exactly** — a run checkpointed at a segment boundary and resumed
 produces byte-identical ``LaneResults`` to an uninterrupted run,
 because the segmented runner's state advances deterministically and
-``device_get``/``device_put`` round-trips preserve every bit.
+``host_fetch``/``device_put`` round-trips preserve every bit. This
+module never fetches: callers hand it host-side state taken through
+the ``host_fetch`` choke point (engine/core.py) at a drained
+boundary, so the GL301 sync ledger and the GL302 donation-lifetime
+prover (docs/LINT.md) audit the fetch at the call site.
 
 Staleness is *refused, never silently misloaded*: the manifest carries
 a signature of the things bit-exact resume depends on — protocol
@@ -389,7 +393,9 @@ def save_sweep_checkpoint(path: str, *, state, ctx,
                           signature: Dict[str, str], until: int,
                           meta: dict) -> None:
     """Serialize one batched sweep's full state + ctx. ``state`` must
-    already be host-side (``jax.device_get``)."""
+    already be host-side — an undonated copy taken through
+    ``host_fetch`` (engine/core.py) at a drained boundary; GL302
+    statically refuses saves of device-fresh bindings."""
     arrays = {**_flatten_tree(state, "state"), **_flatten_tree(ctx, "ctx")}
     save_artifact(path, arrays, signature, dict(meta, until=int(until)))
 

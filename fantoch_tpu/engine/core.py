@@ -1345,6 +1345,40 @@ def aot_donation_safe() -> bool:
     return _jaxlib_version() >= DONATION_CACHE_FIX_JAXLIB
 
 
+def host_fetch(value, *, tier, reason):
+    """The audited device→host *fetch* choke point: a blocking
+    ``jax.device_get`` that carries its GL301 ledger entry in the call
+    itself. Every host-side fetch of device values must flow through
+    here (or :func:`host_sync`) the way every traced emission flows
+    through :func:`emit`/:func:`pack_outbox` — the static sync ledger
+    (fantoch_tpu/lint/transfer.py, docs/LINT.md GL301) reads the
+    ``tier``/``reason`` keywords off the call site, checks the declared
+    tier against the site's loop-nesting depth, and gates the whole
+    ledger against ``lint/transfer_baseline.json``, so a new sync (or
+    one migrating into a hotter loop) fails lint by name instead of
+    silently re-paying the ~1 s/round-trip dispatch tax (docs/PERF.md).
+
+    ``tier`` must be a string literal — one of ``"sweep"`` /
+    ``"checkpoint"`` / ``"window"`` / ``"segment"``, coldest to
+    hottest — and ``reason`` a short literal justification ("window
+    liveness fetch", "checkpoint drain", ...). Both are metadata for
+    the AST pass; at runtime this is exactly ``jax.device_get``."""
+    del tier, reason  # ledger metadata, read statically by GL301
+    return jax.device_get(value)
+
+
+def host_sync(value, *, tier, reason):
+    """The audited device→host *barrier* choke point: blocks until
+    ``value``'s computation finishes without copying it home, then
+    returns ``value`` itself (still on device). Same GL301 ledger
+    contract as :func:`host_fetch`; use this when the host needs a
+    completion guarantee (timing fences, watchdog probes) but not the
+    bytes."""
+    del tier, reason  # ledger metadata, read statically by GL301
+    jax.block_until_ready(value)
+    return value
+
+
 def segment_lane_fn(
     protocol, dims: EngineDims, max_steps: int = 1 << 22,
     reorder: bool = False, faults: FaultFlags = NO_FAULTS,
@@ -1431,9 +1465,9 @@ def build_segment_runner(
     the lane state in place instead of allocating a second full copy
     per call and round-tripping it through HBM. Callers must treat the
     state they pass in as consumed — ``run_sweep`` rebinds the output
-    every segment and takes an explicit host copy (``device_get``)
-    before a checkpoint save, the only boundary where the pre-segment
-    state is still needed. Do NOT donate in a process that uses the
+    every segment and takes an explicit undonated host copy
+    (:func:`host_fetch`) before a checkpoint save, the only boundary
+    where the pre-segment state is still needed. Do NOT donate in a process that uses the
     persistent compile cache: gate on :func:`donation_safe` (the sweep
     driver does) — the current jaxlib corrupts donated state in
     warm-cache processes."""

@@ -45,6 +45,56 @@ KERNEL_MS_HI = 0.3
 # estimator (GL202) multiplies per-lane intermediates by
 SWEEP_LANES = 512
 
+# ----------------------------------------------------------------------
+# declared backend profiles — the ROADMAP item-5 seam. Every width /
+# packing / cost constant above is TPU-shaped; before GPU/CPU become
+# real sweep axes, the GL303 backend-width audit
+# (fantoch_tpu/lint/transfer.py, docs/LINT.md) checks the engine's
+# packing and narrowing choices against EVERY profile declared here,
+# so porting starts from a machine-checked inventory of what breaks
+# where instead of a grep. Fields:
+#
+#   int_width        — signed integer width (bits) of the backend's
+#                      native lane integer; ``SEQ_BOUND`` packings,
+#                      ``INF`` headroom and ``I32_MAX`` clamp targets
+#                      must fit in it
+#   matmul_exact_bound — largest integer magnitude the backend's
+#                      *default* f32 matmul accumulates exactly;
+#                      ``cumsum_i32`` (engine/core.py) computes integer
+#                      prefix sums through f32 matmuls and silently
+#                      rounds past this. TPU/CPU f32 carries the full
+#                      24-bit mantissa; GPU defaults to tf32 tensor
+#                      cores (10 explicit mantissa bits → 1 << 11)
+#                      unless the highest-precision mode is forced
+#   subword_dtypes   — storage dtypes the backend supports for the
+#                      narrowed cold planes (engine/spec.py
+#                      ``narrow_spec``: i16/i8 carry compaction)
+#   kernel_ms        — measured (lo, hi) per-kernel dispatch overhead
+#                      (the docs/PERF.md cost model GL201 gates on),
+#                      or None when unmeasured on that backend — GL303
+#                      flags None so the gap stays a named, baselined
+#                      finding until item 5 measures it
+BACKEND_PROFILES = {
+    "tpu": dict(
+        int_width=32,
+        matmul_exact_bound=F32_EXACT,
+        subword_dtypes=("int8", "int16"),
+        kernel_ms=(KERNEL_MS_LO, KERNEL_MS_HI),
+    ),
+    "gpu": dict(
+        int_width=32,
+        matmul_exact_bound=1 << 11,  # tf32 default
+        subword_dtypes=("int8", "int16"),
+        kernel_ms=None,
+    ),
+    "cpu": dict(
+        int_width=64,
+        matmul_exact_bound=F32_EXACT,
+        subword_dtypes=("int8", "int16"),
+        kernel_ms=None,
+    ),
+}
+
 # per-lane error taxonomy: the engine and the protocol modules OR these
 # bits into int32 error words (per process for protocol state, per lane
 # for engine conditions), so a failing lane names its cause instead of

@@ -11,6 +11,7 @@ import jax
 import numpy as np
 
 from ..core.metrics import Histogram
+from .core import host_fetch
 from .dims import INF, EngineDims, err_names
 from .monitor import viol_names
 from .spec import LaneSpec
@@ -130,7 +131,9 @@ def collect_results(
     final_state,
     specs: Sequence[LaneSpec],
 ) -> List[LaneResults]:
-    st = jax.device_get(final_state)
+    st = host_fetch(
+        final_state, tier="sweep", reason="lane results fetch"
+    )
     out: List[LaneResults] = []
     for lane, spec in enumerate(specs):
         ps = jax.tree_util.tree_map(lambda a: a[lane], st["ps"])

@@ -22,6 +22,16 @@ Four analysis families, one driver (``python -m fantoch_tpu.cli lint``):
    fused-group VMEM footprint (the gap-gather worker-crash class),
    GL203 lane-independence taint proof — the gate for the verified
    lane-sharded sweep path (``run_sweep(shard_lanes=True)``).
+5. **Transfer family** (:mod:`.transfer`, :mod:`.alias`; opt-in
+   ``--transfer``) — the *static* complement to the cost model's
+   dispatch tax: GL301 device→host sync ledger (every explicit /
+   implicit sync over the host orchestration layers, classified
+   per-sweep/-checkpoint/-window/-segment by loop nesting, gated
+   against ``lint/transfer_baseline.json`` with named
+   justifications), GL302 donation-lifetime prover (use-after-donate,
+   device-state checkpoint saves, AOT+donation), GL303 backend-width
+   portability audit against ``engine/dims.py BACKEND_PROFILES``.
+   Entirely AST/arithmetic — no device, no jax.
 
 Every pass shares one cached trace per protocol variant
 (:class:`.jaxpr.TraceCache`), so adding passes does not multiply the
@@ -67,6 +77,8 @@ def run_lint(
     jaxpr_audits: bool = True,
     cost: bool = False,
     cost_baseline: "dict | None" = None,
+    transfer: bool = False,
+    transfer_baseline: "dict | None" = None,
     cache=None,
     progress=None,
 ) -> LintReport:
@@ -77,7 +89,11 @@ def run_lint(
     deliberately broken file). ``cost=True`` adds the cost family —
     GL201 kernel ledger + GL202 VMEM footprint (gated against
     ``cost_baseline``, default the checked-in ``cost_baseline.json``)
-    and the GL203 lane-independence prover. All passes share one
+    and the GL203 lane-independence prover. ``transfer=True`` adds
+    the transfer family — GL301 sync ledger + GL303 backend audit
+    (gated against ``transfer_baseline``, default the checked-in
+    ``transfer_baseline.json``) and the GL302 donation prover; it is
+    pure AST/arithmetic and traces nothing. All passes share one
     :class:`~fantoch_tpu.lint.jaxpr.TraceCache` (pass ``cache`` to
     share across calls), so adding the cost family re-traces nothing
     the audits already traced."""
@@ -94,6 +110,27 @@ def run_lint(
     say("protocol hook registry ...")
     report.extend(rules.check_protocol_hooks())
     report.audits_run.append("hooks")
+
+    if transfer:
+        # GL301 ledger + GL303 backend audit gate against their own
+        # transfer_baseline.json (findings exist only on violation —
+        # like the cost family, never written to baseline.json);
+        # GL302 is baseline-free: clean code has zero findings
+        from .alias import run_alias
+        from .transfer import load_transfer_baseline, run_transfer
+
+        if transfer_baseline is None:
+            transfer_baseline = load_transfer_baseline()
+        findings, summary = run_transfer(
+            baseline=transfer_baseline, progress=say
+        )
+        report.extend(findings)
+        report.transfer = summary
+        report.audits_run.append("transfer")
+
+        say("donation-lifetime prover (GL302) ...")
+        report.extend(run_alias())
+        report.audits_run.append("alias")
 
     names = list(protocols or FULL_PROTOCOLS)
     partial_names = [

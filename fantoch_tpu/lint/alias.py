@@ -1,0 +1,302 @@
+"""GL302 donation-lifetime prover.
+
+Buffer donation (``donate_argnums=(0,)`` on the segment/window
+runners, engine/core.py) consumes the input state on dispatch: the
+caller's binding aliases freed device memory the moment the runner
+call is issued. Two shipped bug classes came from exactly this —
+PR 7's silent-corruption repro (donation + warm compile cache) and
+PR 11's aliasing-drop rule (deserialized AOT executables lose the
+donation metadata and read freed buffers). Both are guarded at runtime
+by :func:`~fantoch_tpu.engine.core.donation_safe` /
+:func:`~fantoch_tpu.engine.core.aot_donation_safe`; this pass turns
+the *conventions those guards assume* into statically refused
+findings over the host orchestration layers
+(``registry.TRANSFER_SCAN_PATHS``):
+
+* **use-after-donate** — a binding passed at the donated argnum
+  (arg 0) of a runner call is read later on some path without being
+  rebound by that call. The sanctioned idiom ``state, alive =
+  runner(state, ctx, until)`` rebinds in the same statement and stays
+  clean; ``out = runner(state, ...)`` followed by any read of
+  ``state`` is refused. Loop bodies are processed twice so a
+  second-iteration read of a first-iteration donation is caught.
+* **device-state checkpoint save** — a checkpoint save call
+  (``save_boundary`` / ``save_sweep_checkpoint`` / ``save``) whose
+  state argument is a bare device-fresh binding (bound from a runner
+  call, not laundered through ``host_fetch``): saves must be taken
+  from undonated host fetches at drained boundaries.
+* **AOT + donation** — a ``get_runner(..., donate=...)`` call whose
+  flag is literally ``True``, or a non-literal flag in a function
+  that never consults ``aot_donation_safe()``: deserialized
+  executables must never be invoked with donation enabled on the
+  pinned jaxlib.
+
+**Soundness over-approximations** (docs/LINT.md): the prover is
+path-insensitive — a donation on either branch of an ``if`` kills the
+binding on the join, and runtime guards it cannot see (``overlap =
+not donate`` disabling the checkpoint-buffer overlap under donation)
+do not resurrect it; every runner call is treated as donating even
+though donation is a runtime decision (the code must be correct under
+donation, because donation auto-engages whenever the process is
+cache-free). It is also intra-procedural: bindings escaping into
+containers, object attributes, or nested closures are invisible —
+``CheckpointBuffer`` parking a state is checked by the runtime
+invariants in parallel/pipeline.py, not here. Emits findings only on
+violation: clean at HEAD, nothing baselined.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set
+
+from ..registry import TRANSFER_SCAN_PATHS
+from .report import Finding
+from .rules import _is_traced_function, _rel, expand_paths
+from .transfer import RUNNER_BUILDERS, _call_name
+
+# checkpoint save entry points whose state argument must be host-side
+SAVE_FNS = ("save_boundary", "save_sweep_checkpoint", "save")
+
+# the laundering constructors: a binding from these is host-side
+FETCH_FNS = ("host_fetch", "device_get")
+
+
+def _assigned_names(targets) -> List[str]:
+    names: List[str] = []
+    for t in targets:
+        if isinstance(t, ast.Name):
+            names.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            names += [
+                e.id for e in t.elts if isinstance(e, ast.Name)
+            ]
+    return names
+
+
+class _FnProver:
+    """Statement-ordered def-use pass over one top-level function."""
+
+    def __init__(self, relpath: str, fn: ast.FunctionDef):
+        self.relpath = relpath
+        self.fn = fn
+        self.findings: List[Finding] = []
+        self.runner_names: Set[str] = set()
+        # name -> line of the donating call that killed it
+        self.dead: Dict[str, int] = {}
+        # device-fresh bindings (runner outputs, not host-fetched)
+        self.device: Set[str] = set()
+        self._reported: Set[str] = set()
+        self._consults_aot_gate = any(
+            isinstance(n, ast.Call)
+            and _call_name(n.func) == "aot_donation_safe"
+            for n in ast.walk(fn)
+        )
+
+    # -- findings -----------------------------------------------------
+
+    def _flag(self, suffix: str, message: str, line: int) -> None:
+        key = f"{self.fn.name}:{suffix}"
+        if key in self._reported:
+            return
+        self._reported.add(key)
+        self.findings.append(
+            Finding(
+                "GL302",
+                "alias",
+                f"{self.relpath}:{self.fn.name}:{suffix}",
+                message,
+                detail=f"line {line}",
+            )
+        )
+
+    # -- statement walk -----------------------------------------------
+
+    def run(self) -> List[Finding]:
+        self._block(self.fn.body)
+        return self.findings
+
+    def _block(self, stmts) -> None:
+        for stmt in stmts:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested closures are opaque (documented)
+        if isinstance(stmt, ast.If):
+            self._branches([stmt.body, stmt.orelse])
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._reads_check(stmt.iter)
+            else:
+                self._reads_check(stmt.test)
+            # twice: a second iteration reads first-iteration kills
+            for _ in (0, 1):
+                self._block(stmt.body)
+            self._block(stmt.orelse)
+            return
+        if isinstance(stmt, ast.Try):
+            self._branches(
+                [stmt.body + stmt.orelse + stmt.finalbody]
+                + [h.body for h in stmt.handlers]
+            )
+            return
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self._reads_check(item.context_expr)
+            self._block(stmt.body)
+            return
+
+        # straight-line statement: check reads against the dead set
+        # FIRST (a donating call reads its own argument while it is
+        # still live), then apply binding effects
+        self._reads_check(stmt)
+        for call in self._calls_in(stmt):
+            self._call_effects(call)
+        if isinstance(stmt, ast.Assign):
+            self._assign_effects(stmt)
+
+    def _branches(self, blocks) -> None:
+        entry_dead = dict(self.dead)
+        entry_dev = set(self.device)
+        exit_dead: Dict[str, int] = {}
+        exit_dev: Set[str] = set()
+        for block in blocks:
+            self.dead = dict(entry_dead)
+            self.device = set(entry_dev)
+            self._block(block)
+            exit_dead.update(self.dead)
+            exit_dev |= self.device
+        # path-insensitive join: dead/device on ANY path stays so
+        self.dead = exit_dead
+        self.device = exit_dev
+
+    # -- reads --------------------------------------------------------
+
+    def _reads_check(self, node: ast.AST) -> None:
+        for n in ast.walk(node):
+            if (
+                isinstance(n, ast.Name)
+                and isinstance(n.ctx, ast.Load)
+                and n.id in self.dead
+            ):
+                self._flag(
+                    f"use-after-donate:{n.id}",
+                    f"`{n.id}` is read after being passed at the "
+                    f"donated argnum of a runner call (line "
+                    f"{self.dead[n.id]}) without being rebound by "
+                    "that call — under donation the binding aliases "
+                    "freed device memory; rebind it (`state, alive = "
+                    "runner(state, ...)`) or take the read from the "
+                    "call's output",
+                    n.lineno,
+                )
+
+    # -- effects ------------------------------------------------------
+
+    def _calls_in(self, stmt) -> List[ast.Call]:
+        return [
+            n for n in ast.walk(stmt) if isinstance(n, ast.Call)
+        ]
+
+    def _call_effects(self, call: ast.Call) -> None:
+        callee = _call_name(call.func)
+
+        # donation kill: arg 0 of a runner call
+        if callee in self.runner_names and call.args:
+            arg0 = call.args[0]
+            if isinstance(arg0, ast.Name):
+                self.dead[arg0.id] = call.lineno
+
+        # device-state checkpoint save
+        if callee in SAVE_FNS:
+            arg = None
+            if call.args:
+                arg = call.args[0]
+            for kw in call.keywords:
+                if kw.arg == "state":
+                    arg = kw.value
+            if isinstance(arg, ast.Name) and arg.id in self.device:
+                self._flag(
+                    f"save-device-state:{arg.id}",
+                    f"checkpoint save of device-fresh `{arg.id}` — "
+                    "saves must be taken from an undonated host copy "
+                    "(host_fetch) at a drained boundary, never from "
+                    "a binding the next dispatch may consume",
+                    call.lineno,
+                )
+
+        # AOT + donation
+        if callee == "get_runner":
+            for kw in call.keywords:
+                if kw.arg != "donate":
+                    continue
+                lit_true = (
+                    isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True
+                )
+                if lit_true or not self._consults_aot_gate:
+                    self._flag(
+                        "aot-donate",
+                        "get_runner(..., donate=...) without an "
+                        "aot_donation_safe() gate in this function — "
+                        "deserialized executables drop donation "
+                        "aliasing on the pinned jaxlib and read "
+                        "freed buffers (engine/core.py "
+                        "aot_donation_safe); the flag must be forced "
+                        "False unless the gate passes",
+                        call.lineno,
+                    )
+
+    def _assign_effects(self, stmt: ast.Assign) -> None:
+        names = _assigned_names(stmt.targets)
+        value = stmt.value
+        if isinstance(value, ast.Call):
+            callee = _call_name(value.func)
+            if callee in RUNNER_BUILDERS and names:
+                # builders returning tuples return the runner first
+                self.runner_names.add(names[0])
+            elif callee in self.runner_names:
+                for n in names:
+                    self.device.add(n)
+            elif callee in FETCH_FNS:
+                for n in names:
+                    self.device.discard(n)
+        # any rebind resurrects the name (the donating call's own
+        # assignment targets included — _call_effects ran first)
+        for n in names:
+            self.dead.pop(n, None)
+            if not isinstance(value, ast.Call):
+                self.device.discard(n)
+
+
+def run_alias(
+    paths: "Sequence[str] | None" = None,
+) -> List[Finding]:
+    """Run the GL302 prover over the transfer scan set (or ``paths``).
+    Traced functions are skipped — donation is a host-orchestration
+    concern; inside a trace there are no buffers to donate."""
+    findings: List[Finding] = []
+    for path in expand_paths(paths or TRANSFER_SCAN_PATHS):
+        with open(path) as fh:
+            tree = ast.parse(fh.read(), filename=path)
+        rel = _rel(path)
+        for node in tree.body:
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                if isinstance(node, ast.ClassDef):
+                    for meth in node.body:
+                        if isinstance(
+                            meth,
+                            (ast.FunctionDef, ast.AsyncFunctionDef),
+                        ) and not _is_traced_function(meth):
+                            findings.extend(
+                                _FnProver(rel, meth).run()
+                            )
+                continue
+            if _is_traced_function(node):
+                continue
+            findings.extend(_FnProver(rel, node).run())
+    return findings

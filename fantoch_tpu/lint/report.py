@@ -47,6 +47,9 @@ class LintReport:
     # per-protocol cost-ledger summaries (kernel counts, estimated
     # ms/step, peak fused footprint) when the cost passes ran
     cost: Dict[str, dict] = field(default_factory=dict)
+    # host-sync ledger summary (per-tier site counts) when the
+    # transfer family ran
+    transfer: Dict[str, object] = field(default_factory=dict)
 
     def extend(self, fs) -> None:
         self.findings.extend(fs)
@@ -79,6 +82,7 @@ class LintReport:
         return {
             "audits": self.audits_run,
             **({"cost": self.cost} if self.cost else {}),
+            **({"transfer": self.transfer} if self.transfer else {}),
             "findings": [
                 {
                     "id": f.id,
@@ -113,13 +117,15 @@ def load_baseline(path: str) -> Dict[str, int]:
 
 def write_baseline(path: str, report: LintReport) -> None:
     # cost-family rules (GL2xx) gate against cost_baseline.json and
+    # the transfer family (GL3xx) against transfer_baseline.json; both
     # emit findings ONLY on violation — writing one here would
-    # permanently suppress a live kernel/VMEM/lane regression, so a
-    # run that happens to include `--cost` must never bake them in
+    # permanently suppress a live kernel/VMEM/sync/donation
+    # regression, so a run that happens to include `--cost` or
+    # `--transfer` must never bake them in
     counts = {
         fid: n
         for fid, n in sorted(report.counts().items())
-        if not fid.startswith("GL2")
+        if not fid.startswith(("GL2", "GL3"))
     }
     payload = {
         "_comment": (

@@ -38,38 +38,19 @@ import ast
 import os
 from typing import Iterable, List, Sequence, Tuple
 
+from ..registry import TRACED_SCAN_PATHS
 from .report import Finding
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
 
-# default scan set: everything that traces into the engine step, plus
-# the checkpoint/campaign entry points (host-side by design — the scan
-# proves they stay that way: no raw emission, no tracer branching, no
-# host-sync ops sneaking into anything that becomes traced)
-DEFAULT_PATHS = (
-    "fantoch_tpu/engine/core.py",
-    "fantoch_tpu/engine/monitor.py",
-    "fantoch_tpu/engine/iset.py",
-    "fantoch_tpu/engine/checkpoint.py",
-    "fantoch_tpu/engine/protocols",
-    "fantoch_tpu/campaign",
-    "fantoch_tpu/traffic",
-    "fantoch_tpu/bote/validate.py",
-    # the sweep driver + its pipelined segment window + the shard_map
-    # partition layer + the AOT executable serialization layer
-    # (parallel/aot.py — host-side orchestration by design; the scan
-    # proves the dispatch loop never grows raw emissions, tracer
-    # branching, or host-sync ops)
-    "fantoch_tpu/parallel",
-    # fleet campaigns: leases/worker/merge are pure host-side file
-    # protocol — the scan proves they stay that way
-    "fantoch_tpu/fleet",
-    # coverage-guided fuzzing: map/mutation/steering are host-side by
-    # design (the digest itself lives in engine/monitor.py, already
-    # scanned) — the scan proves the feedback loop never grows traced
-    # code paths
-    "fantoch_tpu/mc/coverage.py",
-)
+# default scan set: derived from the canonical jax-free registry
+# (fantoch_tpu/registry.py TRACED_SCAN_PATHS) — the list used to live
+# here as an append-only tuple and drifted from the package layout;
+# deriving it from the registry puts it next to the protocol grids so
+# a new subsystem is one visible edit away from coverage, and
+# ``uncovered_traced_modules`` below is the self-test that catches the
+# next drift.
+DEFAULT_PATHS = TRACED_SCAN_PATHS
 
 OUTBOX_KEYS = {"valid", "dst", "mtype", "payload"}
 # the sanctioned constructors (GL101 exempts their defining module)
@@ -289,6 +270,60 @@ class _FileScan(ast.NodeVisitor):
                 )
             )
         self.generic_visit(node)
+
+
+def _imports_jax(tree: ast.AST) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            if any(a.name.split(".")[0] == "jax" for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and node.module.split(".")[0] == "jax":
+                return True
+    return False
+
+
+def uncovered_traced_modules(
+    paths: "Sequence[str] | None" = None,
+) -> List[str]:
+    """Scan-set drift self-test: every ``fantoch_tpu`` module that
+    imports jax AND defines traced-looking functions (per
+    :func:`_is_traced_function`) must be inside the AST scan set —
+    returns the repo-relative paths that are not (empty at HEAD,
+    pinned in tests/test_lint_transfer.py).
+
+    Two deliberate exclusions: the pure-Python reference packages
+    (``protocol/``, ``executor/``, ``sim/``, ``run/``, ``core/``)
+    define ``handle``-named oracle functions but never import jax, so
+    the jax-import filter drops them; and ``fantoch_tpu/lint`` itself
+    is exempt — the analyzers necessarily mention tracer names and
+    build jax traces, and scanning the linter with itself only ever
+    reports its own detection tables."""
+    covered = {
+        _rel(p) for p in expand_paths(paths or DEFAULT_PATHS)
+    }
+    pkg_root = os.path.join(REPO_ROOT, "fantoch_tpu")
+    missing: List[str] = []
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        dirnames[:] = sorted(
+            d for d in dirnames if d not in ("__pycache__", "lint")
+        )
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fn)
+            rel = _rel(full)
+            if rel in covered:
+                continue
+            with open(full) as fh:
+                tree = ast.parse(fh.read(), filename=full)
+            if not _imports_jax(tree):
+                continue
+            if any(
+                _is_traced_function(n) for n in ast.walk(tree)
+            ):
+                missing.append(rel)
+    return missing
 
 
 def run_ast_rules(paths: "Sequence[str] | None" = None) -> List[Finding]:

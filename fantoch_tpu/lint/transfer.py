@@ -1,0 +1,744 @@
+"""GL301 static device→host sync ledger + GL303 backend-width audit.
+
+The measured cost model (docs/PERF.md) prices every host round-trip at
+~1 s over the tunneled runtime against 0.1–0.3 ms per kernel — the
+dispatch tax two tentpoles (pipelined windows, scan-fused windows)
+spent their budgets attacking. Nothing *static* kept a third PR from
+quietly reintroducing a per-segment sync, so this pass builds the
+complete ledger of device→host synchronization points over the host
+orchestration layers (``fantoch_tpu/registry.py``
+``TRANSFER_SCAN_PATHS``) and gates it against a checked-in
+``lint/transfer_baseline.json`` in which every intentional sync
+carries a named justification. A new sync, a count bump, or an
+existing sync migrating into a hotter loop tier fails lint by name.
+
+**What counts as a sync.** Explicit: ``jax.device_get`` /
+``jax.block_until_ready``, the ``.item()`` / ``.tolist()`` /
+``.block_until_ready()`` / ``.copy_to_host_async()`` methods (also via
+``getattr(x, "copy_to_host_async", ...)``), and the audited choke
+points ``host_fetch()`` / ``host_sync()`` (engine/core.py). Implicit:
+``bool()`` / ``int()`` / ``float()`` coercion or an ``if``/``while``
+test over a *device-tracked* binding, and ``np.asarray`` of one — a
+binding is device-tracked when it was (transitively) produced by a
+runner call (``build_segment_runner`` & friends, the same recognizer
+GL302 uses) or ``jax.device_put``, and laundered back to host exactly
+by ``host_fetch``.
+
+**Tier classification** is structural, by loop-nesting depth at the
+sync site: depth 0 → ``sweep``, depth 1 → ``window`` (or
+``checkpoint`` when an ``if`` guard sits between the loop and the
+site — a conditionally-taken sync inside the dispatch loop), depth
+≥ 2 → ``segment``. Hotness orders ``sweep < checkpoint < window <
+segment``. A choke-point call must declare ``tier=``/``reason=`` as
+string literals, and the declared tier may never be *colder* than the
+structural observation (you may conservatively over-claim hotness,
+never hide it).
+
+**Soundness** (docs/LINT.md carries the full notes): the ledger is an
+intra-procedural AST analysis — it does NOT see syncs buried inside
+third-party calls (``np.save`` of a device array, logging that
+stringifies one), device values smuggled through containers or
+attributes (``deque`` of liveness futures — which is why the window
+flags are fetched through ``host_fetch`` at the ``popleft`` site), or
+values crossing function boundaries (parameters are untracked). It is
+a ratchet on the code we write, not a proof about jax.
+
+GL303 audits the TPU-shaped packing/width constants
+(``SEQ_BOUND`` affine packings, ``narrow_spec`` sub-word storage,
+``KERNEL_MS_*`` consumers) against every profile declared in
+``engine/dims.py BACKEND_PROFILES`` — the ROADMAP item-5 seam — so
+multi-backend work starts from a machine-checked inventory. Both
+rules gate against ``transfer_baseline.json`` and emit findings only
+on violation (like the GL2xx cost family): they are never written
+into the main ``baseline.json``.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..registry import TRANSFER_SCAN_PATHS
+from .report import Finding
+from .rules import REPO_ROOT, _is_traced_function, _rel, expand_paths
+
+# the checked-in ledger (CI transfer-gate runs against this)
+DEFAULT_TRANSFER_BASELINE = os.path.join(
+    os.path.dirname(__file__), "transfer_baseline.json"
+)
+
+# coldest → hottest; index is the hotness used for tier comparisons
+TIERS = ("sweep", "checkpoint", "window", "segment")
+_HOTNESS = {t: i for i, t in enumerate(TIERS)}
+
+# the sanctioned fetch/barrier constructors (engine/core.py); their
+# defining file is exempt from the raw-primitive findings the way
+# GL101 exempts emit/pack_outbox's module
+CHOKE_FNS = ("host_fetch", "host_sync")
+CHOKE_FILE = "fantoch_tpu/engine/core.py"
+
+# method-style explicit syncs (device array methods)
+SYNC_ATTRS = ("item", "tolist", "block_until_ready", "copy_to_host_async")
+
+# names whose call results are device-array futures: the runner
+# builders (all return the runner first when they return a tuple) and
+# the device placement primitive. Shared with GL302 (lint/alias.py).
+RUNNER_BUILDERS = (
+    "build_runner",
+    "build_segment_runner",
+    "build_window_runner",
+    "build_partitioned_runner",
+    "get_runner",
+    "_cached_runner",
+)
+
+
+def _call_name(func: ast.AST) -> Optional[str]:
+    """Bare name of a call target: ``f(...)`` and ``mod.f(...)`` both
+    resolve to ``f``."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+@dataclass(frozen=True)
+class SyncSite:
+    """One device→host synchronization point in the ledger."""
+
+    relpath: str
+    fn: str
+    kind: str           # host_fetch@<tier> | device_get | bool | ...
+    tier: str           # structural tier (loop-depth observation)
+    reason: str = ""    # declared justification (choke points only)
+    line: int = 0
+
+    @property
+    def id(self) -> str:
+        return f"GL301:transfer:{self.relpath}:{self.fn}:{self.kind}"
+
+
+class _TransferScan(ast.NodeVisitor):
+    """Per-file GL301 scan: collects :class:`SyncSite` entries plus the
+    findings that are violations regardless of any baseline (a choke
+    call without literal metadata, a declared tier colder than the
+    structural one). Traced functions are skipped — GL104 owns host
+    ops inside traced code."""
+
+    def __init__(self, relpath: str):
+        self.relpath = relpath
+        self.sites: List[SyncSite] = []
+        self.findings: List[Finding] = []
+        self.fn_stack: List[str] = []
+        self.skip_depth = 0      # inside a traced function
+        self._ctl: List[str] = []  # "loop" / "if" nesting markers
+        self.device_names: set = set()
+        self.runner_names: set = set()
+
+    # -- context tracking ---------------------------------------------
+
+    def visit_FunctionDef(self, node):
+        traced = _is_traced_function(node)
+        if not self.fn_stack:
+            # per-top-level-function binding scopes (nested fns share
+            # the outer scope's view — closures read outer bindings)
+            self.device_names = set()
+            self.runner_names = set()
+        choke = (
+            self.relpath == CHOKE_FILE and node.name in CHOKE_FNS
+        )
+        self.fn_stack.append(node.name)
+        if traced or choke:
+            self.skip_depth += 1
+            self.generic_visit(node)
+            self.skip_depth -= 1
+        else:
+            self.generic_visit(node)
+        self.fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _fn(self) -> str:
+        return self.fn_stack[0] if self.fn_stack else "<module>"
+
+    def _loop(self, node):
+        self._ctl.append("loop")
+        self.generic_visit(node)
+        self._ctl.pop()
+
+    visit_For = _loop
+    visit_AsyncFor = _loop
+
+    def visit_While(self, node):
+        self._check_device_test(node.test, node.lineno)
+        self._ctl.append("loop")
+        self.generic_visit(node)
+        self._ctl.pop()
+
+    def visit_If(self, node):
+        self._check_device_test(node.test, node.lineno)
+        self._ctl.append("if")
+        self.generic_visit(node)
+        self._ctl.pop()
+
+    def _observed_tier(self) -> str:
+        depth = sum(1 for k in self._ctl if k == "loop")
+        if depth == 0:
+            return "sweep"
+        if depth >= 2:
+            return "segment"
+        # depth 1: an `if` between the innermost loop and the site
+        # marks a conditionally-taken sync — one notch colder than the
+        # loop body it sits in (the checkpoint-cadence pattern)
+        innermost = len(self._ctl) - 1 - self._ctl[::-1].index("loop")
+        guarded = "if" in self._ctl[innermost + 1:]
+        return "checkpoint" if guarded else "window"
+
+    # -- device-binding tracking --------------------------------------
+
+    def _reads_device(self, node: ast.AST) -> bool:
+        return any(
+            isinstance(n, ast.Name) and n.id in self.device_names
+            for n in ast.walk(node)
+        )
+
+    def visit_Assign(self, node: ast.Assign):
+        self.visit(node.value)  # detect syncs inside the RHS first
+        names = [
+            t.id for t in node.targets if isinstance(t, ast.Name)
+        ]
+        for t in node.targets:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                names += [
+                    e.id for e in t.elts if isinstance(e, ast.Name)
+                ]
+        value = node.value
+        if isinstance(value, ast.Call):
+            callee = _call_name(value.func)
+            if callee in RUNNER_BUILDERS:
+                # builders returning tuples return the runner first
+                if names:
+                    self.runner_names.add(names[0])
+                self.device_names -= set(names)
+                return
+            if callee in self.runner_names or callee == "device_put":
+                self.device_names |= set(names)
+                return
+            if callee in CHOKE_FNS:
+                # the choke point launders device values back to host
+                self.device_names -= set(names)
+                return
+        if self._reads_device(value) and not isinstance(
+            value, ast.Call
+        ):
+            # subscripts/attributes/dict-literals over device values
+            # stay device (state["metrics"], fetch = {...}); calls are
+            # opaque — their results are untracked
+            self.device_names |= set(names)
+            return
+        self.device_names -= set(names)
+
+    # -- sync-site detection ------------------------------------------
+
+    def _site(self, kind, line, tier=None, reason=""):
+        self.sites.append(
+            SyncSite(
+                relpath=self.relpath,
+                fn=self._fn(),
+                kind=kind,
+                tier=tier or self._observed_tier(),
+                reason=reason,
+                line=line,
+            )
+        )
+
+    def _check_device_test(self, test: ast.AST, line: int):
+        # bare (non-Call) tests only: `if bool(x)` / `if host_fetch(x)`
+        # are registered by visit_Call, not double-counted here
+        if (
+            self.skip_depth == 0
+            and not isinstance(test, ast.Call)
+            and self._reads_device(test)
+        ):
+            self._site("bool", line)
+
+    def visit_Call(self, node: ast.Call):
+        if self.skip_depth:
+            self.generic_visit(node)
+            return
+        callee = _call_name(node.func)
+
+        if callee in CHOKE_FNS:
+            meta = {
+                kw.arg: kw.value
+                for kw in node.keywords
+                if kw.arg in ("tier", "reason")
+            }
+            tier = meta.get("tier")
+            reason = meta.get("reason")
+            literal = (
+                isinstance(tier, ast.Constant)
+                and isinstance(tier.value, str)
+                and tier.value in TIERS
+                and isinstance(reason, ast.Constant)
+                and isinstance(reason.value, str)
+                and reason.value
+            )
+            if not literal:
+                self.findings.append(
+                    Finding(
+                        "GL301",
+                        "transfer",
+                        f"{self.relpath}:{self._fn()}:choke-meta",
+                        f"`{callee}` call without literal tier=/reason= "
+                        "keywords — the ledger reads both off the call "
+                        f"site (tier one of {'/'.join(TIERS)})",
+                        detail=f"line {node.lineno}",
+                    )
+                )
+            else:
+                declared, why = tier.value, reason.value
+                observed = self._observed_tier()
+                if _HOTNESS[declared] < _HOTNESS[observed]:
+                    self.findings.append(
+                        Finding(
+                            "GL301",
+                            "transfer",
+                            f"{self.relpath}:{self._fn()}:"
+                            f"tier-claim:{callee}",
+                            f"`{callee}(tier=\"{declared}\")` sits at "
+                            f"structural tier `{observed}` (loop "
+                            "nesting) — a declared tier may over-claim "
+                            "hotness but never hide it",
+                            detail=f"line {node.lineno}",
+                        )
+                    )
+                self._site(
+                    f"{callee}@{declared}",
+                    node.lineno,
+                    tier=observed,
+                    reason=why,
+                )
+            self.generic_visit(node)
+            return
+
+        # raw explicit primitives (anywhere outside the choke file's
+        # own constructors): jax.device_get / jax.block_until_ready
+        if (
+            isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "jax"
+            and node.func.attr in ("device_get", "block_until_ready")
+        ):
+            self._site(node.func.attr, node.lineno)
+        # device-array method syncs. block_until_ready /
+        # copy_to_host_async exist only on device arrays, so any
+        # spelling registers; item/tolist are shared with host numpy
+        # (results serialization calls them on fetched arrays), so
+        # they register only on device-tracked operands — an untracked
+        # flow escaping this is the documented intra-procedural gap
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr
+            in ("block_until_ready", "copy_to_host_async")
+        ):
+            self._site(node.func.attr, node.lineno)
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("item", "tolist")
+            and self._reads_device(node.func.value)
+        ):
+            self._site(node.func.attr, node.lineno)
+        # getattr(x, "copy_to_host_async", ...) — the probing spelling
+        elif (
+            callee == "getattr"
+            and len(node.args) >= 2
+            and isinstance(node.args[1], ast.Constant)
+            and node.args[1].value in SYNC_ATTRS
+        ):
+            self._site(node.args[1].value, node.lineno)
+        # implicit coercions of device-tracked bindings
+        elif callee in ("bool", "int", "float") and node.args:
+            if self._reads_device(node.args[0]):
+                self._site(callee, node.lineno)
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "np"
+            and node.func.attr == "asarray"
+            and node.args
+            and self._reads_device(node.args[0])
+        ):
+            self._site("np.asarray", node.lineno)
+        self.generic_visit(node)
+
+
+def scan_transfer(
+    paths: "Sequence[str] | None" = None,
+) -> Tuple[List[SyncSite], List[Finding]]:
+    """Scan the transfer set: every sync site plus the unconditional
+    findings (bad choke metadata, under-claimed tiers)."""
+    sites: List[SyncSite] = []
+    findings: List[Finding] = []
+    for path in expand_paths(paths or TRANSFER_SCAN_PATHS):
+        with open(path) as fh:
+            tree = ast.parse(fh.read(), filename=path)
+        scan = _TransferScan(_rel(path))
+        scan.visit(tree)
+        sites.extend(scan.sites)
+        findings.extend(scan.findings)
+    return sites, findings
+
+
+def ledger_summary(paths: "Sequence[str] | None" = None) -> dict:
+    """Per-tier sync-site counts — the device-free ``bench.py
+    host_sync_ledger`` metric (pure AST; safe even when no device is
+    reachable)."""
+    sites, _ = scan_transfer(paths)
+    tiers = {t: 0 for t in TIERS}
+    for s in sites:
+        tiers[s.tier] += 1
+    return {
+        "sites": len(sites),
+        "tiers": tiers,
+        "ids": len({s.id for s in sites}),
+    }
+
+
+# ----------------------------------------------------------------------
+# ledger gate (transfer_baseline.json)
+# ----------------------------------------------------------------------
+
+
+def load_transfer_baseline(
+    path: str = DEFAULT_TRANSFER_BASELINE,
+) -> Dict[str, dict]:
+    """``{"entries": {id: {count, tier?, reason}}}``; missing file is
+    an empty ledger (every sync is then a new-sync finding, which is
+    how the first ``--write-transfer-baseline`` run is bootstrapped)."""
+    if not os.path.exists(path):
+        return {}
+    with open(path) as fh:
+        data = json.load(fh)
+    entries = data.get("entries", data)
+    return {
+        str(k): dict(v)
+        for k, v in entries.items()
+        if not str(k).startswith("_")
+    }
+
+
+def _grouped(sites: Sequence[SyncSite]) -> Dict[str, dict]:
+    out: Dict[str, dict] = {}
+    for s in sites:
+        e = out.setdefault(
+            s.id, {"count": 0, "tier": s.tier, "reason": s.reason}
+        )
+        e["count"] += 1
+        if _HOTNESS[s.tier] > _HOTNESS[e["tier"]]:
+            e["tier"] = s.tier
+        if s.reason and not e["reason"]:
+            e["reason"] = s.reason
+    return out
+
+
+def write_transfer_baseline(
+    path: str, sites: Sequence[SyncSite]
+) -> Dict[str, dict]:
+    entries = _grouped(sites)
+    for e in entries.values():
+        if not e["reason"]:
+            e["reason"] = (
+                "UNREVIEWED raw sync — justify or migrate through "
+                "host_fetch/host_sync"
+            )
+    payload = {
+        "_comment": (
+            "GL301 device->host sync ledger + GL303 backend-width "
+            "allowances: finding id -> {count, tier, reason}. Every "
+            "entry is an INTENTIONAL sync with a named justification "
+            "(docs/LINT.md); regenerate with `python -m "
+            "fantoch_tpu.cli lint --write-transfer-baseline` and "
+            "REVIEW the diff — a new id, a count bump, or a hotter "
+            "tier is the regression this file exists to catch."
+        ),
+        "entries": {k: entries[k] for k in sorted(entries)},
+    }
+    with open(path, "w") as fh:
+        json.dump(payload, fh, indent=2)
+        fh.write("\n")
+    return entries
+
+
+def gate_ledger(
+    sites: Sequence[SyncSite],
+    baseline: Dict[str, dict],
+) -> Tuple[List[Finding], List[str]]:
+    """Compare the observed ledger to the checked-in one. Returns
+    (violations, stale-ids); stale allowances stay advisory."""
+    findings: List[Finding] = []
+    got = _grouped(sites)
+    for fid, e in sorted(got.items()):
+        anchor = fid.split(":", 2)[2]
+        allowed = baseline.get(fid)
+        where = f"tier {e['tier']}, x{e['count']}"
+        if allowed is None:
+            findings.append(
+                Finding(
+                    "GL301",
+                    "transfer",
+                    anchor,
+                    f"NEW device->host sync ({where}) — every "
+                    "intentional sync must carry a named "
+                    "justification in lint/transfer_baseline.json; "
+                    "each one costs ~1 s of dispatch stall per "
+                    "occurrence (docs/PERF.md cost model)",
+                )
+            )
+            continue
+        if e["count"] > int(allowed.get("count", 0)):
+            findings.append(
+                Finding(
+                    "GL301",
+                    "transfer",
+                    anchor,
+                    f"sync count grew: {e['count']} observed vs "
+                    f"{allowed.get('count')} allowed ({where})",
+                )
+            )
+        base_tier = allowed.get("tier", "segment")
+        if _HOTNESS[e["tier"]] > _HOTNESS.get(base_tier, 3):
+            findings.append(
+                Finding(
+                    "GL301",
+                    "transfer",
+                    anchor,
+                    f"sync migrated to a HOTTER tier: observed "
+                    f"`{e['tier']}` vs baselined `{base_tier}` — a "
+                    "per-sweep fetch moving into the dispatch loop "
+                    "multiplies its ~1 s stall by the loop trip count",
+                )
+            )
+    stale = sorted(
+        k
+        for k, v in baseline.items()
+        if k.startswith("GL301:")
+        and got.get(k, {"count": 0})["count"] < int(v.get("count", 0))
+    )
+    return findings, stale
+
+
+# ----------------------------------------------------------------------
+# GL303: backend-width portability audit
+# ----------------------------------------------------------------------
+
+# generous bound on the process/source axis of the `src * SEQ_BOUND +
+# seq` affine packings (monitor.py, caesar.py, graphdep.py): partial-
+# replication lanes reach S*n ~ tens; 256 leaves a documented margin
+PACK_SRC_MAX = 256
+
+
+def _load_dims():
+    """Load engine/dims.py by path: it is dependency-free, and going
+    through ``fantoch_tpu.engine`` would pull the jax-heavy package
+    ``__init__`` into a deliberately device-free audit."""
+    import importlib.util
+    import sys
+
+    name = "_gl303_engine_dims"
+    if name in sys.modules:
+        return sys.modules[name]
+    path = os.path.join(REPO_ROOT, "fantoch_tpu", "engine", "dims.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    # registered before exec: dataclass processing resolves the
+    # module's globals through sys.modules
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def backend_audit() -> List[Finding]:
+    """Check the engine's TPU-shaped width/packing constants against
+    every declared backend profile (engine/dims.py
+    ``BACKEND_PROFILES``). Emits one finding per (profile, violated
+    constraint); intentional gaps are baselined with named
+    justifications in transfer_baseline.json."""
+    d = _load_dims()
+    findings: List[Finding] = []
+    anchor = "fantoch_tpu/engine/dims.py"
+
+    for name, prof in sorted(d.BACKEND_PROFILES.items()):
+        imax = 2 ** (int(prof["int_width"]) - 1) - 1
+
+        if d.INF + d.SEQ_BOUND > imax:
+            findings.append(
+                Finding(
+                    "GL303",
+                    "backend",
+                    f"{anchor}:{name}:inf-headroom",
+                    f"INF (1<<30) + SEQ_BOUND wraps the {name} "
+                    f"profile's {prof['int_width']}-bit signed lane "
+                    "integer — `INF + delay` arithmetic overflows",
+                )
+            )
+        if PACK_SRC_MAX * d.SEQ_BOUND + d.SEQ_BOUND > imax:
+            findings.append(
+                Finding(
+                    "GL303",
+                    "backend",
+                    f"{anchor}:{name}:seq-packing",
+                    f"the `src * SEQ_BOUND + seq` affine packing "
+                    f"(monitor.py, caesar.py, graphdep.py) overflows "
+                    f"{name}'s {prof['int_width']}-bit integer for "
+                    f"src up to {PACK_SRC_MAX}",
+                )
+            )
+        if d.I32_MAX > imax:
+            findings.append(
+                Finding(
+                    "GL303",
+                    "backend",
+                    f"{anchor}:{name}:clamp-target",
+                    f"I32_MAX clamp targets exceed {name}'s "
+                    f"{prof['int_width']}-bit lane integer",
+                )
+            )
+        if d.F32_EXACT > int(prof["matmul_exact_bound"]):
+            findings.append(
+                Finding(
+                    "GL303",
+                    "backend",
+                    f"{anchor}:{name}:matmul-exactness",
+                    f"cumsum_i32 (engine/core.py) assumes f32 matmuls "
+                    f"accumulate integers exactly up to F32_EXACT "
+                    f"(1<<24), but the {name} profile's default "
+                    f"matmul is exact only to "
+                    f"{prof['matmul_exact_bound']} — integer prefix "
+                    "sums would silently round (force the "
+                    "highest-precision matmul mode before enabling "
+                    "this backend)",
+                )
+            )
+        subword = set(prof.get("subword_dtypes") or ())
+        for dt in ("int8", "int16"):
+            if dt not in subword:
+                findings.append(
+                    Finding(
+                        "GL303",
+                        "backend",
+                        f"{anchor}:{name}:subword-{dt}",
+                        f"narrow_spec (engine/spec.py) stores cold "
+                        f"planes as {dt}, which the {name} profile "
+                        "does not declare supported — narrowed "
+                        "checkpoints/carries would widen or fail",
+                    )
+                )
+        if prof.get("kernel_ms") is None:
+            findings.append(
+                Finding(
+                    "GL303",
+                    "backend",
+                    f"{anchor}:{name}:kernel-ms-unmeasured",
+                    f"no measured per-kernel dispatch cost for the "
+                    f"{name} profile — the GL201 cost gate and the "
+                    "docs/PERF.md model price kernels with KERNEL_MS_* "
+                    "measured on TPU only; re-measure before trusting "
+                    f"cost estimates on {name} (ROADMAP item 5)",
+                )
+            )
+    return findings
+
+
+def gate_backend(
+    baseline: Dict[str, dict],
+) -> Tuple[List[Finding], List[str]]:
+    """GL303 findings beyond the baseline allowance + stale ids."""
+    findings = backend_audit()
+    allowed: Dict[str, int] = {
+        k: int(v.get("count", 0))
+        for k, v in baseline.items()
+        if k.startswith("GL303:")
+    }
+    seen: Dict[str, int] = {}
+    out: List[Finding] = []
+    for f in findings:
+        seen[f.id] = seen.get(f.id, 0) + 1
+        if seen[f.id] > allowed.get(f.id, 0):
+            out.append(f)
+    stale = sorted(
+        k for k, n in allowed.items() if seen.get(k, 0) < n
+    )
+    return out, stale
+
+
+# ----------------------------------------------------------------------
+# driver + CI selfcheck
+# ----------------------------------------------------------------------
+
+
+def run_transfer(
+    paths: "Sequence[str] | None" = None,
+    *,
+    baseline: "Dict[str, dict] | None" = None,
+    backend: bool = True,
+    progress=None,
+) -> Tuple[List[Finding], dict]:
+    """The transfer family: GL301 ledger gate (+ unconditional
+    choke-metadata/tier-claim findings) and the GL303 backend audit,
+    both against ``transfer_baseline.json``. Returns ``(violations,
+    summary)`` — like the cost family, findings exist only on
+    violation and are never written to the main baseline."""
+    say = progress or (lambda *_: None)
+    if baseline is None:
+        baseline = load_transfer_baseline()
+
+    say("transfer ledger (GL301) ...")
+    sites, findings = scan_transfer(paths)
+    gate, stale = gate_ledger(sites, baseline)
+    findings.extend(gate)
+
+    summary = ledger_summary(paths)
+    summary["stale_baseline"] = stale
+
+    if backend:
+        say("backend-width audit (GL303) ...")
+        bfs, bstale = gate_backend(baseline)
+        findings.extend(bfs)
+        summary["stale_baseline"] = sorted(stale + bstale)
+    return findings, summary
+
+
+def run_transfer_selfcheck(kind: str, progress=None) -> List[Finding]:
+    """CI broken-fixture check: scan the seeded defect fixture and
+    return its findings — the caller exits non-zero when (and only
+    when) the seeded defect is caught, so a crash or an empty scan
+    cannot pass vacuously.
+
+    ``sync``: tests/fixtures/transfer_bad_sync.py adds a per-segment
+    ``.item()`` poll — must regress GL301. ``donate``:
+    tests/fixtures/transfer_bad_donate.py reads a donated buffer —
+    must regress GL302 (lint/alias.py).
+    """
+    say = progress or (lambda *_: None)
+    fixtures = os.path.join(REPO_ROOT, "tests", "fixtures")
+    if kind == "sync":
+        path = os.path.join(fixtures, "transfer_bad_sync.py")
+        say(f"transfer selfcheck: {path} ...")
+        findings, _ = run_transfer(
+            [path], baseline=load_transfer_baseline(), backend=False
+        )
+        return [f for f in findings if f.rule == "GL301"]
+    if kind == "donate":
+        from .alias import run_alias
+
+        path = os.path.join(fixtures, "transfer_bad_donate.py")
+        say(f"transfer selfcheck: {path} ...")
+        return [
+            f for f in run_alias([path]) if f.rule == "GL302"
+        ]
+    raise ValueError(f"unknown transfer selfcheck {kind!r}")

@@ -74,16 +74,32 @@ class SegmentWindow:
         segments i−K+2 … i+1 are already enqueued overlaps the wait
         with their execution). Returns the batch's running verdict as
         of the oldest resolved segment."""
+        from ..engine.core import host_fetch
+
         while self.running and len(self._flags) >= self.depth:
-            self.running = bool(self._flags.popleft())
+            self.running = bool(
+                host_fetch(
+                    self._flags.popleft(),
+                    tier="window",
+                    reason="window liveness fetch",
+                )
+            )
         return self.running
 
     def drain(self) -> bool:
         """Resolve every in-flight flag (a durability boundary or the
         end of the sweep): afterwards the caller's newest state is
         determinate. Returns the final running verdict."""
+        from ..engine.core import host_fetch
+
         while self.running and self._flags:
-            self.running = bool(self._flags.popleft())
+            self.running = bool(
+                host_fetch(
+                    self._flags.popleft(),
+                    tier="window",
+                    reason="window liveness fetch",
+                )
+            )
         self._flags.clear()
         return self.running
 
@@ -93,14 +109,14 @@ class CheckpointBuffer:
     device→host fetch (and the npz write) with the next in-flight
     window instead of serializing with it.
 
-    The serial save path drains the window, blocks on
-    ``jax.device_get`` of the full batched state (~100 MB per 512
-    lanes — minutes over the tunnel, docs/PERF.md), writes the npz,
-    and only then dispatches the next segment: the device sits idle
-    for the whole fetch+write. Here the boundary instead *begins* a
-    save — ``copy_to_host_async`` starts the D2H transfer on every
-    leaf and the (still-device) boundary state is parked — and the
-    blocking ``device_get`` + artifact write happen on the next
+    The serial save path drains the window, blocks on a ``host_fetch``
+    of the full batched state (~100 MB per 512 lanes — minutes over
+    the tunnel, docs/PERF.md), writes the npz, and only then
+    dispatches the next segment: the device sits idle for the whole
+    fetch+write. Here the boundary instead *begins* a save —
+    ``copy_to_host_async`` starts the D2H transfer on every leaf and
+    the (still-device) boundary state is parked — and the blocking
+    ``host_fetch`` + artifact write happen on the next
     :meth:`flush`, which ``run_sweep`` calls right after the next
     segment's dispatch: the transfer and the file write then overlap
     device execution of the new window.
@@ -153,9 +169,16 @@ class CheckpointBuffer:
         when nothing is pending; returns whether a save was written."""
         if self._state is None:
             return False
-        import jax
+        from ..engine.core import host_fetch
 
         state, until = self._state, self._until
         self._state = None
-        save(jax.device_get(state), until)
+        save(
+            host_fetch(
+                state,
+                tier="checkpoint",
+                reason="deferred checkpoint drain",
+            ),
+            until,
+        )
         return True

@@ -47,6 +47,7 @@ from ..engine.core import (
     cast_state_planes,
     donation_safe,
     finish_segmented,
+    host_fetch,
     init_lane_state,
     key_table_fn,
     keygen_ctx_fields,
@@ -715,9 +716,11 @@ def _run_sweep(
     # donation is engaged the runner consumes its input state on
     # dispatch, so ONLY the freshly returned binding is live — the one
     # consumer of a boundary state, the checkpoint save, takes an
-    # explicit undonated host copy (device_get) at a drained boundary
-    # before the next segment is dispatched, which keeps the loop
-    # correct under either donation setting.
+    # explicit undonated host copy (host_fetch, the GL301-audited
+    # choke point) at a drained boundary before the next segment is
+    # dispatched, which keeps the loop correct under either donation
+    # setting. GL302 (lint/alias.py) statically refuses any other
+    # read of a donated binding.
     t_run = _t.perf_counter()
     until = resume_until
     segs_done = 0
@@ -783,7 +786,14 @@ def _run_sweep(
                     if not window.drain():
                         continue  # batch just finished: nothing to save
                     if stop is not None or not overlap:
-                        save_boundary(jax.device_get(state), until)
+                        save_boundary(
+                            host_fetch(
+                                state,
+                                tier="checkpoint",
+                                reason="checkpoint drain",
+                            ),
+                            until,
+                        )
                         if stop is not None:
                             raise SweepInterrupted(ck.path, until, stop)
                     else:
@@ -851,12 +861,15 @@ def _run_sweep(
         fetch["viol"] = state["viol"]
         fetch["viol_step"] = state["viol_step"]
         fetch["cov"] = state["cov"]
-    final = finish_segmented(jax.device_get(fetch), max_steps)
+    final = finish_segmented(
+        host_fetch(fetch, tier="sweep", reason="final results fetch"),
+        max_steps,
+    )
     # undo the storage narrowing on whatever narrowed planes the fetch
     # carries: results are ALWAYS the wide i32 arrays the collectors
     # and the byte-identity contracts predate narrowing with
     final = cast_state_planes(final, nspec, store=False)
-    mark("device_get")
+    mark("host_fetch")
     # the tail-padding seam: duplicate lanes were computed, but exactly
     # the caller's specs come back — never a padded twin's results
     out = collect_results(protocol, dims, final, padded)[: len(specs)]

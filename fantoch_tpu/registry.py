@@ -19,6 +19,51 @@ DEV_PROTOCOLS = ("basic", "fpaxos", "tempo", "atlas", "epaxos", "caesar")
 # the partial-replication twins (engine.protocols.partial_dev_protocol)
 PARTIAL_DEV_PROTOCOLS = ("tempo", "atlas")
 
+# ----------------------------------------------------------------------
+# AST-lint scan sets (lint/rules.py GL101-GL104, lint/transfer.py
+# GL301, lint/alias.py GL302). Canonical here — jax-free, next to the
+# protocol grids — so a new subsystem is one visible edit away from
+# every analyzer instead of a silent drop from coverage; lint/rules.py
+# carries a self-test (``uncovered_traced_modules``) that fails when a
+# module importing jax grows traced-looking functions outside
+# TRACED_SCAN_PATHS.
+# ----------------------------------------------------------------------
+
+# everything that traces into the engine step, plus the checkpoint /
+# campaign / fleet entry points (host-side by design — the scan proves
+# they stay that way: no raw emission, no tracer branching, no
+# host-sync ops sneaking into anything that becomes traced). The
+# parallel package covers the sweep driver, its pipelined segment
+# window, the shard_map partition layer and the AOT serialization
+# layer; mc/coverage.py covers the fuzzing feedback loop.
+TRACED_SCAN_PATHS = (
+    "fantoch_tpu/engine/core.py",
+    "fantoch_tpu/engine/monitor.py",
+    "fantoch_tpu/engine/iset.py",
+    "fantoch_tpu/engine/checkpoint.py",
+    "fantoch_tpu/engine/protocols",
+    "fantoch_tpu/campaign",
+    "fantoch_tpu/traffic",
+    "fantoch_tpu/bote/validate.py",
+    "fantoch_tpu/parallel",
+    "fantoch_tpu/fleet",
+    "fantoch_tpu/mc/coverage.py",
+)
+
+# the host orchestration layers whose device<->host traffic the GL301
+# sync ledger and the GL302 donation-lifetime prover audit: every
+# module that holds device array futures between dispatches. engine/
+# results.py is here (not in TRACED_SCAN_PATHS) because it only
+# *fetches* — it never traces.
+TRANSFER_SCAN_PATHS = (
+    "fantoch_tpu/engine/core.py",
+    "fantoch_tpu/engine/checkpoint.py",
+    "fantoch_tpu/engine/results.py",
+    "fantoch_tpu/parallel",
+    "fantoch_tpu/campaign",
+    "fantoch_tpu/fleet",
+)
+
 # fleet worker ids (fantoch_tpu/fleet, docs/FLEET.md) become lease and
 # journal file names: `leases/<unit>.<worker>` and
 # `journals/<worker>.jsonl`. The rules keep the filenames parseable and

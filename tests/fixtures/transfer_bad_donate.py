@@ -1,0 +1,41 @@
+"""Deliberately broken donation lifetimes for the GL302 fixture.
+
+Never imported by the package — ``cli.py lint --transfer-selfcheck
+donate`` points the donation-lifetime prover (lint/alias.py) here to
+prove the CI entrypoint exits non-zero and names GL302 on the seeded
+defects: a read of a buffer after it was passed at a donated argnum
+(use-after-donate — garbage bytes on a real device), a checkpoint
+save handed device-fresh state, and an AOT-deserialized executable
+invoked with donation without consulting ``aot_donation_safe``."""
+
+from fantoch_tpu.engine.checkpoint import save_boundary
+from fantoch_tpu.engine.core import build_segment_runner
+from fantoch_tpu.parallel import aot as aot_mod
+
+
+def use_after_donate(state, ctx, until, max_steps):
+    runner, _ = build_segment_runner(state, ctx, max_steps)
+    out, alive = runner(state, ctx, until)
+    # GL302 seeded defect: `state` was donated to the runner call
+    # above — its buffer is dead, this read is use-after-donate
+    return out, state["clock"]
+
+
+def save_device_state(state, ctx, until, max_steps):
+    runner, _ = build_segment_runner(state, ctx, max_steps)
+    state, alive = runner(state, ctx, until)
+    # GL302 seeded defect: checkpoint save of device-fresh state —
+    # under donation the npz would capture consumed buffers; the
+    # state must round through host_fetch first
+    save_boundary(state, until)
+    return state
+
+
+def aot_donate(spec, sig, state, ctx, untils, win, nspec):
+    # GL302 seeded defect: donation enabled on a (possibly
+    # deserialized) AOT executable without aot_donation_safe()
+    runner = aot_mod.get_runner(
+        spec, sig, state=state, ctx=ctx, untils=untils,
+        window=win, donate=True, narrow=nspec,
+    )
+    return runner

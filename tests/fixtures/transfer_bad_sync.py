@@ -1,0 +1,24 @@
+"""Deliberately broken host-orchestration code for the GL301 fixture.
+
+Never imported by the package — ``cli.py lint --transfer-selfcheck
+sync`` points the transfer ledger here to prove the CI entrypoint
+exits non-zero and names GL301 on the seeded defect: a per-**segment**
+``.item()`` poll inside the innermost dispatch loop, the exact
+serialize-dispatch-with-execution regression the ledger exists to
+refuse (docs/PERF.md: each sync costs ~1 s over the tunneled
+runtime)."""
+
+from fantoch_tpu.engine.core import build_segment_runner
+
+
+def drive(state, ctx, untils, max_steps):
+    runner, _ = build_segment_runner(state, ctx, max_steps)
+    for until in untils:                # sweep -> window tier
+        for _ in range(8):              # window -> segment tier
+            state, alive = runner(state, ctx, until)
+            # GL301 seeded defect: device scalar resolved per segment
+            # (tier "segment" — hotter than anything the baseline
+            # allows, so this is a new-id regression by name)
+            if state["err"].item():
+                break
+    return state

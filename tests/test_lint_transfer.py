@@ -1,0 +1,469 @@
+"""Transfer-family tests (fantoch_tpu/lint/transfer.py + alias.py):
+GL301 sync-taxonomy and loop-tier classification units on synthetic
+sources, the ledger regression gate, GL302 donation-lifetime prover
+units (use-after-donate, rebind idiom, device-state saves, AOT gate),
+GL303 backend-width audit, clean-at-HEAD pins, the seeded CI
+self-checks, and the GL1xx scan-set coverage self-test — all pure
+AST/arithmetic, no device and no tracing."""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from fantoch_tpu.lint.alias import run_alias
+from fantoch_tpu.lint.transfer import (
+    DEFAULT_TRANSFER_BASELINE,
+    backend_audit,
+    gate_backend,
+    gate_ledger,
+    ledger_summary,
+    load_transfer_baseline,
+    run_transfer,
+    run_transfer_selfcheck,
+    scan_transfer,
+    write_transfer_baseline,
+)
+
+
+def _scan(tmp_path, src, name="synth.py"):
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(src))
+    return scan_transfer([str(path)])
+
+
+def _sites(tmp_path, src):
+    sites, findings = _scan(tmp_path, src)
+    assert findings == [], [f.render() for f in findings]
+    return sites
+
+
+def _alias(tmp_path, src, name="synth.py"):
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(src))
+    return run_alias([str(path)])
+
+
+# ----------------------------------------------------------------------
+# GL301: sync taxonomy
+# ----------------------------------------------------------------------
+
+
+def test_explicit_syncs_registered(tmp_path):
+    sites = _sites(tmp_path, """
+        import jax
+
+        def drive(state):
+            jax.block_until_ready(state)
+            host = jax.device_get(state)
+            return host
+    """)
+    kinds = sorted(s.kind for s in sites)
+    assert kinds == ["block_until_ready", "device_get"]
+    assert all(s.tier == "sweep" for s in sites)
+
+
+def test_implicit_bool_coercion_of_device_value(tmp_path):
+    sites = _sites(tmp_path, """
+        from fantoch_tpu.engine.core import build_segment_runner
+
+        def drive(state, ctx, until):
+            runner, _ = build_segment_runner(state, ctx, 8)
+            state, alive = runner(state, ctx, until)
+            if bool(alive):
+                return state
+            return state
+    """)
+    assert [s.kind for s in sites] == ["bool"]
+
+
+def test_item_only_flags_device_tracked_operands(tmp_path):
+    # numpy shares .item()/.tolist() with device arrays: a host-side
+    # serialization helper must NOT register (the results.py to_json
+    # false-positive class), a runner output must
+    sites = _sites(tmp_path, """
+        from fantoch_tpu.engine.core import get_runner
+
+        def to_json(host_arr):
+            return host_arr.tolist()
+
+        def drive(state, ctx, until):
+            runner = get_runner(state)
+            out = runner(state, ctx, until)
+            return out["err"].item()
+    """)
+    assert [(s.fn, s.kind) for s in sites] == [("drive", "item")]
+
+
+def test_host_fetch_launders_device_to_host(tmp_path):
+    # after host_fetch the binding is host-side: .item() on it is free
+    sites = _sites(tmp_path, """
+        from fantoch_tpu.engine.core import get_runner, host_fetch
+
+        def drive(state, ctx, until):
+            runner = get_runner(state)
+            out = runner(state, ctx, until)
+            host = host_fetch(out, tier="sweep", reason="final fetch")
+            return host["err"].item()
+    """)
+    assert [s.kind for s in sites] == ["host_fetch@sweep"]
+
+
+# ----------------------------------------------------------------------
+# GL301: loop-tier classification
+# ----------------------------------------------------------------------
+
+_TIER_SRC = """
+    import jax
+
+    def drive(state, untils):
+        jax.block_until_ready(state)               # depth 0: sweep
+        for until in untils:                       # depth 1: window
+            jax.block_until_ready(state)
+            if until > 0:                          # guarded: checkpoint
+                jax.block_until_ready(state)
+            for _ in range(8):                     # depth 2: segment
+                jax.block_until_ready(state)
+        return state
+"""
+
+
+def test_loop_depth_tier_classification(tmp_path):
+    tiers = [s.tier for s in _sites(tmp_path, _TIER_SRC)]
+    assert tiers == ["sweep", "window", "checkpoint", "segment"]
+
+
+def test_tier_migration_regresses_against_baseline(tmp_path):
+    # the four same-kind sites group into ONE ledger id whose tier is
+    # the hottest observed ("segment")
+    sites = _sites(tmp_path, _TIER_SRC)
+    path = tmp_path / "base.json"
+    write_transfer_baseline(str(path), sites)
+    base = load_transfer_baseline(str(path))
+    assert len(base) == 1 and next(iter(base.values()))["tier"] == "segment"
+    ok, stale = gate_ledger(sites, base)
+    assert ok == [] and stale == []
+    # the same entry baselined colder: the hotter observed tier is a
+    # migration regression even though the count is unchanged
+    colder = {sid: dict(e, tier="window") for sid, e in base.items()}
+    viol, _ = gate_ledger(sites, colder)
+    assert len(viol) == 1 and "HOTTER" in viol[0].message
+
+
+def test_new_sync_and_count_growth_regress(tmp_path):
+    sites = _sites(tmp_path, """
+        import jax
+
+        def drive(state):
+            jax.block_until_ready(state)
+            jax.device_get(state)
+    """)
+    by_kind = {s.kind: s for s in sites}
+    only_block = {
+        by_kind["block_until_ready"].id: {
+            "count": 1, "tier": "sweep", "reason": "pinned",
+        }
+    }
+    viol, _ = gate_ledger(sites, only_block)
+    assert [f.id for f in viol] == [by_kind["device_get"].id]
+    grown = dict(only_block)
+    grown[by_kind["device_get"].id] = {
+        "count": 1, "tier": "sweep", "reason": "pinned",
+    }
+    ok, _ = gate_ledger(sites, grown)
+    assert ok == []
+
+
+def test_choke_call_requires_literal_metadata(tmp_path):
+    _, findings = _scan(tmp_path, """
+        from fantoch_tpu.engine.core import host_fetch
+
+        def drive(state, tier):
+            return host_fetch(state, tier=tier, reason="dynamic")
+    """)
+    assert len(findings) == 1
+    assert "literal" in findings[0].message
+
+
+def test_choke_tier_underclaim_refused(tmp_path):
+    # declared "sweep" inside a depth-2 loop: the declaration
+    # under-claims hotness, which would let a hot sync hide behind a
+    # cold baseline entry
+    _, findings = _scan(tmp_path, """
+        from fantoch_tpu.engine.core import host_fetch
+
+        def drive(state, untils):
+            for until in untils:
+                for _ in range(8):
+                    state = host_fetch(state, tier="sweep", reason="x")
+            return state
+    """)
+    assert len(findings) == 1
+    assert "never hide" in findings[0].message
+
+
+# ----------------------------------------------------------------------
+# GL302: donation-lifetime prover
+# ----------------------------------------------------------------------
+
+
+def test_use_after_donate_flagged(tmp_path):
+    fs = _alias(tmp_path, """
+        from fantoch_tpu.engine.core import build_segment_runner
+
+        def drive(state, ctx, until):
+            runner, _ = build_segment_runner(state, ctx, 8)
+            out, alive = runner(state, ctx, until)
+            return out, state["clock"]
+    """)
+    assert len(fs) == 1 and fs[0].rule == "GL302"
+    assert "use-after-donate" in fs[0].anchor
+
+
+def test_donate_then_rebind_is_clean(tmp_path):
+    # the engine's standard idiom: the donated binding is resurrected
+    # by the very call that consumed it
+    fs = _alias(tmp_path, """
+        from fantoch_tpu.engine.core import build_segment_runner
+
+        def drive(state, ctx, untils):
+            runner, _ = build_segment_runner(state, ctx, 8)
+            for until in untils:
+                state, alive = runner(state, ctx, until)
+            return state
+    """)
+    assert fs == [], [f.render() for f in fs]
+
+
+def test_save_of_device_fresh_state_flagged(tmp_path):
+    fs = _alias(tmp_path, """
+        from fantoch_tpu.engine.checkpoint import save_boundary
+        from fantoch_tpu.engine.core import build_segment_runner
+
+        def drive(state, ctx, until):
+            runner, _ = build_segment_runner(state, ctx, 8)
+            state, alive = runner(state, ctx, until)
+            save_boundary(state, until)
+    """)
+    assert [f.rule for f in fs] == ["GL302"]
+    assert "save-device-state" in fs[0].anchor
+
+
+def test_save_of_host_fetched_state_clean(tmp_path):
+    fs = _alias(tmp_path, """
+        from fantoch_tpu.engine.checkpoint import save_boundary
+        from fantoch_tpu.engine.core import build_segment_runner, host_fetch
+
+        def drive(state, ctx, until):
+            runner, _ = build_segment_runner(state, ctx, 8)
+            state, alive = runner(state, ctx, until)
+            save_boundary(
+                host_fetch(state, tier="checkpoint", reason="drain"),
+                until,
+            )
+    """)
+    assert fs == [], [f.render() for f in fs]
+
+
+def test_aot_donate_without_gate_flagged(tmp_path):
+    fs = _alias(tmp_path, """
+        from fantoch_tpu.parallel import aot as aot_mod
+
+        def drive(spec, sig, state):
+            return aot_mod.get_runner(spec, sig, state=state, donate=True)
+    """)
+    assert [f.rule for f in fs] == ["GL302"]
+    assert "aot-donate" in fs[0].anchor
+
+
+def test_aot_donate_with_gate_clean(tmp_path):
+    fs = _alias(tmp_path, """
+        from fantoch_tpu.engine.core import aot_donation_safe
+        from fantoch_tpu.parallel import aot as aot_mod
+
+        def drive(spec, sig, state, donate):
+            if not aot_donation_safe():
+                donate = False
+            return aot_mod.get_runner(spec, sig, state=state, donate=donate)
+    """)
+    assert fs == [], [f.render() for f in fs]
+
+
+# ----------------------------------------------------------------------
+# GL303: backend-width audit
+# ----------------------------------------------------------------------
+
+
+def test_backend_audit_names_known_gaps():
+    ids = sorted(f.id for f in backend_audit())
+    assert ids == [
+        "GL303:backend:fantoch_tpu/engine/dims.py:cpu:kernel-ms-unmeasured",
+        "GL303:backend:fantoch_tpu/engine/dims.py:gpu:kernel-ms-unmeasured",
+        "GL303:backend:fantoch_tpu/engine/dims.py:gpu:matmul-exactness",
+    ]
+
+
+def test_backend_gate_clean_against_checked_in_baseline():
+    viol, stale = gate_backend(load_transfer_baseline())
+    assert viol == [] and stale == []
+
+
+def test_backend_gate_flags_unbaselined_gap():
+    base = {
+        k: v
+        for k, v in load_transfer_baseline().items()
+        if "matmul-exactness" not in k
+    }
+    viol, _ = gate_backend(base)
+    assert [f.id for f in viol] == [
+        "GL303:backend:fantoch_tpu/engine/dims.py:gpu:matmul-exactness"
+    ]
+
+
+# ----------------------------------------------------------------------
+# clean at HEAD: the ledger, the prover, the gate
+# ----------------------------------------------------------------------
+
+
+def test_transfer_clean_at_head():
+    findings, summary = run_transfer()
+    assert findings == [], [f.render() for f in findings]
+    assert summary["stale_baseline"] == []
+    assert summary["tiers"]["segment"] == 0, (
+        "a per-segment sync crept into the host layers — docs/PERF.md"
+    )
+
+
+def test_alias_clean_at_head():
+    fs = run_alias()
+    assert fs == [], [f.render() for f in fs]
+
+
+def test_head_ledger_matches_checked_in_baseline():
+    """Every intentional sync at HEAD is named in the baseline with a
+    justification, and the baseline carries no dead entries
+    (regenerate with `lint --write-transfer-baseline` and review)."""
+    sites, findings = scan_transfer()
+    assert findings == []
+    base = load_transfer_baseline()
+    ids = {s.id for s in sites}
+    baselined_301 = {k for k in base if k.startswith("GL301:")}
+    assert ids == baselined_301
+    assert all(base[k].get("reason") for k in base)
+
+
+def test_write_transfer_baseline_roundtrip(tmp_path):
+    sites, _ = scan_transfer()
+    path = tmp_path / "transfer_baseline.json"
+    write_transfer_baseline(str(path), sites)
+    viol, stale = gate_ledger(sites, load_transfer_baseline(str(path)))
+    assert viol == [] and stale == []
+
+
+def test_ledger_summary_is_device_free():
+    summary = ledger_summary()
+    assert summary["sites"] == sum(summary["tiers"].values())
+    assert summary["tiers"]["segment"] == 0
+
+
+# ----------------------------------------------------------------------
+# seeded CI self-checks + CLI plumbing
+# ----------------------------------------------------------------------
+
+
+def test_selfcheck_sync_regresses_gl301():
+    fs = run_transfer_selfcheck("sync")
+    assert fs and all(f.rule == "GL301" for f in fs), fs
+
+
+def test_selfcheck_donate_regresses_gl302():
+    fs = run_transfer_selfcheck("donate")
+    assert fs and all(f.rule == "GL302" for f in fs), fs
+
+
+def test_cli_selfchecks_exit_nonzero_and_name_rule(capsys):
+    from fantoch_tpu import cli
+
+    for kind, rule in (("sync", "GL301"), ("donate", "GL302")):
+        with pytest.raises(SystemExit) as e:
+            cli.main(["lint", "--transfer-selfcheck", kind])
+        assert e.value.code == 1
+        captured = capsys.readouterr()
+        assert rule in captured.err
+        out = json.loads(captured.out.strip().splitlines()[-1])
+        assert out["regressions"] > 0
+
+
+def test_cli_transfer_only_clean_at_head(capsys):
+    from fantoch_tpu import cli
+
+    cli.main(["lint", "--transfer-only", "--baseline"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["regressions"] == 0
+    assert out["transfer"]["ids"] == out["transfer"]["sites"]
+
+
+def test_cli_write_transfer_baseline_refuses_narrowed_run(tmp_path):
+    from fantoch_tpu import cli
+
+    fixture = os.path.join("tests", "fixtures", "transfer_bad_sync.py")
+    with pytest.raises(SystemExit) as e:
+        cli.main(
+            [
+                "lint",
+                "--write-transfer-baseline",
+                "--paths",
+                fixture,
+            ]
+        )
+    assert "narrowed" in str(e.value.code)
+
+
+def test_write_baseline_never_swallows_transfer_findings(tmp_path):
+    """GL3xx findings gate against transfer_baseline.json only — the
+    main suppression baseline must never absorb them (report.py)."""
+    from fantoch_tpu.lint.report import Finding, LintReport, write_baseline
+
+    rep = LintReport()
+    rep.extend(
+        [
+            Finding("GL301", "transfer", "a.py:f:item", "seeded"),
+            Finding("GL101", "ast", "a.py:f:outbox", "kept"),
+        ]
+    )
+    path = tmp_path / "baseline.json"
+    write_baseline(str(path), rep)
+    data = json.loads(path.read_text())["findings"]
+    assert "GL101:ast:a.py:f:outbox" in data
+    assert not any(k.startswith("GL3") for k in data)
+
+
+# ----------------------------------------------------------------------
+# scan-set coverage self-tests (satellite: registry-derived rule sets)
+# ----------------------------------------------------------------------
+
+
+def test_traced_scan_set_covers_every_jax_module():
+    from fantoch_tpu.lint.rules import uncovered_traced_modules
+
+    assert uncovered_traced_modules() == []
+
+
+def test_traced_scan_set_detects_a_dropped_path():
+    from fantoch_tpu.lint.rules import uncovered_traced_modules
+
+    missing = uncovered_traced_modules(paths=("fantoch_tpu/engine/iset.py",))
+    assert any("engine/core.py" in m for m in missing)
+
+
+def test_transfer_scan_paths_exist():
+    from fantoch_tpu.lint.rules import REPO_ROOT
+    from fantoch_tpu.registry import TRANSFER_SCAN_PATHS
+
+    for rel in TRANSFER_SCAN_PATHS:
+        assert os.path.exists(os.path.join(REPO_ROOT, rel)), rel
+
+
+def test_default_transfer_baseline_is_checked_in():
+    assert os.path.exists(DEFAULT_TRANSFER_BASELINE)
