@@ -823,6 +823,25 @@ def _host_sync_ledger() -> "dict | None":
         return {"error": repr(e)}
 
 
+def _determinism_ledger() -> "dict | None":
+    """Determinism-hazard ledger of the artifact-writer layers (the
+    GL401-GL404 scan, fantoch_tpu/lint/determinism.py) — per-rule
+    counts of every baselined ordering/PRNG/serialization/atomicity
+    exception behind the byte-identity pins. Pure AST in-process
+    (imports no jax), so it is honest even when the device backend is
+    unreachable; degrades to an error record, never an exception."""
+    try:
+        from fantoch_tpu.lint.determinism import ledger_summary
+
+        return ledger_summary()
+    except Exception as e:  # noqa: BLE001
+        import sys as _sys
+
+        print(f"bench: determinism ledger unavailable: {e!r}",
+              file=_sys.stderr)
+        return {"error": repr(e)}
+
+
 def _fuzz_selfcheck() -> float:
     from fantoch_tpu.mc.fuzz import FuzzSpec, run_fuzz_point
 
@@ -1337,6 +1356,10 @@ def main() -> None:
                 # drivers (GL301 ledger) — static twin of the measured
                 # dispatch_overhead_s above
                 "host_sync_ledger": _host_sync_ledger(),
+                # per-rule determinism-exception counts of the artifact
+                # writers (GL401-GL404 ledger) — the static surface
+                # behind every byte-identity cmp in this report
+                "determinism_ledger": _determinism_ledger(),
             }
         )
     )
@@ -1520,9 +1543,11 @@ def _emit_unreachable(reason: str = "unreachable at startup") -> None:
                     if static_cost
                     else {}
                 ),
-                # the sync ledger is pure AST — a real number even in
-                # this dead-backend artifact, not a placeholder zero
+                # the sync + determinism ledgers are pure AST — real
+                # numbers even in this dead-backend artifact, not
+                # placeholder zeros
                 "host_sync_ledger": _host_sync_ledger(),
+                "determinism_ledger": _determinism_ledger(),
             }
         )
     )

@@ -190,12 +190,11 @@ class Search:
         import os
         import pickle
 
+        from ..engine.checkpoint import atomic_write
+
         os.makedirs(path, exist_ok=True)
         f = os.path.join(path, f"search_{self._cache_key(params)}.pkl")
-        tmp = f + f".tmp{os.getpid()}"
-        with open(tmp, "wb") as fh:
-            pickle.dump(out, fh)
-        os.replace(tmp, f)
+        atomic_write(f, pickle.dumps(out))
 
     def _rank_n(self, n, subsets, params: RankingParams, xp):
         client_idx = np.asarray(

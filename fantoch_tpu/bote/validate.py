@@ -392,7 +392,7 @@ def validate_frontier(
 
 
 def _write_artifact(path: str, artifact: dict) -> None:
-    from ..engine.checkpoint import atomic_write
+    from ..engine.checkpoint import atomic_write, canonical_json
 
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    atomic_write(path, json.dumps(artifact, indent=2, sort_keys=True))
+    atomic_write(path, canonical_json(artifact, indent=2))

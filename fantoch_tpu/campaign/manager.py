@@ -263,7 +263,7 @@ def campaign_from_json(obj: dict):
 
 def _append_journal(path: str, entry: dict) -> None:
     with open(os.path.join(path, _JOURNAL), "a") as fh:
-        fh.write(json.dumps(entry, sort_keys=True) + "\n")
+        fh.write(_canonical_json(entry) + "\n")
         fh.flush()
         os.fsync(fh.fileno())
 
@@ -305,6 +305,14 @@ def _atomic_write(path: str, text: str) -> None:
     atomic_write(path, text)
 
 
+def _canonical_json(obj, *, indent=None) -> str:
+    # lazy for the same reason as _atomic_write: campaign spec/journal
+    # plumbing must import jax-free
+    from ..engine.checkpoint import canonical_json
+
+    return canonical_json(obj, indent=indent)
+
+
 def _load_or_init_spec(path: str, spec, resume: bool):
     cpath = os.path.join(path, _CAMPAIGN)
     if resume:
@@ -330,9 +338,7 @@ def _load_or_init_spec(path: str, spec, resume: bool):
             )
         return stored  # identical spec: behave like resume
     os.makedirs(path, exist_ok=True)
-    _atomic_write(
-        cpath, json.dumps(spec.to_json(), indent=2, sort_keys=True)
-    )
+    _atomic_write(cpath, _canonical_json(spec.to_json(), indent=2))
     return spec
 
 
@@ -558,9 +564,8 @@ def _run_sweep_campaign(path: str, spec: SweepCampaign, deadline,
         for key, *_ in batches:
             for lane, res in enumerate(done[key]):
                 lines.append(
-                    json.dumps(
-                        {"batch": key, "lane": lane, "result": res},
-                        sort_keys=True,
+                    _canonical_json(
+                        {"batch": key, "lane": lane, "result": res}
                     )
                 )
         _atomic_write(
@@ -760,10 +765,9 @@ def _fuzz_summary(path: str, spec: FuzzCampaign, points, progress,
         # determinism contract tests/CI cmp against
         _atomic_write(
             os.path.join(path, _SUMMARY),
-            json.dumps(
+            _canonical_json(
                 {k: v for k, v in summary.items() if k != "dir"},
                 indent=2,
-                sort_keys=True,
             ),
         )
         summary["summary"] = os.path.join(path, _SUMMARY)

@@ -527,6 +527,10 @@ def cmd_mc(args) -> None:
             point["new_buckets"] = len(fresh)
             point["tried_total"] = tried_total
         if args.out:
+            # same canonical bytes as mc/fuzz.py _persist_artifact:
+            # repro artifacts are diffed/deduped across runs
+            from .engine.checkpoint import atomic_write, canonical_json
+
             os.makedirs(args.out, exist_ok=True)
             for finding in res.findings:
                 if finding.artifact is None:
@@ -535,8 +539,8 @@ def cmd_mc(args) -> None:
                     args.out,
                     f"repro_{proto}_n{n}_lane{finding.lane}.json",
                 )
-                with open(path, "w") as fh:
-                    json.dump(finding.artifact, fh, indent=2)
+                atomic_write(path, canonical_json(finding.artifact,
+                                                  indent=2))
                 artifacts.append(path)
         points.append(point)
         print(json.dumps(point), file=sys.stderr, flush=True)
@@ -758,9 +762,10 @@ def cmd_lint(args) -> None:
     """graft-lint (fantoch_tpu/lint): jaxpr interval audits over every
     device protocol's step, the structural gating differ, AST /
     hook-registry rules, (``--cost``) the kernel/VMEM/lane cost
-    family, and (``--transfer``) the sync-ledger/donation/backend
-    transfer family. Exits non-zero on any finding not covered by the
-    baseline (docs/LINT.md)."""
+    family, (``--transfer``) the sync-ledger/donation/backend
+    transfer family, and (``--determinism``) the GL401-GL404
+    byte-identity prover. Exits non-zero on any finding not covered
+    by the baseline (docs/LINT.md)."""
     from .lint import (
         DEFAULT_BASELINE,
         load_baseline,
@@ -803,6 +808,28 @@ def cmd_lint(args) -> None:
             json.dumps(
                 {
                     "selfcheck": args.transfer_selfcheck,
+                    "regressions": len(findings),
+                }
+            )
+        )
+        raise SystemExit(1 if findings else 0)
+
+    if args.determinism_selfcheck:
+        # same contract for the determinism gate: the seeded fixture
+        # (unordered listdir / unjournaled rng / unsorted dumps / raw
+        # open-w) must produce findings NAMING its rule, or the
+        # byte-identity prover is vacuously green
+        from .lint.determinism import run_determinism_selfcheck
+
+        findings, _ = run_determinism_selfcheck(
+            args.determinism_selfcheck
+        )
+        for f in findings:
+            print(f.render(), file=sys.stderr)
+        print(
+            json.dumps(
+                {
+                    "selfcheck": args.determinism_selfcheck,
                     "regressions": len(findings),
                 }
             )
@@ -874,14 +901,50 @@ def cmd_lint(args) -> None:
         )
         return
 
+    if args.write_determinism_baseline:
+        from .lint.determinism import (
+            DEFAULT_DETERMINISM_BASELINE,
+            scan_determinism,
+            write_determinism_baseline,
+        )
+
+        if args.paths:
+            raise SystemExit(
+                "refusing to write the determinism baseline from a "
+                "run narrowed by --paths (dropped files would turn "
+                "their ledger entries into CI regressions); run "
+                "without it"
+            )
+        sites, findings = scan_determinism()
+        if findings:
+            for f in findings:
+                print(f.render(), file=sys.stderr)
+            raise SystemExit(
+                "refusing to write the determinism baseline while "
+                "the scan itself reports structural findings "
+                "(non-literal sort_keys=); fix those first"
+            )
+        write_determinism_baseline(DEFAULT_DETERMINISM_BASELINE, sites)
+        print(
+            json.dumps(
+                {
+                    "determinism_baseline": DEFAULT_DETERMINISM_BASELINE,
+                    "sites": len(sites),
+                }
+            )
+        )
+        return
+
     report = run_lint(
         protocols,
         ast_paths=args.paths or None,
         jaxpr_audits=not args.no_jaxpr
         and not args.cost_only
-        and not args.transfer_only,
+        and not args.transfer_only
+        and not args.determinism_only,
         cost=args.cost or args.cost_only,
         transfer=args.transfer or args.transfer_only,
+        determinism=args.determinism or args.determinism_only,
         progress=say,
     )
 
@@ -931,6 +994,8 @@ def cmd_lint(args) -> None:
         out["cost"] = report.cost
     if report.transfer:
         out["transfer"] = report.transfer
+    if report.determinism:
+        out["determinism"] = report.determinism
     if args.json:
         out["detail"] = report.to_json(baseline)
     for f in regressions:
@@ -1262,8 +1327,9 @@ def cmd_client(args) -> None:
         for cid, data in handle.data.items()
     }
     if args.output:
-        with open(args.output, "w") as fh:
-            json.dump(out, fh)
+        from .engine.checkpoint import atomic_write, canonical_json
+
+        atomic_write(args.output, canonical_json(out))
     lats = handle.latencies_us()
     lats.sort()
     print(
@@ -1562,6 +1628,25 @@ def main(argv=None) -> None:
                     help="regenerate lint/transfer_baseline.json from "
                     "this run (justification reasons are taken from "
                     "the choke-point call sites)")
+    ln.add_argument("--determinism", action="store_true",
+                    help="add the determinism family: GL401 ordered-"
+                    "output prover + GL402 PRNG discipline + GL403 "
+                    "canonical serialization + GL404 atomic writes "
+                    "(vs lint/determinism_baseline.json)")
+    ln.add_argument("--determinism-only", action="store_true",
+                    help="determinism family without the interval/"
+                    "gating audits (the CI determinism-gate job; "
+                    "device-free)")
+    ln.add_argument("--determinism-selfcheck", default=None,
+                    choices=["order", "rng", "json", "write"],
+                    help="CI broken-fixture check: scan the named "
+                    "seeded-defect fixture; must exit non-zero naming "
+                    "the rule")
+    ln.add_argument("--write-determinism-baseline", action="store_true",
+                    help="regenerate lint/determinism_baseline.json "
+                    "from this run (existing justification reasons "
+                    "are preserved; new entries get an UNREVIEWED "
+                    "placeholder the gate rejects)")
     ln.add_argument("--json", action="store_true",
                     help="include full finding detail in the output")
     ln.set_defaults(fn=cmd_lint)

@@ -266,6 +266,19 @@ def atomic_write(path: str, data: "bytes | str") -> None:
     os.replace(tmp, path)
 
 
+def canonical_json(obj, *, indent=None) -> str:
+    """The one JSON serialization every durable artifact shares:
+    ``sort_keys=True`` always, float formatting through the stdlib's
+    single ``repr`` path, no localized separators. Byte-identity pins
+    (fleet ``--merge`` ≡ 1-worker control, campaign resume ≡ control,
+    AOT manifest drift refusal) compare these bytes across machines,
+    so key order must never depend on dict insertion history — the
+    GL403 lint audit statically requires every artifact writer to come
+    through here (or spell ``sort_keys=True`` literally at the call
+    site)."""
+    return json.dumps(obj, indent=indent, sort_keys=True)
+
+
 def save_artifact(path: str, arrays: Dict[str, np.ndarray],
                   signature: Dict[str, str], meta: dict) -> None:
     """Atomic write: payload first (renamed into place under a name
@@ -288,7 +301,7 @@ def save_artifact(path: str, arrays: Dict[str, np.ndarray],
     }
     atomic_write(
         os.path.join(path, _MANIFEST),
-        json.dumps(manifest, indent=2, sort_keys=True),
+        canonical_json(manifest, indent=2),
     )
     # previous payloads are unreferenced once the manifest lands
     for fn in os.listdir(path):

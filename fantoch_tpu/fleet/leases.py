@@ -229,6 +229,7 @@ def claim_unit(path: str, unit: str, worker: str,
         json.dump(
             {"worker": worker, "unit": unit, "claimed_at": time.time()},
             fh,
+            sort_keys=True,
         )
         fh.flush()
         os.fsync(fh.fileno())

@@ -56,13 +56,14 @@ def _merge_sweep(path: str, spec) -> dict:
     if missing:
         summary["missing_units"] = missing[:8]
         return summary
+    from ..engine.checkpoint import canonical_json
+
     lines: List[str] = []
     for key, *_ in batches:
         for lane, res in enumerate(done[key]):
             lines.append(
-                json.dumps(
-                    {"batch": key, "lane": lane, "result": res},
-                    sort_keys=True,
+                canonical_json(
+                    {"batch": key, "lane": lane, "result": res}
                 )
             )
     _atomic_write(
@@ -124,9 +125,11 @@ def _merge_fuzz(path: str, spec) -> dict:
             for key in (f"{p}/n{n}" for p, n in points)
         },
     }
+    from ..engine.checkpoint import canonical_json
+
     _atomic_write(
         os.path.join(path, _SUMMARY),
-        json.dumps(merged, indent=2, sort_keys=True),
+        canonical_json(merged, indent=2),
     )
     summary["summary"] = os.path.join(path, _SUMMARY)
     return summary

@@ -62,9 +62,11 @@ def worker_journal_path(path: str, worker: str) -> str:
 def append_worker_journal(path: str, worker: str, entry: dict) -> None:
     """Append-fsync one entry to the worker's own journal (the same
     torn-final-line crash contract as the manager's journal)."""
+    from ..engine.checkpoint import canonical_json
+
     os.makedirs(os.path.join(path, JOURNALS_DIR), exist_ok=True)
     with open(worker_journal_path(path, worker), "a") as fh:
-        fh.write(json.dumps(entry, sort_keys=True) + "\n")
+        fh.write(canonical_json(entry) + "\n")
         fh.flush()
         os.fsync(fh.fileno())
 

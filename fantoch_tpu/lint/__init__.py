@@ -32,6 +32,17 @@ Four analysis families, one driver (``python -m fantoch_tpu.cli lint``):
    device-state checkpoint saves, AOT+donation), GL303 backend-width
    portability audit against ``engine/dims.py BACKEND_PROFILES``.
    Entirely AST/arithmetic — no device, no jax.
+6. **Determinism family** (:mod:`.determinism`, :mod:`.ordering`;
+   opt-in ``--determinism``) — the *static* side of every
+   byte-identity pin (fleet ``--merge`` ≡ control, resume ≡ control,
+   AOT ≡ traced): GL401 ordered-output prover (unordered
+   set/filesystem iteration), GL402 PRNG-discipline audit (ambient
+   time/pid/uuid/default-stream randomness reaching serialization),
+   GL403 canonical-serialization audit (``sort_keys=True`` or the
+   ``canonical_json`` choke point), GL404 atomic-artifact audit
+   (writes route through ``atomic_write``). Gated against
+   ``lint/determinism_baseline.json`` where every exception carries a
+   named justification. Pure AST — no device, no jax.
 
 Every pass shares one cached trace per protocol variant
 (:class:`.jaxpr.TraceCache`), so adding passes does not multiply the
@@ -79,6 +90,8 @@ def run_lint(
     cost_baseline: "dict | None" = None,
     transfer: bool = False,
     transfer_baseline: "dict | None" = None,
+    determinism: bool = False,
+    determinism_baseline: "str | None" = None,
     cache=None,
     progress=None,
 ) -> LintReport:
@@ -131,6 +144,19 @@ def run_lint(
         say("donation-lifetime prover (GL302) ...")
         report.extend(run_alias())
         report.audits_run.append("alias")
+
+    if determinism:
+        # GL401-GL404 gate against determinism_baseline.json (findings
+        # exist only on violation — never written to baseline.json);
+        # pure AST over DETERMINISM_SCAN_PATHS, traces nothing
+        from .determinism import run_determinism
+
+        findings, summary = run_determinism(
+            baseline=determinism_baseline, progress=say
+        )
+        report.extend(findings)
+        report.determinism = summary
+        report.audits_run.append("determinism")
 
     names = list(protocols or FULL_PROTOCOLS)
     partial_names = [

@@ -1,0 +1,122 @@
+"""Unordered-iteration classification for the GL401 ordered-output
+prover (lint/determinism.py).
+
+A small, reusable AST pass: classify an expression as an *unordered
+source* (set values, unsorted filesystem enumeration) or not, and
+propagate that classification through straight-line assignments inside
+one function. ``sorted(...)`` launders at the source — a directory
+scan wrapped in ``sorted`` is ordered by construction and never
+reaches the prover.
+
+Deliberately intra-procedural and syntactic: the goal is a *sound
+upper bound* on unordered iteration inside the scan set, with the
+provably order-irrelevant remainder (deletion sweeps, lease tombstone
+scans) carried as named justifications in
+``lint/determinism_baseline.json`` — not a points-to analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional
+
+# filesystem enumerators whose order is explicitly unspecified
+# (os.listdir: "in arbitrary order"; glob sorts nothing; scandir and
+# Path.iterdir yield in directory order, which differs across
+# filesystems and machines)
+UNORDERED_FS_FUNCS = {
+    "listdir": "listdir",
+    "scandir": "scandir",
+    "glob": "glob",
+    "iglob": "glob",
+    "iterdir": "iterdir",
+}
+
+# calls that *consume* an iterable without exposing its order: safe to
+# apply to an unordered source
+ORDER_FREE_CONSUMERS = frozenset(
+    {"sorted", "len", "sum", "min", "max", "any", "all",
+     "set", "frozenset"}
+)
+
+# calls that *materialize* iteration order: list(s) over a set is as
+# order-dependent as `for x in s`
+ORDER_MATERIALIZERS = frozenset({"list", "tuple", "enumerate"})
+
+
+def call_name(func: ast.expr) -> Optional[str]:
+    """Bare callee name for ``f(...)`` / ``mod.f(...)`` / ``x.f(...)``."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def unordered_kind(
+    node: ast.expr, env: Dict[str, str]
+) -> Optional[str]:
+    """Classify ``node`` as an unordered source, returning its kind
+    (``set``/``listdir``/``glob``/``scandir``/``iterdir``) or None.
+
+    ``env`` maps names already known to hold unordered values (built
+    by ``assign_transfer``). ``sorted(...)``/``len(...)``-style
+    consumers classify as ordered regardless of their argument.
+    """
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return "set"
+    if isinstance(node, ast.Name):
+        return env.get(node.id)
+    if isinstance(node, ast.Call):
+        name = call_name(node.func)
+        if name == "set":
+            return "set"
+        if name in UNORDERED_FS_FUNCS:
+            return UNORDERED_FS_FUNCS[name]
+        if name in ORDER_FREE_CONSUMERS:
+            return None
+        # dict views over an unordered-keyed dict inherit the taint;
+        # .copy() and friends on a tainted name do too
+        if (
+            name in ("items", "keys", "values", "copy")
+            and isinstance(node.func, ast.Attribute)
+        ):
+            return unordered_kind(node.func.value, env)
+        if name in ORDER_MATERIALIZERS and node.args:
+            # list(s)/tuple(s)/enumerate(s): the *result* is an
+            # ordered list whose order came from the unordered source
+            # — classification is reported at the call site by the
+            # prover, but the materialized value stays tainted so
+            # downstream iteration is attributed too
+            return unordered_kind(node.args[0], env)
+        return None
+    # set ops (a | b, a - b) stay sets
+    if isinstance(node, ast.BinOp):
+        return unordered_kind(node.left, env) or unordered_kind(
+            node.right, env
+        )
+    if isinstance(node, ast.IfExp):
+        return unordered_kind(node.body, env) or unordered_kind(
+            node.orelse, env
+        )
+    return None
+
+
+def assign_transfer(
+    env: Dict[str, str], targets, value: ast.expr
+) -> None:
+    """Propagate unordered-ness through an assignment: tainted RHS
+    taints every plain-name target, ordered RHS launders them (so
+    ``names = sorted(names)`` cleans the slate)."""
+    kind = unordered_kind(value, env)
+    for t in targets:
+        names = []
+        if isinstance(t, ast.Name):
+            names = [t.id]
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+        for n in names:
+            if kind is not None:
+                env[n] = kind
+            else:
+                env.pop(n, None)

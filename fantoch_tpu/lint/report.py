@@ -50,6 +50,9 @@ class LintReport:
     # host-sync ledger summary (per-tier site counts) when the
     # transfer family ran
     transfer: Dict[str, object] = field(default_factory=dict)
+    # determinism ledger summary (per-rule site counts) when the
+    # GL4xx family ran
+    determinism: Dict[str, object] = field(default_factory=dict)
 
     def extend(self, fs) -> None:
         self.findings.extend(fs)
@@ -83,6 +86,11 @@ class LintReport:
             "audits": self.audits_run,
             **({"cost": self.cost} if self.cost else {}),
             **({"transfer": self.transfer} if self.transfer else {}),
+            **(
+                {"determinism": self.determinism}
+                if self.determinism
+                else {}
+            ),
             "findings": [
                 {
                     "id": f.id,
@@ -116,16 +124,19 @@ def load_baseline(path: str) -> Dict[str, int]:
 
 
 def write_baseline(path: str, report: LintReport) -> None:
-    # cost-family rules (GL2xx) gate against cost_baseline.json and
-    # the transfer family (GL3xx) against transfer_baseline.json; both
-    # emit findings ONLY on violation — writing one here would
-    # permanently suppress a live kernel/VMEM/sync/donation
-    # regression, so a run that happens to include `--cost` or
-    # `--transfer` must never bake them in
+    # this file suppresses ONLY the families that gate against it
+    # (GL0xx structural + GL1xx AST/jaxpr). Every other family has
+    # its own ledger — GL2xx cost_baseline.json, GL3xx
+    # transfer_baseline.json, GL4xx determinism_baseline.json — and
+    # emits findings ONLY on violation, so baking one in here would
+    # permanently suppress a live kernel/VMEM/sync/donation/
+    # determinism regression. An allowlist (not a denylist of known
+    # foreign prefixes) so the NEXT family can't cross-pollinate
+    # either.
     counts = {
         fid: n
         for fid, n in sorted(report.counts().items())
-        if not fid.startswith(("GL2", "GL3"))
+        if fid.startswith(("GL0", "GL1"))
     }
     payload = {
         "_comment": (
@@ -133,9 +144,10 @@ def write_baseline(path: str, report: LintReport) -> None:
             "count. Regenerate with `python -m fantoch_tpu.cli lint "
             "--write-baseline` and REVIEW the diff — every entry is a "
             "deliberately accepted finding (docs/LINT.md documents why "
-            "each current entry is sound). Cost-family findings "
-            "(GL2xx) are never written: they gate against "
-            "cost_baseline.json."
+            "each current entry is sound). Only GL0xx/GL1xx ids are "
+            "ever written: the cost (GL2xx), transfer (GL3xx), and "
+            "determinism (GL4xx) families gate against their own "
+            "ledgers."
         ),
         "findings": counts,
     }

@@ -529,9 +529,9 @@ def save_point_state(directory: str, spec, state: dict) -> str:
     import json
     import os
 
-    from ..engine.checkpoint import atomic_write
+    from ..engine.checkpoint import atomic_write, canonical_json
 
     os.makedirs(directory, exist_ok=True)
     path = point_state_path(directory, spec)
-    atomic_write(path, json.dumps(state, indent=2, sort_keys=True))
+    atomic_write(path, canonical_json(state, indent=2))
     return path

@@ -665,14 +665,14 @@ def _persist_artifact(artifact_dir: str, spec: FuzzSpec,
     is confirmed + shrunk, so a killed campaign session keeps it."""
     import os
 
-    from ..engine.checkpoint import atomic_write
+    from ..engine.checkpoint import atomic_write, canonical_json
 
     os.makedirs(artifact_dir, exist_ok=True)
     path = os.path.join(
         artifact_dir,
         f"repro_{spec.protocol}_n{spec.n}_lane{finding.lane}.json",
     )
-    atomic_write(path, json.dumps(finding.artifact, indent=2, sort_keys=True))
+    atomic_write(path, canonical_json(finding.artifact, indent=2))
     return path
 
 

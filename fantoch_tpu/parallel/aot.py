@@ -159,7 +159,7 @@ def save_executable(dir_: str, signature: Dict[str, str],
     winner is irrelevant. Returns the manifest path."""
     from jax.experimental import serialize_executable as _se
 
-    from ..engine.checkpoint import atomic_write
+    from ..engine.checkpoint import atomic_write, canonical_json
 
     os.makedirs(dir_, exist_ok=True)
     payload, _in_tree, _out_tree = _se.serialize(compiled)
@@ -176,7 +176,7 @@ def save_executable(dir_: str, signature: Dict[str, str],
         "payload": os.path.basename(ppath),
         "payload_sha256": hashlib.sha256(bytes(payload)).hexdigest(),
     }
-    atomic_write(mpath, json.dumps(manifest, indent=2, sort_keys=True))
+    atomic_write(mpath, canonical_json(manifest, indent=2))
     return mpath
 
 

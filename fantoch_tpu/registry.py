@@ -64,6 +64,25 @@ TRANSFER_SCAN_PATHS = (
     "fantoch_tpu/fleet",
 )
 
+# the host layers whose *byte-identity* guarantees the GL401-GL404
+# determinism family (lint/determinism.py) statically audits: every
+# module that enumerates the filesystem, draws randomness, serializes
+# JSON, or writes files that land in a campaign / coverage / AOT
+# directory. cli.py is here (and not in TRACED_SCAN_PATHS) because its
+# subcommands write repro artifacts and result files directly; the
+# lint package itself is excluded for the same reason it is excluded
+# from the GL1xx scan — the analyzers necessarily mention the very
+# patterns they detect.
+DETERMINISM_SCAN_PATHS = (
+    "fantoch_tpu/campaign",
+    "fantoch_tpu/fleet",
+    "fantoch_tpu/mc",
+    "fantoch_tpu/parallel",
+    "fantoch_tpu/bote",
+    "fantoch_tpu/engine/checkpoint.py",
+    "fantoch_tpu/cli.py",
+)
+
 # fleet worker ids (fantoch_tpu/fleet, docs/FLEET.md) become lease and
 # journal file names: `leases/<unit>.<worker>` and
 # `journals/<worker>.jsonl`. The rules keep the filenames parseable and

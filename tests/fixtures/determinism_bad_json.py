@@ -1,0 +1,28 @@
+"""Seeded-broken fixture for the GL403 canonical-serialization
+selfcheck.
+
+Never imported by the package: `cli.py lint --determinism-selfcheck
+json` scans this file and must exit non-zero naming GL403, proving
+the sort_keys/choke-point audit can actually fail.
+"""
+
+import json
+
+
+def write_summary(path, summary):
+    # BUG: json.dump without sort_keys=True — summary bytes now depend
+    # on dict insertion history, breaking merge/resume cmp pins
+    with open(path, "a") as fh:
+        json.dump(summary, fh, indent=2)
+
+
+def append_result(fh, batch, result):
+    # BUG: unsorted json.dumps text reaching a write sink
+    line = json.dumps({"batch": batch, "result": result})
+    fh.write(line + "\n")
+
+
+def debug_print(point):
+    # fine: unsorted dumps to stdout is operator chatter, not a
+    # compared artifact
+    print(json.dumps(point))

@@ -1,0 +1,38 @@
+"""Seeded-broken fixture for the GL401 ordered-output selfcheck.
+
+Never imported by the package: `cli.py lint --determinism-selfcheck
+order` scans this file and must exit non-zero naming GL401, proving
+the unordered-iteration prover can actually fail (a crash or an empty
+scan would otherwise read as a clean gate).
+"""
+
+import json
+import os
+
+
+def merge_journals(path):
+    lines = []
+    # BUG: unsorted directory scan enumerated into an ordered output —
+    # merge order now depends on the filesystem's directory order
+    for name in os.listdir(path):
+        with open(os.path.join(path, name)) as fh:
+            lines.extend(fh.read().splitlines())
+    return lines
+
+
+def rank_points(results):
+    winners = {r["point"] for r in results if r["ok"]}
+    # BUG: set iteration order materialized into the ranking
+    return list(winners)
+
+
+def summarize(path, results):
+    seen = set(r["unit"] for r in results)
+    # fine: membership tests never expose iteration order
+    missing = [u for u in sorted_units(path) if u not in seen]
+    return json.dumps({"missing": missing}, sort_keys=True)
+
+
+def sorted_units(path):
+    # fine: sorted at the source — clean by construction
+    return sorted(os.listdir(path))

@@ -1,0 +1,40 @@
+"""Seeded-broken fixture for the GL402 PRNG-discipline selfcheck.
+
+Never imported by the package: `cli.py lint --determinism-selfcheck
+rng` scans this file and must exit non-zero naming GL402, proving the
+ambient-nondeterminism audit can actually fail.
+"""
+
+import json
+import os
+import random
+import time
+import uuid
+
+
+def journal_entry(path, unit, result):
+    # BUG: wall-clock baked into a journal entry — two byte-identical
+    # re-runs now journal different bytes
+    entry = {"unit": unit, "result": result, "at": time.time()}
+    with open(path, "a") as fh:
+        fh.write(json.dumps(entry, sort_keys=True) + "\n")
+
+
+def artifact_name(out_dir):
+    # BUG: uuid-derived artifact names — the merged artifact set is
+    # never reproducible across runs
+    return os.path.join(out_dir, f"repro_{uuid.uuid4()}.json")
+
+
+def jitter_schedule(n):
+    # BUG: default-stream randomness (no journaled seed) feeding a
+    # result-affecting schedule
+    plan = [random.randint(0, 7) for _ in range(n)]
+    return json.dumps({"plan": plan}, sort_keys=True)
+
+
+def budget_left(deadline, t0):
+    # fine: perf_counter timing is budget metadata, stripped from
+    # every compared artifact — not a GL402 source
+    elapsed = time.perf_counter() - t0
+    return deadline - elapsed

@@ -1,0 +1,33 @@
+"""Seeded-broken fixture for the GL404 atomic-artifact selfcheck.
+
+Never imported by the package: `cli.py lint --determinism-selfcheck
+write` scans this file and must exit non-zero naming GL404, proving
+the atomic-write audit can actually fail.
+"""
+
+import json
+import pathlib
+
+
+def save_frontier(path, frontier):
+    # BUG: raw open-for-write of a durable artifact — a kill mid-write
+    # leaves a torn frontier.json the resume path then chokes on
+    with open(path, "w") as fh:
+        json.dump(frontier, fh, indent=2, sort_keys=True)
+
+
+def save_key(path, key_bytes):
+    # BUG: Path.write_bytes is the same torn-write class
+    pathlib.Path(path).write_bytes(key_bytes)
+
+
+def save_note(path, text):
+    # BUG: write_text too
+    pathlib.Path(path).write_text(text)
+
+
+def append_journal(path, line):
+    # fine: append mode is the sanctioned journal protocol (torn final
+    # lines are tolerated on read)
+    with open(path, "a") as fh:
+        fh.write(line + "\n")

@@ -1,0 +1,673 @@
+"""Determinism-family tests (fantoch_tpu/lint/determinism.py +
+ordering.py): GL401 unordered-source/ordered-sink taxonomy units on
+synthetic sources (including the sorted-at-source clean case and the
+membership-only non-finding), GL402/GL403/GL404 units, the ledger
+regression gate (new id, count bump, reasonless baseline entry),
+clean-at-HEAD + ledger≡baseline pins, canonical_json byte-identity,
+the seeded CI self-checks, baseline cross-pollination guards, and the
+scan-set coverage self-tests — all pure AST, no device and no
+tracing."""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from fantoch_tpu.lint.determinism import (
+    DEFAULT_DETERMINISM_BASELINE,
+    gate_ledger,
+    ledger_summary,
+    load_determinism_baseline,
+    run_determinism,
+    run_determinism_selfcheck,
+    scan_determinism,
+    write_determinism_baseline,
+)
+from fantoch_tpu.registry import DETERMINISM_SCAN_PATHS
+
+
+def _scan(tmp_path, src, name="synth.py"):
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(src))
+    return scan_determinism([str(path)])
+
+
+def _sites(tmp_path, src):
+    sites, findings = _scan(tmp_path, src)
+    assert findings == [], [f.render() for f in findings]
+    return sites
+
+
+def _kinds(sites, rule):
+    return sorted(s.kind for s in sites if s.rule == rule)
+
+
+# ----------------------------------------------------------------------
+# GL401: unordered-source taxonomy
+# ----------------------------------------------------------------------
+
+
+def test_listdir_iteration_flags(tmp_path):
+    sites = _sites(
+        tmp_path,
+        """
+        import os
+        def merge(path):
+            out = []
+            for name in os.listdir(path):
+                out.append(name)
+            return out
+        """,
+    )
+    assert _kinds(sites, "GL401") == ["iter-listdir"]
+
+
+def test_sorted_at_source_is_clean(tmp_path):
+    sites = _sites(
+        tmp_path,
+        """
+        import os, glob
+        def merge(path):
+            out = []
+            for name in sorted(os.listdir(path)):
+                out.append(name)
+            for p in sorted(glob.glob(path + "/*.json")):
+                out.append(p)
+            return out
+        """,
+    )
+    assert _kinds(sites, "GL401") == []
+
+
+def test_set_iteration_flags(tmp_path):
+    sites = _sites(
+        tmp_path,
+        """
+        def rank(results):
+            winners = {r for r in results}
+            order = []
+            for w in winners:
+                order.append(w)
+            return order
+        """,
+    )
+    assert _kinds(sites, "GL401") == ["iter-set"]
+
+
+def test_set_membership_only_is_clean(tmp_path):
+    # sets used purely for O(1) membership never expose iteration
+    # order — the required non-finding
+    sites = _sites(
+        tmp_path,
+        """
+        def missing(units, results):
+            seen = set(r["unit"] for r in results)
+            return [u for u in units if u not in seen]
+        """,
+    )
+    assert _kinds(sites, "GL401") == []
+
+
+def test_tainted_name_iteration_flags(tmp_path):
+    sites = _sites(
+        tmp_path,
+        """
+        import os
+        def scan(path):
+            names = os.listdir(path)
+            return [n for n in names]
+        """,
+    )
+    assert _kinds(sites, "GL401") == ["iter-listdir"]
+
+
+def test_sorted_launders_the_name(tmp_path):
+    sites = _sites(
+        tmp_path,
+        """
+        import os
+        def scan(path):
+            names = os.listdir(path)
+            names = sorted(names)
+            return [n for n in names]
+        """,
+    )
+    assert _kinds(sites, "GL401") == []
+
+
+def test_glob_scandir_iterdir_flag(tmp_path):
+    sites = _sites(
+        tmp_path,
+        """
+        import glob, os, pathlib
+        def scan(path):
+            a = [p for p in glob.glob(path)]
+            b = [e for e in os.scandir(path)]
+            c = [f for f in pathlib.Path(path).iterdir()]
+            return a, b, c
+        """,
+    )
+    assert _kinds(sites, "GL401") == [
+        "iter-glob", "iter-iterdir", "iter-scandir",
+    ]
+
+
+def test_materializing_a_set_flags(tmp_path):
+    sites = _sites(
+        tmp_path,
+        """
+        def rank(points):
+            winners = set(points)
+            return list(winners), ",".join(winners)
+        """,
+    )
+    assert _kinds(sites, "GL401") == ["iter-set", "iter-set"]
+
+
+def test_sorted_consumer_suppresses_inner_generator(tmp_path):
+    # sorted(f(x) for x in s): the set is iterated, but the consumer
+    # re-orders — order-free overall
+    sites = _sites(
+        tmp_path,
+        """
+        def rank(points):
+            winners = set(points)
+            return sorted(w + 1 for w in winners)
+        """,
+    )
+    assert _kinds(sites, "GL401") == []
+
+
+def test_dict_views_of_tainted_dict_flag(tmp_path):
+    sites = _sites(
+        tmp_path,
+        """
+        def views(results):
+            winners = set(results)
+            return [w for w in winners.copy()]
+        """,
+    )
+    assert _kinds(sites, "GL401") == ["iter-set"]
+
+
+# ----------------------------------------------------------------------
+# GL402: PRNG discipline
+# ----------------------------------------------------------------------
+
+
+def test_wall_clock_into_journal_flags(tmp_path):
+    sites = _sites(
+        tmp_path,
+        """
+        import json, time
+        def entry(fh, unit):
+            rec = {"unit": unit, "at": time.time()}
+            fh.write(json.dumps(rec, sort_keys=True))
+        """,
+    )
+    assert _kinds(sites, "GL402") == ["time.time"]
+
+
+def test_perf_counter_is_not_a_source(tmp_path):
+    sites = _sites(
+        tmp_path,
+        """
+        import json, time
+        def entry(fh, unit, t0):
+            rec = {"unit": unit, "elapsed": time.perf_counter() - t0}
+            fh.write(json.dumps(rec, sort_keys=True))
+        """,
+    )
+    assert _kinds(sites, "GL402") == []
+
+
+def test_seeded_stream_is_clean(tmp_path):
+    # random.Random(seed) / np.random.default_rng(seed) are the
+    # journaled-stream discipline — not sources
+    sites = _sites(
+        tmp_path,
+        """
+        import json, random
+        import numpy as np
+        def plan(fh, seed, n):
+            rng = random.Random(seed)
+            g = np.random.default_rng(seed)
+            rec = {"plan": [rng.randint(0, 7) for _ in range(n)],
+                   "x": float(g.uniform())}
+            fh.write(json.dumps(rec, sort_keys=True))
+        """,
+    )
+    assert _kinds(sites, "GL402") == []
+
+
+def test_default_stream_random_flags(tmp_path):
+    sites = _sites(
+        tmp_path,
+        """
+        import json, random
+        import numpy as np
+        def plan(fh, n):
+            rec = {"plan": [random.randint(0, 7) for _ in range(n)],
+                   "x": float(np.random.uniform())}
+            fh.write(json.dumps(rec, sort_keys=True))
+        """,
+    )
+    assert _kinds(sites, "GL402") == ["np.random", "random"]
+
+
+def test_pid_derived_filename_flags(tmp_path):
+    sites = _sites(
+        tmp_path,
+        """
+        import os
+        def write(path, data):
+            tmp = f"{path}.{os.getpid()}.tmp"
+            with open(tmp, "a") as fh:
+                fh.write(data)
+        """,
+    )
+    assert _kinds(sites, "GL402") == ["os.getpid"]
+
+
+def test_uuid_flags_and_bare_ttl_compare_is_clean(tmp_path):
+    sites = _sites(
+        tmp_path,
+        """
+        import json, time, uuid
+        def name(fh):
+            fh.write(json.dumps({"id": str(uuid.uuid4())},
+                                sort_keys=True))
+        def expired(mtime, ttl):
+            now = time.time()
+            return now - mtime > ttl
+        """,
+    )
+    assert _kinds(sites, "GL402") == ["uuid"]
+
+
+# ----------------------------------------------------------------------
+# GL403: canonical serialization
+# ----------------------------------------------------------------------
+
+
+def test_json_dump_without_sort_keys_flags(tmp_path):
+    sites = _sites(
+        tmp_path,
+        """
+        import json
+        def save(path, obj):
+            with open(path, "a") as fh:
+                json.dump(obj, fh, indent=2)
+        """,
+    )
+    assert _kinds(sites, "GL403") == ["dump-unsorted"]
+
+
+def test_json_dump_sorted_is_clean(tmp_path):
+    sites = _sites(
+        tmp_path,
+        """
+        import json
+        def save(path, obj):
+            with open(path, "a") as fh:
+                json.dump(obj, fh, indent=2, sort_keys=True)
+        """,
+    )
+    assert _kinds(sites, "GL403") == []
+
+
+def test_unsorted_dumps_reaching_write_sink_flags(tmp_path):
+    sites = _sites(
+        tmp_path,
+        """
+        import json
+        def direct(fh, obj):
+            fh.write(json.dumps(obj))
+        def via_name(write, obj):
+            line = json.dumps(obj)
+            write("x", line)
+        """,
+    )
+    # `write` is both the fh.write attribute sink and the bare-name
+    # sink in via_name
+    assert _kinds(sites, "GL403") == [
+        "dumps-unsorted", "dumps-unsorted",
+    ]
+
+
+def test_unsorted_dumps_to_stdout_is_clean(tmp_path):
+    sites = _sites(
+        tmp_path,
+        """
+        import json
+        def chatter(point):
+            print(json.dumps(point))
+        """,
+    )
+    assert _kinds(sites, "GL403") == []
+
+
+def test_nonliteral_sort_keys_is_structural(tmp_path):
+    sites, findings = _scan(
+        tmp_path,
+        """
+        import json
+        def save(path, obj, flag):
+            with open(path, "a") as fh:
+                json.dump(obj, fh, sort_keys=flag)
+        """,
+    )
+    assert len(findings) == 1 and findings[0].rule == "GL403"
+    assert "non-literal" in findings[0].message
+
+
+def test_canonical_json_choke_is_sanctioned(tmp_path):
+    sites = _sites(
+        tmp_path,
+        """
+        def save(path, obj):
+            from fantoch_tpu.engine.checkpoint import (
+                atomic_write, canonical_json,
+            )
+            atomic_write(path, canonical_json(obj, indent=2))
+        """,
+    )
+    assert _kinds(sites, "GL403") == []
+    assert _kinds(sites, "GL404") == []
+
+
+# ----------------------------------------------------------------------
+# GL404: atomic artifacts
+# ----------------------------------------------------------------------
+
+
+def test_raw_writes_flag(tmp_path):
+    sites = _sites(
+        tmp_path,
+        """
+        import pathlib
+        def save(path, data):
+            with open(path, "w") as fh:
+                fh.write(data)
+        def save2(path, data):
+            pathlib.Path(path).write_text(data)
+        def save3(path, data):
+            pathlib.Path(path).write_bytes(data)
+        """,
+    )
+    assert _kinds(sites, "GL404") == [
+        "open-w", "write-bytes", "write-text",
+    ]
+
+
+def test_append_and_read_modes_are_clean(tmp_path):
+    # append is the sanctioned journal protocol; reads are irrelevant
+    sites = _sites(
+        tmp_path,
+        """
+        def journal(path, line):
+            with open(path, "a") as fh:
+                fh.write(line)
+        def load(path):
+            with open(path) as fh:
+                return fh.read()
+        def load_rb(path):
+            with open(path, "rb") as fh:
+                return fh.read()
+        """,
+    )
+    assert _kinds(sites, "GL404") == []
+
+
+def test_atomic_write_choke_body_is_exempt():
+    # the real checkpoint.py: atomic_write's own open-for-write is the
+    # sanctioned implementation, not a finding — but its pid temp name
+    # stays a (baselined) GL402 site
+    sites, findings = scan_determinism(
+        ["fantoch_tpu/engine/checkpoint.py"]
+    )
+    assert findings == []
+    gl404 = [s for s in sites if s.rule == "GL404"]
+    assert gl404 == []
+    assert any(
+        s.rule == "GL402" and s.fn == "atomic_write" for s in sites
+    )
+
+
+# ----------------------------------------------------------------------
+# ledger gate
+# ----------------------------------------------------------------------
+
+
+def _synthetic_sites(tmp_path):
+    return _sites(
+        tmp_path,
+        """
+        import os
+        def scan(path):
+            return [n for n in os.listdir(path)]
+        """,
+    )
+
+
+def test_gate_new_id_is_a_finding(tmp_path):
+    sites = _synthetic_sites(tmp_path)
+    findings, stale = gate_ledger(sites, {})
+    assert len(findings) == 1
+    assert findings[0].rule == "GL401"
+    assert "NEW determinism hazard" in findings[0].message
+    assert stale == []
+
+
+def test_gate_baselined_site_passes_and_count_bump_fails(tmp_path):
+    sites = _synthetic_sites(tmp_path)
+    fid = sites[0].id
+    base = {fid: {"count": 1, "reason": "synthetic justification"}}
+    findings, _ = gate_ledger(sites, base)
+    assert findings == []
+    findings, _ = gate_ledger(sites + sites, base)
+    assert len(findings) == 1 and "count grew" in findings[0].message
+
+
+def test_gate_reasonless_baseline_entry_fails(tmp_path):
+    sites = _synthetic_sites(tmp_path)
+    fid = sites[0].id
+    findings, _ = gate_ledger(sites, {fid: {"count": 1, "reason": ""}})
+    assert len(findings) == 1
+    assert "no written justification" in findings[0].message
+    findings, _ = gate_ledger(
+        sites, {fid: {"count": 1, "reason": "UNREVIEWED placeholder"}}
+    )
+    assert len(findings) == 1
+
+
+def test_gate_stale_allowance_is_advisory(tmp_path):
+    sites = _synthetic_sites(tmp_path)
+    base = {
+        sites[0].id: {"count": 5, "reason": "synthetic justification"}
+    }
+    findings, stale = gate_ledger(sites, base)
+    assert findings == []
+    assert stale == [sites[0].id]
+
+
+# ----------------------------------------------------------------------
+# clean-at-HEAD pins
+# ----------------------------------------------------------------------
+
+
+def test_determinism_clean_at_head():
+    findings, summary = run_determinism()
+    assert findings == [], [f.render() for f in findings]
+    assert summary["sites"] == summary["ids"] == summary["baseline_entries"]
+
+
+def test_head_ledger_matches_checked_in_baseline():
+    sites, findings = scan_determinism()
+    assert findings == []
+    base = load_determinism_baseline()
+    assert sorted({s.id for s in sites}) == sorted(base)
+    # every baselined exception carries a real written justification
+    for fid, e in base.items():
+        reason = str(e.get("reason", ""))
+        assert reason.strip(), fid
+        assert not reason.startswith("UNREVIEWED"), fid
+
+
+def test_write_determinism_baseline_roundtrip(tmp_path):
+    sites, _ = scan_determinism()
+    path = str(tmp_path / "det.json")
+    write_determinism_baseline(path, sites)
+    base = load_determinism_baseline(path)
+    assert sorted(base) == sorted({s.id for s in sites})
+    # fresh entries get the UNREVIEWED placeholder, which the gate
+    # itself then rejects — a thoughtless regen cannot go green
+    findings, _ = gate_ledger(sites, base)
+    assert findings and all(
+        "justification" in f.message for f in findings
+    )
+    # a regen over reviewed entries preserves the written reasons
+    reviewed = {
+        fid: {"count": e["count"], "reason": f"reviewed {fid}"}
+        for fid, e in base.items()
+    }
+    with open(path, "w") as fh:
+        json.dump({"entries": reviewed}, fh)
+    write_determinism_baseline(path, sites)
+    base2 = load_determinism_baseline(path)
+    assert all(
+        base2[fid]["reason"] == f"reviewed {fid}" for fid in base2
+    )
+
+
+def test_canonical_json_is_byte_identical_to_sorted_dumps():
+    from fantoch_tpu.engine.checkpoint import canonical_json
+
+    obj = {"b": [1, 2], "a": {"z": 0.25, "y": None}, "c": "x"}
+    assert canonical_json(obj) == json.dumps(obj, sort_keys=True)
+    assert canonical_json(obj, indent=2) == json.dumps(
+        obj, indent=2, sort_keys=True
+    )
+
+
+def test_ledger_summary_shape():
+    s = ledger_summary()
+    assert set(s) == {"sites", "rules", "ids"}
+    assert set(s["rules"]) == {"GL401", "GL402", "GL403", "GL404"}
+    assert all(isinstance(v, int) for v in s["rules"].values())
+    assert s["sites"] >= s["ids"] > 0
+
+
+# ----------------------------------------------------------------------
+# selfchecks + CLI
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kind,rule", [
+    ("order", "GL401"),
+    ("rng", "GL402"),
+    ("json", "GL403"),
+    ("write", "GL404"),
+])
+def test_selfcheck_fixture_names_its_rule(kind, rule):
+    findings, summary = run_determinism_selfcheck(kind)
+    assert findings, f"selfcheck {kind} is vacuously green"
+    assert all(f.rule == rule for f in findings)
+    assert summary["selfcheck_rule"] == rule
+
+
+@pytest.mark.parametrize("kind,rule", [
+    ("order", "GL401"),
+    ("rng", "GL402"),
+    ("json", "GL403"),
+    ("write", "GL404"),
+])
+def test_cli_selfcheck_exits_nonzero_naming_rule(
+    kind, rule, capsys
+):
+    from fantoch_tpu import cli
+
+    with pytest.raises(SystemExit) as e:
+        cli.main(["lint", "--determinism-selfcheck", kind])
+    assert e.value.code == 1
+    captured = capsys.readouterr()
+    assert rule in captured.err
+    out = json.loads(captured.out.strip().splitlines()[-1])
+    assert out["selfcheck"] == kind and out["regressions"] > 0
+
+
+def test_cli_determinism_only_clean_at_head(capsys):
+    from fantoch_tpu import cli
+
+    cli.main(["lint", "--determinism-only", "--baseline"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["regressions"] == 0
+    assert out["determinism"]["rules"]["GL403"] == 0
+
+
+def test_cli_write_determinism_baseline_refuses_narrowed_run(tmp_path):
+    from fantoch_tpu import cli
+
+    with pytest.raises(SystemExit) as e:
+        cli.main([
+            "lint", "--write-determinism-baseline",
+            "--paths", str(tmp_path / "nope.py"),
+        ])
+    assert "narrowed" in str(e.value.code)
+
+
+# ----------------------------------------------------------------------
+# baseline cross-pollination guards (report.py write_baseline)
+# ----------------------------------------------------------------------
+
+
+def test_write_baseline_refuses_all_foreign_families(tmp_path):
+    from fantoch_tpu.lint.report import (
+        Finding, LintReport, load_baseline, write_baseline,
+    )
+
+    report = LintReport()
+    report.extend([
+        Finding("GL001", "tempo", "a.py:f:add", "keep"),
+        Finding("GL104", "ast", "b.py:g", "keep"),
+        Finding("GL201", "cost", "c.py:h:kernels", "drop"),
+        Finding("GL301", "transfer", "d.py:i:bool", "drop"),
+        Finding("GL404", "determinism", "e.py:j:open-w", "drop"),
+    ])
+    path = str(tmp_path / "baseline.json")
+    write_baseline(path, report)
+    base = load_baseline(path)
+    assert set(base) == {
+        "GL001:tempo:a.py:f:add",
+        "GL104:ast:b.py:g",
+    }
+
+
+# ----------------------------------------------------------------------
+# scan-set coverage
+# ----------------------------------------------------------------------
+
+
+def test_determinism_scan_paths_exist_and_exclude_lint():
+    from fantoch_tpu.lint.rules import REPO_ROOT, expand_paths
+
+    files = expand_paths(DETERMINISM_SCAN_PATHS)
+    assert files, "empty determinism scan set"
+    rels = [os.path.relpath(f, REPO_ROOT) for f in files]
+    assert all(not r.startswith("fantoch_tpu/lint") for r in rels)
+    assert "fantoch_tpu/cli.py" in rels
+    assert any(r.startswith("fantoch_tpu/campaign") for r in rels)
+    assert any(r.startswith("fantoch_tpu/fleet") for r in rels)
+    assert any(r.startswith("fantoch_tpu/mc") for r in rels)
+    assert any(r.startswith("fantoch_tpu/bote") for r in rels)
+
+
+def test_uncovered_traced_modules_still_empty():
+    from fantoch_tpu.lint.rules import uncovered_traced_modules
+
+    assert uncovered_traced_modules() == []
+
+
+def test_determinism_baseline_is_checked_in():
+    assert os.path.exists(DEFAULT_DETERMINISM_BASELINE)
