@@ -82,6 +82,17 @@ COV_CHUNKS = int(_os.environ.get("FANTOCH_BENCH_COV_CHUNKS", "4"))
 COV_MIN_BUDGET_S = float(
     _os.environ.get("FANTOCH_BENCH_COV_MIN_BUDGET", "420")
 )
+# the farm's fault classes, steered individually over the same budget
+# (mc/fuzz.py class_spec; "mixed" is the headline steered number)
+COV_CLASSES = ("crash", "drop", "jitter")
+
+# covmap-compaction self-check shape (mc/covmap.py): time to persist a
+# synthetic COVMAP_BUCKETS-bucket map as a versioned binary file plus
+# a keep-2 compaction, vs the per-chunk canonical-JSON state rewrite
+# it replaced — pure host I/O, measured even in dead-backend artifacts
+COVMAP_BUCKETS = int(
+    _os.environ.get("FANTOCH_BENCH_COVMAP_BUCKETS", "100000")
+)
 
 # checkpoint-roundtrip self-check shape (engine/checkpoint.py): the
 # documented 512-lane tempo sweep state, reduced by the CPU-fallback
@@ -869,16 +880,22 @@ def _fuzz_selfcheck() -> float:
     return res.schedules_per_sec
 
 
-def _fuzz_coverage() -> "tuple[float, float]":
+def _fuzz_coverage() -> "tuple[float, float, dict]":
     """Blind vs coverage-steered bucket discovery per 1000 schedules
     (mc/coverage.py) on a fixed-seed tempo n=3 point: both modes spend
     the identical chunked budget (COV_CHUNKS chunks of COV_CHUNK
     schedules) in this process, the steered mode feeding each chunk's
-    new-bucket plans back through the seed mutators. Returns
-    (blind, steered) buckets/ksched."""
+    new-bucket plans back through the seed mutators. The farm's
+    non-mixed fault classes (mc/fuzz.py class_spec) are then each
+    steered over the same budget — their salted streams and zeroed
+    envelopes reuse the compiled COV_CHUNK-lane runner, so the
+    per-class rates isolate how rich each fault slice's interleaving
+    space is, not a compile. Returns (blind, steered,
+    {class: steered buckets/ksched})."""
     from fantoch_tpu.mc import coverage as cov
     from fantoch_tpu.mc.fuzz import (
         FuzzSpec,
+        class_spec,
         draw_plans,
         plan_rng,
         point_config,
@@ -886,7 +903,7 @@ def _fuzz_coverage() -> "tuple[float, float]":
         run_fuzz_point,
     )
 
-    spec = FuzzSpec(
+    base = FuzzSpec(
         protocol="tempo",
         n=3,
         f=1,
@@ -894,11 +911,11 @@ def _fuzz_coverage() -> "tuple[float, float]":
         commands_per_client=5,
         seed=0xC0F,
     )
-    config = point_config(spec)
-    dev = point_protocol(spec)
     total = COV_CHUNK * COV_CHUNKS
 
-    def run(steered: bool) -> float:
+    def run(spec, steered: bool) -> float:
+        config = point_config(spec)
+        dev = point_protocol(spec)
         rng = plan_rng(spec)
         cmap, pool, mrng = cov.restore_steering(spec, None)
         for _ in range(COV_CHUNKS):
@@ -914,7 +931,56 @@ def _fuzz_coverage() -> "tuple[float, float]":
             cov.fold_chunk(cmap, pool, res.digests, plans)
         return cmap.bucket_count * 1000.0 / total
 
-    return run(False), run(True)
+    blind = run(base, False)
+    steered = run(base, True)
+    per_class = {
+        c: run(class_spec(base, c), True) for c in COV_CLASSES
+    }
+    return blind, steered, per_class
+
+
+def _covmap_compact() -> "tuple[float, float]":
+    """Binary coverage-map persistence tax (mc/covmap.py): build a
+    synthetic COVMAP_BUCKETS-bucket map, then time (a) one versioned
+    binary write plus the keep-2 compaction a farm chunk pays, vs (b)
+    the canonical-JSON point-state rewrite it replaced. Pure host I/O
+    against a tmpdir — no device, usable even in dead-backend
+    artifacts. Returns (binary_s, json_s)."""
+    import shutil
+    import tempfile
+
+    from fantoch_tpu.engine.checkpoint import atomic_write, canonical_json
+    from fantoch_tpu.mc import covmap as cvm
+    from fantoch_tpu.mc.coverage import CoverageMap
+
+    sig = {"bench": "covmap_compact", "buckets": COVMAP_BUCKETS}
+    # deterministic synthetic digests (a PCG stream would do too, but
+    # the shape — sorted i64 pairs — is all the format cares about)
+    cmap = CoverageMap(
+        signature=sig,
+        buckets={(i * 0x9E3779B97F4A7C15) & ((1 << 63) - 1): 1
+                 for i in range(COVMAP_BUCKETS)},
+    )
+    d = tempfile.mkdtemp(prefix="fantoch_covmap_bench_")
+    key = "bench/n3"
+    try:
+        # pre-seed two older versions so the timed write triggers a
+        # real keep-2 compaction (the steady-state farm cost)
+        cvm.save_point_map(d, key, 1, cmap)
+        cvm.save_point_map(d, key, 2, cmap)
+        t0 = time.time()
+        cvm.save_point_map(d, key, 3, cmap)
+        cvm.compact_point_maps(d, key, keep=2)
+        binary_s = time.time() - t0
+        t0 = time.time()
+        atomic_write(
+            _os.path.join(d, "state.json"),
+            canonical_json({"coverage": cmap.to_json()}) + "\n",
+        )
+        json_s = time.time() - t0
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    return binary_s, json_s
 
 
 def main() -> None:
@@ -1047,10 +1113,14 @@ def main() -> None:
     else:
         try:
             cov_rates = _fuzz_coverage()
+            per_cls = ", ".join(
+                f"{c}={r:.1f}" for c, r in cov_rates[2].items()
+            )
             print(
                 f"coverage self-check: {COV_CHUNK * COV_CHUNKS} "
                 f"schedules, {cov_rates[0]:.1f} blind vs "
-                f"{cov_rates[1]:.1f} steered buckets/ksched",
+                f"{cov_rates[1]:.1f} steered buckets/ksched "
+                f"(per class: {per_cls})",
                 file=sys.stderr,
                 flush=True,
             )
@@ -1064,6 +1134,24 @@ def main() -> None:
                 f"coverage self-check {cov_note}", file=sys.stderr,
                 flush=True,
             )
+
+    # covmap persistence tax (mc/covmap.py): pure host I/O, no device
+    # and no compile — runs unconditionally, honest-zero only if the
+    # write itself fails
+    covmap_s, covmap_note = None, None
+    try:
+        covmap_s = _covmap_compact()
+        print(
+            f"covmap self-check: {COVMAP_BUCKETS} buckets, "
+            f"binary+compact {covmap_s[0]:.3f}s vs JSON "
+            f"{covmap_s[1]:.3f}s",
+            file=sys.stderr,
+            flush=True,
+        )
+    except Exception as e:  # noqa: BLE001
+        covmap_note = f"failed: {type(e).__name__}: {e}"[:300]
+        print(f"covmap self-check {covmap_note}", file=sys.stderr,
+              flush=True)
 
     # traffic-schedule tax (fantoch_tpu/traffic): host-side epoch-table
     # build time, plus the measured flat-vs-diurnal rate delta on a
@@ -1249,8 +1337,26 @@ def main() -> None:
                 "fuzz_buckets_per_ksched_blind": (
                     round(cov_rates[0], 2) if cov_rates else 0.0
                 ),
+                # each fault class steered alone over the same budget
+                # (zeros = the shared skip/failure reason above)
+                "fuzz_buckets_per_ksched_class": (
+                    {c: round(r, 2) for c, r in cov_rates[2].items()}
+                    if cov_rates
+                    else {c: 0.0 for c in COV_CLASSES}
+                ),
                 "fuzz_cov_schedules": COV_CHUNK * COV_CHUNKS,
                 **({"fuzz_cov_note": cov_note} if cov_note else {}),
+                # binary map write + keep-2 compaction vs the JSON
+                # state rewrite, COVMAP_BUCKETS synthetic buckets
+                # (0.0 = write failed; note carries the reason)
+                "covmap_compact_s": (
+                    round(covmap_s[0], 3) if covmap_s else 0.0
+                ),
+                "covmap_json_s": (
+                    round(covmap_s[1], 3) if covmap_s else 0.0
+                ),
+                "covmap_buckets": COVMAP_BUCKETS,
+                **({"covmap_note": covmap_note} if covmap_note else {}),
                 # save + restore + bit-exact compare of a CKPT_LANES
                 # tempo state (0.0 = self-check unavailable, see stderr)
                 "checkpoint_roundtrip_s": (
@@ -1472,6 +1578,19 @@ def _remaining() -> float:
     return DEADLINE_S - (time.time() - t0)
 
 
+def _covmap_compact_or_none() -> "tuple[float, float] | None":
+    import sys
+
+    try:
+        return _covmap_compact()
+    except Exception as e:  # noqa: BLE001
+        print(
+            f"covmap self-check failed: {type(e).__name__}: {e}",
+            file=sys.stderr,
+        )
+        return None
+
+
 def _emit_unreachable(reason: str = "unreachable at startup") -> None:
     import sys
 
@@ -1502,8 +1621,24 @@ def _emit_unreachable(reason: str = "unreachable at startup") -> None:
                 # too — honest zeros with the shared reason
                 "fuzz_buckets_per_ksched": 0.0,
                 "fuzz_buckets_per_ksched_blind": 0.0,
+                "fuzz_buckets_per_ksched_class": {
+                    c: 0.0 for c in COV_CLASSES
+                },
                 "fuzz_cov_schedules": COV_CHUNK * COV_CHUNKS,
                 "fuzz_cov_note": f"skipped: TPU backend {reason}",
+                # covmap persistence is pure host I/O — still a real
+                # measurement here, like the table build below
+                **(
+                    (lambda s: {
+                        "covmap_compact_s": round(s[0], 3),
+                        "covmap_json_s": round(s[1], 3),
+                    } if s else {
+                        "covmap_compact_s": 0.0,
+                        "covmap_json_s": 0.0,
+                        "covmap_note": "failed (see stderr)",
+                    })(_covmap_compact_or_none())
+                ),
+                "covmap_buckets": COVMAP_BUCKETS,
                 # the roundtrip needs a live (CPU) jax backend to build
                 # the tempo state; the CPU-fallback path measures it,
                 # this last-ditch artifact records an honest zero
@@ -1565,6 +1700,10 @@ _CPU_FALLBACK_ENV = {
     "FANTOCH_BENCH_FUZZ_SCHEDULES": "8",
     "FANTOCH_BENCH_COV_CHUNK": "8",
     "FANTOCH_BENCH_COV_CHUNKS": "3",
+    # the per-class steered passes triple the coverage self-check's
+    # schedule count, and the synthetic compaction map shrinks to keep
+    # the host-mesh run's I/O share negligible
+    "FANTOCH_BENCH_COVMAP_BUCKETS": "20000",
     "FANTOCH_BENCH_CKPT_LANES": "64",
     "FANTOCH_BENCH_TRAFFIC_LANES": "64",
     "FANTOCH_BENCH_TRAFFIC_SUBSETS": "1",

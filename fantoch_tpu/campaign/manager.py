@@ -61,6 +61,36 @@ class CampaignError(RuntimeError):
     resume, spec mismatch, unknown kind/protocol) — refused loudly."""
 
 
+def point_class_key(protocol: str, n: int,
+                    fault_class: str = "mixed") -> str:
+    """Journal/lease key of one (protocol, n, fault class) fuzz unit.
+    ``mixed`` is deliberately the bare legacy ``<proto>/n<n>`` key —
+    every pre-split journal entry and lease name IS the mixed class,
+    so legacy farms resume without rewriting a byte. Jax-free here
+    (not mc/coverage.py, which re-exports it) so the fleet merge can
+    enumerate farm units without importing the engine."""
+    base = f"{protocol}/n{int(n)}"
+    if fault_class == "mixed":
+        return base
+    return f"{base}/{fault_class}"
+
+
+def parse_point_key(key: str) -> Tuple[str, int, str]:
+    """Inverse of :func:`point_class_key`:
+    ``(protocol, n, fault_class)`` — 2-segment keys are the legacy
+    ``mixed`` class."""
+    parts = key.split("/")
+    if len(parts) == 2:
+        proto, rest, cls = parts[0], parts[1], "mixed"
+    elif len(parts) == 3:
+        proto, rest, cls = parts
+    else:
+        raise ValueError(f"malformed fuzz point key {key!r}")
+    if not rest.startswith("n") or not rest[1:].isdigit():
+        raise ValueError(f"malformed fuzz point key {key!r}")
+    return proto, int(rest[1:]), cls
+
+
 # ----------------------------------------------------------------------
 # campaign specs (JSON round-trip, value equality)
 # ----------------------------------------------------------------------
@@ -174,6 +204,23 @@ class FuzzCampaign:
     # starvation floor: every incomplete point is kept within this
     # share of the most-fuzzed point's schedule count
     min_share: float = 0.25
+    # fault-class shards (registry.FAULT_CLASSES): each (protocol, n)
+    # point splits into one independently journaled/leasable unit per
+    # class, with its own PCG64 streams and coverage signature
+    # (mc/fuzz.py class_spec). ("mixed",) is the legacy single-unit
+    # full envelope — pre-split journals resume byte-compatibly.
+    classes: Tuple[str, ...] = ("mixed",)
+    # plateau retirement (docs/MC.md "Standing farm"): retire a point
+    # after this many CONSECUTIVE chunks that opened zero new coverage
+    # buckets, recycling its budget into the live grid via a journaled
+    # retirement entry. 0 = never retire (the legacy posture);
+    # requires coverage.
+    retire_after: int = 0
+    # persist each point's coverage map as a compacted binary covmap
+    # file (mc/covmap.py) instead of inline journal JSON — the farm
+    # format for maps too large to rewrite per chunk. Requires
+    # coverage.
+    binary_maps: bool = False
 
     kind = "fuzz"
 
@@ -253,6 +300,36 @@ def campaign_from_json(obj: dict):
             )
         if spec.scan_window is not None and int(spec.scan_window) < 1:
             raise CampaignError("scan_window must be >= 1 when set")
+    if kind == "fuzz":
+        from ..registry import FAULT_CLASSES
+
+        bad_c = [c for c in spec.classes if c not in FAULT_CLASSES]
+        if bad_c:
+            raise CampaignError(
+                f"unknown fault class(es) {bad_c}; choose from "
+                f"{','.join(FAULT_CLASSES)}"
+            )
+        if not spec.classes:
+            raise CampaignError(
+                "the fault-class axis needs at least one class "
+                '(use ["mixed"] for the legacy full envelope)'
+            )
+        if len(set(spec.classes)) != len(spec.classes):
+            raise CampaignError(
+                "duplicate fault classes in the campaign grid"
+            )
+        if int(spec.retire_after) < 0:
+            raise CampaignError("retire_after must be >= 0")
+        if spec.retire_after and not spec.coverage:
+            raise CampaignError(
+                "retire_after reads the coverage discovery signal; "
+                "set coverage=true (or retire_after=0)"
+            )
+        if spec.binary_maps and not spec.coverage:
+            raise CampaignError(
+                "binary_maps persists coverage maps; set "
+                "coverage=true (or binary_maps=false)"
+            )
     return spec
 
 
@@ -588,10 +665,11 @@ def _run_sweep_campaign(path: str, spec: SweepCampaign, deadline,
 # ----------------------------------------------------------------------
 
 
-def _fuzz_point_spec(spec: FuzzCampaign, proto: str, n: int, chunk: int):
-    from ..mc.fuzz import FuzzSpec
+def _fuzz_point_spec(spec: FuzzCampaign, proto: str, n: int, chunk: int,
+                     fault_class: str = "mixed"):
+    from ..mc.fuzz import FuzzSpec, class_spec
 
-    return FuzzSpec(
+    base = FuzzSpec(
         protocol=proto,
         n=n,
         f=spec.f,
@@ -607,26 +685,61 @@ def _fuzz_point_spec(spec: FuzzCampaign, proto: str, n: int, chunk: int):
         aws=spec.aws,
         inject_bug=spec.inject_bug,
     )
+    return class_spec(base, fault_class)
 
 
 # journal-entry keys that never reach summaries: internal generator
-# positions and the raw seed pool (the coverage map itself DOES reach
-# the summary — it is the merged, worker-count-invariant artifact the
-# fleet and resume byte-identity contracts pin)
-_FUZZ_INTERNAL_KEYS = ("kind", "point", "rng_state", "mrng_state", "seeds")
+# positions, the raw seed pool and its digest anchors (the coverage
+# map itself DOES reach the summary — it is the merged,
+# worker-count-invariant artifact the fleet and resume byte-identity
+# contracts pin)
+_FUZZ_INTERNAL_KEYS = (
+    "kind", "point", "rng_state", "mrng_state", "seeds", "seed_digests",
+)
+
+
+def _restore_binary_map(path: str, key: str, prev: dict, pspec) -> dict:
+    """Binary-maps mode: the journal entry carries only the map's
+    SHA-256; rehydrate the steering state by loading the journaled
+    generation's covmap file and refusing — by name — a file whose
+    bytes do not hash to what the journal recorded (a torn farm
+    directory, or the documented stale-worker race one generation past
+    the compaction window)."""
+    import hashlib
+
+    from ..mc import covmap as cvm
+    from ..mc.coverage import point_signature
+
+    cmap = cvm.load_point_map(
+        path, key, int(prev["tried"]),
+        signature=point_signature(pspec),
+    )
+    want = prev.get("cov_sha256")
+    got = hashlib.sha256(cvm.covmap_bytes(cmap)).hexdigest()
+    if want is not None and got != want:
+        raise cvm.CovmapError(
+            f"covmap for {key} at tried={prev['tried']} hashes to "
+            f"{got[:12]}… but the journal recorded {want[:12]}… — "
+            "the map file and journal disagree; refusing to continue "
+            "from inconsistent coverage"
+        )
+    stored = dict(prev)
+    stored["coverage"] = cmap.to_json()
+    return stored
 
 
 def _fuzz_chunk(spec: FuzzCampaign, proto: str, n: int,
-                prev: Optional[dict], planet, path: str) -> dict:
-    """Draw, run and fold ONE chunk of (proto, n) into a new cumulative
-    journal entry, continuing exactly from ``prev`` (None = fresh
-    point). This is the single shared chunk engine of the
-    single-process manager AND every fleet worker (fleet/worker.py):
-    chunk k's plans depend only on the journaled state after chunk
-    k−1 — the root generator position, and in coverage mode the map,
-    seed pool and mutator position — so the plan stream is identical
-    whichever process draws it, and chunked ≡ one-shot stays true
-    across SIGKILL and worker handoffs."""
+                prev: Optional[dict], planet, path: str,
+                fault_class: str = "mixed") -> dict:
+    """Draw, run and fold ONE chunk of (proto, n, fault class) into a
+    new cumulative journal entry, continuing exactly from ``prev``
+    (None = fresh point). This is the single shared chunk engine of
+    the single-process manager AND every fleet worker
+    (fleet/worker.py): chunk k's plans depend only on the journaled
+    state after chunk k−1 — the root generator position, and in
+    coverage mode the map, seed pool and mutator position — so the
+    plan stream is identical whichever process draws it, and chunked
+    ≡ one-shot stays true across SIGKILL and worker handoffs."""
     from ..mc.fuzz import (
         draw_plans,
         plan_rng,
@@ -637,10 +750,10 @@ def _fuzz_chunk(spec: FuzzCampaign, proto: str, n: int,
         run_fuzz_point,
     )
 
-    key = f"{proto}/n{n}"
+    key = point_class_key(proto, n, fault_class)
     tried = int(prev["tried"]) if prev else 0
     size = min(spec.chunk, spec.schedules - tried)
-    pspec = _fuzz_point_spec(spec, proto, n, size)
+    pspec = _fuzz_point_spec(spec, proto, n, size, fault_class)
     config = point_config(pspec)
     dev = point_protocol(pspec)
     # the journaled generator position — restored, never recomputed
@@ -649,18 +762,25 @@ def _fuzz_chunk(spec: FuzzCampaign, proto: str, n: int,
     rng = (
         restore_rng(prev["rng_state"])
         if prev
-        else plan_rng(_fuzz_point_spec(spec, proto, n, spec.chunk))
+        else plan_rng(
+            _fuzz_point_spec(spec, proto, n, spec.chunk, fault_class)
+        )
     )
     cmap = pool = mrng = None
     if spec.coverage:
         from ..mc import coverage as cov
 
+        stored = prev
+        if prev and spec.binary_maps and "coverage" not in prev:
+            # write-ahead binary map: rehydrate from the covmap file
+            # the journaled generation references (hash-checked)
+            stored = _restore_binary_map(path, key, prev, pspec)
         # the map/pool/mutator-position travel the journal like the
         # root PRNG position; a map journaled under a different point
         # signature refuses by name (CoverageMismatchError)
-        cmap, pool, mrng = cov.restore_steering(pspec, prev)
+        cmap, pool, mrng = cov.restore_steering(pspec, stored)
         plans = cov.draw_steered(
-            pspec, config, dev, size, rng, mrng, pool
+            pspec, config, dev, size, rng, mrng, pool, cmap=cmap
         )
     else:
         plans = draw_plans(pspec, config, dev, count=size, rng=rng)
@@ -721,24 +841,122 @@ def _fuzz_chunk(spec: FuzzCampaign, proto: str, n: int,
         fresh = fold_chunk(cmap, pool, res.digests, plans)
         recent = list(prev.get("cov_recent", []) if prev else [])
         recent.append([size, len(fresh)])
-        entry["coverage"] = cmap.to_json()
+        if spec.binary_maps:
+            # write-ahead: the map lands durably (atomic, versioned)
+            # BEFORE the journal entry referencing it — a kill in
+            # between leaves an orphan covmap the deterministic rerun
+            # overwrites with identical bytes
+            import hashlib
+
+            from ..mc import covmap as cvm
+
+            cvm.save_point_map(path, key, tried, cmap)
+            entry["cov_sha256"] = hashlib.sha256(
+                cvm.covmap_bytes(cmap)
+            ).hexdigest()
+            # compaction cadence: keep this generation + its
+            # predecessor; everything older is re-derivable from the
+            # journal and no live reader references it
+            cvm.compact_point_maps(path, key, keep=2)
+        else:
+            entry["coverage"] = cmap.to_json()
         entry["seeds"] = pool.to_json()
+        entry["seed_digests"] = pool.digests_json()
         entry["mrng_state"] = rng_state(mrng)
         entry["cov_recent"] = recent[-max(int(spec.steer_window), 1):]
         entry["cov_buckets"] = cmap.bucket_count
+        # consecutive chunks with zero new buckets — the plateau
+        # signal retire_after reads; pure function of journaled
+        # history, so resumes and fleet workers agree on dryness
+        entry["cov_dry"] = (
+            0 if fresh
+            else int(prev.get("cov_dry", 0) if prev else 0) + 1
+        )
     return entry
 
 
+def fuzz_point_keys(spec: FuzzCampaign) -> List[str]:
+    """The canonical (protocol × n × fault class) unit enumeration —
+    shared by the manager loop, every fleet worker and the merge, so
+    ranking/lease/summary orders agree everywhere."""
+    return [
+        point_class_key(p, n, c)
+        for p in spec.protocols
+        for n in spec.ns
+        for c in spec.classes
+    ]
+
+
+def fuzz_retired(spec: FuzzCampaign, entries) -> List[str]:
+    """The journaled retirement set, in first-retirement order (the
+    order is cosmetic — membership is what ranking consumes).
+    Duplicate retirement entries are expected under the fleet: any
+    worker that derives eligibility from the journal may append one,
+    and identical-content duplicates are harmless."""
+    if not int(spec.retire_after):
+        return []
+    out: List[str] = []
+    for e in entries:
+        if e.get("kind") == "retire" and e.get("point") not in out:
+            out.append(e["point"])
+    return out
+
+
+def retire_entry(key: str, entry: dict) -> dict:
+    """The journaled retirement record for one plateaued point —
+    derived purely from that point's own journaled state, so every
+    worker/resume that finds it eligible writes the identical entry."""
+    return {
+        "kind": "retire",
+        "point": key,
+        "tried": int(entry.get("tried", 0)),
+        "cov_dry": int(entry.get("cov_dry", 0)),
+    }
+
+
+def materialize_final_maps(path: str, progress) -> None:
+    """Materialize each finished point's binary map under its canonical
+    (unversioned) name — the file CI `cmp`s across farms — and drop the
+    remaining versioned generations: the journal no longer needs them.
+    Idempotent: an already-materialized final map is sha-verified
+    against the journal instead of rewritten (and a mismatch refuses),
+    so re-summarizing a compacted farm is safe."""
+    import hashlib
+
+    from ..mc import covmap as cvm
+
+    for key in sorted(progress):
+        entry = progress[key]
+        if "cov_sha256" not in entry:
+            continue
+        fpath = cvm.final_map_path(path, key)
+        if os.path.exists(fpath):
+            with open(fpath, "rb") as fh:
+                got = hashlib.sha256(fh.read()).hexdigest()
+            if got != entry["cov_sha256"]:
+                raise cvm.CovmapError(
+                    f"final covmap for {key} hashes to "
+                    f"{got[:12]}… but the journal recorded "
+                    f"{entry['cov_sha256'][:12]}…; refusing"
+                )
+            continue
+        cmap = cvm.load_point_map(path, key, int(entry["tried"]))
+        cvm.save_covmap(fpath, cmap)
+        cvm.compact_point_maps(path, key, keep=0)
+
+
 def _fuzz_summary(path: str, spec: FuzzCampaign, points, progress,
-                  interrupted) -> dict:
+                  interrupted, retired=()) -> dict:
+    keys = fuzz_point_keys(spec)
+    retired = [k for k in sorted(retired)]
     done = interrupted is None and all(
-        int(progress.get(f"{p}/n{n}", {}).get("tried", 0))
-        >= spec.schedules
-        for p, n in points
+        k in retired
+        or int(progress.get(k, {}).get("tried", 0)) >= spec.schedules
+        for k in keys
     )
     summary = {
         "kind": "fuzz",
-        "points_total": len(points),
+        "points_total": len(keys),
         "done": done,
         "interrupted": interrupted,
         "dir": path,
@@ -758,6 +976,12 @@ def _fuzz_summary(path: str, spec: FuzzCampaign, points, progress,
             for key in sorted(progress)
         },
     }
+    if int(spec.retire_after):
+        # present only for retirement-enabled farms, so every legacy
+        # summary's bytes are untouched
+        summary["retired"] = retired
+    if done and spec.binary_maps:
+        materialize_final_maps(path, progress)
     if done:
         # the persisted artifact is dir-invariant (no absolute paths),
         # so a control campaign and a SIGKILLed+resumed one in ANOTHER
@@ -777,11 +1001,19 @@ def _fuzz_summary(path: str, spec: FuzzCampaign, points, progress,
 def _run_fuzz_campaign(path: str, spec: FuzzCampaign, deadline,
                        stop_flag) -> dict:
     planet = _planet(spec.aws)
-    points = [(p, n) for p in spec.protocols for n in spec.ns]
+    points = [
+        (p, n, c)
+        for p in spec.protocols
+        for n in spec.ns
+        for c in spec.classes
+    ]
+    keys = fuzz_point_keys(spec)
     progress: Dict[str, dict] = {}
-    for entry in _read_journal(path):
+    journal = _read_journal(path)
+    for entry in journal:
         if entry.get("kind") == "fuzz":
             progress[entry["point"]] = entry  # latest line wins
+    retired = set(fuzz_retired(spec, journal))
 
     interrupted = None
     progressed = 0
@@ -796,6 +1028,24 @@ def _run_fuzz_campaign(path: str, spec: FuzzCampaign, deadline,
         ):
             interrupted = "budget exhausted"
             break
+        # plateau retirement is self-healing: eligibility is derived
+        # from each point's own journaled dryness counter at every
+        # loop top, so a session killed between a dry chunk's append
+        # and its retirement entry retires the identical point at the
+        # identical chunk on resume (and a fleet peer may have done it
+        # already — the duplicate entry is identical content)
+        if int(spec.retire_after):
+            for k in keys:
+                e = progress.get(k)
+                if (
+                    e is not None
+                    and k not in retired
+                    and int(e.get("tried", 0)) < spec.schedules
+                    and int(e.get("cov_dry", 0))
+                    >= int(spec.retire_after)
+                ):
+                    _append_journal(path, retire_entry(k, e))
+                    retired.add(k)
         # next chunk's point: the coverage allocator's pick (recent
         # bucket-discovery rate with the starvation floor), or — blind
         # — the first incomplete point of the canonical enumeration,
@@ -805,27 +1055,30 @@ def _run_fuzz_campaign(path: str, spec: FuzzCampaign, deadline,
 
             order = rank_points(
                 points, progress, spec.schedules,
-                min_share=spec.min_share,
+                min_share=spec.min_share, retired=retired,
             )
         else:
             order = [
-                f"{p}/n{n}"
-                for p, n in points
-                if int(progress.get(f"{p}/n{n}", {}).get("tried", 0))
+                k
+                for k in keys
+                if int(progress.get(k, {}).get("tried", 0))
                 < spec.schedules
             ]
         if not order:
             break
         key = order[0]
-        proto, n = key.rsplit("/n", 1)
+        proto, n, cls = parse_point_key(key)
         entry = _fuzz_chunk(
-            spec, proto, int(n), progress.get(key), planet, path
+            spec, proto, n, progress.get(key), planet, path,
+            fault_class=cls,
         )
         _append_journal(path, entry)
         progress[key] = entry
         progressed += 1
 
-    return _fuzz_summary(path, spec, points, progress, interrupted)
+    return _fuzz_summary(
+        path, spec, points, progress, interrupted, retired=retired
+    )
 
 
 def _merge_counts(a: dict, b: dict) -> dict:

@@ -404,9 +404,106 @@ def cmd_mc(args) -> None:
     generator positions persist in the directory, so every session
     mutates the seeds the previous ones discovered instead of
     restarting from blind sampling — a stored map whose point
-    signature disagrees is refused (exit 2), like checkpoints."""
+    signature disagrees is refused (exit 2), like checkpoints.
+    ``--farm DIR`` runs the standing fuzz farm instead (docs/MC.md
+    "Standing farm"): a durable coverage campaign in DIR with
+    fault-class-sharded points (--classes), frontier-weighted
+    mutation, plateau retirement (--retire-after) and compact binary
+    coverage maps; re-invoking the same command resumes it. Exits 0
+    drained, 75 interrupted, 2 refused. ``--migrate-covmaps DIR``
+    converts a --coverage-dir's JSON point states to the binary
+    format, proving each conversion lossless before returning."""
     import os
     import time
+
+    if args.migrate_covmaps:
+        from .mc import coverage as cov
+        from .mc import covmap as cvm
+
+        try:
+            written = cvm.migrate_point_states(args.migrate_covmaps)
+        except cov.CoverageError as e:
+            # refusal, not recovery: foreign digest versions and
+            # round-trip mismatches are named, never skipped silently
+            print(
+                f"mc refused: {type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
+            raise SystemExit(2)
+        print(json.dumps({"migrated": written, "count": len(written)}))
+        return
+
+    if args.farm:
+        protocols = args.protocols.split(",")
+        unknown = [p for p in protocols if p not in ENGINE_PROTOCOLS]
+        if unknown:
+            raise SystemExit(
+                f"unknown protocol(s) {unknown}; choose from "
+                f"{','.join(ENGINE_PROTOCOLS)}"
+            )
+        if args.inject_bug and protocols != ["tempo"]:
+            raise SystemExit(
+                "--inject-bug is a Tempo-specific self-check; pass "
+                "--protocols tempo"
+            )
+        from .campaign import (
+            CampaignError,
+            campaign_from_json,
+            run_campaign,
+        )
+        from .engine.checkpoint import CheckpointError
+        from .parallel.aot import AotMismatchError
+
+        grid = {
+            "kind": "fuzz",
+            "protocols": protocols,
+            "ns": list(args.ns),
+            "f": args.f,
+            "conflict": args.conflict,
+            "pool_size": args.pool_size,
+            "clients_per_region": args.clients_per_region,
+            "commands_per_client": args.commands,
+            "schedules": args.schedules,
+            "chunk": args.chunk,
+            "seed": args.seed,
+            "jitter_max": args.jitter_max,
+            "crash_share": args.crash_share,
+            "drop_share": args.drop_share,
+            "confirm": not args.no_confirm,
+            "max_confirm": args.max_confirm,
+            "shrink_budget": args.shrink_budget,
+            "strict_missing": bool(args.strict_missing),
+            "inject_bug": bool(args.inject_bug),
+            "aws": bool(args.aws),
+            # the farm posture: coverage-steered, class-sharded,
+            # binary-mapped; an identical re-invocation resumes the
+            # stored campaign, a drifted one is refused (exit 2)
+            "coverage": True,
+            "binary_maps": True,
+            "classes": [c for c in args.classes.split(",") if c],
+            "retire_after": args.retire_after,
+        }
+        try:
+            spec = campaign_from_json(grid)
+            summary = run_campaign(
+                args.farm, spec, budget_s=args.budget_s
+            )
+        except (CheckpointError, CampaignError,
+                AotMismatchError) as e:
+            print(
+                f"mc refused: {type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
+            raise SystemExit(2)
+        print(json.dumps(summary))
+        if not summary["done"]:
+            print(
+                f"farm interrupted ({summary['interrupted']}); state "
+                "is journaled — re-run the same command to continue",
+                file=sys.stderr,
+            )
+            raise SystemExit(EXIT_INTERRUPTED)
+        return
 
     from .mc.fuzz import (
         FuzzSpec,
@@ -492,7 +589,8 @@ def cmd_mc(args) -> None:
             )
             lane_offset = int(stored["tried"]) if stored else 0
             plans = cov.draw_steered(
-                spec, config, dev, spec.schedules, rng, mrng, pool
+                spec, config, dev, spec.schedules, rng, mrng, pool,
+                cmap=cmap,
             )
             cov_state = (cov, cmap, pool, rng, mrng)
         res = run_fuzz_point(
@@ -521,6 +619,10 @@ def cmd_mc(args) -> None:
                     "mrng_state": rng_state(mrng),
                     "coverage": cmap.to_json(),
                     "seeds": pool.to_json(),
+                    # per-seed digest anchors for the frontier-weighted
+                    # draw; stored states without them (older sessions)
+                    # restore with uniform weights
+                    "seed_digests": pool.digests_json(),
                 },
             )
             point["coverage_buckets"] = cmap.bucket_count
@@ -702,6 +804,42 @@ def cmd_fleet(args) -> None:
     if not (args.workers or args.worker_id or args.merge):
         raise SystemExit("fleet needs --workers N, --worker-id ID, "
                          "and/or --merge")
+    if args.farm:
+        # the farm contract is asserted up front, against --grid or
+        # the stored campaign.json, so no worker claims a unit of a
+        # grid that silently lacks the farm posture
+        import os as _os
+
+        from .campaign.manager import _CAMPAIGN
+
+        fspec = spec
+        if fspec is None:
+            cpath = _os.path.join(args.dir, _CAMPAIGN)
+            if _os.path.exists(cpath):
+                try:
+                    fspec = campaign_from_json(json.load(open(cpath)))
+                except (ValueError, CampaignError) as e:
+                    print(
+                        f"fleet refused: {type(e).__name__}: {e}",
+                        file=sys.stderr,
+                    )
+                    raise SystemExit(2)
+        shape = (
+            fspec is not None
+            and getattr(fspec, "kind", None) == "fuzz"
+            and bool(getattr(fspec, "coverage", False))
+            and bool(getattr(fspec, "binary_maps", False))
+        )
+        if not shape:
+            print(
+                "fleet refused: --farm needs a standing-farm fuzz "
+                "grid (coverage + binary_maps, docs/MC.md "
+                '"Standing farm"); got '
+                + ("no campaign spec" if fspec is None
+                   else f"kind={getattr(fspec, 'kind', None)!r}"),
+                file=sys.stderr,
+            )
+            raise SystemExit(2)
 
     done = True
     try:
@@ -1487,6 +1625,40 @@ def main(argv=None) -> None:
                     help="directory for repro artifacts")
     mc.add_argument("--replay", default=None,
                     help="re-execute a repro artifact (host oracle)")
+    mc.add_argument(
+        "--farm", default=None, metavar="DIR",
+        help="run the standing fuzz farm in DIR instead of a one-shot "
+             "grid (docs/MC.md \"Standing farm\"): a durable "
+             "coverage campaign with fault-class-sharded points "
+             "(--classes), frontier-weighted mutation, plateau "
+             "retirement (--retire-after) and compact binary coverage "
+             "maps; re-running the identical command resumes, a "
+             "drifted one is refused (exit 2); exits 0 drained, 75 "
+             "interrupted",
+    )
+    mc.add_argument("--chunk", type=int, default=128,
+                    help="farm mode: schedules per journaled chunk")
+    mc.add_argument(
+        "--classes", default="crash,drop,jitter,mixed",
+        help="farm mode: comma-separated fault classes "
+        "(registry.FAULT_CLASSES) to shard each (protocol, n) point "
+        "into — each class is an independently leasable/retirable "
+        "unit with its own PRNG streams and coverage map; 'mixed' "
+        "alone reproduces the legacy unsharded units",
+    )
+    mc.add_argument(
+        "--retire-after", type=int, default=0,
+        help="farm mode: retire a point after this many consecutive "
+        "chunks with zero new coverage buckets (its remaining budget "
+        "recycles into the live grid); 0 = never retire",
+    )
+    mc.add_argument(
+        "--migrate-covmaps", default=None, metavar="DIR",
+        help="convert a --coverage-dir's JSON point states to the "
+        "binary covmap format (mc/covmap.py), proving each "
+        "conversion lossless by round-trip before returning; "
+        "original JSON files are left untouched",
+    )
     mc.set_defaults(fn=cmd_mc)
 
     ca = sub.add_parser(
@@ -1573,6 +1745,13 @@ def main(argv=None) -> None:
                     help="test hook: interrupt each claimed sweep unit "
                     "after N segments (checkpoint durable, lease "
                     "released — the unit returns to the pool)")
+    fl.add_argument(
+        "--farm", action="store_true",
+        help="assert the campaign is a standing fuzz farm (a fuzz "
+        "grid with coverage + binary_maps — docs/MC.md \"Standing "
+        "farm\"); a non-farm spec is refused (exit 2) before any "
+        "worker claims a unit",
+    )
     fl.set_defaults(fn=cmd_fleet)
 
     ln = sub.add_parser(

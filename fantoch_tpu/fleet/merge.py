@@ -78,20 +78,32 @@ def _merge_sweep(path: str, spec) -> dict:
 
 
 def _merge_fuzz(path: str, spec) -> dict:
-    from ..campaign.manager import _SUMMARY, _atomic_write
+    from ..campaign.manager import (
+        _SUMMARY,
+        _atomic_write,
+        fuzz_point_keys,
+        fuzz_retired,
+        point_class_key,
+    )
 
     points = fuzz_points(spec)
-    progress = fuzz_point_progress(read_all_journals(path))
+    keys = fuzz_point_keys(spec)
+    assert keys == [point_class_key(*t) for t in points]
+    entries = read_all_journals(path)
+    progress = fuzz_point_progress(entries)
+    # a retired point counts as settled: its budget was recycled by
+    # design, so the merge must not report it as missing work
+    retired = set(fuzz_retired(spec, entries))
     missing = [
-        f"{p}/n{n}"
-        for p, n in points
-        if int(progress.get(f"{p}/n{n}", {}).get("tried", 0))
-        < spec.schedules
+        key
+        for key in keys
+        if key not in retired
+        and int(progress.get(key, {}).get("tried", 0)) < spec.schedules
     ]
     summary = {
         "kind": "fuzz",
-        "points_total": len(points),
-        "points_done": len(points) - len(missing),
+        "points_total": len(keys),
+        "points_done": len(keys) - len(missing),
         "merged": not missing,
         "dir": path,
     }
@@ -113,8 +125,9 @@ def _merge_fuzz(path: str, spec) -> dict:
         # chunk-count × chunk-size, which would over-count a final
         # chunk smaller than `chunk`
         "schedules_tried": sum(
-            int(progress[f"{p}/n{n}"].get("tried", 0))
-            for p, n in points
+            int(progress[key].get("tried", 0))
+            for key in keys
+            if key in progress
         ),
         "points": {
             key: {
@@ -122,9 +135,24 @@ def _merge_fuzz(path: str, spec) -> dict:
                 for k, v in progress[key].items()
                 if k not in _FUZZ_INTERNAL_KEYS
             }
-            for key in (f"{p}/n{n}" for p, n in points)
+            for key in keys
+            if key in progress
         },
     }
+    if int(getattr(spec, "retire_after", 0)):
+        # present only for retirement-enabled farms (mirrors the
+        # single-process summary's conditional), so every legacy
+        # merged summary's bytes are untouched
+        merged["retired"] = sorted(retired)
+    if getattr(spec, "binary_maps", False):
+        # binary-map farms: settle each point's final `.covmap` under
+        # its canonical name before the summary references it — the
+        # same idempotent, sha-verified materialization the
+        # single-process manager runs, so a fleet merge and a solo
+        # campaign leave byte-identical map files behind
+        from ..campaign.manager import materialize_final_maps
+
+        materialize_final_maps(path, progress)
     from ..engine.checkpoint import canonical_json
 
     _atomic_write(

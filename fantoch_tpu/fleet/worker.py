@@ -55,6 +55,34 @@ def worker_scan_order(keys: Sequence[str], worker_id: str) -> List[str]:
     return list(keys[off:]) + list(keys[:off])
 
 
+# lease-claim backoff bounds (claim_backoff_s): base doubles per
+# consecutive miss up to this cap — long enough to let a holder finish
+# a chunk, short enough that a freed unit is picked up promptly
+_BACKOFF_BASE_S = 0.01
+_BACKOFF_CAP_S = 0.25
+
+
+def claim_backoff_s(worker_id: str, misses: int) -> float:
+    """Bounded deterministic backoff after ``misses`` consecutive lost
+    lease claims: exponential in the miss streak with a worker-id-keyed
+    phase (crc32 — no wall clock, no ``random.*``; GL402 keeps ambient
+    nondeterminism out of journaled artifacts, and this function's
+    output only ever feeds ``time.sleep``) so contending workers
+    desynchronize instead of re-colliding in lockstep. Pure function
+    of (worker_id, misses): the same worker backs off the same way in
+    every replay."""
+    if misses <= 0:
+        return 0.0
+    step = min(int(misses), 5)
+    phase = (
+        zlib.crc32(f"{worker_id}:{misses}".encode("utf-8")) % 1024
+    ) / 1024.0
+    return min(
+        _BACKOFF_BASE_S * (1 << step) * (0.5 + 0.5 * phase),
+        _BACKOFF_CAP_S,
+    )
+
+
 def worker_journal_path(path: str, worker: str) -> str:
     return os.path.join(path, JOURNALS_DIR, f"{worker}.jsonl")
 
@@ -132,8 +160,18 @@ def fuzz_point_progress(entries: List[dict]) -> Dict[str, dict]:
     return progress
 
 
-def fuzz_points(spec) -> List[Tuple[str, int]]:
-    return [(p, n) for p in spec.protocols for n in spec.ns]
+def fuzz_points(spec) -> List[Tuple[str, int, str]]:
+    """The canonical (protocol, n, fault class) unit triples — the
+    fleet twin of ``campaign.manager.fuzz_point_keys`` (legacy specs
+    carry ``classes=("mixed",)``, collapsing to the pre-split pairs
+    under the legacy keys)."""
+    classes = tuple(getattr(spec, "classes", ("mixed",)))
+    return [
+        (p, n, c)
+        for p in spec.protocols
+        for n in spec.ns
+        for c in classes
+    ]
 
 
 def _run_sweep_units(path, spec, worker_id, deadline, stop_flag,
@@ -170,6 +208,8 @@ def _run_sweep_units(path, spec, worker_id, deadline, stop_flag,
     interrupted = None
     completed = 0
     skipped_held = 0
+    claim_attempts = 0
+    misses = 0
     # repeated passes over the grid: a unit leased elsewhere on pass k
     # may be journaled, abandoned (checkpointed + released), or
     # expired by pass k+1 — the worker keeps sweeping as long as it
@@ -205,10 +245,21 @@ def _run_sweep_units(path, spec, worker_id, deadline, stop_flag,
                 remaining = max(remaining, 0.0)
             if key in done:
                 continue
+            claim_attempts += 1
             lease = claim_unit(path, key, worker_id, ttl_s)
             if lease is None:
+                # a lost claim used to retry the next unit immediately
+                # — a hot spin when most of the grid is held. Back off
+                # (bounded, worker-keyed, deterministic) and spend the
+                # bought time refreshing the done-set: units whose
+                # holders finished during the backoff are skipped
+                # without burning another claim on them
                 pass_held += 1
+                misses += 1
+                time.sleep(claim_backoff_s(worker_id, misses))
+                done = sweep_done_units(read_all_journals(path))
                 continue
+            misses = 0
             try:
                 # the unit may have been journaled between the pass
                 # scan and the claim (its previous holder finishing):
@@ -280,28 +331,81 @@ def _run_sweep_units(path, spec, worker_id, deadline, stop_flag,
         "units_done": sum(1 for k, *_ in batches if k in done),
         "units_completed_here": completed,
         "units_held_elsewhere": skipped_held,
+        "claim_attempts": claim_attempts,
         "done": all(k in done for k, *_ in batches),
         "interrupted": interrupted,
         "dir": path,
     }
 
 
+def _fuzz_retired_set(spec, entries) -> set:
+    from ..campaign.manager import fuzz_retired
+
+    return set(fuzz_retired(spec, entries))
+
+
+def _heal_retirements(path, spec, worker_id, progress, retired) -> None:
+    """Append any retirement entries the journaled dryness counters
+    already imply (campaign.manager.retire_entry): self-healing like
+    the manager loop — a worker killed between a dry chunk's append
+    and its retirement entry leaves the next reader to write the
+    identical entry, and duplicates across worker journals are
+    identical content by construction."""
+    from ..campaign.manager import point_class_key, retire_entry
+
+    if not int(getattr(spec, "retire_after", 0)):
+        return
+    for proto, n, cls in fuzz_points(spec):
+        key = point_class_key(proto, n, cls)
+        e = progress.get(key)
+        if (
+            e is not None
+            and key not in retired
+            and int(e.get("tried", 0)) < spec.schedules
+            and int(e.get("cov_dry", 0)) >= int(spec.retire_after)
+        ):
+            append_worker_journal(
+                path, worker_id, retire_entry(key, e)
+            )
+            retired.add(key)
+
+
 def _run_fuzz_units(path, spec, worker_id, deadline, stop_flag, ttl_s,
                     stop_after_units):
-    from ..campaign.manager import _fuzz_chunk, _planet
+    from ..campaign.manager import (
+        _fuzz_chunk,
+        _planet,
+        point_class_key,
+    )
 
     planet = _planet(spec.aws)
     points = fuzz_points(spec)
-    keys = [f"{p}/n{n}" for p, n in points]
+    keys = [point_class_key(p, n, c) for p, n, c in points]
     steered = bool(spec.coverage)
     interrupted = None
     chunks_done = 0
     completed_points = 0
+    claim_attempts = 0
+    misses = 0
+
+    def settled(progress, retired):
+        # a point is settled once fully fuzzed OR retired — retired
+        # budget recycles into the live grid instead of blocking done
+        return [
+            k in retired
+            or int(progress.get(k, {}).get("tried", 0))
+            >= spec.schedules
+            for k in keys
+        ]
+
     # the same pass discipline as the sweep loop: keep sweeping while
     # progressing, exit (not block) once a pass advances nothing
     while True:
         pass_chunks = chunks_done
-        progress = fuzz_point_progress(read_all_journals(path))
+        journal = read_all_journals(path)
+        progress = fuzz_point_progress(journal)
+        retired = _fuzz_retired_set(spec, journal)
+        _heal_retirements(path, spec, worker_id, progress, retired)
         if steered:
             # fleet-steered budgets: every worker ranks the SAME
             # union-of-journals state (mc/coverage.py rank_points —
@@ -313,7 +417,7 @@ def _run_fuzz_units(path, spec, worker_id, deadline, stop_flag, ttl_s,
 
             scan = rank_points(
                 points, progress, spec.schedules,
-                min_share=spec.min_share,
+                min_share=spec.min_share, retired=retired,
             )
         else:
             # blind mode: the canonical enumeration, rotated per
@@ -335,19 +439,27 @@ def _run_fuzz_units(path, spec, worker_id, deadline, stop_flag, ttl_s,
             ):
                 interrupted = "unit-limit"
                 break
-            proto, n = key.rsplit("/n", 1)
+            proto, n, cls = _parse_key(key)
+            claim_attempts += 1
             lease = claim_unit(path, key, worker_id, ttl_s)
             if lease is None:
+                # bounded deterministic backoff instead of the old
+                # immediate retry on the next ranked point — see
+                # claim_backoff_s; nothing journaled depends on it
+                misses += 1
+                time.sleep(claim_backoff_s(worker_id, misses))
                 continue
+            misses = 0
             try:
                 # re-read under the lease: the previous holder may
-                # have advanced (or finished) the point before
+                # have advanced (or finished/retired) the point before
                 # releasing — the journaled cumulative state (root +
                 # mutator generator positions, coverage map, seed
                 # pool) crosses workers through the journals
-                prev = fuzz_point_progress(
-                    read_all_journals(path)
-                ).get(key)
+                journal = read_all_journals(path)
+                prev = fuzz_point_progress(journal).get(key)
+                if key in _fuzz_retired_set(spec, journal):
+                    continue
                 tried = int(prev["tried"]) if prev else 0
                 if tried >= spec.schedules:
                     completed_points += 1
@@ -365,12 +477,22 @@ def _run_fuzz_units(path, spec, worker_id, deadline, stop_flag, ttl_s,
                             interrupted = "budget exhausted"
                             break
                         entry = _fuzz_chunk(
-                            spec, proto, int(n), prev, planet, path
+                            spec, proto, n, prev, planet, path,
+                            fault_class=cls,
                         )
                         append_worker_journal(path, worker_id, entry)
                         prev = entry
                         tried = int(entry["tried"])
                         chunks_done += 1
+                        if int(getattr(spec, "retire_after", 0)) and (
+                            int(entry.get("cov_dry", 0))
+                            >= int(spec.retire_after)
+                        ):
+                            # plateaued under our own lease: journal
+                            # the retirement immediately so the next
+                            # ranking (ours or any peer's) recycles
+                            # this point's budget
+                            break
                         if steered and tried < spec.schedules:
                             # one chunk per claim: re-rank against the
                             # fleet's fresh journals instead of
@@ -383,35 +505,36 @@ def _run_fuzz_units(path, spec, worker_id, deadline, stop_flag, ttl_s,
                 lease.release()
             if steered:
                 break  # re-rank after every claimed chunk
-        progress = fuzz_point_progress(read_all_journals(path))
-        all_done = all(
-            int(progress.get(f"{p}/n{n}", {}).get("tried", 0))
-            >= spec.schedules
-            for p, n in points
-        )
-        if interrupted or all_done or chunks_done == pass_chunks:
+        journal = read_all_journals(path)
+        progress = fuzz_point_progress(journal)
+        retired = _fuzz_retired_set(spec, journal)
+        _heal_retirements(path, spec, worker_id, progress, retired)
+        if interrupted or all(settled(progress, retired)) or (
+            chunks_done == pass_chunks
+        ):
             break
 
-    progress = fuzz_point_progress(read_all_journals(path))
-    done = all(
-        int(progress.get(f"{p}/n{n}", {}).get("tried", 0))
-        >= spec.schedules
-        for p, n in points
-    )
+    journal = read_all_journals(path)
+    progress = fuzz_point_progress(journal)
+    retired = _fuzz_retired_set(spec, journal)
+    state = settled(progress, retired)
     return {
         "kind": "fuzz",
         "worker": worker_id,
         "points_total": len(points),
-        "points_done": sum(
-            1
-            for p, n in points
-            if int(progress.get(f"{p}/n{n}", {}).get("tried", 0))
-            >= spec.schedules
-        ),
-        "done": done,
+        "points_done": sum(1 for s in state if s),
+        "points_retired": len(retired),
+        "claim_attempts": claim_attempts,
+        "done": all(state),
         "interrupted": interrupted,
         "dir": path,
     }
+
+
+def _parse_key(key: str) -> Tuple[str, int, str]:
+    from ..campaign.manager import parse_point_key
+
+    return parse_point_key(key)
 
 
 def run_fleet_worker(

@@ -49,8 +49,16 @@ _COVERAGE_EXPORTS = (
     "SeedPool",
 )
 
+# covmap.py (binary coverage maps, docs/MC.md "Standing farm")
+# re-exports its refusal types lazily for the same reason
+_COVMAP_EXPORTS = (
+    "CovmapError",
+    "CovmapVersionError",
+)
+
 __all__ = [
-    "CheckResult", "ModelChecker", *_FUZZ_EXPORTS, *_COVERAGE_EXPORTS
+    "CheckResult", "ModelChecker", *_FUZZ_EXPORTS,
+    *_COVERAGE_EXPORTS, *_COVMAP_EXPORTS
 ]
 
 
@@ -63,4 +71,8 @@ def __getattr__(name):
         from . import coverage
 
         return getattr(coverage, name)
+    if name in _COVMAP_EXPORTS:
+        from . import covmap
+
+        return getattr(covmap, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

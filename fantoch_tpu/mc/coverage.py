@@ -50,7 +50,15 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..campaign.manager import CampaignError
+# parse_point_key / point_class_key are re-exported here for
+# coverage-centric callers; their canonical definitions live in
+# campaign/manager.py, which stays jax-free so the fleet merge can
+# enumerate farm units without importing the engine
+from ..campaign.manager import (
+    CampaignError,
+    parse_point_key,
+    point_class_key,
+)
 from ..engine.faults import FaultPlan, unavailable
 from ..engine.monitor import HASH_MUL
 
@@ -107,7 +115,7 @@ def point_signature(spec) -> dict:
     class the pool already carries). Two points with equal signatures
     draw digests AND seeds from the same space; anything else is
     refused by name at load."""
-    return {
+    out = {
         "kind": COVERAGE_KIND,
         "version": COVERAGE_VERSION,
         "hash_mul": HASH_MUL,
@@ -127,6 +135,14 @@ def point_signature(spec) -> dict:
         "aws": bool(spec.aws),
         "inject_bug": bool(spec.inject_bug),
     }
+    # the class key is signature identity too (a crash-class map and a
+    # drop-class map of one point live in different seed/digest
+    # spaces), but "mixed" is elided so every legacy map — written
+    # before the class split existed — keeps matching byte-for-byte
+    cls = getattr(spec, "fault_class", "mixed")
+    if cls != "mixed":
+        out["fault_class"] = str(cls)
+    return out
 
 
 # ----------------------------------------------------------------------
@@ -241,19 +257,34 @@ def plan_to_json(plan: FaultPlan) -> dict:
 class SeedPool:
     """Bounded FIFO of plans that opened new coverage buckets, stored
     as canonical plan JSON (:func:`plan_to_json`) in insertion order;
-    the newest ``MAX_SEEDS`` survive."""
+    the newest ``MAX_SEEDS`` survive. Each seed optionally remembers
+    the digest of the bucket it opened (``digests``, parallel to
+    ``plans``) — the frontier-weighted draw's anchor. The digest list
+    journals as a separate entry key (``seed_digests``) so the pool's
+    own JSON form — and with it every pre-frontier journal — is
+    unchanged; seeds restored from a legacy journal carry ``None`` and
+    weigh like any non-frontier seed."""
 
     plans: List[dict] = field(default_factory=list)
+    digests: List[Optional[int]] = field(default_factory=list)
+
+    def __post_init__(self):
+        # legacy constructors pass plans only
+        while len(self.digests) < len(self.plans):
+            self.digests.append(None)
 
     def __len__(self) -> int:
         return len(self.plans)
 
-    def add(self, plan: FaultPlan) -> None:
+    def add(self, plan: FaultPlan,
+            digest: Optional[int] = None) -> None:
         obj = plan_to_json(plan)
         if obj in self.plans:
             return
         self.plans.append(obj)
+        self.digests.append(None if digest is None else int(digest))
         del self.plans[:-MAX_SEEDS]
+        del self.digests[:-MAX_SEEDS]
 
     def get(self, index: int) -> FaultPlan:
         return FaultPlan.from_json(self.plans[index])
@@ -261,9 +292,21 @@ class SeedPool:
     def to_json(self) -> list:
         return [dict(p) for p in self.plans]
 
+    def digests_json(self) -> list:
+        return [None if d is None else int(d) for d in self.digests]
+
     @staticmethod
-    def from_json(obj: Sequence[dict]) -> "SeedPool":
-        return SeedPool(plans=[dict(p) for p in obj])
+    def from_json(obj: Sequence[dict],
+                  digests: Optional[Sequence[Optional[int]]] = None,
+                  ) -> "SeedPool":
+        plans = [dict(p) for p in obj]
+        if digests is None or len(digests) != len(plans):
+            # legacy journal (or a foreign-length list): no anchors
+            return SeedPool(plans=plans)
+        return SeedPool(
+            plans=plans,
+            digests=[None if d is None else int(d) for d in digests],
+        )
 
 
 def mutation_rng(spec) -> np.random.Generator:
@@ -363,21 +406,77 @@ def mutate_plan(plan: FaultPlan, rng: np.random.Generator, spec,
     return out
 
 
+def _popcount32(a: np.ndarray) -> np.ndarray:
+    """Per-element popcount of a uint32 array (the classic SWAR
+    bit-twiddle) — integer-only, so the frontier metric is exactly
+    reproducible on every host."""
+    a = a.astype(np.uint32, copy=True)
+    a -= (a >> np.uint32(1)) & np.uint32(0x55555555)
+    a = (a & np.uint32(0x33333333)) + (
+        (a >> np.uint32(2)) & np.uint32(0x33333333)
+    )
+    a = (a + (a >> np.uint32(4))) & np.uint32(0x0F0F0F0F)
+    return (a * np.uint32(0x01010101)) >> np.uint32(24)
+
+
+def frontier_weights(pool: SeedPool, cmap: Optional[CoverageMap]
+                     ) -> List[int]:
+    """Integer draw weight per pooled seed: ``1 + Hamming distance``
+    (popcount of the 32-bit xor) from the seed's opening digest to the
+    NEAREST other already-hit bucket. A seed whose bucket sits far
+    from everything else the map has hit is a frontier seed — its
+    interleaving neighborhood is under-explored — and draws
+    proportionally more mutation budget. Seeds without a recorded
+    digest (legacy journals) and every seed when the map holds fewer
+    than two buckets weigh 1, which makes the weighted draw consume
+    the mutator stream *identically* to the historical uniform draw.
+    Pure integer function of journaled pool + map state: every fleet
+    worker and every resume weighs identically."""
+    if not len(pool):
+        return []
+    weights = [1] * len(pool)
+    if cmap is None or cmap.bucket_count < 2:
+        return weights
+    hit = np.fromiter(
+        (d & 0xFFFFFFFF for d in cmap.buckets), dtype=np.uint32,
+        count=cmap.bucket_count,
+    )
+    for i, d in enumerate(pool.digests):
+        if d is None:
+            continue
+        x = _popcount32(hit ^ np.uint32(int(d) & 0xFFFFFFFF))
+        # distance to the nearest OTHER bucket: the seed's own bucket
+        # xors to 0 — mask it out instead of letting it zero the min
+        x = x[x > 0]
+        if x.size:
+            weights[i] = 1 + int(x.min())
+    return weights
+
+
 def draw_steered(spec, config, protocol, count: int,
                  rng: np.random.Generator, mrng: np.random.Generator,
-                 pool: SeedPool) -> List[FaultPlan]:
+                 pool: SeedPool,
+                 cmap: Optional[CoverageMap] = None) -> List[FaultPlan]:
     """The coverage-steered analog of ``draw_plans``: each plan is a
     mutation of a pooled seed with probability :data:`MUTATE_SHARE`
-    (when the pool holds any), else the next root-PRNG draw. Both
+    (when the pool holds any), else the next root-PRNG draw. Seed
+    selection is frontier-weighted (:func:`frontier_weights`) when the
+    caller passes the point's coverage map; without one — or when no
+    seed carries a digest anchor — every weight is 1 and the draw is
+    bit-identical to the historical uniform selection. Both
     generators advance deterministically, so chunked draws against
     journaled positions equal one-shot draws — the same contract the
     blind stream carries."""
     from .fuzz import draw_plans
 
+    weights = frontier_weights(pool, cmap)
+    cum = np.cumsum(weights) if weights else None
+    total = int(cum[-1]) if weights else 0
     plans: List[FaultPlan] = []
     for _ in range(count):
         if len(pool) and mrng.random() < MUTATE_SHARE:
-            seed = pool.get(int(mrng.integers(len(pool))))
+            r = int(mrng.integers(total))
+            seed = pool.get(int(np.searchsorted(cum, r, side="right")))
             plans.append(
                 mutate_plan(seed, mrng, spec, config, protocol)
             )
@@ -405,7 +504,9 @@ def restore_steering(spec, stored: Optional[dict]
     from .fuzz import restore_rng
 
     cmap = CoverageMap.from_json(stored["coverage"], signature=sig)
-    pool = SeedPool.from_json(stored.get("seeds", []))
+    pool = SeedPool.from_json(
+        stored.get("seeds", []), digests=stored.get("seed_digests")
+    )
     mrng = (
         restore_rng(stored["mrng_state"])
         if "mrng_state" in stored
@@ -427,7 +528,7 @@ def fold_chunk(cmap: CoverageMap, pool: SeedPool,
     remaining = set(fresh)
     for i, d in enumerate(digests):
         if int(d) in remaining:
-            pool.add(plans[i])
+            pool.add(plans[i], digest=int(d))
             remaining.discard(int(d))
     return fresh
 
@@ -449,17 +550,28 @@ def discovery_rate(entry: Optional[dict]) -> float:
     return sum(int(b) for _, b in recent) / sched
 
 
-def rank_points(points: Sequence[Tuple[str, int]],
+def rank_points(points: Sequence[Tuple],
                 progress: Dict[str, dict], schedules: int,
-                min_share: float = MIN_SHARE) -> List[str]:
+                min_share: float = MIN_SHARE,
+                retired: Optional[Sequence[str]] = None) -> List[str]:
     """Order a campaign's incomplete points for the next chunk of
     budget: starved points first (never tried, or more than
     ``1 - min_share`` behind the most-fuzzed point — the floor that
     keeps every point progressing), then by recent bucket-discovery
     rate descending; all ties break on the canonical enumeration.
-    Pure function of journaled counters — every resumed session and
-    every fleet worker reading the same journals ranks identically."""
-    keys = [point_key(p, n) for p, n in points]
+    ``points`` holds ``(protocol, n)`` pairs or farm-mode
+    ``(protocol, n, fault_class)`` triples; ``retired`` keys (plateau
+    retirement, docs/MC.md "Standing farm") drop out entirely — their
+    counts no longer feed the starvation floor, so their budget
+    recycles into the live grid. Pure function of journaled counters —
+    every resumed session and every fleet worker reading the same
+    journals ranks identically."""
+    keys = [
+        point_key(*p) if len(p) == 2 else point_class_key(*p)
+        for p in points
+    ]
+    gone = set(retired or ())
+    keys = [k for k in keys if k not in gone]
     tried = {
         k: int((progress.get(k) or {}).get("tried", 0)) for k in keys
     }

@@ -150,6 +150,12 @@ class FuzzSpec:
     aws: bool = False              # AWS planet dataset (else GCP);
                                    # recorded in artifacts for --replay
     inject_bug: bool = False       # swap in the broken Tempo twins
+    # which slice of the fault envelope this point fuzzes
+    # (registry.FAULT_CLASSES; "mixed" = the legacy full envelope).
+    # Derived via class_spec() — never set by hand: the non-mixed
+    # classes also re-salt the seed and zero the excluded shares, and
+    # the coverage signature binds the class so maps never mix.
+    fault_class: str = "mixed"
 
     def planet(self) -> Planet:
         if self.aws:
@@ -188,6 +194,49 @@ def _protocol_pair(spec: FuzzSpec, clients: int):
         )
     return dev_protocol(spec.protocol, sized, keys=keys), \
         ORACLES[spec.protocol]
+
+
+# per-class seed salts: each non-mixed fault class owns independent
+# journaled PCG64 streams (plan + mutation) even though it shares the
+# grid's root seed, so a crash-class point and a drop-class point of
+# the same (protocol, n) never replay correlated perturbation draws.
+# "mixed" is unsalted on purpose: legacy journals resume byte-exactly.
+_CLASS_SEED_SALT = {
+    "mixed": 0x0,
+    "crash": 0x0C7A54,
+    "drop": 0x00D709,
+    "jitter": 0x3177E7,
+}
+
+
+def class_spec(spec: FuzzSpec, fault_class: str) -> FuzzSpec:
+    """Derive the per-fault-class fuzz point from a grid-level spec
+    (docs/MC.md "Standing farm"): ``mixed`` returns the spec unchanged
+    — byte-compatible with every pre-split journal and coverage map —
+    while ``crash``/``drop``/``jitter`` restrict the envelope to that
+    class (the excluded shares go to zero, which also gates
+    ``mutate_plan`` from ever re-introducing the excluded faults) and
+    re-salt the seed for class-independent PCG64 streams."""
+    salt = _CLASS_SEED_SALT.get(fault_class)
+    if salt is None:
+        raise ValueError(
+            f"unknown fault class {fault_class!r}; choose from "
+            "crash, drop, jitter, mixed (registry.FAULT_CLASSES)"
+        )
+    if fault_class == "mixed":
+        return spec
+    kw = {
+        "fault_class": fault_class,
+        "seed": (spec.seed ^ salt) & 0x7FFFFFFF,
+    }
+    if fault_class == "crash":
+        kw["drop_share"] = 0.0
+    elif fault_class == "drop":
+        kw["crash_share"] = 0.0
+    else:  # jitter
+        kw["crash_share"] = 0.0
+        kw["drop_share"] = 0.0
+    return replace(spec, **kw)
 
 
 def plan_rng(spec: FuzzSpec) -> np.random.Generator:

@@ -19,6 +19,14 @@ DEV_PROTOCOLS = ("basic", "fpaxos", "tempo", "atlas", "epaxos", "caesar")
 # the partial-replication twins (engine.protocols.partial_dev_protocol)
 PARTIAL_DEV_PROTOCOLS = ("tempo", "atlas")
 
+# the fault classes a standing fuzz farm shards each (protocol, n)
+# point into (mc/fuzz.py ``class_spec``, docs/MC.md "Standing farm").
+# ``mixed`` is the legacy full envelope — a journal or coverage map
+# written before the class split resumes as ``mixed`` byte-compatibly.
+# Kept jax-free here so campaign grid validation can refuse unknown
+# classes before any backend initializes.
+FAULT_CLASSES = ("crash", "drop", "jitter", "mixed")
+
 # ----------------------------------------------------------------------
 # AST-lint scan sets (lint/rules.py GL101-GL104, lint/transfer.py
 # GL301, lint/alias.py GL302). Canonical here — jax-free, next to the
@@ -48,6 +56,7 @@ TRACED_SCAN_PATHS = (
     "fantoch_tpu/parallel",
     "fantoch_tpu/fleet",
     "fantoch_tpu/mc/coverage.py",
+    "fantoch_tpu/mc/covmap.py",
 )
 
 # the host orchestration layers whose device<->host traffic the GL301

@@ -408,6 +408,334 @@ def test_steered_fleet_two_workers_merge_equals_solo(tmp_path):
 
 
 # ----------------------------------------------------------------------
+# the standing farm (docs/MC.md "Standing farm"): fault-class shards,
+# frontier weighting, plateau retirement, binary coverage maps
+# ----------------------------------------------------------------------
+
+
+def test_class_split_chunked_equals_one_shot_across_journal_hop():
+    """Each fault class is its own fuzz point: salted PCG64 streams,
+    restricted envelope, own signature — and each class's steered
+    stream splits across a journal hop exactly like the legacy mixed
+    stream (chunked ≡ one-shot)."""
+    from fantoch_tpu.mc.fuzz import class_spec
+
+    base = FuzzSpec(protocol="tempo", n=3, schedules=8, seed=11,
+                    crash_share=0.3, drop_share=0.2)
+    # mixed IS the base spec: pre-split journals/maps stay byte-compat
+    assert class_spec(base, "mixed") == base
+    assert point_signature(class_spec(base, "mixed")) == \
+        point_signature(base)
+    with pytest.raises(ValueError, match="fault class"):
+        class_spec(base, "partition")
+
+    specs = {c: class_spec(base, c)
+             for c in ("crash", "drop", "jitter")}
+    # restricted envelopes: the excluded fault shares go to zero, so
+    # mutation can never re-introduce the excluded class
+    assert specs["crash"].drop_share == 0.0
+    assert specs["crash"].crash_share == base.crash_share
+    assert specs["drop"].crash_share == 0.0
+    assert specs["jitter"].crash_share == 0.0
+    assert specs["jitter"].drop_share == 0.0
+    # class-independent streams + class-distinct signatures
+    sigs = {c: point_signature(s) for c, s in specs.items()}
+    assert len({json.dumps(s, sort_keys=True)
+                for s in sigs.values()}) == 3
+    for c, s in sigs.items():
+        assert s["fault_class"] == c
+        assert s != point_signature(base)
+
+    streams = {}
+    for c, spec in specs.items():
+        config, dev = point_config(spec), point_protocol(spec)
+        pool = SeedPool()
+        for p in draw_plans(spec, config, dev)[:4]:
+            pool.add(p)
+        rng, mrng = plan_rng(spec), mutation_rng(spec)
+        reference = draw_steered(spec, config, dev, 8, rng, mrng,
+                                 pool)
+        rng, mrng = plan_rng(spec), mutation_rng(spec)
+        first = draw_steered(spec, config, dev, 3, rng, mrng, pool)
+        # the journal hop: both generator positions + the pool
+        # JSON-round-tripped, exactly as a chunk boundary persists
+        r_state = json.loads(json.dumps(rng_state(rng)))
+        m_state = json.loads(json.dumps(rng_state(mrng)))
+        pool2 = SeedPool.from_json(
+            json.loads(json.dumps(pool.to_json()))
+        )
+        rest = draw_steered(
+            spec, config, dev, 5,
+            restore_rng(r_state), restore_rng(m_state), pool2,
+        )
+        assert first + rest == reference, c
+        streams[c] = [plan_to_json(p) for p in reference]
+    # the salted seeds give every class a distinct plan stream
+    assert streams["crash"] != streams["drop"]
+    assert streams["crash"] != streams["jitter"]
+    assert streams["drop"] != streams["jitter"]
+
+
+def test_farm_spec_validation_refuses_bad_shapes():
+    from fantoch_tpu.campaign import CampaignError
+
+    for bad in (
+        dict(COV_GRID, classes=["crash", "nope"]),
+        dict(COV_GRID, classes=[]),
+        dict(COV_GRID, classes=["crash", "crash"]),
+        dict(COV_GRID, retire_after=-1),
+        dict(COV_GRID, coverage=False, retire_after=2),
+        dict(COV_GRID, coverage=False, binary_maps=True),
+    ):
+        with pytest.raises(CampaignError):
+            campaign_from_json(bad)
+
+
+def test_frontier_weights_favor_isolated_buckets():
+    """The frontier-weighted draw: a pooled seed whose digest sits far
+    (Hamming-wise) from every other hit bucket weighs more than one in
+    a dense cluster; seeds without digest anchors (legacy pools) and
+    cmap-less call sites stay uniform — the legacy draw, bit for
+    bit."""
+    from fantoch_tpu.mc.coverage import frontier_weights
+
+    spec = FuzzSpec(protocol="tempo", n=3, schedules=6, seed=3)
+    cmap = CoverageMap(signature=point_signature(spec))
+    # one tight cluster (pairwise distance 1) + one far outlier
+    cmap.observe([0b0000, 0b0001, 0b0011, 0b1111000011110000])
+    pool = SeedPool()
+    plans = draw_plans(spec, point_config(spec),
+                       point_protocol(spec))
+    pool.add(plans[0], digest=0b0000)
+    pool.add(plans[1], digest=0b1111000011110000)
+    pool.add(plans[2], digest=None)  # legacy seed: no anchor
+    w = frontier_weights(pool, cmap)
+    assert w[2] == 1                     # anchor-less → uniform
+    assert w[1] > w[0] > 1               # outlier outweighs cluster
+    # no map → every weight 1 (the uniform legacy draw)
+    assert frontier_weights(pool, None) == [1, 1, 1]
+    # round-tripping the pool WITH its digest anchors preserves the
+    # weights (the journal carries them in `seed_digests`)
+    pool2 = SeedPool.from_json(
+        json.loads(json.dumps(pool.to_json())),
+        digests=json.loads(json.dumps(pool.digests_json())),
+    )
+    assert frontier_weights(pool2, cmap) == w
+    # ...and a legacy pool (no digests key) degrades to uniform
+    pool3 = SeedPool.from_json(json.loads(json.dumps(pool.to_json())))
+    assert frontier_weights(pool3, cmap) == [1, 1, 1]
+
+
+def test_legacy_mixed_journal_and_campaign_json_resume(tmp_path):
+    """A pre-split campaign dir — campaign.json without the farm
+    fields, journal keyed `proto/nN` with inline JSON maps — resumes
+    under the split-aware code to a summary byte-identical to a
+    fresh control's: `mixed` elision keeps every legacy artifact
+    valid."""
+    grid = campaign_from_json(COV_GRID)
+    ctrl = str(tmp_path / "ctrl")
+    assert run_campaign(ctrl, grid)["done"]
+
+    intr = str(tmp_path / "intr")
+    s = run_campaign(intr, grid, budget_s=0.0)
+    assert not s["done"]
+    # rewrite campaign.json as a pre-farm file: drop the new fields
+    cpath = os.path.join(intr, "campaign.json")
+    stored = json.load(open(cpath))
+    for k in ("classes", "retire_after", "binary_maps"):
+        stored.pop(k)
+    with open(cpath, "w") as fh:
+        json.dump(stored, fh, indent=2, sort_keys=True)
+    s = run_campaign(intr, resume=True)
+    assert s["done"]
+    assert set(s["points"]) == {"basic/n3"}  # the legacy key, intact
+    assert _read(os.path.join(ctrl, "summary.json")) == _read(
+        os.path.join(intr, "summary.json")
+    )
+
+
+def test_retirement_deterministic_across_interruption(tmp_path):
+    """Plateau retirement: a point whose last `retire_after` chunks
+    opened zero new buckets retires via a journaled entry at a
+    deterministic chunk — and a farm interrupted mid-plateau and
+    resumed retires the identical set at the identical chunk, with
+    byte-identical summaries."""
+    # jitter_max=1 disables jitter ⇒ every schedule of the jitter
+    # class drives the same interleaving ⇒ coverage saturates on the
+    # first chunk and the point goes dry immediately
+    grid = campaign_from_json(dict(
+        COV_GRID, schedules=20, classes=["jitter"], retire_after=2,
+        jitter_max=1,
+    ))
+    ctrl = str(tmp_path / "ctrl")
+    s = run_campaign(ctrl, grid)
+    assert s["done"]
+    assert s["retired"] == ["basic/n3/jitter"]
+    # first chunk opens buckets, then exactly retire_after dry chunks:
+    # retirement lands at chunk 3 ⇒ tried == 6, never the full 20
+    assert s["points"]["basic/n3/jitter"]["tried"] == 6
+    entries = [
+        json.loads(x) for x in open(os.path.join(ctrl,
+                                                 "journal.jsonl"))
+    ]
+    retire = [e for e in entries if e.get("kind") == "retire"]
+    assert retire == [{
+        "kind": "retire", "point": "basic/n3/jitter",
+        "tried": 6, "cov_dry": 2,
+    }]
+
+    intr = str(tmp_path / "intr")
+    run_campaign(intr, grid, budget_s=0.0)  # one chunk, then stop
+    run_campaign(intr, resume=True, budget_s=0.0)  # mid-plateau stop
+    s = run_campaign(intr, resume=True)
+    assert s["done"]
+    assert s["retired"] == ["basic/n3/jitter"]
+    assert _read(os.path.join(ctrl, "summary.json")) == _read(
+        os.path.join(intr, "summary.json")
+    )
+    # retired points never re-rank: a further resume is a no-op that
+    # re-summarizes without another chunk
+    before = _read(os.path.join(intr, "journal.jsonl"))
+    assert run_campaign(intr, resume=True)["done"]
+    assert _read(os.path.join(intr, "journal.jsonl")) == before
+
+
+def test_binary_covmap_round_trip_compact_and_migration(tmp_path):
+    """The compact binary map format: canonical bytes (save → load →
+    re-save is byte-stable), versioned per-chunk files compact down to
+    a bounded window, and a JSON point state migrates losslessly
+    (golden round-trip, original left untouched)."""
+    from fantoch_tpu.mc import covmap as cvm
+    from fantoch_tpu.mc.coverage import save_point_state
+
+    spec = FuzzSpec(protocol="tempo", n=3, seed=4)
+    sig = point_signature(spec)
+    m = CoverageMap(signature=sig)
+    m.observe([5, -1, 5, 1 << 62, -(1 << 62)])
+
+    data = cvm.covmap_bytes(m)
+    back = cvm.covmap_from_bytes(data, signature=sig)
+    assert back.buckets == m.buckets and back.signature == sig
+    assert cvm.covmap_bytes(back) == data  # canonical: byte-stable
+    p = str(tmp_path / "m.covmap")
+    cvm.save_covmap(p, m)
+    assert cvm.covmap_bytes(cvm.load_covmap(p, signature=sig)) == data
+
+    # versioned farm files + compaction window
+    d = str(tmp_path / "farm")
+    key = "tempo/n3/crash"
+    for tried in (2, 4, 6):
+        m.observe([tried])
+        cvm.save_point_map(d, key, tried, m)
+    names = sorted(os.listdir(os.path.join(d, "covmaps")))
+    assert names == [
+        "tempo_n3_crash.t00000002.covmap",
+        "tempo_n3_crash.t00000004.covmap",
+        "tempo_n3_crash.t00000006.covmap",
+    ]
+    cvm.compact_point_maps(d, key, keep=2)
+    assert sorted(os.listdir(os.path.join(d, "covmaps"))) == names[1:]
+    got = cvm.load_point_map(d, key, 6, signature=sig)
+    assert got.buckets == m.buckets
+    tried, latest = cvm.latest_point_map(d, key)
+    assert tried == 6 and latest.buckets == m.buckets
+
+    # lossless JSON → binary migration, original untouched
+    cd = str(tmp_path / "covdir")
+    state = {
+        "kind": "fuzz-coverage", "version": m.to_json()["version"],
+        "tried": 6, "coverage": m.to_json(), "seeds": [],
+    }
+    save_point_state(cd, spec, state)
+    before = _read(os.path.join(cd, "cov_tempo_n3.json"))
+    written = cvm.migrate_point_states(cd)
+    assert [os.path.basename(w) for w in written] == [
+        "cov_tempo_n3.covmap"
+    ]
+    assert _read(os.path.join(cd, "cov_tempo_n3.json")) == before
+    mig = cvm.load_covmap(written[0], signature=sig)
+    assert mig.buckets == m.buckets
+    # migration is idempotent byte-for-byte
+    first = _read(written[0])
+    assert cvm.migrate_point_states(cd) == written
+    assert _read(written[0]) == first
+
+
+def test_binary_covmap_foreign_version_and_signature_refused(tmp_path):
+    """Refusals, by name: a foreign container version, a foreign point
+    signature and structural damage never load (and never silently
+    rebuild)."""
+    from fantoch_tpu.mc import covmap as cvm
+
+    spec = FuzzSpec(protocol="tempo", n=3, seed=4)
+    sig = point_signature(spec)
+    m = CoverageMap(signature=sig)
+    m.observe([5, -1])
+    p = str(tmp_path / "m.covmap")
+    cvm.save_covmap(p, m)
+
+    data = _read(p)
+    # container version lives right after the 8-byte magic (<I)
+    foreign = data[:8] + (99).to_bytes(4, "little") + data[12:]
+    fp = str(tmp_path / "foreign.covmap")
+    with open(fp, "wb") as fh:
+        fh.write(foreign)
+    with pytest.raises(cvm.CovmapVersionError, match="version"):
+        cvm.load_covmap(fp, signature=sig)
+
+    other = point_signature(FuzzSpec(protocol="fpaxos", n=5, seed=4))
+    with pytest.raises(CoverageMismatchError, match="protocol"):
+        cvm.load_covmap(p, signature=other)
+
+    with open(str(tmp_path / "trunc.covmap"), "wb") as fh:
+        fh.write(data[:-3])
+    with pytest.raises(cvm.CovmapError):
+        cvm.load_covmap(str(tmp_path / "trunc.covmap"), signature=sig)
+    # the refusal hierarchy rides the existing exit-2 path
+    assert issubclass(cvm.CovmapError, CoverageError)
+    assert issubclass(cvm.CovmapVersionError, CoverageMismatchError)
+
+
+def test_binary_maps_farm_resume_and_final_maps_byte_identical(
+    tmp_path,
+):
+    """The farm identity pin (device tier-1 shape): a binary-map farm
+    interrupted and resumed produces summary.json AND the final
+    per-point `.covmap` files byte-identical to the uninterrupted
+    control's; journal entries carry `cov_sha256` instead of the
+    inline JSON map."""
+    grid = campaign_from_json(dict(
+        COV_GRID, classes=["crash", "jitter"], crash_share=0.3,
+        drop_share=0.2, binary_maps=True,
+    ))
+    ctrl = str(tmp_path / "ctrl")
+    s = run_campaign(ctrl, grid)
+    assert s["done"]
+    assert set(s["points"]) == {"basic/n3/crash", "basic/n3/jitter"}
+    for e in (json.loads(x)
+              for x in open(os.path.join(ctrl, "journal.jsonl"))):
+        if e.get("kind") == "fuzz":
+            assert "coverage" not in e and "cov_sha256" in e
+    finals = sorted(os.listdir(os.path.join(ctrl, "covmaps")))
+    # done farms keep ONLY the canonical final maps (versioned
+    # generations compacted away)
+    assert finals == [
+        "basic_n3_crash.covmap", "basic_n3_jitter.covmap"
+    ]
+
+    intr = str(tmp_path / "intr")
+    run_campaign(intr, grid, budget_s=0.0)
+    assert run_campaign(intr, resume=True)["done"]
+    assert _read(os.path.join(ctrl, "summary.json")) == _read(
+        os.path.join(intr, "summary.json")
+    )
+    for name in finals:
+        assert _read(os.path.join(ctrl, "covmaps", name)) == _read(
+            os.path.join(intr, "covmaps", name)
+        ), name
+
+
+# ----------------------------------------------------------------------
 # slow tier: tempo + subprocess SIGKILL
 # ----------------------------------------------------------------------
 

@@ -154,6 +154,149 @@ def test_work_stealing_scan_order_cuts_contention(tmp_path):
     assert rotated == 0
 
 
+def test_claim_backoff_deterministic_bounded_and_cuts_attempts(
+    tmp_path,
+):
+    """The lease-claim backoff satellite: a lost claim used to retry
+    the next unit immediately — a hot spin over the lease dir when
+    most of the grid is held. The fix is ``claim_backoff_s`` (pure
+    function of (worker_id, miss streak) — no wall clock, no
+    ``random.*``, GL402-safe since it only feeds ``time.sleep``) plus
+    a done-set refresh after every miss, so units whose holders finish
+    during the bought time are skipped without burning a claim. The
+    drain replay pins the effect: the backoff policy spends strictly
+    fewer claim attempts than the immediate-retry policy on the same
+    8×8 grid while journaling the SAME completions — and the merged
+    bytes, which erase completion order, cannot tell them apart."""
+    from fantoch_tpu.engine.checkpoint import canonical_json
+    from fantoch_tpu.fleet.worker import claim_backoff_s
+
+    # pure, bounded, worker-keyed: identical on every call, zero for a
+    # zero streak, capped at the module cap, and phase-shifted between
+    # workers so contenders desynchronize instead of re-colliding
+    for w in ("w0", "w1", "long-worker-id_9"):
+        assert claim_backoff_s(w, 0) == 0.0
+        seq = [claim_backoff_s(w, m) for m in range(1, 12)]
+        assert seq == [claim_backoff_s(w, m) for m in range(1, 12)]
+        assert all(0.0 < s <= 0.25 for s in seq)
+    assert claim_backoff_s("w0", 3) != claim_backoff_s("w1", 3)
+
+    units = [f"p/n3/b{i}" for i in range(8)]
+    workers = [f"w{i}" for i in range(8)]
+    WORK_TICKS = 4  # ticks a holder runs its unit before journaling
+
+    def drain(backoff):
+        """Lockstep replay of the sweep claim loop (one scan step per
+        worker per tick) against a shared lease table + journal —
+        time-free, so the pinned counts are exact. ``backoff=False``
+        is the old immediate-retry policy; ``backoff=True`` sleeps a
+        streak-scaled number of ticks after a miss and refreshes the
+        done-set on wake, exactly the shipped loop's moves."""
+        held, journal = {}, []
+        snapshot = {w: set() for w in workers}
+        pos = {w: 0 for w in workers}
+        holding = {w: None for w in workers}
+        work_left = {w: 0 for w in workers}
+        sleep = {w: 0 for w in workers}
+        misses = {w: 0 for w in workers}
+        active = {w: True for w in workers}
+        pass_completed = {w: 0 for w in workers}
+        attempts = 0
+        ticks = 0
+        while len(journal) < len(units) or any(
+            holding[w] for w in workers
+        ):
+            ticks += 1
+            assert ticks < 10_000
+            for w in workers:
+                if not active[w]:
+                    continue
+                if holding[w] is not None:
+                    work_left[w] -= 1
+                    if work_left[w] <= 0:
+                        u = holding[w]
+                        journal.append(u)
+                        del held[u]
+                        holding[w] = None
+                        snapshot[w] = set(journal)
+                        pass_completed[w] += 1
+                    continue
+                if sleep[w] > 0:
+                    sleep[w] -= 1
+                    if sleep[w] == 0:
+                        # the refresh bought by the backoff
+                        snapshot[w] = set(journal)
+                    continue
+                while (
+                    pos[w] < len(units)
+                    and units[pos[w]] in snapshot[w]
+                ):
+                    pos[w] += 1
+                if pos[w] >= len(units):
+                    # pass bottom: exit once a pass completes nothing
+                    # (or the grid is drained), else restart the pass
+                    # on a fresh journal read — the real loop's gate
+                    if not pass_completed[w] or (
+                        len(journal) == len(units)
+                    ):
+                        active[w] = False
+                    else:
+                        pass_completed[w] = 0
+                        pos[w] = 0
+                        snapshot[w] = set(journal)
+                    continue
+                u = units[pos[w]]
+                attempts += 1
+                if u in journal:
+                    # completed after this worker's snapshot: the real
+                    # loop's under-lease re-check discards it and
+                    # refreshes (both policies)
+                    snapshot[w] = set(journal)
+                    continue
+                if u in held:
+                    misses[w] += 1
+                    pos[w] += 1
+                    if backoff:
+                        sleep[w] = min(1 << min(misses[w], 3), 8)
+                else:
+                    held[u] = w
+                    holding[w] = u
+                    work_left[w] = WORK_TICKS
+                    misses[w] = 0
+        return attempts, journal
+
+    spin_attempts, spin_done = drain(backoff=False)
+    back_attempts, back_done = drain(backoff=True)
+    assert sorted(spin_done) == sorted(back_done) == sorted(units)
+    # the hot spin: 8 wins plus a miss for every held unit every
+    # worker scans past, across every pass until its exit gate
+    assert spin_attempts == 64
+    # backoff + refresh-on-wake cuts the claim traffic outright
+    assert back_attempts < spin_attempts
+    assert back_attempts == 46
+    # merge-bytes identity: journal both policies' completions and
+    # check the canonical-order merged lines agree byte for byte —
+    # backoff is a lease-traffic hint only, never a results change
+    merged = []
+    for name, order in (("spin", spin_done), ("back", back_done)):
+        d = str(tmp_path / name)
+        for i, u in enumerate(order):
+            append_worker_journal(
+                d, f"w{i % 8}",
+                {"kind": "batch", "id": u, "results": [{"err": 0}]},
+            )
+        done = sweep_done_units(read_all_journals(d))
+        merged.append(
+            [
+                canonical_json(
+                    {"batch": u, "lane": 0, "result": done[u][0]}
+                )
+                for u in units
+            ]
+        )
+    assert merged[0] == merged[1]
+
+
 def test_lease_reclaim_only_after_ttl(tmp_path):
     """The reclaim gate: a live (heartbeated) lease is never stolen;
     an expired one is reclaimable by exactly one claimant."""
