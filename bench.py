@@ -177,6 +177,25 @@ TRAFFIC_MIN_BUDGET_S = float(
     _os.environ.get("FANTOCH_BENCH_TRAFFIC_MIN_BUDGET", "420")
 )
 
+# open-loop serving self-check shape (fantoch_tpu/serving): the small
+# tempo grid measured closed vs open loop (the open-loop step is its
+# own compile, so the delta isolates the arrival-release arithmetic
+# per point), and a tiny knee campaign timed end-to-end
+OPENLOOP_SUBSETS = int(
+    _os.environ.get("FANTOCH_BENCH_OPENLOOP_SUBSETS", "2")
+)
+KNEE_COMMANDS = int(_os.environ.get("FANTOCH_BENCH_KNEE_COMMANDS", "10"))
+KNEE_LOADS = tuple(
+    int(x)
+    for x in _os.environ.get("FANTOCH_BENCH_KNEE_LOADS", "50,200").split(",")
+)
+
+# minimum remaining total budget for the open-loop self-checks (two
+# cold compiles: the open-loop n=5 grid and the n=3 knee campaign)
+OPENLOOP_MIN_BUDGET_S = float(
+    _os.environ.get("FANTOCH_BENCH_OPENLOOP_MIN_BUDGET", "420")
+)
+
 
 def _region_subsets(planet, count: int):
     """``count`` genuinely-distinct N-region subsets: stride through
@@ -266,6 +285,104 @@ def _traffic_sweep_delta() -> "tuple[float, float] | None":
 
         traceback.print_exc()
         print(f"bench: traffic sweep delta unavailable: {e!r}",
+              file=sys.stderr)
+        return None
+
+
+def _openloop_sweep_delta() -> "tuple[float, float] | None":
+    """Measured closed-vs-open-loop sweep rate on a small tempo grid
+    (``OPENLOOP_SUBSETS`` × f × conflicts points, same shape both
+    sides): one warmup + one timed run per client mode, so the
+    reported delta is the per-point cost of the compiled arrival
+    gathers + release recursion (engine/core.py open-loop step 5),
+    not compile time. Returns (closed_pps, open_pps) or None."""
+    import sys
+
+    try:
+        planet = Planet.new()
+        region_sets = _region_subsets(planet, OPENLOOP_SUBSETS)
+        clients = N * CLIENTS_PER_REGION
+        total = COMMANDS * clients
+        dev, base = _build("tempo", clients)
+        # open-loop lanes keep up to open_window commands of every
+        # client in flight, so the queue planes size by total commands
+        # (the campaign manager's shape) — shared by the closed side,
+        # keeping both timings on identical dims
+        dims = EngineDims.for_protocol(
+            dev, n=N, clients=clients, payload=dev.payload_width(N),
+            total_commands=total, dot_slots=total + 1, regions=N,
+            hist_buckets=2048,
+        )
+
+        def specs(arrivals):
+            # window 2: at n=5/f=2/conflict=100 a deeper in-flight
+            # window overflows tempo's fixed detached-vote slots
+            # (ERR_CAPACITY, loud) — the self-check measures arrival
+            # arithmetic, not that protocol bound
+            out = make_sweep_specs(
+                dev, planet, region_sets=region_sets, fs=FS,
+                conflicts=CONFLICTS, commands_per_client=COMMANDS,
+                clients_per_region=CLIENTS_PER_REGION, dims=dims,
+                config_base=base, arrivals=arrivals, open_window=2,
+            )
+            out.sort(
+                key=lambda s: (s.config.f, int(s.ctx["conflict_rate"]))
+            )
+            return out
+
+        rates = []
+        for arrivals in (None, "poisson"):
+            batch = specs(arrivals)
+            run_sweep(dev, dims, batch)  # warmup/compile
+            t0 = time.perf_counter()
+            results = run_sweep(dev, dims, batch)
+            dt = time.perf_counter() - t0
+            bad = [r.err_cause for r in results if r.err]
+            assert not bad, f"open-loop self-check failing lanes: {bad[:4]}"
+            rates.append(len(batch) / dt)
+        return rates[0], rates[1]
+    except Exception as e:  # noqa: BLE001
+        import traceback
+
+        traceback.print_exc()
+        print(f"bench: open-loop sweep delta unavailable: {e!r}",
+              file=sys.stderr)
+        return None
+
+
+def _knee_sweep_rate() -> "tuple[float, int] | None":
+    """Measured curve points per second of a tiny tempo knee sweep
+    (serving/knee.py) run end-to-end through the campaign manager —
+    journaling, checkpoints, artifact write included, so the rate is
+    what a real knee campaign pays per (region-set, protocol, load)
+    point. Returns (points_per_sec, points) or None."""
+    import shutil
+    import sys
+    import tempfile
+
+    try:
+        from fantoch_tpu.serving import run_knee_sweep
+
+        work = tempfile.mkdtemp(prefix="fantoch_knee_bench_")
+        try:
+            t0 = time.perf_counter()
+            artifact, summary = run_knee_sweep(
+                work, protocols=("tempo",), ns=(3,),
+                loads=KNEE_LOADS, commands_per_client=KNEE_COMMANDS,
+                batch_lanes=64, segment_steps=512,
+            )
+            dt = time.perf_counter() - t0
+            assert artifact is not None, f"knee sweep interrupted: {summary}"
+            points = sum(len(p["curve"]) for p in artifact["points"])
+            assert points > 0, "knee sweep measured no curve points"
+            return points / dt, points
+        finally:
+            shutil.rmtree(work, ignore_errors=True)
+    except Exception as e:  # noqa: BLE001
+        import traceback
+
+        traceback.print_exc()
+        print(f"bench: knee sweep rate unavailable: {e!r}",
               file=sys.stderr)
         return None
 
@@ -1176,6 +1293,33 @@ def main() -> None:
                 flush=True,
             )
 
+    # open-loop serving tax (fantoch_tpu/serving): closed-vs-open rate
+    # on the small tempo grid plus a tiny end-to-end knee campaign —
+    # both honest-zero when skipped/failed, like the traffic self-check
+    # (the open-loop step and the knee grid are their own compiles, so
+    # the budget guard protects the already-measured sweep artifact)
+    openloop_rates, knee_rate, openloop_note = None, None, None
+    if TOTAL_BUDGET_S - _since_birth() < OPENLOOP_MIN_BUDGET_S:
+        openloop_note = (
+            "skipped: insufficient budget for the open-loop compiles"
+        )
+        print(f"open-loop self-check {openloop_note}", file=sys.stderr,
+              flush=True)
+    else:
+        openloop_rates = _openloop_sweep_delta()
+        knee_rate = _knee_sweep_rate()
+        if openloop_rates is None or knee_rate is None:
+            openloop_note = "failed (see stderr)"
+        else:
+            print(
+                f"open-loop self-check: closed "
+                f"{openloop_rates[0]:.2f}/s vs open "
+                f"{openloop_rates[1]:.2f}/s; knee "
+                f"{knee_rate[0]:.2f} curve points/s",
+                file=sys.stderr,
+                flush=True,
+            )
+
     # dispatch tax (parallel/pipeline.py): serial vs pipelined on the
     # small tempo grid, plus measured ms/step at the 512/2048-lane
     # shapes. Budget-guarded like the other self-checks — the small
@@ -1378,6 +1522,34 @@ def main() -> None:
                     round(traffic_rates[1], 2) if traffic_rates else 0.0
                 ),
                 **({"traffic_note": traffic_note} if traffic_note else {}),
+                # measured closed vs open-loop rate on the small tempo
+                # grid, and the relative per-point slowdown the arrival
+                # machinery costs (0.0 = skipped/failed; note carries
+                # the reason)
+                "sweep_points_per_sec_closed_small": (
+                    round(openloop_rates[0], 2) if openloop_rates else 0.0
+                ),
+                "sweep_points_per_sec_openloop": (
+                    round(openloop_rates[1], 2) if openloop_rates else 0.0
+                ),
+                "openloop_vs_closed_overhead": (
+                    round(openloop_rates[0] / openloop_rates[1] - 1.0, 3)
+                    if openloop_rates and openloop_rates[1] > 0
+                    else 0.0
+                ),
+                # curve points per second of a tiny tempo knee campaign
+                # run end-to-end (journal + checkpoints + artifact;
+                # 0.0 = skipped/failed, same note)
+                "knee_points_per_sec": (
+                    round(knee_rate[0], 2) if knee_rate else 0.0
+                ),
+                "knee_points": knee_rate[1] if knee_rate else 0,
+                "knee_loads": list(KNEE_LOADS),
+                **(
+                    {"openloop_note": openloop_note}
+                    if openloop_note
+                    else {}
+                ),
                 # serial-minus-pipelined wall time on the fixed small
                 # tempo grid (positive = the in-flight window wins;
                 # 0.0 = skipped/failed, note carries the reason)
@@ -1652,6 +1824,15 @@ def _emit_unreachable(reason: str = "unreachable at startup") -> None:
                 "sweep_points_per_sec_flat_small": 0.0,
                 "sweep_points_per_sec_diurnal": 0.0,
                 "traffic_note": f"sweeps skipped: TPU backend {reason}",
+                # the open-loop grid and knee campaign need the device
+                # runner too — honest zeros with the shared reason
+                "sweep_points_per_sec_closed_small": 0.0,
+                "sweep_points_per_sec_openloop": 0.0,
+                "openloop_vs_closed_overhead": 0.0,
+                "knee_points_per_sec": 0.0,
+                "knee_points": 0,
+                "knee_loads": list(KNEE_LOADS),
+                "openloop_note": f"skipped: TPU backend {reason}",
                 "dispatch_overhead_s": 0.0,
                 "dispatch_serial_s": 0.0,
                 "dispatch_pipelined_s": 0.0,
@@ -1707,6 +1888,11 @@ _CPU_FALLBACK_ENV = {
     "FANTOCH_BENCH_CKPT_LANES": "64",
     "FANTOCH_BENCH_TRAFFIC_LANES": "64",
     "FANTOCH_BENCH_TRAFFIC_SUBSETS": "1",
+    # open-loop self-checks on the host mesh: one subset for the
+    # closed-vs-open delta, a 2-load knee ladder with short lanes
+    "FANTOCH_BENCH_OPENLOOP_SUBSETS": "1",
+    "FANTOCH_BENCH_KNEE_COMMANDS": "6",
+    "FANTOCH_BENCH_KNEE_LOADS": "50,200",
     "FANTOCH_BENCH_DISPATCH_SUBSETS": "1",
     # measured on the 2-core CPU mesh: 4-step segments make the
     # per-call dispatch tax a visible fraction (serial 4.8s vs

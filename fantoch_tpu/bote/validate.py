@@ -20,6 +20,15 @@ batch checkpoints at segment boundaries, and a SIGKILLed validation
 resumes exactly where it stopped (``cli.py bote --validate --resume``).
 The frontier artifact is written atomically once the grid completes.
 
+``rank_by="knee"`` swaps the closed-loop conflict grid for an
+open-loop offered-load ladder (``serving/knee.py``): every candidate
+is driven with the same seeded arrival process at each load, and the
+candidates are re-ranked by where their measured throughput–latency
+knee sits — a candidate that sustains more offered load before its
+p99 leaves the unloaded envelope outranks one that saturates early,
+regardless of what the closed-form score said. The closed-form score
+is still carried per candidate so the re-ranking itself is the result.
+
 Closed-form and measured numbers are NOT the same quantity: the model
 returns one commit latency per client region (no conflicts, no
 queuing, fast path always), while the measured side reports the
@@ -131,14 +140,25 @@ def _measured_campaign(
     batch_lanes: int,
     segment_steps: int,
     aws: bool,
+    arrivals: Sequence[str] = ("closed",),
+    offered_loads: Sequence[int] = (100,),
+    open_window: int = 4,
+    mean_gap_ms: int = 4,
 ):
     from ..campaign.manager import SweepCampaign
 
+    # the defaults reproduce the legacy closed-loop grid byte-for-byte
+    # (campaign/manager.py keeps "closed" batch ids unsegmented), so a
+    # pre-knee journal still resumes under rank_by="score"
     return SweepCampaign(
         protocols=tuple(protocols),
         fs=tuple(fs),
         conflicts=tuple(conflicts),
         traffic=tuple(traffic),
+        arrivals=tuple(arrivals),
+        offered_loads=tuple(offered_loads),
+        open_window=int(open_window),
+        mean_gap_ms=int(mean_gap_ms),
         region_sets=tuple(c.regions for c in candidates),
         commands_per_client=commands,
         clients_per_region=clients_per_region,
@@ -221,6 +241,8 @@ def build_frontier_artifact(
     aws: bool,
     measured: "Dict[Tuple[str, ...], dict] | None",
     dryrun: bool,
+    rank_by: str = "score",
+    serving: "dict | None" = None,
 ) -> dict:
     # per-(protocol, f) closed-form key, so a consumer comparing the
     # measured f=2 stats is pointed at af2/ff2, never at fs[0]'s model
@@ -232,6 +254,43 @@ def build_frontier_artifact(
         )
         for p in protocols
     }
+    assert rank_by in ("score", "knee"), rank_by
+    assert (serving is not None) == (rank_by == "knee"), (
+        "serving parameters accompany exactly the knee re-ranking"
+    )
+    rows = [
+        {
+            "regions": list(c.regions),
+            "score": c.score,
+            "closed_form": c.closed_form,
+            "measured": (
+                None if measured is None else measured.get(tuple(c.regions))
+            ),
+        }
+        for c in candidates
+    ]
+    if rank_by == "knee" and measured is not None:
+        from ..serving.knee import locate_knee
+
+        for row in rows:
+            curves = row["measured"] or {}
+            row["knee"] = {
+                proto: locate_knee(curve, serving["knee_mult"])
+                for proto, curve in sorted(curves.items())
+            }
+        # a candidate's rank key is its *worst* protocol: the smallest
+        # load at which any swept protocol's p99 leaves the unloaded
+        # envelope. A never-located knee means the candidate sustained
+        # the whole ladder — it outranks every saturated one. Python's
+        # sort is stable, so closed-form order breaks ties.
+        def _rank_key(row: dict) -> float:
+            knees = [
+                k if k is not None else float("inf")
+                for k in row["knee"].values()
+            ] or [float("-inf")]
+            return -min(knees)
+
+        rows.sort(key=_rank_key)
     return {
         "kind": FRONTIER_KIND,
         "version": FRONTIER_VERSION,
@@ -244,20 +303,10 @@ def build_frontier_artifact(
         "commands_per_client": int(commands),
         "clients_per_region": int(clients_per_region),
         "dryrun": bool(dryrun),
+        "rank_by": rank_by,
+        "serving": serving,
         "model_keys": model_keys,
-        "candidates": [
-            {
-                "regions": list(c.regions),
-                "score": c.score,
-                "closed_form": c.closed_form,
-                "measured": (
-                    None
-                    if measured is None
-                    else measured.get(tuple(c.regions))
-                ),
-            }
-            for c in candidates
-        ],
+        "candidates": rows,
     }
 
 
@@ -265,7 +314,9 @@ def check_frontier_artifact(obj: dict) -> None:
     """Schema check for the frontier artifact (the CI traffic-smoke
     job pins this on a --dryrun run): required keys, per-candidate
     closed-form p50/p99, and — unless dryrun — measured p50/p99 for
-    every (protocol, f, traffic, conflict) grid point."""
+    every (protocol, f, traffic, conflict) grid point, or (under
+    ``rank_by: knee``) a measured curve covering every offered load
+    plus a knee that is null or one of the swept loads."""
     for k in (
         "kind", "version", "n", "planet", "protocols", "fs",
         "conflicts", "traffic", "commands_per_client", "dryrun",
@@ -274,6 +325,20 @@ def check_frontier_artifact(obj: dict) -> None:
         assert k in obj, f"frontier artifact missing {k!r}"
     assert obj["kind"] == FRONTIER_KIND, obj["kind"]
     assert obj["candidates"], "frontier artifact has no candidates"
+    # pre-knee artifacts carry neither key: score-ranked by construction
+    rank_by = obj.get("rank_by", "score")
+    assert rank_by in ("score", "knee"), rank_by
+    serving = obj.get("serving")
+    if rank_by == "knee":
+        assert serving, "knee-ranked artifacts carry serving parameters"
+        for k in (
+            "arrival", "loads", "knee_mult", "open_window", "mean_gap_ms"
+        ):
+            assert k in serving, f"serving parameters missing {k!r}"
+        assert serving["arrival"] != "closed", serving
+        assert serving["loads"], "knee re-ranking needs a load ladder"
+    else:
+        assert serving is None, "score-ranked artifacts carry no serving"
     for cand in obj["candidates"]:
         for k in ("regions", "score", "closed_form", "measured"):
             assert k in cand, f"candidate missing {k!r}"
@@ -291,6 +356,31 @@ def check_frontier_artifact(obj: dict) -> None:
             continue
         measured = cand["measured"]
         assert measured, "measured artifact has no sweep stats"
+        if rank_by == "knee":
+            assert "knee" in cand, "knee-ranked candidate missing knee"
+            for proto in obj["protocols"]:
+                curve = measured.get(proto)
+                assert curve is not None, (
+                    f"measured curve missing for {proto} {cand['regions']}"
+                )
+                for load in serving["loads"]:
+                    stats = curve.get(str(load))
+                    assert stats is not None, (
+                        f"curve missing load {load} for {proto} "
+                        f"{cand['regions']}"
+                    )
+                    if stats.get("errors"):
+                        assert stats.get("error_cause"), stats
+                        for field in ("mean", "p50", "p99", "goodput_cps"):
+                            assert stats.get(field) is None, (field, stats)
+                        continue
+                    for field in ("mean", "p50", "p99", "goodput_cps"):
+                        assert isinstance(stats.get(field), (int, float)), (
+                            proto, load, field,
+                        )
+                knee = cand["knee"].get(proto)
+                assert knee is None or knee in serving["loads"], knee
+            continue
         for proto in obj["protocols"]:
             for f in obj["fs"]:
                 for tname in obj["traffic"]:
@@ -339,6 +429,12 @@ def validate_frontier(
     budget_s: Optional[float] = None,
     dryrun: bool = False,
     out: Optional[str] = None,
+    rank_by: str = "score",
+    arrival: str = "poisson",
+    loads: Optional[Sequence[int]] = None,
+    open_window: int = 4,
+    mean_gap_ms: int = 4,
+    knee_mult: Optional[float] = None,
 ) -> Tuple[Optional[dict], dict]:
     """Run (or resume) the measured validation of ``candidates`` and,
     once the campaign grid completes, write the frontier artifact.
@@ -348,11 +444,32 @@ def validate_frontier(
     ``resume=True`` to continue exactly where it stopped (the PR-5
     checkpoint/journal machinery). ``dryrun`` skips the device sweeps
     and emits the artifact with ``measured: null`` per candidate —
-    the CI schema check's fast path."""
+    the CI schema check's fast path.
+
+    ``rank_by="knee"`` replaces the closed-loop conflict grid with an
+    open-loop offered-load ladder (``serving/knee.py``) and re-orders
+    the artifact's candidates by their measured knee position —
+    worst-protocol knee descending, never-saturated first; the
+    closed-form ``score`` rides along unranked."""
     assert candidates, "nothing to validate"
+    assert rank_by in ("score", "knee"), rank_by
     ns = {len(c.regions) for c in candidates}
     assert len(ns) == 1, f"candidates span multiple n: {sorted(ns)}"
     n = ns.pop()
+
+    serving = None
+    if rank_by == "knee":
+        from ..serving.knee import DEFAULT_KNEE_MULT, DEFAULT_LOADS
+
+        serving = {
+            "arrival": arrival,
+            "loads": [int(l) for l in (loads or DEFAULT_LOADS)],
+            "knee_mult": float(
+                DEFAULT_KNEE_MULT if knee_mult is None else knee_mult
+            ),
+            "open_window": int(open_window),
+            "mean_gap_ms": int(mean_gap_ms),
+        }
 
     out = out or os.path.join(path, FRONTIER_ARTIFACT)
     if dryrun:
@@ -360,7 +477,7 @@ def validate_frontier(
             candidates, n=n, protocols=protocols, fs=fs,
             conflicts=conflicts, traffic=traffic, commands=commands,
             clients_per_region=clients_per_region, aws=aws,
-            measured=None, dryrun=True,
+            measured=None, dryrun=True, rank_by=rank_by, serving=serving,
         )
         check_frontier_artifact(artifact)
         _write_artifact(out, artifact)
@@ -373,17 +490,32 @@ def validate_frontier(
         traffic=traffic, commands=commands,
         clients_per_region=clients_per_region, pool_size=pool_size,
         batch_lanes=batch_lanes, segment_steps=segment_steps, aws=aws,
+        **(
+            {}
+            if serving is None
+            else {
+                "arrivals": (serving["arrival"],),
+                "offered_loads": tuple(serving["loads"]),
+                "open_window": serving["open_window"],
+                "mean_gap_ms": serving["mean_gap_ms"],
+            }
+        ),
     )
     summary = run_campaign(path, spec, resume=resume, budget_s=budget_s)
     if not summary["done"]:
         return None, summary
 
-    measured = _collect_measured(path, spec)
+    if rank_by == "knee":
+        from ..serving.knee import collect_curves
+
+        measured = collect_curves(path, spec)
+    else:
+        measured = _collect_measured(path, spec)
     artifact = build_frontier_artifact(
         candidates, n=n, protocols=protocols, fs=fs,
         conflicts=conflicts, traffic=traffic, commands=commands,
         clients_per_region=clients_per_region, aws=aws,
-        measured=measured, dryrun=False,
+        measured=measured, dryrun=False, rank_by=rank_by, serving=serving,
     )
     check_frontier_artifact(artifact)
     _write_artifact(out, artifact)

@@ -113,6 +113,17 @@ class SweepCampaign:
     # trace different graphs so they never share a batch. "flat" is the
     # static path (byte-identical to a traffic-less campaign).
     traffic: Tuple[str, ...] = ("flat",)
+    # open-loop arrival axis (registry.ARRIVAL_PRESETS): each preset
+    # runs once per entry of ``offered_loads`` (percent of the preset's
+    # base offered load — the knee sweep's load axis, serving/knee.py).
+    # Like traffic, open-loop lanes trace a different graph than
+    # closed-loop lanes, so every (preset, load) point gets its own
+    # batch group and an ``/a<name>l<load>`` batch-id segment;
+    # "closed" keeps the legacy ids so pre-arrivals journals resume.
+    arrivals: Tuple[str, ...] = ("closed",)
+    offered_loads: Tuple[int, ...] = (100,)
+    open_window: int = 4      # per-client in-flight cap (GL202 plane)
+    mean_gap_ms: int = 4      # base mean inter-arrival gap at load 100
     subsets: int = 1          # region subsets per n
     # explicit region sets (e.g. bote frontier candidates,
     # bote/validate.py); overrides the ns × subsets enumeration — each
@@ -291,6 +302,50 @@ def campaign_from_json(obj: dict):
                 "the traffic axis needs at least one preset "
                 '(use ["flat"] for the static path)'
             )
+        from ..registry import ARRIVAL_PRESETS
+
+        bad_a = [a for a in spec.arrivals if a not in ARRIVAL_PRESETS]
+        if bad_a:
+            raise CampaignError(
+                f"unknown arrival preset(s) {bad_a}; choose from "
+                f"{','.join(ARRIVAL_PRESETS)}"
+            )
+        if not spec.arrivals:
+            raise CampaignError(
+                "the arrivals axis needs at least one preset "
+                '(use ["closed"] for the closed-loop path)'
+            )
+        bad_l = [
+            l for l in spec.offered_loads
+            if not isinstance(l, int) or l < 1
+        ]
+        if bad_l or not spec.offered_loads:
+            raise CampaignError(
+                "offered_loads must be a non-empty list of positive "
+                f"load percentages, got {list(spec.offered_loads)}"
+            )
+        if spec.open_window < 1:
+            raise CampaignError(
+                f"open_window must be >= 1, got {spec.open_window}"
+            )
+        if spec.mean_gap_ms < 1:
+            raise CampaignError(
+                "mean_gap_ms must be >= 1 (the engine clock is "
+                f"integer ms), got {spec.mean_gap_ms}"
+            )
+        if any(a != "closed" for a in spec.arrivals):
+            # open-loop lanes own the issue clock: traffic think delays
+            # are asserted zero in make_lane, so refuse the grid here
+            # by name instead of dying mid-campaign
+            thinky = [
+                t for t in spec.traffic if t in ("diurnal", "flash")
+            ]
+            if thinky:
+                raise CampaignError(
+                    f"traffic preset(s) {thinky} carry think delays, "
+                    "which open-loop arrivals replace; combine "
+                    'arrivals with ["flat"] or ["churn"] traffic'
+                )
         if spec.region_sets is not None and not spec.region_sets:
             raise CampaignError("region_sets must not be empty when set")
         if spec.aot and spec.mesh_shard:
@@ -472,12 +527,14 @@ def _sweep_groups(spec: SweepCampaign, planet):
 
 
 def _sweep_batches(spec: SweepCampaign):
-    """Deterministic batch enumeration: one (protocol, n, traffic)
-    group shares a compiled runner; its grid chunks into
+    """Deterministic batch enumeration: one (protocol, n, traffic,
+    arrival, load) group shares a compiled runner; its grid chunks into
     ``batch_lanes`` units. Traffic presets get their own groups (and a
     ``/t<name>`` batch-id segment) because schedule tables change the
     traced graph — "flat" lanes keep the legacy ids, so pre-traffic
-    journals still resume."""
+    journals still resume. The open-loop arrival axis works the same
+    way: each (preset, offered load) point is its own ``/a<name>l<load>``
+    group, "closed" keeps the legacy ids."""
     from ..engine import EngineDims
     from ..engine.faults import FaultPlan
     from ..engine.protocols import dev_config_kwargs, dev_protocol
@@ -522,32 +579,52 @@ def _sweep_batches(spec: SweepCampaign):
                 regions=n,
             )
             base = Config(**dev_config_kwargs(proto, n, spec.fs[0]))
-            for tname in spec.traffic:
-                lanes = make_sweep_specs(
-                    dev,
-                    planet,
-                    region_sets=region_sets,
-                    fs=list(spec.fs),
-                    conflicts=list(spec.conflicts),
-                    commands_per_client=spec.commands_per_client,
-                    clients_per_region=spec.clients_per_region,
-                    dims=dims,
-                    config_base=base,
-                    extra_time_ms=spec.extra_time_ms,
-                    pool_size=spec.pool_size,
-                    faults=plans,
-                    traffic=tname,
-                )
-                tseg = "" if tname == "flat" else f"/t{tname}"
-                for j in range(0, len(lanes), spec.batch_lanes):
-                    batches.append(
-                        (
-                            f"{proto}/n{n}{tseg}/b{j // spec.batch_lanes}",
-                            dev,
-                            dims,
-                            lanes[j : j + spec.batch_lanes],
-                        )
+            # arrival axis points: "closed" runs once (offered load is
+            # meaningless without an arrival process); open presets run
+            # once per offered_loads entry — the knee sweep's load axis
+            arrival_points = []
+            for aname in spec.arrivals:
+                if aname == "closed":
+                    arrival_points.append(("closed", 100))
+                else:
+                    arrival_points.extend(
+                        (aname, load) for load in spec.offered_loads
                     )
+            for tname in spec.traffic:
+                for aname, load in arrival_points:
+                    lanes = make_sweep_specs(
+                        dev,
+                        planet,
+                        region_sets=region_sets,
+                        fs=list(spec.fs),
+                        conflicts=list(spec.conflicts),
+                        commands_per_client=spec.commands_per_client,
+                        clients_per_region=spec.clients_per_region,
+                        dims=dims,
+                        config_base=base,
+                        extra_time_ms=spec.extra_time_ms,
+                        pool_size=spec.pool_size,
+                        faults=plans,
+                        traffic=tname,
+                        arrivals=None if aname == "closed" else aname,
+                        arrival_load=load,
+                        arrival_gap_ms=spec.mean_gap_ms,
+                        open_window=spec.open_window,
+                    )
+                    tseg = "" if tname == "flat" else f"/t{tname}"
+                    aseg = (
+                        "" if aname == "closed" else f"/a{aname}l{load}"
+                    )
+                    for j in range(0, len(lanes), spec.batch_lanes):
+                        batches.append(
+                            (
+                                f"{proto}/n{n}{tseg}{aseg}"
+                                f"/b{j // spec.batch_lanes}",
+                                dev,
+                                dims,
+                                lanes[j : j + spec.batch_lanes],
+                            )
+                        )
     return batches
 
 

@@ -186,6 +186,21 @@ def cmd_sim(args) -> None:
         commands_per_client=args.commands,
         payload_size=0,
     )
+    if args.arrivals is not None:
+        from .registry import ARRIVAL_PRESETS
+
+        if args.arrivals not in ARRIVAL_PRESETS or args.arrivals == "closed":
+            open_presets = [a for a in ARRIVAL_PRESETS if a != "closed"]
+            raise SystemExit(
+                f"unknown arrival preset {args.arrivals!r}; choose "
+                f"from {','.join(open_presets)}"
+            )
+        if args.reorder:
+            raise SystemExit(
+                "--arrivals pins FIFO delivery (the open-loop "
+                "device/oracle equivalence relies on it); drop "
+                "--reorder"
+            )
     runner = Runner(
         _oracle_protocol(args.protocol),
         planet,
@@ -195,6 +210,10 @@ def cmd_sim(args) -> None:
         list(regions),
         list(regions),
         seed=args.seed,
+        arrivals=args.arrivals,
+        arrival_load=args.offered_load,
+        arrival_gap_ms=args.arrival_gap_ms,
+        open_window=args.open_window,
     )
     if args.reorder:
         runner.reorder_messages = True
@@ -255,6 +274,28 @@ def cmd_sweep(args) -> None:
             commands=args.commands,
             clients=args.n * args.clients_per_region,
         )
+
+    if args.arrivals is not None:
+        from .registry import ARRIVAL_PRESETS
+
+        if args.arrivals not in ARRIVAL_PRESETS or args.arrivals == "closed":
+            open_presets = [a for a in ARRIVAL_PRESETS if a != "closed"]
+            raise SystemExit(
+                f"unknown arrival preset {args.arrivals!r}; choose "
+                f"from {','.join(open_presets)}"
+            )
+        if args.shards > 1:
+            raise SystemExit("--arrivals is single-shard for now")
+        if traffic in ("diurnal", "flash"):
+            raise SystemExit(
+                f"--traffic {traffic} carries think delays, which "
+                "open-loop arrivals replace; combine --arrivals with "
+                "flat or churn traffic"
+            )
+        if args.offered_load < 1 or args.open_window < 1:
+            raise SystemExit(
+                "--offered-load and --open-window must be >= 1"
+            )
 
     planet = _planet(args)
     all_regions = planet.regions()
@@ -329,6 +370,10 @@ def cmd_sweep(args) -> None:
         pool_size=args.pool_size,
         faults=fault_plans,
         traffic=traffic,
+        arrivals=args.arrivals,
+        arrival_load=args.offered_load,
+        arrival_gap_ms=args.arrival_gap_ms,
+        open_window=args.open_window,
     )
     from .parallel.aot import AotMismatchError
     from .parallel.sweep import LaneMixingError
@@ -356,6 +401,7 @@ def cmd_sweep(args) -> None:
     summary = {
         "protocol": args.protocol,
         "traffic": traffic or "flat",
+        "arrivals": args.arrivals or "closed",
         "points": len(specs),
         "errors": errs,
         "error_causes": sorted(
@@ -388,6 +434,8 @@ def cmd_sweep(args) -> None:
                 attrs["faults"] = spec.fault_meta
             if spec.traffic_meta is not None:
                 attrs["traffic"] = spec.traffic_meta
+            if spec.arrival_meta is not None:
+                attrs["arrivals"] = spec.arrival_meta
             rows.append((attrs, res))
         save_results(args.out, rows)
         summary["out"] = args.out
@@ -1195,6 +1243,22 @@ def cmd_bote_validate(args) -> None:
             f"unknown traffic preset(s) {bad}; choose from "
             f"{','.join(TRAFFIC_PRESETS)}"
         )
+    if args.rank_by == "knee":
+        from .registry import ARRIVAL_PRESETS
+
+        if args.arrival not in ARRIVAL_PRESETS or args.arrival == "closed":
+            open_presets = [a for a in ARRIVAL_PRESETS if a != "closed"]
+            raise SystemExit(
+                f"unknown arrival preset {args.arrival!r}; choose "
+                f"from {','.join(open_presets)}"
+            )
+        carry_think = [t for t in traffic if t in ("diurnal", "flash")]
+        if carry_think:
+            raise SystemExit(
+                f"--traffic {','.join(carry_think)} carries think "
+                "delays, which open-loop arrivals replace; --rank-by "
+                "knee combines with flat or churn traffic"
+            )
     planet = _planet(args)
     params = RankingParams(
         min_mean_fpaxos_improv=args.min_mean_improv,
@@ -1228,6 +1292,12 @@ def cmd_bote_validate(args) -> None:
             budget_s=args.budget_s,
             dryrun=args.dryrun,
             out=args.out,
+            rank_by=args.rank_by,
+            arrival=args.arrival,
+            loads=args.loads,
+            open_window=args.open_window,
+            mean_gap_ms=args.mean_gap_ms,
+            knee_mult=args.knee_mult,
         )
     except (CheckpointError, CampaignError) as e:
         print(
@@ -1239,6 +1309,88 @@ def cmd_bote_validate(args) -> None:
     if artifact is None:
         print(
             f"validation interrupted ({summary['interrupted']}); the "
+            "campaign is checkpointed — re-run with --resume to "
+            "continue",
+            file=sys.stderr,
+        )
+        raise SystemExit(EXIT_INTERRUPTED)
+
+
+def cmd_knee(args) -> None:
+    """Measured throughput–latency knee sweep (serving/knee.py): one
+    open-loop arrival preset at a ladder of offered loads per
+    (protocol, region-set, traffic) point, through the campaign
+    manager (resumable across SIGKILL); once the grid completes, the
+    latency-vs-offered-load curves and the located knee are written as
+    one canonical knee.json artifact. --dryrun emits the parameter
+    shell with points: null (the CI schema-check path)."""
+    from .campaign import CampaignError
+    from .engine.checkpoint import CheckpointError
+    from .registry import ARRIVAL_PRESETS, TRAFFIC_PRESETS
+    from .serving import run_knee_sweep
+
+    protocols = args.protocols.split(",")
+    unknown = [p for p in protocols if p not in ENGINE_PROTOCOLS]
+    if unknown:
+        raise SystemExit(
+            f"unknown protocol(s) {unknown}; choose from "
+            f"{','.join(ENGINE_PROTOCOLS)}"
+        )
+    if args.arrival not in ARRIVAL_PRESETS or args.arrival == "closed":
+        open_presets = [a for a in ARRIVAL_PRESETS if a != "closed"]
+        raise SystemExit(
+            f"unknown arrival preset {args.arrival!r}; choose from "
+            f"{','.join(open_presets)}"
+        )
+    traffic = args.traffic.split(",")
+    bad = [t for t in traffic if t not in TRAFFIC_PRESETS]
+    if bad:
+        raise SystemExit(
+            f"unknown traffic preset(s) {bad}; choose from "
+            f"{','.join(TRAFFIC_PRESETS)}"
+        )
+    carry_think = [t for t in traffic if t in ("diurnal", "flash")]
+    if carry_think:
+        raise SystemExit(
+            f"--traffic {','.join(carry_think)} carries think delays, "
+            "which open-loop arrivals replace; combine with flat or "
+            "churn traffic"
+        )
+    region_sets = [args.regions] if args.regions else None
+    try:
+        artifact, summary = run_knee_sweep(
+            args.dir,
+            protocols=protocols,
+            ns=args.ns,
+            region_sets=region_sets,
+            arrival=args.arrival,
+            loads=args.loads,
+            traffic=traffic,
+            fs=args.fs or [1],
+            conflicts=args.conflicts,
+            commands_per_client=args.commands,
+            clients_per_region=args.clients_per_region,
+            open_window=args.open_window,
+            mean_gap_ms=args.mean_gap_ms,
+            batch_lanes=args.batch_lanes,
+            segment_steps=args.segment_steps,
+            knee_mult=args.knee_mult,
+            aws=bool(args.aws),
+            resume=args.resume,
+            budget_s=args.budget_s,
+            dryrun=args.dryrun,
+            out=args.out,
+        )
+    except (CheckpointError, CampaignError) as e:
+        print(
+            f"knee sweep refused: {type(e).__name__}: {e}",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    print(json.dumps(summary))
+    if artifact is None:
+        print(
+            f"knee sweep interrupted ({summary['interrupted']}); the "
             "campaign is checkpointed — re-run with --resume to "
             "continue",
             file=sys.stderr,
@@ -1499,6 +1651,16 @@ def main(argv=None) -> None:
     sub = parser.add_subparsers(dest="cmd", required=True)
 
     sim = sub.add_parser("sim", help="one oracle DES run (exact)")
+    sim.add_argument("--arrivals", default=None,
+                     help="open-loop arrival preset "
+                     "(poisson,burst,ramp); mirrors the engine's "
+                     "open-loop client mode bit-exactly")
+    sim.add_argument("--offered-load", type=int, default=100,
+                     help="open-loop offered load (percent of base)")
+    sim.add_argument("--open-window", type=int, default=4,
+                     help="open-loop in-flight cap per client")
+    sim.add_argument("--arrival-gap-ms", type=int, default=4,
+                     help="base mean inter-arrival gap (ms) at 100%% load")
     _add_common(sim, sweep=False)
     sim.add_argument("--f", type=int, default=1)
     sim.add_argument("--reorder", action="store_true")
@@ -1531,6 +1693,35 @@ def main(argv=None) -> None:
         "point (flat,diurnal,flash,churn — docs/TRAFFIC.md); presets "
         "compose with each point's conflict rate; flat/omitted = the "
         "static workload",
+    )
+    sw.add_argument(
+        "--arrivals",
+        default=None,
+        help="open-loop arrival preset applied to every sweep point "
+        "(poisson,burst,ramp — docs/TRAFFIC.md 'Open-loop arrivals'): "
+        "commands are timestamped by seeded arrival draws independent "
+        "of completion, a bounded in-flight window queues the rest, "
+        "and queue delay counts into latency; omitted = closed loop",
+    )
+    sw.add_argument(
+        "--offered-load",
+        type=int,
+        default=100,
+        help="open-loop offered load as a percent of the preset's "
+        "base arrival rate (100 = as authored; 200 = halved gaps)",
+    )
+    sw.add_argument(
+        "--open-window",
+        type=int,
+        default=4,
+        help="open-loop in-flight cap per client; arrivals beyond it "
+        "wait in the arrival queue (their wait lands in latency)",
+    )
+    sw.add_argument(
+        "--arrival-gap-ms",
+        type=int,
+        default=4,
+        help="open-loop base mean inter-arrival gap in ms at 100%% load",
     )
     sw.add_argument(
         "--shard-lanes",
@@ -1866,6 +2057,28 @@ def main(argv=None) -> None:
     bt.add_argument("--pool-size", type=int, default=1)
     bt.add_argument("--batch-lanes", type=int, default=64)
     bt.add_argument("--segment-steps", type=int, default=2048)
+    bt.add_argument("--rank-by", default="score",
+                    choices=["score", "knee"],
+                    help="knee: replace the closed-loop conflict grid "
+                    "with an open-loop offered-load ladder "
+                    "(serving/knee.py) and re-rank candidates by their "
+                    "measured throughput-latency knee")
+    bt.add_argument("--arrival", default="poisson",
+                    help="open-loop arrival preset for --rank-by knee "
+                    "(poisson,burst,ramp)")
+    bt.add_argument("--loads", type=_ints, default=None,
+                    help="offered-load ladder (percent of base rate) "
+                    "for --rank-by knee; default 50,100,200,400")
+    bt.add_argument("--open-window", type=int, default=4,
+                    help="open-loop in-flight cap per client "
+                    "(--rank-by knee)")
+    bt.add_argument("--mean-gap-ms", type=int, default=4,
+                    help="base mean inter-arrival gap in ms at 100%% "
+                    "load (--rank-by knee)")
+    bt.add_argument("--knee-mult", type=float, default=None,
+                    help="knee threshold: first load whose p99 exceeds "
+                    "this multiple of the lowest load's p99 "
+                    "(default 3.0)")
     bt.add_argument("--resume", action="store_true",
                     help="continue an interrupted validation campaign")
     bt.add_argument("--budget-s", type=float, default=None)
@@ -1876,6 +2089,57 @@ def main(argv=None) -> None:
                     help="frontier artifact path (default "
                     "<dir>/frontier.json)")
     bt.set_defaults(fn=cmd_bote)
+
+    kn = sub.add_parser(
+        "knee",
+        help="measured throughput-latency knee sweep: an open-loop "
+        "arrival preset at a ladder of offered loads, through the "
+        "campaign manager, emitting latency-vs-offered-load curves "
+        "and the located knee as knee.json (serving/knee.py)",
+    )
+    kn.add_argument("--dir", required=True,
+                    help="campaign/artifact directory")
+    kn.add_argument("--protocols", default="tempo,fpaxos",
+                    help="comma-separated engine protocols")
+    kn.add_argument("--ns", type=_ints, default=[3],
+                    help="region-set sizes when --regions unset")
+    kn.add_argument("--regions", type=lambda s: s.split(","),
+                    default=None,
+                    help="comma-separated region names (default: the "
+                    "campaign manager's per-n default sets)")
+    kn.add_argument("--arrival", default="poisson",
+                    help="open-loop arrival preset (poisson,burst,ramp)")
+    kn.add_argument("--loads", type=_ints, default=[50, 100, 200, 400],
+                    help="offered-load ladder as percent of the "
+                    "preset's base rate")
+    kn.add_argument("--traffic", default="flat",
+                    help="comma-separated traffic presets (flat,churn; "
+                    "diurnal/flash carry think delays and are refused)")
+    kn.add_argument("--fs", type=_ints, default=None)
+    kn.add_argument("--conflicts", type=_ints, default=[100])
+    kn.add_argument("--commands", type=int, default=20,
+                    help="commands per client per lane")
+    kn.add_argument("--clients-per-region", type=int, default=1)
+    kn.add_argument("--open-window", type=int, default=4,
+                    help="open-loop in-flight cap per client")
+    kn.add_argument("--mean-gap-ms", type=int, default=4,
+                    help="base mean inter-arrival gap in ms at 100%% "
+                    "load")
+    kn.add_argument("--knee-mult", type=float, default=3.0,
+                    help="knee threshold: first load whose p99 exceeds "
+                    "this multiple of the lowest load's p99")
+    kn.add_argument("--batch-lanes", type=int, default=64)
+    kn.add_argument("--segment-steps", type=int, default=2048)
+    kn.add_argument("--aws", action="store_true")
+    kn.add_argument("--resume", action="store_true",
+                    help="continue an interrupted knee campaign")
+    kn.add_argument("--budget-s", type=float, default=None)
+    kn.add_argument("--dryrun", action="store_true",
+                    help="skip the device sweeps; emit the artifact "
+                    "shell with points: null (schema-check path)")
+    kn.add_argument("--out", default=None,
+                    help="knee artifact path (default <dir>/knee.json)")
+    kn.set_defaults(fn=cmd_knee)
 
     pr = sub.add_parser(
         "proc", help="run one replica server over TCP (run layer)"

@@ -180,6 +180,21 @@ class KeyGenState:
                             for k, v in kg.traffic.compile(need).items()
                         }
                     )
+                    if kg.zipf is not None:
+                        # epoch-varying zipf mirror: the identical
+                        # [E, K] cumulative table make_lane ships as
+                        # ctx["traffic_zipf_cum"] (engine/spec.py) —
+                        # same builder, same float32 rows, same rule
+                        # (traffic AND zipf => table present)
+                        coefficient, total_keys = kg.zipf
+                        ctx.update(
+                            {
+                                k: jnp.asarray(v)
+                                for k, v in kg.traffic.zipf_tables(
+                                    coefficient, int(total_keys)
+                                ).items()
+                            }
+                        )
                 self._stream_ctx = ctx
             seqs = jnp.arange(lo, lo + self._BATCH, dtype=jnp.int32)
             client_index = self.client_id - 1

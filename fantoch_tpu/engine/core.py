@@ -367,11 +367,25 @@ def gen_key(ctx, client, cmd_seq):
         )
         pool = jnp.where(conflict, pool_key, ctx["pool_size"] + client)
     u = jr.uniform(jr.fold_in(k, 2), ())
+    if "traffic_zipf_cum" in ctx:
+        # epoch-varying Zipf (KeyGen::Zipf under a schedule): the [E, K]
+        # cumulative table's row for this command's epoch replaces the
+        # static zipf_cum before the inverse-CDF draw — same fold-in
+        # stream, so a single-epoch override degenerates to the static
+        # draw over the overridden table
+        tbl = ctx["traffic_seq_epoch"]
+        ze = oh_take(
+            tbl,
+            jnp.minimum(jnp.asarray(cmd_seq, I32), tbl.shape[0] - 1),
+        )
+        zipf_cum = oh_get(ctx["traffic_zipf_cum"], ze)
+    else:
+        zipf_cum = ctx["zipf_cum"]
     # clamp: float32 rounding can leave cum[-1] < 1.0, and a draw at or
     # above it would index one past the table
     zipf = jnp.minimum(
-        jnp.searchsorted(ctx["zipf_cum"], u, side="right"),
-        ctx["zipf_cum"].shape[0] - 1,
+        jnp.searchsorted(zipf_cum, u, side="right"),
+        zipf_cum.shape[0] - 1,
     )
     return jnp.where(ctx["key_gen_kind"] == 0, pool, zipf).astype(I32)
 
@@ -404,12 +418,16 @@ TRAFFIC_CTX_FIELDS = (
 def keygen_ctx_fields(ctx) -> tuple:
     """The ctx keys :func:`gen_key` reads for this lane's structure —
     the base generator fields plus, when the lane carries a traffic
-    schedule, its epoch tables. Every caller that slices a keygen ctx
-    (key tables, lane-state init, the host DeviceStream mirror) must
-    use this so schedule-driven keys stay bit-identical everywhere."""
+    schedule, its epoch tables (and the epoch-varying zipf table when
+    present). Every caller that slices a keygen ctx (key tables,
+    lane-state init, the host DeviceStream mirror) must use this so
+    schedule-driven keys stay bit-identical everywhere."""
+    fields = KEYGEN_CTX_FIELDS
     if "traffic_seq_epoch" in ctx:
-        return KEYGEN_CTX_FIELDS + TRAFFIC_CTX_FIELDS
-    return KEYGEN_CTX_FIELDS
+        fields = fields + TRAFFIC_CTX_FIELDS
+    if "traffic_zipf_cum" in ctx:
+        fields = fields + ("traffic_zipf_cum",)
+    return fields
 
 
 def first_keys_fn(C: int):
@@ -499,11 +517,18 @@ def init_lane_state(
         )
     else:
         think0 = 0
+    # open loop: the first SUBMIT leaves at its *arrival* time A(c, 1)
+    # instead of t=0 (the schedule's first inter-arrival gap; think is
+    # asserted zero for open-loop lanes in make_lane)
+    open_loop = "ol_arrival" in ctx_np
     slot = 0
     for c in range(C):
         if not live[c]:
             continue
-        pool[slot, PA] = ctx_np["client_delay"][c, attach[c]] + think0
+        release0 = (
+            int(ctx_np["ol_arrival"][c, 1]) if open_loop else think0
+        )
+        pool[slot, PA] = ctx_np["client_delay"][c, attach[c]] + release0
         # each client's first SUBMIT is emission #1 on its channel
         pool[slot, PKS] = N + c
         pool[slot, PKC] = 1
@@ -525,20 +550,33 @@ def init_lane_state(
     next_periodic[live_rows:, :] = INF
 
     mon = monitor.mon_init(dims, monitor_keys) if monitor_keys else {}
+    clients = {
+        "issued": live.astype(np.int32),
+        "completed": np.zeros((C,), np.int32),
+        "start_time": np.zeros((C,), np.int32),
+        # result parts (per-key/per-shard partials) of the command
+        # in flight + latest part arrival
+        "parts": np.zeros((C,), np.int32),
+        "part_max": np.zeros((C,), np.int32),
+    }
+    if open_loop:
+        W = int(ctx_np["ol_window"])
+        clients.update({
+            # completion-time ring (GL202-bounded arrival-queue plane):
+            # completion #k of client c lands at slot (k-1) mod W —
+            # overwrite-safe because command s stages only after s-W
+            # completed, so at most W live entries exist at once
+            "ol_comp_t": np.zeros((C, W), np.int32),
+            # monotone release clamp R(s) = max(A(s), F(s), R(s-1));
+            # seeds at the first arrival
+            "ol_last_rel": ctx_np["ol_arrival"][:, 1].astype(np.int32),
+        })
     return {
         **mon,
         "pool": pool,
         "ps": protocol.init_state(dims, ctx_np),
         "next_periodic": next_periodic,
-        "clients": {
-            "issued": live.astype(np.int32),
-            "completed": np.zeros((C,), np.int32),
-            "start_time": np.zeros((C,), np.int32),
-            # result parts (per-key/per-shard partials) of the command
-            # in flight + latest part arrival
-            "parts": np.zeros((C,), np.int32),
-            "part_max": np.zeros((C,), np.int32),
-        },
+        "clients": clients,
         "metrics": {
             "hist": np.zeros((dims.RR, dims.H), np.int32),
             "lat_sum": np.zeros((dims.RR,), np.int32),
@@ -805,20 +843,108 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False,
         "delay": jnp.ones((N, 1), I32),
         "src": popped_rows[:, PSRC][:, None],
     }
-    F2 = 2 * F + 1
-    out = jax.tree_util.tree_map(
-        lambda a, b, r: jnp.concatenate([a, b, r], axis=1).reshape(
-            (N * F2,) + a.shape[2:]
-        ),
-        pout,
-        outbox,
-        rq,
-    )
+    open_loop = "ol_arrival" in ctx
+    if open_loop:
+        # open-loop trigger 1 — arrival-queue staging at SUBMIT pop
+        # (docs/TRAFFIC.md "Open-loop arrivals"): when a process pops
+        # client sc's SUBMIT for command s and the in-flight window
+        # already admits command q = s+1, the NEXT SUBMIT is staged
+        # immediately with release time R(q) = max(A(q), F(q),
+        # R(s)) — arrival A from the precomputed table, window gate F
+        # from the completion-time ring, monotone clamp from
+        # ol_last_rel — independent of s's completion. One extra
+        # emission row per process carries it, with the engine's
+        # delay/src override mechanism pinning its pool arrival to
+        # R(q) + d_sub (>= ep by R(q) >= R(s) = ep - d_sub, single
+        # shard). Window-full commands are staged by trigger 2 at the
+        # gate-crossing completion instead (step 5) — the two triggers
+        # target the same command under contradictory window gates, so
+        # they are mutually exclusive by construction.
+        cl0 = st["clients"]
+        A_tbl = ctx["ol_arrival"]                          # [C, T]
+        Wd = cl0["ol_comp_t"].shape[1]
+        sc = jnp.clip(msg["src"] - N, 0, C - 1)           # [N]
+        s_seq = msg["payload"][:, 1]
+        q_next = s_seq + 1
+        stage1 = (
+            msg["valid"]
+            & (msg["mtype"] == protocol.SUBMIT)
+            & (msg["src"] >= N)
+            & (s_seq == cl0["issued"][sc])
+            & (q_next <= ctx["cmd_budget"][sc])
+            & (cl0["completed"][sc] + Wd >= q_next)
+        )
+        f_gate = jnp.where(
+            q_next > Wd,
+            cl0["ol_comp_t"][sc, jnp.mod(q_next - Wd - 1, Wd)],
+            0,
+        )
+        rel1 = jnp.maximum(
+            jnp.maximum(
+                A_tbl[sc, jnp.minimum(q_next, A_tbl.shape[1] - 1)],
+                f_gate,
+            ),
+            cl0["ol_last_rel"][sc],
+        )
+        attach1 = ctx["client_attach"][sc]
+        d_sub1 = ctx["client_delay"][sc, attach1]
+        if "key_table" in ctx:
+            T_keys = ctx["key_table"].shape[1]
+            key1 = ctx["key_table"][
+                sc, jnp.minimum(q_next, T_keys - 1)
+            ]
+        else:
+            key1 = jax.vmap(lambda cc, ss: gen_key(ctx, cc, ss))(
+                sc, q_next
+            )
+        stage_payload = jnp.zeros((N, 1, P), I32)
+        stage_payload = stage_payload.at[:, 0, 0].set(sc)
+        stage_payload = stage_payload.at[:, 0, 1].set(q_next)
+        stage_payload = stage_payload.at[:, 0, 2].set(key1)
+        stage = {
+            "valid": stage1[:, None],
+            "dst": attach1[:, None],
+            "mtype": jnp.full((N, 1), protocol.SUBMIT, I32),
+            "payload": stage_payload,
+            "delay": jnp.where(stage1, rel1 + d_sub1 - ep, 0)[:, None],
+            "src": (N + sc)[:, None],
+        }
+        F2 = 2 * F + 2
+        out = jax.tree_util.tree_map(
+            lambda a, b, s, r: jnp.concatenate(
+                [a, b, s, r], axis=1
+            ).reshape((N * F2,) + a.shape[2:]),
+            pout,
+            outbox,
+            stage,
+            rq,
+        )
+    else:
+        F2 = 2 * F + 1
+        out = jax.tree_util.tree_map(
+            lambda a, b, r: jnp.concatenate([a, b, r], axis=1).reshape(
+                (N * F2,) + a.shape[2:]
+            ),
+            pout,
+            outbox,
+            rq,
+        )
     emitter = jnp.repeat(procs, F2)
     E = N * F2
     valid, dst = out["valid"], out["dst"]
     # each process's last emission row is its readiness-gate requeue
     is_rq = jnp.zeros((N, F2), bool).at[:, F2 - 1].set(True).reshape(E)
+    if open_loop:
+        # the stage row sits just before the requeue row; like requeues
+        # it is excluded from channel counting (its kcnt is the
+        # client's submit number) — its delay override already keeps it
+        # out of wire faults, scaling and prio marking
+        is_stage = (
+            jnp.zeros((N, F2), bool).at[:, F2 - 2].set(True).reshape(E)
+        )
+        stage_seq_e = (
+            jnp.zeros((N, F2), I32).at[:, F2 - 2].set(q_next).reshape(E)
+        )
 
     # 5. client rewrite: TO_CLIENT → latency record + next SUBMIT -------
     # reorder perturbation (runner.rs:520-524): every hop's delay scales
@@ -866,45 +992,136 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False,
         is_client_done[:, None] & (c[:, None] == iota_c[None, :])
     )  # [E, C]
     arrivals = jnp.sum(oh_done, axis=0, dtype=I32)                  # [C]
-    if "cmd_parts" in ctx:
-        T_parts = ctx["cmd_parts"].shape[1]
-        need = ctx["cmd_parts"][
-            iota_c, jnp.minimum(cl["issued"], T_parts - 1)
-        ]
+    if open_loop:
+        # open-loop completion accounting: every TO_CLIENT is one
+        # whole completion (single-shard single-key is asserted in
+        # make_lane, so cmd_parts is always 1) and — unlike the closed
+        # loop — several commands of one client can complete in one
+        # step (up to W are in flight). Attribution is count-based:
+        # the k-th completion of client c closes the k-th arrival.
+        # This is exactly the oracle's fold order: all of a client's
+        # TO_CLIENTs come from its single attach process, per-process
+        # handled times are nondecreasing and d_back is constant per
+        # (client, attach), so count order = time order; same-step
+        # completions all share one t_arr (one handler per process per
+        # step), making the within-step assignment multiset-invariant.
+        k0 = cl["completed"]
+        Wd = cl["ol_comp_t"].shape[1]
+        completed = k0 + arrivals
+        # one completion-arrival instant per client per step (see
+        # above); completions k0+1..k0+arrivals land in the ring at
+        # slots (k0 .. k0+arrivals-1) mod W — overwrite-safe because
+        # entry #k is next needed to gate command k+W, which cannot
+        # have been staged while #k was still in flight
+        t_c = jnp.max(jnp.where(oh_done, t_arr[:, None], 0), axis=0)
+        w_iota = jnp.arange(Wd, dtype=I32)
+        in_ring = (
+            jnp.mod(w_iota[None, :] - k0[:, None], Wd)
+            < arrivals[:, None]
+        )                                                       # [C, W]
+        ol_comp_t = jnp.where(in_ring, t_c[:, None], cl["ol_comp_t"])
+        # the closed loop's parts/start_time machinery idles (zeros)
+        parts = cl["parts"]
+        part_max = cl["part_max"]
+        start_time = cl["start_time"]
+        done_t = t_c                                            # [C]
+        row_idx = jnp.arange(E, dtype=I32)
+        last_row = jnp.max(
+            jnp.where(oh_done, row_idx[:, None], -1), axis=0
+        )                                                       # [C]
+        is_completing = (
+            is_client & (row_idx == last_row[c]) & (arrivals[c] > 0)
+        )
+        # open-loop trigger 2 — gate-crossing completion: command
+        # pend = issued+1 was window-blocked at its SUBMIT pop
+        # (trigger 1's gate failed, so ~gate_old) and this step's
+        # completions just admitted it. The last completing row is
+        # rewritten into its SUBMIT with release R(pend) =
+        # max(A(pend), t_c, R(pend-1)) — F(pend) = t_c because the
+        # gate crossed this very step, so completion #(pend-W)
+        # happened now. Mutually exclusive with trigger 1 (gate_old
+        # there is exactly ~gate_old here).
+        pend = cl["issued"] + 1                                 # [C]
+        more_c = cl["issued"] < ctx["cmd_budget"]
+        gate_new = completed + Wd >= pend
+        gate_old = k0 + Wd >= pend
+        trigger2_c = (arrivals > 0) & more_c & gate_new & ~gate_old
+        issue = is_completing & trigger2_c[c]
+        oh_issue = (
+            oh_done & (row_idx[:, None] == last_row[None, :])
+            & trigger2_c[None, :]
+        )                                                       # [E, C]
+        A_tbl = ctx["ol_arrival"]
+        rel2_c = jnp.maximum(
+            jnp.maximum(
+                A_tbl[iota_c, jnp.minimum(pend, A_tbl.shape[1] - 1)],
+                t_c,
+            ),
+            cl["ol_last_rel"],
+        )
+        # fold trigger 1 (per-process, step 4) to per-client: at most
+        # one SUBMIT per client pops per step (single attach process,
+        # one pop per process), so the one-hot has <= 1 hit per column
+        oh_t1 = stage1[:, None] & (sc[:, None] == iota_c[None, :])
+        staged1_c = jnp.any(oh_t1, axis=0)                      # [C]
+        rel1_c = jnp.sum(
+            jnp.where(oh_t1, rel1[:, None], 0), axis=0, dtype=I32
+        )
+        issued = (
+            cl["issued"]
+            + jnp.sum(oh_issue, axis=0, dtype=I32)
+            + staged1_c.astype(I32)
+        )
+        ol_last_rel = jnp.maximum(
+            cl["ol_last_rel"],
+            jnp.where(
+                staged1_c,
+                rel1_c,
+                jnp.where(trigger2_c, rel2_c, cl["ol_last_rel"]),
+            ),
+        )
     else:
-        need = jnp.ones((C,), I32)
-    parts_new = cl["parts"] + arrivals
-    # latest part arrival per client (parts can arrive out of step
-    # order under lookahead execution, so carry a running max)
-    part_max = jnp.maximum(
-        cl["part_max"],
-        jnp.max(jnp.where(oh_done, t_arr[:, None], 0), axis=0),
-    )
-    complete_c = (arrivals > 0) & (parts_new >= need)               # [C]
-    completed = cl["completed"] + complete_c.astype(I32)
-    parts = jnp.where(complete_c, 0, parts_new)
-    done_t = part_max                                               # [C]
-    latency_c = done_t - cl["start_time"]
-    part_max = jnp.where(complete_c, 0, part_max)
+        if "cmd_parts" in ctx:
+            T_parts = ctx["cmd_parts"].shape[1]
+            need = ctx["cmd_parts"][
+                iota_c, jnp.minimum(cl["issued"], T_parts - 1)
+            ]
+        else:
+            need = jnp.ones((C,), I32)
+        parts_new = cl["parts"] + arrivals
+        # latest part arrival per client (parts can arrive out of step
+        # order under lookahead execution, so carry a running max)
+        part_max = jnp.maximum(
+            cl["part_max"],
+            jnp.max(jnp.where(oh_done, t_arr[:, None], 0), axis=0),
+        )
+        complete_c = (arrivals > 0) & (parts_new >= need)           # [C]
+        completed = cl["completed"] + complete_c.astype(I32)
+        parts = jnp.where(complete_c, 0, parts_new)
+        done_t = part_max                                           # [C]
+        latency_c = done_t - cl["start_time"]
+        part_max = jnp.where(complete_c, 0, part_max)
 
-    # the completing row: the last row per client this step (row choice
-    # only picks which outbox slot carries the next SUBMIT; its base
-    # time comes from done_t)
-    row_idx = jnp.arange(E, dtype=I32)
-    last_row = jnp.max(
-        jnp.where(oh_done, row_idx[:, None], -1), axis=0
-    )                                                               # [C]
-    is_completing = is_client & (row_idx == last_row[c]) & complete_c[c]
+        # the completing row: the last row per client this step (row
+        # choice only picks which outbox slot carries the next SUBMIT;
+        # its base time comes from done_t)
+        row_idx = jnp.arange(E, dtype=I32)
+        last_row = jnp.max(
+            jnp.where(oh_done, row_idx[:, None], -1), axis=0
+        )                                                           # [C]
+        is_completing = (
+            is_client & (row_idx == last_row[c]) & complete_c[c]
+        )
 
-    more = cl["issued"][c] < ctx["cmd_budget"][c]
-    issue = is_completing & more
-    oh_issue = (
-        oh_done & (row_idx[:, None] == last_row[None, :])
-        & complete_c[None, :] & more[:, None]
-    )                                                               # [E, C]
-    issued = cl["issued"] + jnp.sum(oh_issue, axis=0, dtype=I32)
-    st_new = jnp.where(jnp.any(oh_issue, axis=0), done_t, -1)
-    start_time = jnp.where(st_new >= 0, st_new, cl["start_time"])
+        more = cl["issued"][c] < ctx["cmd_budget"][c]
+        issue = is_completing & more
+        oh_issue = (
+            oh_done & (row_idx[:, None] == last_row[None, :])
+            & complete_c[None, :] & more[:, None]
+        )                                                           # [E, C]
+        issued = cl["issued"] + jnp.sum(oh_issue, axis=0, dtype=I32)
+        st_new = jnp.where(jnp.any(oh_issue, axis=0), done_t, -1)
+        start_time = jnp.where(st_new >= 0, st_new, cl["start_time"])
     next_seq = cl["issued"][c] + 1
     if "key_table" in ctx:
         # precomputed (client, seq) → key table: no RNG in the loop
@@ -922,10 +1139,30 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False,
     # metrics on completion only (hist/lat_log keep their scatters —
     # their one-hot forms would materialize [E, RR, H]-scale
     # intermediates)
-    latency = latency_c[c]
-    row = jnp.where(
-        is_completing, ctx["client_region_row"][c], dims.RR
-    )
+    if open_loop:
+        # queue-delay-inclusive latency, one record per TO_CLIENT row
+        # (several of one client can land in a step): completion
+        # #(k0 + within-step row rank) closes arrival #k, so latency =
+        # t_arr - A(k) — the arrival-queue wait plus the full protocol
+        # round trip. Ranks among same-step rows are by row order,
+        # which is sound because they all share one t_arr (see the
+        # completion-accounting comment above).
+        same_cd = (c[:, None] == c[None, :]) & is_client_done[None, :]
+        rank_e = jnp.sum(
+            same_cd & (row_idx[None, :] <= row_idx[:, None]),
+            axis=1, dtype=I32,
+        )
+        k_i = cl["completed"][c] + rank_e
+        latency = t_arr - ctx["ol_arrival"][
+            c, jnp.minimum(k_i, ctx["ol_arrival"].shape[1] - 1)
+        ]
+        rec = is_client_done
+        log_src = k_i - 1
+    else:
+        latency = latency_c[c]
+        rec = is_completing
+        log_src = cl["completed"][c]
+    row = jnp.where(rec, ctx["client_region_row"][c], dims.RR)
     bucket = jnp.clip(latency, 0, dims.H - 1)
     metrics = st["metrics"]
     hist = metrics["hist"].at[row, bucket].add(1, mode="drop")
@@ -934,9 +1171,9 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False,
         jnp.where(oh_row, latency[:, None], 0), axis=0, dtype=I32
     )
     lat_count = metrics["lat_count"] + jnp.sum(oh_row, axis=0, dtype=I32)
-    log_idx = jnp.where(is_completing, cl["completed"][c], LAT_LOG)
+    log_idx = jnp.where(rec, log_src, LAT_LOG)
     lat_log = metrics["lat_log"].at[
-        jnp.where(is_completing, c, C), log_idx
+        jnp.where(rec, c, C), log_idx
     ].set(latency, mode="drop")
 
     # rewrite entries in place
@@ -959,7 +1196,13 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False,
     # traffic schedule adds the issued command's epoch think delay —
     # diurnal load — which the oracle mirrors as extra submit distance
     # (structure-gated: schedule-less lanes trace the exact line below)
-    if "traffic_think" in ctx:
+    if open_loop:
+        # trigger-2 SUBMITs leave at the staged release time R(pend),
+        # not at completion: queue delay (release - arrival) is the
+        # open loop's latency component, not an issue-time shift.
+        # Think delays are asserted zero for open-loop lanes.
+        base = jnp.where(issue, rel2_c[c], ep_e)
+    elif "traffic_think" in ctx:
         tbl = ctx["traffic_seq_epoch"]
         e_next = oh_take(tbl, jnp.minimum(next_seq, tbl.shape[0] - 1))
         think = oh_take(ctx["traffic_think"], e_next)
@@ -1036,9 +1279,16 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False,
     # keep their place in the per-channel FIFO order and never consume
     # channel counter values
     dst_b = dst.reshape(N, F2)
-    chan_b = (
-        (valid & ~is_client & ~is_rq).reshape(N, F2)
-    )  # channel-counted rows
+    if open_loop:
+        # staged SUBMITs are client emissions (kcnt = submit number
+        # below), never channel-counted process sends
+        chan_b = (valid & ~is_client & ~is_rq & ~is_stage).reshape(
+            N, F2
+        )
+    else:
+        chan_b = (
+            (valid & ~is_client & ~is_rq).reshape(N, F2)
+        )  # channel-counted rows
     same = (dst_b[:, None, :] == dst_b[:, :, None]) & chan_b[:, None, :]
     rank_b = jnp.sum(
         same & (rows[None, :] < rows[:, None])[None], axis=2
@@ -1056,8 +1306,15 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False,
         st["pair_cnt"][emitter, safe_dst] + rank_b.reshape(E) + 1,
     )
     kcnt = jnp.where(is_rq, orig_kcnt, kcnt)
+    if open_loop:
+        # a staged SUBMIT's tie-break key is the client's submit
+        # number, like rewritten SUBMITs — same (ksrc, kcnt) contract
+        # the oracle keys client channels by
+        kcnt = jnp.where(is_stage, stage_seq_e, kcnt)
+        counted = valid & ~is_client & ~is_rq & ~is_stage
+    else:
+        counted = valid & ~is_client & ~is_rq
     ksrc = src  # N + c for client-issued SUBMITs, emitter otherwise
-    counted = valid & ~is_client & ~is_rq
     ohe = emitter[:, None] == procs[None, :]                  # [E, N]
     ohd = (dst[:, None] == procs[None, :]) & counted[:, None]
     pair_cnt = st["pair_cnt"] + jnp.sum(
@@ -1172,18 +1429,22 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False,
         if monitor_keys
         else {}
     )
+    clients_out = {
+        "issued": issued,
+        "completed": completed,
+        "start_time": start_time,
+        "parts": parts,
+        "part_max": part_max,
+    }
+    if open_loop:
+        clients_out["ol_comp_t"] = ol_comp_t
+        clients_out["ol_last_rel"] = ol_last_rel
     return {
         **out_mon,
         "pool": new_pool,
         "ps": ps,
         "next_periodic": next_periodic,
-        "clients": {
-            "issued": issued,
-            "completed": completed,
-            "start_time": start_time,
-            "parts": parts,
-            "part_max": part_max,
-        },
+        "clients": clients_out,
         "metrics": {
             "hist": hist,
             "lat_sum": lat_sum,

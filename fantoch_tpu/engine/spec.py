@@ -47,6 +47,9 @@ class LaneSpec:
     # traffic-schedule metadata (fantoch_tpu/traffic); None for static
     # lanes AND for flat schedules (which collapse to the static path)
     traffic_meta: "dict | None" = None
+    # open-loop arrival-schedule metadata (docs/TRAFFIC.md "Open-loop
+    # arrivals"); None for closed-loop lanes
+    arrival_meta: "dict | None" = None
 
 
 def _sorted_indices(planet: Planet, process_regions: Sequence[str]) -> np.ndarray:
@@ -79,6 +82,10 @@ def make_lane(
     reorder: bool = False,
     faults: "FaultPlan | None" = None,
     traffic=None,
+    arrivals=None,
+    arrival_load: int = 100,
+    arrival_gap_ms: int = 4,
+    open_window: int = 4,
 ) -> LaneSpec:
     """``zipf=(coefficient, total_keys)`` switches the workload from the
     ConflictPool generator to Zipf sampling over ``total_keys`` keys
@@ -116,7 +123,21 @@ def make_lane(
     the GL005 gating pin survive; only non-flat schedules add the
     ``traffic_*`` epoch tables (structure-gated in engine/core.py).
     Lanes of one batch must agree on having (or not having) tables —
-    ``stack_lanes`` refuses a mix."""
+    ``stack_lanes`` refuses a mix.
+
+    ``arrivals`` attaches an open-loop arrival process (docs/TRAFFIC.md
+    "Open-loop arrivals"): an
+    :class:`~fantoch_tpu.traffic.ArrivalSchedule`, a preset name from
+    ``registry.ARRIVAL_PRESETS`` (resolved against this lane's
+    ``arrival_gap_ms``/``commands_per_client``), a JSON schedule dict,
+    or None/"closed" — the closed loop, tracing the bit-identical
+    legacy jaxpr. Open-loop lanes timestamp every command by a seeded
+    arrival draw independent of completion; at most ``open_window``
+    commands are in flight per client (window-blocked commands queue,
+    and queue delay counts into latency). ``arrival_load`` scales the
+    offered load (percent of the schedule's base rate). Open-loop
+    lanes are single-shard, non-reorder, think-free; the closed- and
+    open-loop forms never share a batch (``stack_lanes`` refuses)."""
     n = config.n
     S = config.shard_count
     assert len(process_regions) == n
@@ -139,14 +160,36 @@ def make_lane(
         traffic = None
     traffic_meta = None
     if traffic is not None:
-        assert zipf is None, (
-            "traffic schedules drive the ConflictPool generator; Zipf "
-            "lanes take the static path"
-        )
         assert S == 1 and getattr(protocol, "KPC", 1) == 1, (
             "traffic schedules are single-shard/single-key for now"
         )
         traffic_meta = traffic.meta()
+
+    from ..traffic.schedule import resolve_arrivals
+
+    arrivals = resolve_arrivals(
+        arrivals, mean_gap_ms=arrival_gap_ms,
+        commands=commands_per_client, load_pct=arrival_load,
+    )
+    arrival_meta = None
+    if arrivals is not None:
+        assert S == 1 and getattr(protocol, "KPC", 1) == 1, (
+            "open-loop arrivals are single-shard/single-key for now"
+        )
+        assert not reorder, (
+            "open-loop arrivals need the deterministic delay matrix "
+            "(count-based completion attribution); reorder lanes are "
+            "closed-loop only"
+        )
+        assert traffic is None or all(
+            p.think_ms == 0 for p in traffic.phases
+        ), (
+            "think delays model a closed loop's idle time between "
+            "commands; an open-loop lane's issue times come from the "
+            "arrival schedule instead"
+        )
+        assert open_window >= 1, open_window
+        arrival_meta = dict(arrivals.meta(), window=int(open_window))
 
     if faults is not None and faults.is_noop():
         faults = None
@@ -316,6 +359,22 @@ def make_lane(
             "out-of-range keys would be silently dropped"
         )
         ctx.update(traffic.compile(commands_per_client))
+        if zipf is not None:
+            # epoch-varying Zipf (satellite of docs/TRAFFIC.md): one
+            # cumulative row per phase, phase coef 0.0 = the lane's
+            # base coefficient; gen_key gathers the command's epoch
+            # row, the DeviceStream mirror builds the identical table
+            ctx.update(traffic.zipf_tables(zipf[0], int(zipf[1])))
+    if arrivals is not None:
+        # the whole per-client arrival-time table is drawn host-side
+        # once and shipped verbatim to the engine AND the host oracle
+        # (sim/runner.py) — bit-exact mirroring by construction; the
+        # in-step queue plane (clients/ol_comp_t) is [C, open_window],
+        # GL202-bounded by the compile-time window knob
+        ctx["ol_arrival"] = arrivals.arrival_table(
+            seed=seed, clients=C, commands=commands_per_client,
+        )
+        ctx["ol_window"] = np.int32(open_window)
     ctx.update(fault_ctx(faults, dims))
     ctx["fault_unavail"] = np.int32(1 if unavail else 0)
     if S > 1 or getattr(protocol, "KPC", 1) > 1:
@@ -341,6 +400,7 @@ def make_lane(
             else None
         ),
         traffic_meta=traffic_meta,
+        arrival_meta=arrival_meta,
     )
 
 

@@ -74,6 +74,10 @@ def make_sweep_specs(
     pool_size: int = 1,
     faults: "Sequence[FaultPlan | None] | None" = None,
     traffic=None,
+    arrivals=None,
+    arrival_load: int = 100,
+    arrival_gap_ms: int = 4,
+    open_window: int = 4,
 ) -> List[LaneSpec]:
     """The sweep grid: one lane per (region set, f, conflict) point —
     replicated once per entry of ``faults`` (None = fault-free), so a
@@ -85,7 +89,16 @@ def make_sweep_specs(
     schedule instead of being overridden — a
     :class:`~fantoch_tpu.traffic.TrafficSchedule`, or None/"flat" for
     the static path. One sweep = one schedule; a traffic *axis* is the
-    campaign grid's job (campaign/manager.py)."""
+    campaign grid's job (campaign/manager.py).
+
+    ``arrivals`` switches every point to the open-loop client mode
+    (docs/TRAFFIC.md "Open-loop arrivals"): a preset name
+    (``registry.ARRIVAL_PRESETS``) resolved against ``arrival_gap_ms``
+    and scaled by ``arrival_load`` (percent of the preset's base
+    offered load), an :class:`~fantoch_tpu.traffic.ArrivalSchedule`,
+    or None/"closed" for the closed-loop static path. Like traffic,
+    one sweep = one (arrival process, offered load) point; the load
+    axis is the campaign grid's / knee sweep's job (serving/knee.py)."""
     base = config_base or Config(n=len(region_sets[0]), f=1,
                                  gc_interval_ms=100)
     plans: Sequence["FaultPlan | None"] = faults or [None]
@@ -111,6 +124,10 @@ def make_sweep_specs(
                 seed=i // len(plans),  # same workload across a point's plans
                 faults=plan,
                 traffic=traffic,
+                arrivals=arrivals,
+                arrival_load=arrival_load,
+                arrival_gap_ms=arrival_gap_ms,
+                open_window=open_window,
             )
         )
     return specs
@@ -579,6 +596,14 @@ def _run_sweep(
                     for s in specs
                 }
             ),
+            # arrival-process names (open-loop client mode), with the
+            # same by-name refusal contract as `traffic`
+            "arrivals": sorted(
+                {
+                    (s.arrival_meta or {"name": "closed"})["name"]
+                    for s in specs
+                }
+            ),
             # the storage-dtype spec of the saved state planes: a
             # resume whose narrowing disagrees (different budgets, a
             # narrow=False run, a pre-narrowing checkpoint) is refused
@@ -593,6 +618,7 @@ def _run_sweep(
                     "regions": list(s.process_regions),
                     "faults": s.fault_meta,
                     "traffic": s.traffic_meta,
+                    "arrivals": s.arrival_meta,
                 }
                 for s in specs
             ],
@@ -609,6 +635,13 @@ def _run_sweep(
             # mismatches are still refused — by the jaxpr signature and
             # the ctx field/bit compare.
             expect_keys.append("traffic")
+        if ckpt_meta["arrivals"] != ["closed"]:
+            # same legacy-compat rule for the open-loop arrival axis:
+            # pre-arrivals checkpoints carry no `arrivals` key and a
+            # closed-loop batch is bit-compatible with them; a resume
+            # onto a different arrival schedule is refused by name
+            # (the ol_arrival table is also bit-compared via the ctx)
+            expect_keys.append("arrivals")
         if ck.resume and checkpoint_exists(ck.path):
             # a stale/corrupted artifact raises here — refusal, not a
             # silent from-scratch rerun. Artifacts are pad-free (the

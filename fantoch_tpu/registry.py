@@ -52,6 +52,7 @@ TRACED_SCAN_PATHS = (
     "fantoch_tpu/engine/protocols",
     "fantoch_tpu/campaign",
     "fantoch_tpu/traffic",
+    "fantoch_tpu/serving",
     "fantoch_tpu/bote/validate.py",
     "fantoch_tpu/parallel",
     "fantoch_tpu/fleet",
@@ -88,6 +89,7 @@ DETERMINISM_SCAN_PATHS = (
     "fantoch_tpu/mc",
     "fantoch_tpu/parallel",
     "fantoch_tpu/bote",
+    "fantoch_tpu/serving",
     "fantoch_tpu/engine/checkpoint.py",
     "fantoch_tpu/cli.py",
 )
@@ -197,4 +199,70 @@ def traffic_preset(name, *, conflict, pool_size=1, commands):
     raise ValueError(
         f"unknown traffic preset {name!r}; choose from "
         f"{','.join(TRAFFIC_PRESETS)}"
+    )
+
+
+# named open-loop arrival presets (fantoch_tpu/traffic ArrivalSchedule,
+# docs/TRAFFIC.md "Open-loop arrivals"): the campaign grid's `arrivals`
+# axis and `sweep --arrivals` accept exactly these. Presets are
+# parameterized by the lane's base mean inter-arrival gap and command
+# budget so they compose with the offered-load axis (which scales the
+# gaps) instead of overriding it.
+ARRIVAL_PRESETS = ("closed", "poisson", "burst", "ramp")
+
+
+def arrival_preset(name, *, mean_gap_ms, commands):
+    """Resolve an arrival preset name to a plain schedule dict (the
+    JSON form ``fantoch_tpu.traffic.ArrivalSchedule.from_json``
+    consumes), or None for ``"closed"`` — the closed-loop static path
+    by construction.
+
+    Kept jax/numpy-free on purpose: the CLI builds campaign grids from
+    these before any backend initializes (see module docstring).
+
+    * ``closed`` — no arrival process; the lane traces the
+      bit-identical closed-loop jaxpr (the arrivals axis's control
+      point).
+    * ``poisson`` — a stationary Poisson process: one phase,
+      exponential gaps of mean ``mean_gap_ms`` over the whole budget.
+    * ``burst`` — base Poisson traffic, then a burst at ~8x the rate
+      over ~a fifth of the budget, then recovery at the base rate.
+    * ``ramp`` — offered load doubling in four steps: gaps 4x -> 2x ->
+      1x -> 0.5x the base mean, a quarter of the budget each.
+    """
+    if name == "closed":
+        return None
+    assert commands >= 1, "presets scale to the per-client budget"
+    assert mean_gap_ms >= 1, "the engine clock is integer ms"
+    if name == "poisson":
+        return {
+            "name": "poisson",
+            "cycle": False,
+            "phases": [
+                dict(commands=commands, mean_gap_ms=mean_gap_ms)
+            ],
+        }
+    if name == "burst":
+        spike = max(1, commands // 5)
+        pre = max(1, (commands - spike) // 2)
+        phases = [
+            dict(commands=pre, mean_gap_ms=mean_gap_ms),
+            dict(commands=spike,
+                 mean_gap_ms=max(1, mean_gap_ms // 8)),
+            dict(commands=max(1, commands - pre - spike),
+                 mean_gap_ms=mean_gap_ms),
+        ]
+        return {"name": "burst", "cycle": False, "phases": phases}
+    if name == "ramp":
+        q = max(1, commands // 4)
+        phases = [
+            dict(commands=q, mean_gap_ms=mean_gap_ms * 4),
+            dict(commands=q, mean_gap_ms=mean_gap_ms * 2),
+            dict(commands=q, mean_gap_ms=mean_gap_ms),
+            dict(commands=q, mean_gap_ms=max(1, mean_gap_ms // 2)),
+        ]
+        return {"name": "ramp", "cycle": False, "phases": phases}
+    raise ValueError(
+        f"unknown arrival preset {name!r}; choose from "
+        f"{','.join(ARRIVAL_PRESETS)}"
     )

@@ -84,6 +84,10 @@ class Runner:
         seed: int = 0,
         fault_plan: Optional[FaultPlan] = None,
         traffic=None,
+        arrivals=None,
+        arrival_load: int = 100,
+        arrival_gap_ms: int = 4,
+        open_window: int = 4,
     ):
         assert len(process_regions) == config.n
         assert config.gc_interval_ms is not None
@@ -95,6 +99,52 @@ class Runner:
         # the workload's DeviceStream(traffic=...) generator; pass the
         # SAME schedule in both places for differential runs.
         self._traffic = traffic
+
+        # open-loop arrival mirror (fantoch_tpu/traffic ArrivalSchedule,
+        # docs/TRAFFIC.md "Open-loop arrivals"): the oracle builds the
+        # SAME seeded arrival table the engine ships as ctx["ol_arrival"]
+        # (engine/spec.py make_lane) and replays the engine's two
+        # staging triggers — at-SUBMIT-pop (trigger 1) and
+        # gate-crossing-completion (trigger 2) — so command s's SUBMIT
+        # reaches its attach process at exactly R(s) + d_sub on both
+        # sides, with R(s) = max(A(s), F(s), R(s-1)). Latency is
+        # queue-delay-inclusive: completion #k of client c records
+        # t - A(c, k) into Runner-owned records (count-based
+        # attribution, the engine's step-5 contract), bypassing the
+        # closed-loop Client bookkeeping for reporting.
+        from ..traffic.schedule import resolve_arrivals
+
+        arrivals = resolve_arrivals(
+            arrivals, mean_gap_ms=arrival_gap_ms,
+            commands=workload.commands_per_client,
+            load_pct=arrival_load,
+        )
+        self._arrivals = arrivals
+        self._ol_table = None
+        if arrivals is not None:
+            assert config.shard_count == 1, (
+                "open-loop arrivals are single-shard (make_lane asserts"
+                " the same)"
+            )
+            assert traffic is None or all(
+                p.think_ms == 0 for p in traffic.phases
+            ), "open-loop lanes own the issue clock; think must be 0"
+            assert open_window >= 1, open_window
+            self._ol_window = int(open_window)
+            self._ol_budget = int(workload.commands_per_client)
+            self._ol_table = arrivals.arrival_table(
+                seed=seed,
+                clients=clients_per_process * len(client_regions),
+                commands=workload.commands_per_client,
+            )
+            # per-client open-loop state (registered clients only):
+            # completion count, completion times in completion order
+            # (the engine's ring, unbounded host-side), the monotone
+            # release clamp R(s-1), and the latency records (ms)
+            self._ol_completed: Dict[int, int] = {}
+            self._ol_comp_times: Dict[int, List[int]] = {}
+            self._ol_last_rel: Dict[int, int] = {}
+            self._ol_lat: Dict[int, List[int]] = {}
 
         # fault-plan mirror (engine/faults.py): the oracle applies the
         # exact crash/window/drop model the device engine applies, so
@@ -236,6 +286,14 @@ class Runner:
                 self.client_to_region[client_id] = region
                 registered += 1
         self.client_count = registered
+        if self._ol_table is not None:
+            for cid in self.client_to_region:
+                self._ol_completed[cid] = 0
+                self._ol_comp_times[cid] = []
+                # R(0) seeds at the first arrival (the engine's
+                # ol_last_rel init, engine/core.py init_lane_state)
+                self._ol_last_rel[cid] = int(self._ol_table[cid - 1, 1])
+                self._ol_lat[cid] = []
 
         for process_id, event, delay in periodic:
             self._schedule_periodic(process_id, event, delay)
@@ -262,12 +320,21 @@ class Runner:
     def run(
         self, extra_sim_time_ms: Optional[int] = None
     ) -> Tuple[dict, dict, Dict[str, Tuple[int, Histogram]]]:
+        if self._ol_table is not None:
+            # open-loop schedules own the issue clock; the legacy
+            # reorder perturbation would scale the release-pinned
+            # submit distances (make_lane asserts the same)
+            assert not self.reorder_messages
         for client_id, process_id, cmd in self.simulation.start_clients():
             # every first command is seq 1 (the engine arms the first
-            # SUBMIT at client_delay + think(1) identically)
+            # SUBMIT at client_delay + think(1) identically); open loop:
+            # it leaves at its arrival time A(c, 1) instead
+            extra = self._think_ms(1)
+            if self._ol_table is not None:
+                extra = int(self._ol_table[client_id - 1, 1])
             self._schedule_submit(
                 ("client", client_id), process_id, cmd,
-                extra_delay=self._think_ms(1),
+                extra_delay=extra,
             )
 
         self._simulation_loop(extra_sim_time_ms)
@@ -342,6 +409,16 @@ class Runner:
                     action = (_TO_CLIENT, client_id, cmd_result)
             if kind == _TO_CLIENT:
                 _, client_id, cmd_result = action
+                if self._ol_table is not None:
+                    if self._ol_to_client(client_id, cmd_result):
+                        clients_done += 1
+                        if clients_done == self.client_count:
+                            if extra_sim_time_ms is None:
+                                return
+                            final_time = time.millis() + extra_sim_time_ms
+                    if final_time is not None and time.millis() > final_time:
+                        return
+                    continue
                 submit = self.simulation.forward_to_client(cmd_result)
                 if submit is not None:
                     process_id, cmd = submit
@@ -400,12 +477,104 @@ class Runner:
         process, _executor, pending, time = self.simulation.get_process(
             process_id
         )
+        if self._ol_table is not None:
+            self._ol_trigger1(cmd)
         if self.shard_count == 1:
             # process-side aggregation (runner.rs:351-362); multi-shard
             # registers client-side at submit-schedule time instead
             pending.wait_for(cmd)
         process.submit(None, cmd, time)
         self._send_to_processes_and_executors(process_id)
+
+    # -- open-loop arrival staging (docs/TRAFFIC.md) --------------------
+
+    def _ol_arrival_ms(self, client_id: int, seq: int) -> int:
+        """A(c, seq) from the shared seeded table (seqs 1-based; the
+        last column extends, mirroring the engine's clamped gather)."""
+        row = self._ol_table[client_id - 1]
+        return int(row[min(seq, len(row) - 1)])
+
+    def _ol_trigger1(self, cmd: Command) -> None:
+        """Trigger 1 — staging at SUBMIT pop (engine/core.py step 4):
+        popping client c's latest SUBMIT s stages command q = s+1 at
+        release R(q) = max(A(q), F(q), R(s)) when the in-flight window
+        already admits it; window-full commands wait for trigger 2."""
+        client_id = cmd.rifl.source
+        seq = cmd.rifl.sequence
+        client, time = self.simulation.get_client(client_id)
+        if seq != client.issued_commands():
+            return  # an older command's SUBMIT; q was already staged
+        q = seq + 1
+        if q > self._ol_budget:
+            return
+        done = self._ol_completed[client_id]
+        if done + self._ol_window < q:
+            return  # window full: the gate-crossing completion stages q
+        # F(q): completion time of command q - W (0 before W completions)
+        f_gate = (
+            self._ol_comp_times[client_id][q - self._ol_window - 1]
+            if q > self._ol_window
+            else 0
+        )
+        rel = max(
+            self._ol_arrival_ms(client_id, q),
+            f_gate,
+            self._ol_last_rel[client_id],
+        )
+        self._ol_stage(client_id, rel)
+
+    def _ol_to_client(self, client_id: int, cmd_result) -> bool:
+        """Open-loop TO_CLIENT: count-based completion accounting plus
+        trigger 2 (engine/core.py step 5). Returns True when this
+        completion finishes the client's budget. Latency is
+        queue-delay-inclusive — t - A(c, k) for completion #k — and
+        lands in Runner-owned records; the closed-loop auto-resubmit
+        (forward_to_client) is bypassed."""
+        client, time = self.simulation.get_client(client_id)
+        client.cmd_recv(cmd_result.rifl, time)
+        t = time.millis()
+        k = self._ol_completed[client_id] + 1
+        self._ol_completed[client_id] = k
+        self._ol_comp_times[client_id].append(t)
+        self._ol_lat[client_id].append(
+            t - self._ol_arrival_ms(client_id, k)
+        )
+        # trigger 2 — gate-crossing completion: command pend = issued+1
+        # was window-blocked at its predecessor's SUBMIT pop and this
+        # completion just admitted it (gate crosses exactly once, at
+        # #(pend - W)); F(pend) = t by construction
+        pend = client.issued_commands() + 1
+        if (
+            pend <= self._ol_budget
+            and k + self._ol_window >= pend
+            and not ((k - 1) + self._ol_window >= pend)
+        ):
+            rel = max(
+                self._ol_arrival_ms(client_id, pend),
+                t,
+                self._ol_last_rel[client_id],
+            )
+            self._ol_stage(client_id, rel)
+        return k == self._ol_budget
+
+    def _ol_stage(self, client_id: int, rel: int) -> None:
+        """Issue the client's next command with its SUBMIT pinned to
+        arrive at the attach process at rel + d_sub — the engine's
+        delay-override emission row. ``extra_delay`` may be negative
+        (rel can precede now by up to d_sub on trigger 1); the total
+        scheduled distance rel - R(s) stays >= 0 because releases are
+        monotone."""
+        client, time = self.simulation.get_client(client_id)
+        nxt = client.cmd_send(time)
+        assert nxt is not None, "staged past the command budget"
+        target_shard, cmd = nxt
+        self._ol_last_rel[client_id] = rel
+        self._schedule_submit(
+            ("client", client_id),
+            client.shard_process(target_shard),
+            cmd,
+            extra_delay=rel - time.millis(),
+        )
 
     def _handle_send(self, from_, from_shard_id, process_id, msg) -> None:
         process, _, _, time = self.simulation.get_process(process_id)
@@ -667,7 +836,14 @@ class Runner:
             client, _ = self.simulation.get_client(client_id)
             issued, histogram = out.get(region, (0, Histogram()))
             issued += client.issued_commands()
-            for latency_us in client.data.latency_data():
-                histogram.increment(latency_us // 1000)
+            if self._ol_table is not None:
+                # open loop: queue-delay-inclusive ms records owned by
+                # the runner (see _ol_to_client) — the Client-side
+                # submit-to-response data would omit the arrival wait
+                for latency_ms in self._ol_lat[client_id]:
+                    histogram.increment(latency_ms)
+            else:
+                for latency_us in client.data.latency_data():
+                    histogram.increment(latency_us // 1000)
             out[region] = (issued, histogram)
         return out

@@ -22,13 +22,19 @@ time-varying workloads. See docs/TRAFFIC.md.
 """
 
 from .schedule import (
+    ArrivalPhase,
+    ArrivalSchedule,
     TrafficPhase,
     TrafficSchedule,
+    resolve_arrivals,
     resolve_traffic,
 )
 
 __all__ = [
+    "ArrivalPhase",
+    "ArrivalSchedule",
     "TrafficPhase",
     "TrafficSchedule",
+    "resolve_arrivals",
     "resolve_traffic",
 ]

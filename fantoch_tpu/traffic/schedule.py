@@ -45,7 +45,13 @@ import numpy as np
 
 @dataclass(frozen=True)
 class TrafficPhase:
-    """One epoch of the schedule, covering ``commands`` command seqs."""
+    """One epoch of the schedule, covering ``commands`` command seqs.
+
+    ``zipf_coef`` is the per-epoch Zipf skew for lanes running the
+    ``KeyGen::Zipf`` workload: 0.0 (the default) means "the lane's base
+    coefficient", a nonzero value overrides it for this epoch — so a
+    schedule can move the key-popularity skew over time the same way it
+    moves the conflict pool. Pool-only lanes ignore it entirely."""
 
     commands: int
     conflict_rate: int
@@ -53,6 +59,7 @@ class TrafficPhase:
     pool_base: int = 0
     think_ms: int = 0
     read_pct: int = 0
+    zipf_coef: float = 0.0
 
     def __post_init__(self) -> None:
         assert self.commands >= 1, "a phase must cover >= 1 command"
@@ -61,15 +68,16 @@ class TrafficPhase:
         assert self.pool_base >= 0, self.pool_base
         assert self.think_ms >= 0, self.think_ms
         assert 0 <= self.read_pct <= 100, self.read_pct
+        assert self.zipf_coef >= 0.0, self.zipf_coef
 
-    def knobs(self) -> Tuple[int, int, int, int]:
+    def knobs(self) -> Tuple[int, int, int, int, float]:
         """The parameters whose variation makes a schedule non-flat
         (read_pct rides along in the tables but never reaches the
         engine's arithmetic, so a read-mix-only schedule is still
         flat for the device)."""
         return (
             self.conflict_rate, self.pool_size, self.pool_base,
-            self.think_ms,
+            self.think_ms, self.zipf_coef,
         )
 
 
@@ -126,13 +134,14 @@ class TrafficSchedule:
     def is_flat(self) -> bool:
         """True when the schedule is indistinguishable from the static
         ConflictPool path: one effective knob tuple, no think delay, no
-        pool rotation. Flat schedules compile to NO ctx tables."""
+        pool rotation, no zipf override. Flat schedules compile to NO
+        ctx tables."""
         knobs = {p.knobs() for p in self.phases}
         if len(knobs) != 1:
             return False
-        (conflict, _size, base, think) = next(iter(knobs))
+        (conflict, _size, base, think, zcoef) = next(iter(knobs))
         del conflict
-        return base == 0 and think == 0
+        return base == 0 and think == 0 and zcoef == 0.0
 
     # -- device lowering ----------------------------------------------
 
@@ -168,6 +177,31 @@ class TrafficSchedule:
             "traffic_pool_span": np.int32(self.pool_span()),
         }
 
+    def zipf_tables(
+        self, base_coefficient: float, total_keys: int
+    ) -> Dict[str, np.ndarray]:
+        """The epoch-varying ``KeyGen::Zipf`` extension: one cumulative
+        weight row per phase, ``[E, K]``, row ``e`` built from phase
+        e's ``zipf_coef`` (0.0 = the lane's base coefficient). The
+        engine's ``gen_key`` gathers the row for the command's epoch
+        before the searchsorted draw; the host oracle mirror
+        (client/key_gen.py) builds the identical table from the same
+        schedule, so the two sides agree bit-exactly."""
+        from ..client.key_gen import zipf_weights
+
+        rows = []
+        for p in self.phases:
+            coef = p.zipf_coef if p.zipf_coef > 0.0 else base_coefficient
+            rows.append(
+                np.cumsum(zipf_weights(total_keys, coef)).astype(
+                    np.float32
+                )
+            )
+        return {"traffic_zipf_cum": np.stack(rows, axis=0)}
+
+    def has_zipf_override(self) -> bool:
+        return any(p.zipf_coef > 0.0 for p in self.phases)
+
     def meta(self) -> dict:
         """Compact JSON-able lane metadata (LaneSpec.traffic_meta)."""
         return {
@@ -181,6 +215,9 @@ class TrafficSchedule:
     # -- JSON round-trip (campaign grids, repro artifacts) ------------
 
     def to_json(self) -> dict:
+        # zipf_coef is emitted only when set so every pre-zipf schedule
+        # round-trips byte-identically (repro artifacts, campaign
+        # journals, checkpoint meta all compare canonical JSON)
         return {
             "name": self.name,
             "cycle": bool(self.cycle),
@@ -192,6 +229,11 @@ class TrafficSchedule:
                     "pool_base": p.pool_base,
                     "think_ms": p.think_ms,
                     "read_pct": p.read_pct,
+                    **(
+                        {"zipf_coef": p.zipf_coef}
+                        if p.zipf_coef > 0.0
+                        else {}
+                    ),
                 }
                 for p in self.phases
             ],
@@ -269,3 +311,198 @@ def traffic_key_capacity(
             span = max(span if span is not None else pool_size,
                        sched.pool_span())
     return None if span is None else span + clients
+
+
+# ----------------------------------------------------------------------
+# Open-loop arrival schedules (docs/TRAFFIC.md "Open-loop arrivals").
+#
+# A closed-loop client arms command s+1 only when command s completes —
+# the one workload shape planet-scale services never have (Schroeder et
+# al., NSDI'06: closed-loop load generation hides saturation and
+# suffers coordinated omission). An ArrivalSchedule instead timestamps
+# every command by a seeded arrival process *independent of
+# completion*: per-client exponential inter-arrival gaps whose mean is
+# piecewise over the command-seq axis, exactly like the traffic knobs.
+# The whole arrival table is drawn host-side once per lane
+# (``arrival_table``) and shipped verbatim to both the device engine
+# and the host oracle, so the two mirror bit-exactly by construction.
+# ----------------------------------------------------------------------
+
+# salt for the per-client arrival PRNG streams, so arrival draws never
+# collide with any other seeded stream derived from the lane seed
+ARRIVAL_STREAM_SALT = 0x0A21
+
+
+@dataclass(frozen=True)
+class ArrivalPhase:
+    """One epoch of an arrival schedule: ``commands`` command seqs
+    arriving with exponential gaps of mean ``mean_gap_ms`` (>= 1; the
+    engine clock is integer ms and a 0-mean phase would collapse every
+    arrival onto one tick)."""
+
+    commands: int
+    mean_gap_ms: int
+
+    def __post_init__(self) -> None:
+        assert self.commands >= 1, "a phase must cover >= 1 command"
+        assert self.mean_gap_ms >= 1, self.mean_gap_ms
+
+
+@dataclass(frozen=True)
+class ArrivalSchedule:
+    """A named piecewise arrival-rate schedule over the per-client
+    command-seq axis. ``cycle=True`` repeats the pattern over the whole
+    budget; ``cycle=False`` extends the last phase forever."""
+
+    name: str
+    phases: Tuple[ArrivalPhase, ...]
+    cycle: bool = False
+
+    def __post_init__(self) -> None:
+        assert self.phases, "a schedule needs at least one phase"
+
+    @property
+    def pattern_len(self) -> int:
+        return sum(p.commands for p in self.phases)
+
+    def epoch_of(self, seq: int) -> int:
+        """Phase index of 1-based command ``seq`` (same axis semantics
+        as :meth:`TrafficSchedule.epoch_of`)."""
+        assert seq >= 1, "command seqs are 1-based"
+        idx = (seq - 1) % self.pattern_len if self.cycle else min(
+            seq - 1, self.pattern_len - 1
+        )
+        for e, p in enumerate(self.phases):
+            if idx < p.commands:
+                return e
+            idx -= p.commands
+        return len(self.phases) - 1  # unreachable
+
+    def mean_gap_ms(self, seq: int) -> int:
+        return self.phases[self.epoch_of(seq)].mean_gap_ms
+
+    def scale(self, load_pct: int) -> "ArrivalSchedule":
+        """The offered-load axis: scale every phase's mean gap so the
+        arrival *rate* becomes ``load_pct`` percent of this schedule's
+        (gap 100/load times the base, floored at the 1 ms tick). A
+        scaled schedule is renamed ``name@load`` so checkpoint and
+        campaign meta refuse a resumed sweep whose load drifted — by
+        name, before any bit compare."""
+        assert load_pct >= 1, load_pct
+        if load_pct == 100:
+            return self
+        return ArrivalSchedule(
+            name=f"{self.name}@{load_pct}",
+            cycle=self.cycle,
+            phases=tuple(
+                ArrivalPhase(
+                    commands=p.commands,
+                    mean_gap_ms=max(
+                        1, round(p.mean_gap_ms * 100 / load_pct)
+                    ),
+                )
+                for p in self.phases
+            ),
+        )
+
+    def arrival_table(
+        self, *, seed: int, clients: int, commands: int
+    ) -> np.ndarray:
+        """The per-lane arrival-time table: ``[C, T]`` i32 cumulative
+        arrival times (ms), ``T = commands + 2`` with column 0 unused
+        so 1-based command seqs index directly (the key-table layout).
+        Client c's gaps come from its own counter-salted stream
+        ``default_rng([seed, SALT, c])`` — insertion-ordered and
+        independent of draw interleaving, the GL402 discipline — with
+        the gap before command s drawn exponential with the mean of
+        s's epoch, floored at 1 ms. ``A[c, 1]`` is the first command's
+        arrival (the first gap after t=0); the engine and the host
+        oracle both consume THIS array verbatim, which is the whole
+        bit-exactness argument."""
+        T = commands + 2
+        table = np.zeros((clients, T), np.int64)
+        for c in range(clients):
+            rng = np.random.default_rng(
+                [int(seed), ARRIVAL_STREAM_SALT, int(c)]
+            )
+            t = 0
+            for s in range(1, T):
+                gap = max(
+                    1,
+                    int(round(rng.exponential(
+                        self.mean_gap_ms(s)
+                    ))),
+                )
+                t += gap
+                table[c, s] = t
+        table[:, 0] = table[:, 1]  # unused column mirrors seq 1
+        assert int(table.max()) < np.iinfo(np.int32).max
+        return table.astype(np.int32)
+
+    def meta(self) -> dict:
+        """Compact JSON-able lane metadata (LaneSpec.arrival_meta)."""
+        return {
+            "name": self.name,
+            "epochs": len(self.phases),
+            "cycle": bool(self.cycle),
+            "pattern_commands": self.pattern_len,
+            "mean_gaps_ms": [p.mean_gap_ms for p in self.phases],
+        }
+
+    def to_json(self) -> dict:
+        return {
+            "name": self.name,
+            "cycle": bool(self.cycle),
+            "phases": [
+                {
+                    "commands": p.commands,
+                    "mean_gap_ms": p.mean_gap_ms,
+                }
+                for p in self.phases
+            ],
+        }
+
+    @staticmethod
+    def from_json(obj: dict) -> "ArrivalSchedule":
+        return ArrivalSchedule(
+            name=str(obj["name"]),
+            cycle=bool(obj.get("cycle", False)),
+            phases=tuple(
+                ArrivalPhase(**phase) for phase in obj["phases"]
+            ),
+        )
+
+
+ArrivalLike = Union[None, str, dict, "ArrivalSchedule"]
+
+
+def resolve_arrivals(
+    spec: ArrivalLike,
+    *,
+    mean_gap_ms: int,
+    commands: int,
+    load_pct: int = 100,
+) -> Optional[ArrivalSchedule]:
+    """Resolve an arrival spec to a schedule (or None = closed loop).
+
+    ``spec`` may be a preset name from :data:`fantoch_tpu.registry
+    .ARRIVAL_PRESETS` (parameterized by the lane's base mean gap and
+    command budget), a JSON schedule dict, an already-built
+    :class:`ArrivalSchedule`, or None. ``"closed"`` resolves to None —
+    the closed-loop static path, by construction. ``load_pct`` applies
+    the offered-load scaling (:meth:`ArrivalSchedule.scale`) after
+    resolution."""
+    if spec is None:
+        return None
+    if isinstance(spec, ArrivalSchedule):
+        return spec.scale(load_pct)
+    if isinstance(spec, dict):
+        return ArrivalSchedule.from_json(spec).scale(load_pct)
+    from ..registry import arrival_preset
+
+    obj = arrival_preset(
+        str(spec), mean_gap_ms=mean_gap_ms, commands=commands
+    )
+    if obj is None:
+        return None
+    return ArrivalSchedule.from_json(obj).scale(load_pct)

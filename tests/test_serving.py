@@ -1,0 +1,717 @@
+"""Open-loop serving workloads (fantoch_tpu/serving, docs/TRAFFIC.md
+"Open-loop arrivals").
+
+Contracts pinned here:
+
+1. **Arrival schedules** — preset resolution, offered-load scaling
+   (name gains ``@<load>``, gaps rescale, 1 ms floor), and the
+   ``[C, T+2]`` arrival-table shape/monotonicity the engine and oracle
+   both consume.
+2. **Closed is free** — a lane without arrivals carries no ``ol_*``
+   ctx and traces the identical step graph (GL005-style pin via the
+   structure gate); an open-loop lane traces a genuinely different
+   one and must never share a batch with closed lanes.
+3. **Bit-exact differential** — tempo and fpaxos open-loop lanes
+   (poisson + burst presets, scaled loads) under crash and drop fault
+   plans run bit-exactly between the vmapped engine and the host
+   oracle (latency distributions + protocol metrics).
+4. **Queue delay is latency** — saturating the in-flight window
+   strictly raises measured latency versus an unbounded window at the
+   same arrival schedule: the arrival-queue wait lands in the curve
+   (no coordinated omission).
+5. **Campaign/knee wiring** — the sweep campaign's ``arrivals`` ×
+   ``offered_loads`` axes journal per-(preset, load) batch groups,
+   resume onto a different arrival grid is refused *by name* at both
+   the campaign and checkpoint layers, and a knee sweep interrupted
+   mid-grid resumes to a byte-identical ``knee.json``.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from fantoch_tpu.client import Workload
+from fantoch_tpu.client.key_gen import DeviceStream
+from fantoch_tpu.core import Config, Planet
+from fantoch_tpu.engine import (
+    EngineDims,
+    FaultPlan,
+    LinkWindow,
+    make_lane,
+    run_lanes,
+)
+from fantoch_tpu.engine.protocols import FPaxosDev, TempoDev
+from fantoch_tpu.protocol import FPaxos, Tempo
+from fantoch_tpu.protocol.base import ProtocolMetricsKind
+from fantoch_tpu.registry import ARRIVAL_PRESETS, arrival_preset
+from fantoch_tpu.sim import Runner
+from fantoch_tpu.traffic import ArrivalPhase, ArrivalSchedule, resolve_arrivals
+
+COMMANDS = 8
+CPR = 1
+
+
+# ----------------------------------------------------------------------
+# arrival schedules
+# ----------------------------------------------------------------------
+
+
+def test_arrival_presets_resolve():
+    for name in ARRIVAL_PRESETS:
+        sched = resolve_arrivals(name, mean_gap_ms=4, commands=20)
+        if name == "closed":
+            assert sched is None
+            continue
+        assert isinstance(sched, ArrivalSchedule)
+        assert sched.name == name
+        assert sum(p.commands for p in sched.phases) == 20
+        if name == "burst":
+            gaps = [p.mean_gap_ms for p in sched.phases]
+            assert min(gaps) < gaps[0], gaps  # the spike is denser
+    with pytest.raises(ValueError):
+        arrival_preset("rush_hour", mean_gap_ms=4, commands=5)
+
+
+def test_arrival_schedule_scale_and_table():
+    sched = ArrivalSchedule(
+        "poisson", (ArrivalPhase(commands=6, mean_gap_ms=8),)
+    )
+    double = sched.scale(200)
+    assert double.name == "poisson@200"
+    assert double.phases[0].mean_gap_ms == 4
+    # the 1 ms floor: no offered load can produce same-instant draws
+    assert sched.scale(100000).phases[0].mean_gap_ms == 1
+    # load 100 keeps the bare name so legacy/simple grids stay stable
+    assert sched.scale(100).name == "poisson"
+
+    table = sched.arrival_table(seed=3, clients=4, commands=6)
+    assert table.shape == (4, 8)  # [C, commands + 2]
+    assert table.dtype == np.int32
+    # col 0 mirrors col 1 (seqs are 1-based; slot 0 never offered) and
+    # per-client arrivals are strictly increasing (>= 1 ms gaps)
+    assert np.array_equal(table[:, 0], table[:, 1])
+    assert (np.diff(table[:, 1:], axis=1) >= 1).all()
+    # seeded: same seed reproduces, different seed diverges
+    assert np.array_equal(
+        table, sched.arrival_table(seed=3, clients=4, commands=6)
+    )
+    assert not np.array_equal(
+        table, sched.arrival_table(seed=4, clients=4, commands=6)
+    )
+    # JSON round trip preserves value equality
+    assert ArrivalSchedule.from_json(sched.to_json()) == sched
+
+    with pytest.raises(AssertionError):
+        ArrivalPhase(commands=0, mean_gap_ms=4)
+    with pytest.raises(AssertionError):
+        ArrivalPhase(commands=1, mean_gap_ms=0)
+
+
+# ----------------------------------------------------------------------
+# closed collapses to the static path; open traces differently
+# ----------------------------------------------------------------------
+
+
+def _tempo_setup(commands=COMMANDS, n=3):
+    planet = Planet.new()
+    regions = planet.regions()[:n]
+    config = Config(n=n, f=1, gc_interval_ms=100,
+                    tempo_detached_send_interval_ms=100)
+    clients = CPR * n
+    dev = TempoDev(keys=1 + clients)
+    total = commands * clients
+    dims = EngineDims.for_protocol(
+        dev, n=n, clients=clients, payload=dev.payload_width(n),
+        total_commands=total, dot_slots=total + 1, regions=n,
+    )
+    return planet, regions, config, dev, dims
+
+
+def test_closed_loop_collapses_to_static():
+    """GL005-style pin: "closed" resolves to no schedule at all, the
+    no-arrivals lane carries no ol_* ctx and traces the identical
+    step graph, and an open-loop lane traces a different one."""
+    from fantoch_tpu.engine.core import init_lane_state
+    from fantoch_tpu.lint.gating import alpha_equivalent
+    from fantoch_tpu.lint.jaxpr import trace_step
+
+    assert resolve_arrivals("closed", mean_gap_ms=4, commands=4) is None
+    assert resolve_arrivals(None, mean_gap_ms=4, commands=4) is None
+
+    planet, regions, config, dev, dims = _tempo_setup(commands=2)
+
+    def lane(arrivals):
+        return make_lane(
+            dev, planet, config, conflict_rate=100, pool_size=1,
+            commands_per_client=2, clients_per_region=CPR,
+            process_regions=regions, client_regions=regions, dims=dims,
+            arrivals=arrivals, open_window=2,
+        )
+
+    static = lane(None)
+    assert static.arrival_meta is None
+    assert not any(k.startswith("ol_") for k in static.ctx)
+    opened = lane("poisson")
+    assert opened.arrival_meta is not None
+    assert opened.ctx["ol_arrival"].shape == (dims.C, 2 + 2)
+    assert int(opened.ctx["ol_window"]) == 2
+
+    def trace(spec, name):
+        state = init_lane_state(dev, dims, spec.ctx)
+        return trace_step(dev, dims, state, spec.ctx, name=name)
+
+    ok, why = alpha_equivalent(
+        trace(static, "static").closed, trace(lane(None), "closed").closed
+    )
+    assert ok, f"the closed-loop step must not drift: {why}"
+    ok, _why = alpha_equivalent(
+        trace(static, "static").closed, trace(opened, "open").closed
+    )
+    assert not ok, "an open-loop lane must change the traced step"
+
+    # structure-gated lanes never share a batch with closed lanes
+    with pytest.raises(AssertionError):
+        run_lanes(dev, dims, [lane(None), lane("poisson")])
+
+
+# ----------------------------------------------------------------------
+# device vs oracle bit-exact open loop under faults
+# ----------------------------------------------------------------------
+
+
+def _run_oracle(protocol_cls, config, regions, plan, *, arrivals,
+                arrival_load=100, open_window, seed=0,
+                commands=COMMANDS):
+    planet = Planet.new()
+    workload = Workload(
+        shard_count=1,
+        key_gen=DeviceStream(conflict_rate=100, pool_size=1, seed=seed),
+        keys_per_command=1,
+        commands_per_client=commands,
+        payload_size=0,
+    )
+    runner = Runner(
+        protocol_cls, planet, config, workload, CPR, regions,
+        list(regions), seed=seed, fault_plan=plan,
+        arrivals=arrivals, arrival_load=arrival_load,
+        open_window=open_window,
+    )
+    metrics, _, latencies = runner.run(extra_sim_time_ms=1000)
+    fast = slow = stable = 0
+    for pm, _em in metrics.values():
+        fast += pm.get_aggregated(ProtocolMetricsKind.FAST_PATH) or 0
+        slow += pm.get_aggregated(ProtocolMetricsKind.SLOW_PATH) or 0
+        stable += pm.get_aggregated(ProtocolMetricsKind.STABLE) or 0
+    return latencies, fast, slow, stable
+
+
+def _assert_latencies_equal(res, oracle_lat, regions):
+    for region in regions:
+        dev_done = res.issued(region)
+        if region not in oracle_lat:
+            assert dev_done == 0, region
+            continue
+        _issued, hist = oracle_lat[region]
+        assert dev_done == hist.count(), region
+        if hist.count():
+            assert res.latency_mean(region) == hist.mean(), region
+            assert res.histogram(region).mean() == hist.mean(), region
+
+
+def test_engine_oracle_bitexact_openloop_faults_tempo():
+    """Tempo, burst arrivals + crash + link window, in-flight cap 3:
+    engine ≡ oracle (queue-delay-inclusive latencies + metrics)."""
+    n, seed = 3, 0
+    planet = Planet.new()
+    regions = planet.regions()[:n]
+    config = Config(n=n, f=1, gc_interval_ms=100,
+                    tempo_detached_send_interval_ms=100)
+    plan = FaultPlan(
+        crashes={2: 260},
+        windows=(LinkWindow(src=0, dst=1, t0=40, t1=220, mult=3),),
+    )
+    clients = CPR * n
+    dev = TempoDev(keys=1 + clients)
+    total = COMMANDS * clients
+    dims = EngineDims.for_protocol(
+        dev, n=n, clients=clients, payload=dev.payload_width(n),
+        total_commands=total, dot_slots=total + 1, regions=n,
+    )
+    spec = make_lane(
+        dev, planet, config, conflict_rate=100, pool_size=1,
+        commands_per_client=COMMANDS, clients_per_region=CPR,
+        process_regions=regions, client_regions=regions, dims=dims,
+        seed=seed, faults=plan, arrivals="burst", open_window=3,
+    )
+    res = run_lanes(dev, dims, [spec])[0]
+    assert not res.err, res.err_cause
+    oracle_lat, fast, slow, stable = _run_oracle(
+        Tempo, config, regions, plan, arrivals="burst", open_window=3,
+        seed=seed,
+    )
+    assert int(res.protocol_metrics["fast_path"].sum()) == fast
+    assert int(res.protocol_metrics["slow_path"].sum()) == slow
+    assert int(res.protocol_metrics["stable"].sum()) == stable
+    _assert_latencies_equal(res, oracle_lat, regions)
+
+
+def test_engine_oracle_bitexact_openloop_drops_tempo():
+    """Tempo, poisson arrivals scaled to 200% load under seeded wire
+    drops (horizon-bounded): engine ≡ oracle — wire faults never touch
+    the client hops carrying staged arrivals."""
+    n, seed = 3, 2
+    planet = Planet.new()
+    regions = planet.regions()[:n]
+    config = Config(n=n, f=1, gc_interval_ms=100,
+                    tempo_detached_send_interval_ms=100)
+    plan = FaultPlan(drop_bp=500, drop_seed=9, horizon_ms=5000)
+    clients = CPR * n
+    dev = TempoDev(keys=1 + clients)
+    total = COMMANDS * clients
+    dims = EngineDims.for_protocol(
+        dev, n=n, clients=clients, payload=dev.payload_width(n),
+        total_commands=total, dot_slots=total + 1, regions=n,
+    )
+    spec = make_lane(
+        dev, planet, config, conflict_rate=100, pool_size=1,
+        commands_per_client=COMMANDS, clients_per_region=CPR,
+        process_regions=regions, client_regions=regions, dims=dims,
+        seed=seed, faults=plan, arrivals="poisson", arrival_load=200,
+        open_window=2,
+    )
+    res = run_lanes(dev, dims, [spec])[0]
+    assert not res.err, res.err_cause
+    oracle_lat, fast, slow, stable = _run_oracle(
+        Tempo, config, regions, plan, arrivals="poisson",
+        arrival_load=200, open_window=2, seed=seed,
+    )
+    assert int(res.protocol_metrics["fast_path"].sum()) == fast
+    assert int(res.protocol_metrics["slow_path"].sum()) == slow
+    assert int(res.protocol_metrics["stable"].sum()) == stable
+    _assert_latencies_equal(res, oracle_lat, regions)
+
+
+def test_engine_oracle_bitexact_openloop_faults_fpaxos():
+    """FPaxos (leader-based), burst arrivals + non-leader crash +
+    window: engine ≡ oracle."""
+    n, seed = 3, 1
+    planet = Planet.new()
+    regions = planet.regions()[:n]
+    config = Config(n=n, f=1, gc_interval_ms=100, leader=1)
+    plan = FaultPlan(
+        crashes={2: 300},
+        windows=(LinkWindow(src=1, dst=0, t0=0, t1=150, mult=2),),
+    )
+    clients = CPR * n
+    dev = FPaxosDev
+    total = COMMANDS * clients
+    dims = EngineDims.for_protocol(
+        dev, n=n, clients=clients, payload=dev.payload_width(n),
+        total_commands=total, dot_slots=total + 1, regions=n,
+    )
+    spec = make_lane(
+        dev, planet, config, conflict_rate=100, pool_size=1,
+        commands_per_client=COMMANDS, clients_per_region=CPR,
+        process_regions=regions, client_regions=regions, dims=dims,
+        seed=seed, faults=plan, arrivals="burst", open_window=3,
+    )
+    res = run_lanes(dev, dims, [spec])[0]
+    assert not res.err, res.err_cause
+    oracle_lat, _fast, _slow, stable = _run_oracle(
+        FPaxos, config, regions, plan, arrivals="burst", open_window=3,
+        seed=seed,
+    )
+    assert int(res.protocol_metrics["stable"].sum()) == stable
+    _assert_latencies_equal(res, oracle_lat, regions)
+
+
+def test_engine_oracle_bitexact_openloop_drops_fpaxos():
+    """FPaxos, ramp arrivals at 150% load under seeded drops."""
+    n, seed = 3, 4
+    planet = Planet.new()
+    regions = planet.regions()[:n]
+    config = Config(n=n, f=1, gc_interval_ms=100, leader=1)
+    plan = FaultPlan(drop_bp=400, drop_seed=5, horizon_ms=5000)
+    clients = CPR * n
+    dev = FPaxosDev
+    total = COMMANDS * clients
+    dims = EngineDims.for_protocol(
+        dev, n=n, clients=clients, payload=dev.payload_width(n),
+        total_commands=total, dot_slots=total + 1, regions=n,
+    )
+    spec = make_lane(
+        dev, planet, config, conflict_rate=100, pool_size=1,
+        commands_per_client=COMMANDS, clients_per_region=CPR,
+        process_regions=regions, client_regions=regions, dims=dims,
+        seed=seed, faults=plan, arrivals="ramp", arrival_load=150,
+        open_window=4,
+    )
+    res = run_lanes(dev, dims, [spec])[0]
+    assert not res.err, res.err_cause
+    oracle_lat, _fast, _slow, stable = _run_oracle(
+        FPaxos, config, regions, plan, arrivals="ramp",
+        arrival_load=150, open_window=4, seed=seed,
+    )
+    assert int(res.protocol_metrics["stable"].sum()) == stable
+    _assert_latencies_equal(res, oracle_lat, regions)
+
+
+# ----------------------------------------------------------------------
+# the in-flight cap pins queue delay into latency
+# ----------------------------------------------------------------------
+
+
+def test_open_window_saturation_counts_queue_delay():
+    """At a saturating offered load, a window-1 lane's latency must
+    strictly exceed an unbounded-window lane's on the same arrival
+    schedule: the excess is exactly the arrival-queue wait, which an
+    open loop counts (coordinated omission would hide it)."""
+    planet, regions, config, dev, dims = _tempo_setup()
+
+    def lane(window):
+        return make_lane(
+            dev, planet, config, conflict_rate=100, pool_size=1,
+            commands_per_client=COMMANDS, clients_per_region=CPR,
+            process_regions=regions, client_regions=regions, dims=dims,
+            seed=0, arrivals="poisson", arrival_load=400,
+            arrival_gap_ms=4, open_window=window,
+        )
+
+    capped, uncapped = run_lanes(
+        dev, dims, [lane(1)]
+    )[0], run_lanes(dev, dims, [lane(COMMANDS)])[0]
+    assert not capped.err and not uncapped.err
+    means = []
+    for res in (capped, uncapped):
+        total = count = 0.0
+        for region in regions:
+            h = res.histogram(region)
+            total += h.mean() * h.count()
+            count += h.count()
+        assert count == COMMANDS * len(regions) * CPR
+        means.append(total / count)
+    assert means[0] > means[1], (
+        "a saturated in-flight window must surface queue delay in "
+        f"latency (capped {means[0]:.1f} ms <= uncapped {means[1]:.1f} ms)"
+    )
+
+
+# ----------------------------------------------------------------------
+# campaign arrivals axis + refusal by name
+# ----------------------------------------------------------------------
+
+
+def test_campaign_arrivals_axis_and_refusals(tmp_path):
+    from fantoch_tpu.campaign import (
+        CampaignError,
+        campaign_from_json,
+        run_campaign,
+    )
+
+    grid = {
+        "kind": "sweep",
+        "protocols": ["basic"],
+        "ns": [3],
+        "conflicts": [100],
+        "subsets": 1,
+        "commands_per_client": 2,
+        "batch_lanes": 2,
+        "segment_steps": 64,
+        "arrivals": ["poisson"],
+        "offered_loads": [100, 200],
+        "open_window": 2,
+    }
+    spec = campaign_from_json(grid)
+    path = str(tmp_path / "c1")
+    summary = run_campaign(path, spec)
+    assert summary["done"], summary
+    assert summary["errors"] == 0
+    # per-(preset, load) batch groups journaled under tagged ids
+    ids = set()
+    with open(os.path.join(path, "journal.jsonl")) as fh:
+        for line in fh:
+            ids.add(json.loads(line)["id"])
+    assert any("/apoissonl100/" in i for i in ids), ids
+    assert any("/apoissonl200/" in i for i in ids), ids
+
+    # resume onto a different arrival grid: refused by the stored-spec
+    # equality check, by name
+    other = campaign_from_json({**grid, "arrivals": ["burst"]})
+    with pytest.raises(CampaignError):
+        run_campaign(path, other)
+
+    # unknown preset / empty axis / bad loads refused at parse time
+    with pytest.raises(CampaignError, match="arrival preset"):
+        campaign_from_json({**grid, "arrivals": ["rush_hour"]})
+    with pytest.raises(CampaignError, match="offered_loads"):
+        campaign_from_json({**grid, "offered_loads": [0]})
+    with pytest.raises(CampaignError, match="think delays"):
+        campaign_from_json({**grid, "traffic": ["diurnal"]})
+
+    # closed grids keep the legacy (untagged) batch ids
+    closed = campaign_from_json(
+        {k: v for k, v in grid.items()
+         if k not in ("arrivals", "offered_loads", "open_window")}
+    )
+    path2 = str(tmp_path / "c2")
+    assert run_campaign(path2, closed)["done"]
+    with open(os.path.join(path2, "journal.jsonl")) as fh:
+        for line in fh:
+            assert "/a" not in json.loads(line)["id"].split("/b")[0]
+
+
+def test_checkpoint_refuses_arrival_swap(tmp_path):
+    """The sweep checkpoint names its arrival schedule: resuming burst
+    lanes onto a poisson checkpoint raises CheckpointMismatchError
+    naming `arrivals`; a pre-arrivals manifest (no key) still resumes
+    a closed-loop run."""
+    from fantoch_tpu.engine.checkpoint import (
+        CheckpointMismatchError,
+        CheckpointSpec,
+        SweepInterrupted,
+    )
+    from fantoch_tpu.engine.protocols import BasicDev
+    from fantoch_tpu.parallel.sweep import make_sweep_specs, run_sweep
+
+    planet = Planet.new()
+    regions = planet.regions()[:3]
+    commands = 2
+    clients = 3
+    total = commands * clients
+    dev = BasicDev
+    dims = EngineDims.for_protocol(
+        dev, n=3, clients=clients, payload=dev.payload_width(3),
+        total_commands=total, dot_slots=total + 1, regions=3,
+    )
+
+    def specs(arrivals):
+        return make_sweep_specs(
+            dev, planet, region_sets=[regions], fs=[1], conflicts=[100],
+            commands_per_client=commands, clients_per_region=1,
+            dims=dims, arrivals=arrivals, open_window=2,
+        )
+
+    ck = str(tmp_path / "ck")
+    with pytest.raises(SweepInterrupted):
+        run_sweep(
+            dev, dims, specs("poisson"), segment_steps=8, scan_window=1,
+            checkpoint=CheckpointSpec(
+                path=ck, keep=True, stop_after_segments=1
+            ),
+        )
+    with pytest.raises(CheckpointMismatchError, match="arrivals"):
+        run_sweep(
+            dev, dims, specs("burst"), segment_steps=8,
+            checkpoint=CheckpointSpec(path=ck, keep=True),
+        )
+    results = run_sweep(
+        dev, dims, specs("poisson"), segment_steps=8,
+        checkpoint=CheckpointSpec(path=ck),
+    )
+    assert len(results) == 1 and not results[0].err
+
+    # legacy compatibility: a pre-arrivals manifest must still resume
+    # a closed-loop run (the by-name check only applies to open lanes)
+    ck2 = str(tmp_path / "ck_legacy")
+    with pytest.raises(SweepInterrupted):
+        run_sweep(
+            dev, dims, specs(None), segment_steps=8, scan_window=1,
+            checkpoint=CheckpointSpec(
+                path=ck2, keep=True, stop_after_segments=1
+            ),
+        )
+    mpath = os.path.join(ck2, "manifest.json")
+    manifest = json.load(open(mpath))
+    assert manifest["meta"].pop("arrivals") == ["closed"]
+    with open(mpath, "w") as fh:
+        json.dump(manifest, fh)
+    results = run_sweep(
+        dev, dims, specs(None), segment_steps=8,
+        checkpoint=CheckpointSpec(path=ck2),
+    )
+    assert len(results) == 1 and not results[0].err
+
+
+# ----------------------------------------------------------------------
+# knee location + artifact gate (host-only)
+# ----------------------------------------------------------------------
+
+
+def test_locate_knee():
+    from fantoch_tpu.serving import locate_knee
+
+    curve = {
+        "50": {"p99": 100.0}, "100": {"p99": 150.0},
+        "200": {"p99": 350.0}, "400": {"p99": 900.0},
+    }
+    assert locate_knee(curve, 3.0) == 200
+    assert locate_knee(curve, 2.0) == 200
+    assert locate_knee(curve, 10.0) is None
+    # an errored baseline locates nothing (no envelope to leave)
+    assert locate_knee({"50": {"p99": None}, "100": {"p99": 9.0}}) is None
+    # errored mid-points are skipped, not treated as exceedances
+    assert locate_knee(
+        {"50": {"p99": 10.0}, "100": {"p99": None},
+         "200": {"p99": 99.0}}, 3.0
+    ) == 200
+
+
+def test_knee_artifact_gate(tmp_path):
+    from fantoch_tpu.serving import check_knee_artifact, run_knee_sweep
+
+    artifact, summary = run_knee_sweep(
+        str(tmp_path / "dry"), protocols=("tempo", "fpaxos"),
+        loads=(50, 200), dryrun=True,
+    )
+    assert summary["done"] and summary["dryrun"]
+    check_knee_artifact(artifact)
+    on_disk = json.load(open(summary["artifact"]))
+    check_knee_artifact(on_disk)
+    assert on_disk["points"] is None
+
+    base = json.loads(json.dumps(artifact))
+    base["dryrun"] = False
+    stats = {"mean": 1.0, "p50": 1.0, "p99": 1.0, "count": 4,
+             "goodput_cps": 10.0, "lanes": 1, "errors": 0}
+
+    def point(proto, curve, knee):
+        return {"regions": ["a", "b", "c"], "protocol": proto,
+                "curve": curve, "knee": knee}
+
+    good = dict(base, points=[
+        point(p, {"50": dict(stats), "200": dict(stats)}, None)
+        for p in ("tempo", "fpaxos")
+    ])
+    check_knee_artifact(good)
+    # errored points carry nulls + a cause, never fake percentiles
+    err_stats = {"mean": None, "p50": None, "p99": None, "count": 0,
+                 "goodput_cps": None, "lanes": 1, "errors": 1,
+                 "error_cause": "pool-overflow"}
+    check_knee_artifact(dict(base, points=[
+        point(p, {"50": dict(stats), "200": dict(err_stats)}, None)
+        for p in ("tempo", "fpaxos")
+    ]))
+    fake = dict(err_stats, p99=0.0, error_cause=None)
+    with pytest.raises(AssertionError):
+        check_knee_artifact(dict(base, points=[
+            point(p, {"50": dict(stats), "200": dict(fake)}, None)
+            for p in ("tempo", "fpaxos")
+        ]))
+    # a knee outside the swept ladder is refused
+    with pytest.raises(AssertionError):
+        check_knee_artifact(dict(good, points=[
+            dict(good["points"][0], knee=75), good["points"][1]
+        ]))
+    # every swept protocol must be represented
+    with pytest.raises(AssertionError):
+        check_knee_artifact(dict(good, points=good["points"][:1]))
+    # a curve missing a swept load is refused
+    with pytest.raises(AssertionError):
+        check_knee_artifact(dict(base, points=[
+            point(p, {"50": dict(stats)}, None)
+            for p in ("tempo", "fpaxos")
+        ]))
+
+
+def test_frontier_artifact_gate_rank_by_knee():
+    from fantoch_tpu.bote.validate import (
+        check_frontier_artifact,
+        frontier_candidates,
+        validate_frontier,
+    )
+
+    planet = Planet.new()
+    cands = frontier_candidates(planet, 3, 2)
+    artifact, summary = validate_frontier(
+        "/nonexistent-never-written", planet=planet, candidates=cands,
+        rank_by="knee", loads=(50, 200), dryrun=True,
+        out=os.devnull,
+    )
+    assert summary["done"] and summary["dryrun"]
+    check_frontier_artifact(artifact)
+    assert artifact["rank_by"] == "knee"
+    assert artifact["serving"]["loads"] == [50, 200]
+    # score-ranked artifacts must not smuggle serving parameters
+    bad = json.loads(json.dumps(artifact))
+    bad["rank_by"] = "score"
+    with pytest.raises(AssertionError):
+        check_frontier_artifact(bad)
+    # knee-ranked measured candidates need a curve per protocol/load
+    measured = json.loads(json.dumps(artifact))
+    measured["dryrun"] = False
+    stats = {"mean": 1.0, "p50": 1.0, "p99": 1.0, "count": 2,
+             "goodput_cps": 5.0, "lanes": 1, "errors": 0}
+    for cand in measured["candidates"]:
+        cand["measured"] = {
+            p: {"50": dict(stats), "200": dict(stats)}
+            for p in measured["protocols"]
+        }
+        cand["knee"] = {p: 200 for p in measured["protocols"]}
+    check_frontier_artifact(measured)
+    measured["candidates"][0]["knee"] = {
+        p: 75 for p in measured["protocols"]
+    }
+    with pytest.raises(AssertionError):
+        check_frontier_artifact(measured)
+
+
+# ----------------------------------------------------------------------
+# knee sweep through the campaign manager (slow tier)
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_knee_sweep_interrupted_resume_byte_identical(tmp_path):
+    """A knee sweep stopped mid-grid (budget stop — the same journal
+    state a SIGKILL leaves) resumes to a knee.json byte-identical to
+    the uninterrupted control's."""
+    from fantoch_tpu.serving import check_knee_artifact, run_knee_sweep
+
+    kw = dict(
+        protocols=("tempo",), ns=(3,), arrival="poisson",
+        loads=(50, 200), commands_per_client=6, open_window=2,
+        segment_steps=512,
+    )
+    ctrl = str(tmp_path / "ctrl")
+    art_ctrl, summary = run_knee_sweep(ctrl, **kw)
+    assert summary["done"], summary
+    check_knee_artifact(art_ctrl)
+
+    intr = str(tmp_path / "intr")
+    art0, s0 = run_knee_sweep(intr, budget_s=0.0, **kw)
+    assert art0 is None and not s0["done"]
+    art1, s1 = run_knee_sweep(intr, resume=True, **kw)
+    assert s1["done"], s1
+    with open(os.path.join(ctrl, "knee.json"), "rb") as fh:
+        ctrl_bytes = fh.read()
+    with open(os.path.join(intr, "knee.json"), "rb") as fh:
+        intr_bytes = fh.read()
+    assert ctrl_bytes == intr_bytes
+
+
+@pytest.mark.slow
+def test_knee_sweep_locates_knee_two_protocols(tmp_path):
+    """The measured curve artifact locates a knee for both protocols
+    on the CPU mesh: the load-25 baseline is unloaded, the heavy loads
+    saturate the in-flight window, and queue delay drives p99 past
+    knee_mult x baseline."""
+    from fantoch_tpu.serving import check_knee_artifact, run_knee_sweep
+
+    artifact, summary = run_knee_sweep(
+        str(tmp_path / "knee"), protocols=("tempo", "fpaxos"),
+        ns=(3,), arrival="poisson", loads=(25, 400, 3200),
+        commands_per_client=48, open_window=4, segment_steps=1024,
+    )
+    assert summary["done"], summary
+    check_knee_artifact(artifact)
+    assert {p["protocol"] for p in artifact["points"]} == {
+        "tempo", "fpaxos"
+    }
+    for point in artifact["points"]:
+        assert point["knee"] == 400, point
+        curve = point["curve"]
+        assert curve["3200"]["p99"] > 3.0 * curve["25"]["p99"]
+        # goodput keeps rising with offered load until saturation
+        assert curve["400"]["goodput_cps"] > curve["25"]["goodput_cps"]
