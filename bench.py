@@ -970,6 +970,26 @@ def _determinism_ledger() -> "dict | None":
         return {"error": repr(e)}
 
 
+def _shard_axis_ledger() -> "dict | None":
+    """Axis-shardability ledger of the per-protocol state planes (the
+    GL501 prover, fantoch_tpu/lint/shard.py) — per-protocol
+    SHARDABLE/COLLECTIVE/REPLICATED verdict counts from the checked-in
+    shard baseline, the static complement to the measured 2-D-mesh
+    sweep numbers. Reads only the JSON artifact (imports no jax), so
+    it is honest even when the device backend is unreachable; degrades
+    to an error record, never an exception."""
+    try:
+        from fantoch_tpu.lint.shard import shard_axis_ledger_summary
+
+        return shard_axis_ledger_summary()
+    except Exception as e:  # noqa: BLE001
+        import sys as _sys
+
+        print(f"bench: shard axis ledger unavailable: {e!r}",
+              file=_sys.stderr)
+        return {"error": repr(e)}
+
+
 def _fuzz_selfcheck() -> float:
     from fantoch_tpu.mc.fuzz import FuzzSpec, run_fuzz_point
 
@@ -1638,6 +1658,10 @@ def main() -> None:
                 # writers (GL401-GL404 ledger) — the static surface
                 # behind every byte-identity cmp in this report
                 "determinism_ledger": _determinism_ledger(),
+                # per-protocol axis-shardability verdict counts
+                # (GL501 ledger) — the static twin of the 2-D-mesh
+                # sweep numbers, proving which state planes may shard
+                "shard_axis_ledger": _shard_axis_ledger(),
             }
         )
     )
@@ -1859,11 +1883,12 @@ def _emit_unreachable(reason: str = "unreachable at startup") -> None:
                     if static_cost
                     else {}
                 ),
-                # the sync + determinism ledgers are pure AST — real
-                # numbers even in this dead-backend artifact, not
-                # placeholder zeros
+                # the sync + determinism + shard ledgers are static
+                # (pure AST / checked-in JSON) — real numbers even in
+                # this dead-backend artifact, not placeholder zeros
                 "host_sync_ledger": _host_sync_ledger(),
                 "determinism_ledger": _determinism_ledger(),
+                "shard_axis_ledger": _shard_axis_ledger(),
             }
         )
     )

@@ -949,8 +949,9 @@ def cmd_lint(args) -> None:
     device protocol's step, the structural gating differ, AST /
     hook-registry rules, (``--cost``) the kernel/VMEM/lane cost
     family, (``--transfer``) the sync-ledger/donation/backend
-    transfer family, and (``--determinism``) the GL401-GL404
-    byte-identity prover. Exits non-zero on any finding not covered
+    transfer family, (``--determinism``) the GL401-GL404
+    byte-identity prover, and (``--shard``) the GL501-GL503
+    shardability family. Exits non-zero on any finding not covered
     by the baseline (docs/LINT.md)."""
     from .lint import (
         DEFAULT_BASELINE,
@@ -1016,6 +1017,26 @@ def cmd_lint(args) -> None:
             json.dumps(
                 {
                     "selfcheck": args.determinism_selfcheck,
+                    "regressions": len(findings),
+                }
+            )
+        )
+        raise SystemExit(1 if findings else 0)
+
+    if args.shard_selfcheck:
+        # same contract for the shardability gate: the seeded fixture
+        # (out-of-choke axis mix / spec sharding a REPLICATED axis /
+        # over-budget candidate mesh) must produce findings NAMING
+        # GL501/GL502/GL503, or the axis prover is vacuously green
+        from .lint.shard import run_shard_selfcheck
+
+        findings, _ = run_shard_selfcheck(args.shard_selfcheck)
+        for f in findings:
+            print(f.render(), file=sys.stderr)
+        print(
+            json.dumps(
+                {
+                    "selfcheck": args.shard_selfcheck,
                     "regressions": len(findings),
                 }
             )
@@ -1121,16 +1142,58 @@ def cmd_lint(args) -> None:
         )
         return
 
+    if args.write_shard_baseline:
+        from .lint.shard import (
+            DEFAULT_SHARD_BASELINE,
+            run_shard,
+            write_shard_baseline,
+        )
+
+        if protocols:
+            raise SystemExit(
+                "refusing to write the shard baseline from a run "
+                "narrowed by --protocols (missing audits would turn "
+                "into CI regressions); run without it"
+            )
+        _, summary = run_shard(progress=say)
+        degraded = {
+            a: s["degradations"]
+            for a, s in summary["audits"].items()
+            if s["degradations"]
+        }
+        if degraded:
+            raise SystemExit(
+                "refusing to write the shard baseline while the axis "
+                f"taint degrades on unknown primitives ({degraded}); "
+                "add the missing transfer rules first — a degraded "
+                "verdict is conservative, not proven"
+            )
+        write_shard_baseline(DEFAULT_SHARD_BASELINE, summary["ledgers"])
+        print(
+            json.dumps(
+                {
+                    "shard_baseline": DEFAULT_SHARD_BASELINE,
+                    "audits": {
+                        a: s["verdicts"]
+                        for a, s in summary["audits"].items()
+                    },
+                }
+            )
+        )
+        return
+
     report = run_lint(
         protocols,
         ast_paths=args.paths or None,
         jaxpr_audits=not args.no_jaxpr
         and not args.cost_only
         and not args.transfer_only
-        and not args.determinism_only,
+        and not args.determinism_only
+        and not args.shard_only,
         cost=args.cost or args.cost_only,
         transfer=args.transfer or args.transfer_only,
         determinism=args.determinism or args.determinism_only,
+        shard=args.shard or args.shard_only,
         progress=say,
     )
 
@@ -1140,6 +1203,7 @@ def cmd_lint(args) -> None:
             args.no_jaxpr
             or args.cost_only
             or args.transfer_only
+            or args.shard_only
             or protocols
             or args.paths
         )
@@ -1182,6 +1246,10 @@ def cmd_lint(args) -> None:
         out["transfer"] = report.transfer
     if report.determinism:
         out["determinism"] = report.determinism
+    if report.shard:
+        out["shard"] = {
+            k: v for k, v in report.shard.items() if k != "ledgers"
+        }
     if args.json:
         out["detail"] = report.to_json(baseline)
     for f in regressions:
@@ -2017,6 +2085,24 @@ def main(argv=None) -> None:
                     "from this run (existing justification reasons "
                     "are preserved; new entries get an UNREVIEWED "
                     "placeholder the gate rejects)")
+    ln.add_argument("--shard", action="store_true",
+                    help="add the shardability family: GL501 axis-"
+                    "shardability ledger (vs lint/shard_baseline.json) "
+                    "+ GL502 partition-rule auditor (parallel/specs.py) "
+                    "+ GL503 per-shard footprint gate")
+    ln.add_argument("--shard-only", action="store_true",
+                    help="shardability family without the interval/"
+                    "gating audits (the CI shard-gate job)")
+    ln.add_argument("--shard-selfcheck", default=None,
+                    choices=["axis", "spec", "vmem"],
+                    help="CI broken-fixture check: audit the named "
+                    "seeded-defect fixture; must exit non-zero naming "
+                    "GL501/GL502/GL503")
+    ln.add_argument("--write-shard-baseline", action="store_true",
+                    help="regenerate lint/shard_baseline.json from "
+                    "this run (hand-edited reasons survive while the "
+                    "verdict is unchanged; refuses to write while the "
+                    "axis taint degrades on unknown primitives)")
     ln.add_argument("--json", action="store_true",
                     help="include full finding detail in the output")
     ln.set_defaults(fn=cmd_lint)

@@ -203,6 +203,84 @@ def oh_take(vec, idxs):
     return jnp.sum(jnp.where(hit, vec, 0), axis=-1).astype(vec.dtype)
 
 
+# fold_health's i32 branch carries dims.ERR_* bitmasks; the per-bit
+# pred embedding below is exact exactly while every flag lives in this
+# many low bits (pinned against the dims catalogue at import)
+_HEALTH_NBITS = 8
+assert max(ERR_POOL, ERR_STUCK, ERR_TRUNCATED, ERR_UNAVAIL) < (
+    1 << _HEALTH_NBITS
+), "dims.ERR_* outgrew fold_health's bit embedding — raise _HEALTH_NBITS"
+
+
+def fold_health(flags):
+    """OR-fold a per-process flag vector into one lane scalar. The
+    step's health verdicts (requeue ``stuck``, the protocol error
+    codes) are the only *scalar* cross-process reductions in the step;
+    under the state-sharded mesh (ROADMAP item 3) each is one tiny
+    psum per step, mirroring ``parallel/partition.py``'s liveness
+    psum. Declared by name as a GL501 choke point (lint/shard.py
+    ``CHOKE_FNS``) — keep the reduction inside this function.
+
+    The i32 branch (dims.ERR_* masks) ORs per *bit* through one pred
+    reduction rather than ``jnp.bitwise_or.reduce``: the SPMD
+    partitioner on the pinned jaxlib has no cross-shard ``or``
+    computation for s32 (pred is supported), and the state-sharded
+    layout turns this fold into exactly that collective. Bit-exact
+    for any mask in the low :data:`_HEALTH_NBITS` bits — the static
+    assert above pins that to the ERR catalogue — and still one
+    reduce kernel (the bit spread/recombine is fusable elementwise,
+    so the GL201 ledger is unchanged)."""
+    flags = jnp.asarray(flags)
+    if flags.dtype == jnp.bool_:
+        return jnp.any(flags)
+    masks = jnp.asarray([1 << b for b in range(_HEALTH_NBITS)], I32)
+    bits = jnp.any((flags[:, None] & masks[None, :]) != 0, axis=0)
+    out = jnp.zeros((), I32)
+    for b in range(_HEALTH_NBITS):
+        out = out | jnp.where(bits[b], I32(1 << b), I32(0))
+    return out
+
+
+def fold_count(flags):
+    """Population-count companion to :func:`fold_health`: the per-step
+    requeue diagnostic sums a per-process flag vector to one lane
+    scalar — a small sum-psum on the state-sharded mesh. Declared by
+    name as a GL501 choke point (lint/shard.py ``CHOKE_FNS``)."""
+    return jnp.sum(flags, dtype=I32)
+
+
+def emitter_times(ep, emitter):
+    """Per-emission read of each emitter's local time. The ``[N]``
+    time vector rides the same all-gather as the emission merge
+    (:func:`merge_emissions`), so the wire batch can stamp departure
+    times without a second hop. Declared by name as a GL501 choke
+    point (lint/shard.py ``CHOKE_FNS``)."""
+    return ep[emitter]
+
+
+def mark_popped(slot, has, m):
+    """One-hot OR-combine of the per-process pops into the pool's
+    ``[M]`` free map. Under the state-sharded mesh (ROADMAP item 3)
+    the pool stays replicated per lane shard, so the pop commit is a
+    small OR-psum of each process shard's one-hot pop mask. Declared
+    by name as a GL501 choke point (lint/shard.py ``CHOKE_FNS``)."""
+    return jnp.any(
+        (jnp.arange(m, dtype=I32)[None, :] == slot[:, None])
+        & has[:, None],
+        axis=0,
+    )
+
+
+def frontier_min(reach, ep):
+    """The virtual-time frontier all-reduce: the per-destination safe
+    bound (column min of the reachability matrix) and the lane-wide
+    minimum event time. This is the one unavoidable per-step
+    cross-process reduction of the time oracle — a small min-psum
+    pair on the state-sharded mesh. Declared by name as a GL501 choke
+    point (lint/shard.py ``CHOKE_FNS``)."""
+    return jnp.min(reach, axis=0), jnp.min(ep)
+
+
 # ----------------------------------------------------------------------
 # message pool layout: one packed [M, 8 + P] i32 image so pops gather a
 # whole message row in one kernel and the step's emissions land in one
@@ -316,6 +394,42 @@ def emit_broadcast(outbox, mtype, payload, n, me=None, exclude_me=False,
         "delay": jnp.full((nmax,), -1, I32),
         "src": jnp.full((nmax,), -1, I32),
     }
+
+
+def merge_emissions(n, f2, *parts):
+    """Flatten the per-process emission blocks ``[N, *, ...]`` into one
+    ``[N*F2, ...]`` wire batch. This is the lone *structural* N-mix in
+    the step outside the routing helpers: every process's rows
+    interleave into a single emission axis, so under a state-sharded
+    mesh (ROADMAP item 3) this is where the cross-device all-gather
+    happens. Declared by name as a GL501 choke point (lint/shard.py
+    ``CHOKE_FNS``) — keep the concatenate+reshape inside this
+    function. The explicit flatten/unflatten loop (rather than a
+    ``tree_map`` lambda) keeps every mixing equation's source frame
+    named ``merge_emissions``, which is what the choke match keys on."""
+    all_leaves = [jax.tree_util.tree_leaves(p) for p in parts]
+    treedef = jax.tree_util.tree_structure(parts[0])
+    merged = []
+    for xs in zip(*all_leaves):
+        merged.append(
+            jnp.concatenate(xs, axis=1).reshape(
+                (n * f2,) + xs[0].shape[2:]
+            )
+        )
+    return jax.tree_util.tree_unflatten(treedef, merged)
+
+
+def run_handlers(protocol, ps, msg, procs, ep, ctx, dims):
+    """Apply each process's message handler at its own local time.
+    Elementwise over the process axis by construction — GL501 proves
+    the ``ps`` N axis mixes nowhere in here — so a state-sharded mesh
+    (ROADMAP item 3) can run this phase under ``shard_map`` with no
+    collectives. Named and exported for exactly that use (and for the
+    shard-family bit-identity pin in tests/test_lint_shard.py)."""
+    def handle_one(ps_slice, m, me, t):
+        return protocol.handle(ps_slice, m, me, t, ctx, dims)
+
+    return jax.vmap(handle_one)(ps, msg, procs, ep)
 
 
 # ----------------------------------------------------------------------
@@ -713,8 +827,7 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False,
         INF,
         ep[:, None] + ctx["lookahead"],
     )                                                         # [q, p]
-    bound = jnp.min(reach, axis=0)                            # [N]
-    T = jnp.min(ep)  # lane-wide virtual time
+    bound, T = frontier_min(reach, ep)  # [N], lane-wide virtual time
     # strictly below the bound: at ep == bound a message with a smaller
     # tie key could still arrive at exactly ep. Processes at the global
     # minimum T are always safe (nothing can arrive before T) — that
@@ -766,11 +879,7 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False,
         "payload": popped_rows[:, PPAY:],
     }
     # free the popped slots (one-hot, fuses; a scatter is a kernel)
-    popped = jnp.any(
-        (jnp.arange(M, dtype=I32)[None, :] == slot[:, None])
-        & has[:, None],
-        axis=0,
-    )
+    popped = mark_popped(slot, has, M)
     arrival = jnp.where(popped, INF, arrival)
 
     # readiness gate: a message that overtook its prerequisite (possible
@@ -787,7 +896,7 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False,
         rdy = jnp.ones((N,), bool)
     requeued = has & ~rdy
     rq_next = jnp.where(requeued, popped_rows[:, PRQ] + 1, 0)  # [N]
-    stuck = jnp.any(rq_next > REQUEUE_LIMIT)
+    stuck = fold_health(rq_next > REQUEUE_LIMIT)
     msg = dict(
         msg,
         valid=has & rdy,
@@ -804,10 +913,9 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False,
         next_periodic_in,
     )
 
-    def handle_one(ps_slice, m, me, t):
-        return protocol.handle(ps_slice, m, me, t, ctx, dims)
-
-    ps, outbox = jax.vmap(handle_one)(ps, msg, procs, ep)  # outbox [N,F]
+    ps, outbox = run_handlers(
+        protocol, ps, msg, procs, ep, ctx, dims
+    )  # outbox [N, F]
     if monitor_keys:
         ps, mon = monitor.strip_mon(ps)
         viol, viol_step = monitor.step_viol(st, mon["mon_flags"])
@@ -910,25 +1018,10 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False,
             "src": (N + sc)[:, None],
         }
         F2 = 2 * F + 2
-        out = jax.tree_util.tree_map(
-            lambda a, b, s, r: jnp.concatenate(
-                [a, b, s, r], axis=1
-            ).reshape((N * F2,) + a.shape[2:]),
-            pout,
-            outbox,
-            stage,
-            rq,
-        )
+        out = merge_emissions(N, F2, pout, outbox, stage, rq)
     else:
         F2 = 2 * F + 1
-        out = jax.tree_util.tree_map(
-            lambda a, b, r: jnp.concatenate([a, b, r], axis=1).reshape(
-                (N * F2,) + a.shape[2:]
-            ),
-            pout,
-            outbox,
-            rq,
-        )
+        out = merge_emissions(N, F2, pout, outbox, rq)
     emitter = jnp.repeat(procs, F2)
     E = N * F2
     valid, dst = out["valid"], out["dst"]
@@ -966,7 +1059,7 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False,
         def scaled(d, row):
             return d
 
-    ep_e = ep[emitter]  # each emission leaves at its emitter's local time
+    ep_e = emitter_times(ep, emitter)  # emissions leave at local time
     is_client = valid & (dst >= N)
     c = jnp.where(is_client, dst - N, 0)
     d_back = scaled(ctx["client_delay"][c, emitter], 0)
@@ -1371,7 +1464,11 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False,
     target = jnp.where(deliver, target, M)
     n_free = jnp.sum(free)
     pool_overflow = jnp.sum(deliver) > n_free
-    rq_arr = jnp.zeros((N, F2), I32).at[:, F2 - 1].set(rq_next).reshape(E)
+    # the requeue-count column joins the wire batch through the same
+    # flatten choke as the emissions themselves
+    rq_arr = merge_emissions(
+        N, F2, jnp.zeros((N, F2), I32).at[:, F2 - 1].set(rq_next)
+    )
     # diagnostic: peak pool occupancy, for sizing EngineDims.M
     pool_peak = jnp.maximum(
         st["pool_peak"], M - n_free + jnp.sum(deliver, dtype=I32)
@@ -1414,7 +1511,7 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False,
         st["err"]
         | ERR_POOL * pool_overflow
         | ERR_STUCK * stuck
-        | jnp.bitwise_or.reduce(jnp.asarray(protocol.error(ps), I32))
+        | fold_health(jnp.asarray(protocol.error(ps), I32))
     )
     if faults.crash:
         # statically-known unavailability (crashes exceed what the
@@ -1455,7 +1552,7 @@ def _lane_step(protocol, dims: EngineDims, st, ctx, reorder: bool = False,
         "pair_cnt": pair_cnt,
         "pool_peak": pool_peak,
         "fault_dropped": st["fault_dropped"] + n_lost,
-        "requeues": st["requeues"] + jnp.sum(requeued, dtype=I32),
+        "requeues": st["requeues"] + fold_count(requeued),
         "max_completion": max_completion,
         "steps": st["steps"] + 1,
         "hlog": hlog,

@@ -43,6 +43,17 @@ Four analysis families, one driver (``python -m fantoch_tpu.cli lint``):
    (writes route through ``atomic_write``). Gated against
    ``lint/determinism_baseline.json`` where every exception carries a
    named justification. Pure AST — no device, no jax.
+7. **Shardability family** (:mod:`.shard`; opt-in ``--shard``) — the
+   static prerequisite for ROADMAP item 3's 2-D (lanes x state) mesh:
+   GL501 axis-shardability prover (per-(plane, axis) SHARDABLE /
+   COLLECTIVE / REPLICATED verdicts from a forward taint over every
+   named state axis, gated against ``lint/shard_baseline.json`` with
+   per-entry evidence reasons), GL502 partition-rule auditor (every
+   ``parallel/specs.py`` regex -> PartitionSpec rule proven against
+   the GL501 ledger — also the proof ``run_sweep(state_shards > 1)``
+   consults before compiling a layout), GL503 per-shard footprint
+   gate (GL202's fused-group VMEM analysis under shard-divided
+   shapes for the declared candidate meshes).
 
 Every pass shares one cached trace per protocol variant
 (:class:`.jaxpr.TraceCache`), so adding passes does not multiply the
@@ -92,6 +103,8 @@ def run_lint(
     transfer_baseline: "dict | None" = None,
     determinism: bool = False,
     determinism_baseline: "str | None" = None,
+    shard: bool = False,
+    shard_baseline: "dict | None" = None,
     cache=None,
     progress=None,
 ) -> LintReport:
@@ -164,7 +177,7 @@ def run_lint(
         if not protocols or n in protocols
     ]
 
-    if jaxpr_audits or cost:
+    if jaxpr_audits or cost or shard:
         from .jaxpr import TraceCache, build_protocol_trace
 
         cache = cache or TraceCache()
@@ -244,6 +257,28 @@ def run_lint(
             trace = audit_trace_for(name, shards=2)
             report.extend(check_lanes(trace))
             report.audits_run.append(f"lanes:{trace.name}")
+
+    if shard:
+        # GL501-GL503 gate against shard_baseline.json (findings exist
+        # only on violation — never written to baseline.json); traces
+        # at the dedicated distinct-dim SHARD_SHAPE, shared via the
+        # same TraceCache under ("shard", audit) keys
+        from .shard import load_shard_baseline, run_shard
+
+        if shard_baseline is None:
+            shard_baseline = load_shard_baseline()
+        findings, summary = run_shard(
+            protocols,
+            include_partial=include_partial,
+            cache=cache,
+            baseline=shard_baseline,
+            progress=say,
+        )
+        report.extend(findings)
+        report.shard = summary
+        report.audits_run.extend(
+            f"shard:{a}" for a in summary.get("audits", {})
+        )
 
     say(f"lint done in {time.perf_counter() - t0:.1f}s")
     return report

@@ -184,7 +184,7 @@ def _fusion_groups(flat: List[FlatEqn]) -> List[List[int]]:
 
 
 def _group_stat(flat: List[FlatEqn], group: List[int],
-                uses: Dict[Any, List[int]]) -> GroupStat:
+                uses: Dict[Any, List[int]], nbytes=None) -> GroupStat:
     """Peak live intermediate bytes for one fused group: a value lives
     from its defining position to its last in-group use. Values
     consumed *outside* the group (or carried in the jaxpr outputs) are
@@ -192,7 +192,10 @@ def _group_stat(flat: List[FlatEqn], group: List[int],
     their production point but do not stack to the end of the group
     (holding every output live would charge a long fusion for its
     whole output set at once, which is not how the documented crashes
-    behaved — the killer was one oversized in-flight broadcast)."""
+    behaved — the killer was one oversized in-flight broadcast).
+    ``nbytes`` overrides the per-value byte measure (GL503 re-runs
+    this analysis with shard-divided sizes)."""
+    bytes_of = nbytes or (lambda v: _bytes(v.aval))
     pos = {idx: p for p, idx in enumerate(group)}
     gset = set(group)
     delta = [0] * (len(group) + 1)
@@ -200,7 +203,7 @@ def _group_stat(flat: List[FlatEqn], group: List[int],
     for idx in group:
         e = flat[idx]
         for v in e.outvars:
-            b = _bytes(v.aval)
+            b = bytes_of(v)
             if b == 0:
                 continue
             in_group = [

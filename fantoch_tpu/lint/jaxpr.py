@@ -1156,6 +1156,7 @@ def build_protocol_trace(name: str, *, n: int = 3, clients: int = 3,
                          commands: int = 2, shards: int = 1,
                          dot_slots: "int | None" = None,
                          faults=None, monitor_keys: int = 0,
+                         regions: "int | None" = None,
                          audit: "str | None" = None) -> StepTrace:
     """Build a small representative lane for ``name`` and trace its
     step (abstract values only — no XLA compile, ~1 s per protocol)."""
@@ -1170,7 +1171,7 @@ def build_protocol_trace(name: str, *, n: int = 3, clients: int = 3,
     )
 
     planet = Planet.new()
-    regions = planet.regions()[:n]
+    planet_regions = planet.regions()[:n]
     total = commands * clients
     if shards > 1:
         dev = partial_dev_protocol(name, clients, shards)
@@ -1181,7 +1182,9 @@ def build_protocol_trace(name: str, *, n: int = 3, clients: int = 3,
             executor_executed_notification_interval_ms=100,
             executor_cleanup_interval_ms=100,
         )
-        dims = EngineDims.for_partial(dev, n, clients, total)
+        dims = EngineDims.for_partial(
+            dev, n, clients, total, dot_slots=dot_slots, regions=regions,
+        )
     else:
         dev = dev_protocol(name, clients)
         config = Config(**dev_config_kwargs(name, n, 1))
@@ -1189,7 +1192,7 @@ def build_protocol_trace(name: str, *, n: int = 3, clients: int = 3,
             dev, n=n, clients=clients, payload=dev.payload_width(n),
             total_commands=total,
             dot_slots=dot_slots if dot_slots is not None else total + 1,
-            regions=n,
+            regions=regions if regions is not None else n,
         )
     # multi-key partial commands need a pool that can produce distinct
     # keys; single-shard lanes keep the max-conflict workload
@@ -1197,7 +1200,8 @@ def build_protocol_trace(name: str, *, n: int = 3, clients: int = 3,
     spec = make_lane(
         dev, planet, config, conflict_rate=conflict, pool_size=pool_size,
         commands_per_client=commands, clients_per_region=1,
-        process_regions=regions, client_regions=regions, dims=dims,
+        process_regions=planet_regions, client_regions=planet_regions,
+        dims=dims,
         faults=faults,
     )
     state = init_lane_state(dev, dims, spec.ctx, monitor_keys=monitor_keys)

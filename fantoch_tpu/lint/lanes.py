@@ -498,6 +498,16 @@ class LaneTaint:
             return a
         return MIXED
 
+    def _sub(self, flat) -> "LaneTaint":
+        """Sub-analysis for a loop body — subclasses (the GL501 axis
+        taint) override so fixpoint recursion keeps their rules."""
+        return type(self)(flat, self.audit, self.lanes)
+
+    def _merge_sub(self, sub: "LaneTaint") -> None:
+        """Adopt a converged loop-body sub-analysis's findings —
+        subclasses carrying extra per-run records override."""
+        self.findings.extend(sub.findings)
+
     def _loop_fixpoint(self, flat, binvars, boutvars, consts, carries):
         """Widen loop-carry taints to a fixpoint (a carry that starts
         lane-constant — broadcast zeros — and picks up the lane axis
@@ -505,7 +515,7 @@ class LaneTaint:
         once more keeping findings. Returns the converged carry-out
         taints (the fixpoint run's findings land in self.findings)."""
         for _ in range(4):
-            sub = LaneTaint(flat, self.audit, self.lanes)
+            sub = self._sub(flat)
             for v, t in zip(binvars, consts + carries):
                 sub.env[v] = t
             sub.run()
@@ -514,11 +524,11 @@ class LaneTaint:
                 self._join(c, o) for c, o in zip(carries, outs[:len(carries)])
             ]
             if joined == carries:
-                self.findings.extend(sub.findings)
+                self._merge_sub(sub)
                 return outs
             carries = joined
         # non-converging (alternating axes): degrade every carry
-        self.findings.extend(sub.findings)
+        self._merge_sub(sub)
         return [MIXED] * len(boutvars)
 
     def _scan(self, eqn: FlatEqn, ins):

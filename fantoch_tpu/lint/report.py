@@ -53,6 +53,9 @@ class LintReport:
     # determinism ledger summary (per-rule site counts) when the
     # GL4xx family ran
     determinism: Dict[str, object] = field(default_factory=dict)
+    # shardability ledger summary (per-audit axis verdict counts)
+    # when the GL5xx family ran
+    shard: Dict[str, object] = field(default_factory=dict)
 
     def extend(self, fs) -> None:
         self.findings.extend(fs)
@@ -91,6 +94,20 @@ class LintReport:
                 if self.determinism
                 else {}
             ),
+            # the live GL501 ledgers ride on the report only for
+            # --write-shard-baseline; the printed summary keeps the
+            # per-audit verdict counts
+            **(
+                {
+                    "shard": {
+                        k: v
+                        for k, v in self.shard.items()
+                        if k != "ledgers"
+                    }
+                }
+                if self.shard
+                else {}
+            ),
             "findings": [
                 {
                     "id": f.id,
@@ -127,12 +144,12 @@ def write_baseline(path: str, report: LintReport) -> None:
     # this file suppresses ONLY the families that gate against it
     # (GL0xx structural + GL1xx AST/jaxpr). Every other family has
     # its own ledger — GL2xx cost_baseline.json, GL3xx
-    # transfer_baseline.json, GL4xx determinism_baseline.json — and
-    # emits findings ONLY on violation, so baking one in here would
-    # permanently suppress a live kernel/VMEM/sync/donation/
-    # determinism regression. An allowlist (not a denylist of known
-    # foreign prefixes) so the NEXT family can't cross-pollinate
-    # either.
+    # transfer_baseline.json, GL4xx determinism_baseline.json, GL5xx
+    # shard_baseline.json — and emits findings ONLY on violation, so
+    # baking one in here would permanently suppress a live
+    # kernel/VMEM/sync/donation/determinism/shardability regression.
+    # An allowlist (not a denylist of known foreign prefixes) so the
+    # NEXT family can't cross-pollinate either.
     counts = {
         fid: n
         for fid, n in sorted(report.counts().items())
@@ -145,9 +162,9 @@ def write_baseline(path: str, report: LintReport) -> None:
             "--write-baseline` and REVIEW the diff — every entry is a "
             "deliberately accepted finding (docs/LINT.md documents why "
             "each current entry is sound). Only GL0xx/GL1xx ids are "
-            "ever written: the cost (GL2xx), transfer (GL3xx), and "
-            "determinism (GL4xx) families gate against their own "
-            "ledgers."
+            "ever written: the cost (GL2xx), transfer (GL3xx), "
+            "determinism (GL4xx), and shardability (GL5xx) families "
+            "gate against their own ledgers."
         ),
         "findings": counts,
     }

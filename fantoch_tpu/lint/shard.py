@@ -1,0 +1,950 @@
+"""graft-shard: axis-shardability prover + partition audit (GL501-GL503).
+
+ROADMAP item 3 wants a 2-D device mesh (``lanes`` x ``state``) that
+shards the big per-protocol state planes *within* a lane — but a state
+axis may only be partitioned if no equation of the batched step mixes
+positions along it, except at the declared emission/quorum choke
+points where item 3 places its cross-device collectives. This family
+proves that property statically, before any mesh exists:
+
+* **GL501 — axis-shardability ledger.** :class:`AxisTaint`
+  generalizes :mod:`.lanes`'s forward taint from the single vmapped
+  lane axis to *every named state axis* (N processes, C clients, D
+  dot/exec slots, M pool rows, RR regions — sizes sourced from the
+  trace's :class:`~fantoch_tpu.engine.dims.EngineDims`). Each
+  (plane, axis) pair is classified ``SHARDABLE`` (no equation mixes
+  positions along it), ``COLLECTIVE`` (mixes only inside the declared
+  choke points :data:`CHOKE_FNS`), or ``REPLICATED`` (mixes in open
+  code — sharding it would need collectives item 3 does not plan).
+  Verdicts land in the checked-in ``lint/shard_baseline.json`` with a
+  per-entry evidence reason; a new pair, a changed verdict, or a
+  reasonless entry fails the gate (mirroring GL4xx). A primitive
+  without a transfer rule that receives axis taint degrades to a
+  finding, never to a silent pass.
+* **GL502 — partition-rule auditor.** ``parallel/specs.py`` declares
+  per-protocol regex -> PartitionSpec rule lists over the ledger's
+  dotted plane names. GL502 proves every declared rule against the
+  ledger: a spec sharding a ``REPLICATED`` axis, an axis with no
+  verdict, an unmatched plane, or a dead rule each fail CI *by name*.
+  The same audit backs ``run_sweep(mesh_shard=True, state_shards>1)``'s
+  proof consult (``parallel/sweep.py _STATE_PROOFS`` /
+  ``StateShardingError``).
+* **GL503 — per-shard footprint gate.** Re-runs GL202's fused-group
+  VMEM analysis with every value's bytes divided by the candidate
+  mesh extent along the axes it provably carries (lane axis by
+  ``lanes`` shards, spec-sharded state axes by ``state`` shards), so
+  "this planet fits at shards=S" is a static verdict before any
+  device is touched.
+
+Soundness notes (what the taint does and does not prove, the
+choke-point *trust* boundary, GL503's streaming-vs-resident caveat)
+live in docs/LINT.md#gl501.
+
+This module imports nothing heavier than the stdlib at import time so
+bench.py's device-free ``shard_axis_ledger`` metric can read the
+checked-in ledger without initializing jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .report import Finding
+
+# ----------------------------------------------------------------------
+# constants
+# ----------------------------------------------------------------------
+
+# the checked-in verdict ledger (regenerate: lint --write-shard-baseline)
+DEFAULT_SHARD_BASELINE = os.path.join(
+    os.path.dirname(__file__), "shard_baseline.json"
+)
+
+# the trace shape the ledger is computed at. Chosen so every tracked
+# dimension has a DISTINCT size (N=5, C=13, D=17, RR=7, M derived
+# ~285) — axis labels are attached by size, and a collision would only
+# blur the label (verdict keys are positional), but distinct sizes
+# keep the ledger readable. regions > n is an EngineDims requirement.
+SHARD_SHAPE = dict(n=5, clients=13, commands=2, dot_slots=17, regions=7)
+
+# batch size for the vmap replay the taints walk: the documented sweep
+# batch (cost.SWEEP_LANES). The axis taint's size checks compare
+# against the SEEDED axis's own size, never the batch size, so any
+# batch works — sharing the cost family's keeps the replay cacheable.
+SHARD_LANES = 512
+
+# the named dims whose axes the ledger tracks, in EngineDims-attribute
+# form. H (histogram buckets) and the small F/R/P capacity dims are
+# deliberately untracked: nobody plans to shard them, and every
+# untracked axis is simply absent from the ledger (GL502 then refuses
+# any spec that tries to shard one — absence is not permission).
+TRACKED_DIMS = ("N", "C", "D", "M", "RR")
+
+# verdicts
+SHARDABLE = "SHARDABLE"
+COLLECTIVE = "COLLECTIVE"
+REPLICATED = "REPLICATED"
+
+# the declared cross-device choke points (ROADMAP item 3): the ONLY
+# functions where an axis-mixing equation is classified COLLECTIVE
+# instead of REPLICATED. This is a TRUST boundary, not a proof — the
+# taint proves mixing happens nowhere else, and item 3's runtime must
+# independently get the collective at each choke right. The emission
+# side (emit_broadcast / pack_outbox / merge_emissions) is the
+# all-gather onto the wire batch; oh_route is the scatter back;
+# oh_get is the single-row remote fetch; fold_health / frontier_min
+# are the two tiny per-step scalar psums (docs/LINT.md#gl501).
+CHOKE_FNS = frozenset(
+    {
+        "emit_broadcast",
+        "pack_outbox",
+        "merge_emissions",
+        "oh_route",
+        "oh_get",
+        "fold_health",
+        "fold_count",
+        "frontier_min",
+        "mark_popped",
+        "emitter_times",
+    }
+)
+
+# event kinds recorded by AxisTaint
+_MIX = "mix"                # out-of-choke structural mixing
+_COLL = "collective"        # mixing inside a declared choke point
+_UNKNOWN = "unknown"        # no transfer rule for a tainted primitive
+_ERROR = "error"            # a transfer rule crashed on this equation
+
+
+def _known_prims():
+    """Primitives the taint has a real transfer rule for — an axis
+    reaching any other primitive is a GL501 degradation finding, so a
+    jax upgrade introducing a new primitive names itself here."""
+    from .lanes import (
+        CONSERVATIVE_MIXED,
+        ELEMENTWISE,
+        LEADING_AXIS_PRESERVING,
+    )
+
+    structural = {
+        "reduce_sum", "reduce_prod", "reduce_max", "reduce_min",
+        "reduce_and", "reduce_or", "reduce_xor", "argmax", "argmin",
+        "cumsum", "cumprod", "cummax", "cummin", "cumlogsumexp",
+        "sort", "rev", "broadcast_in_dim", "reshape", "squeeze",
+        "transpose", "slice", "pad", "concatenate", "dot_general",
+        "gather", "scatter", "scatter-add", "scatter-mul",
+        "scatter-max", "scatter-min", "dynamic_slice",
+        "dynamic_update_slice", "scan", "while",
+    }
+    return (
+        structural | CONSERVATIVE_MIXED | ELEMENTWISE
+        | LEADING_AXIS_PRESERVING
+    )
+
+
+# ----------------------------------------------------------------------
+# GL501: the axis taint
+# ----------------------------------------------------------------------
+
+
+def _make_axis_taint():
+    """Build the AxisTaint class lazily so importing this module never
+    pulls :mod:`.lanes` (and through it jax) — bench.py reads the
+    checked-in ledger via :func:`shard_axis_ledger_summary` from a
+    jax-free probe."""
+    from .lanes import MIXED, LaneTaint
+
+    known = _known_prims()
+
+    class AxisTaint(LaneTaint):
+        """Forward taint for ONE named state axis over a batched step.
+
+        Same transfer rules as the GL203 lane taint (``self.lanes`` is
+        the *seeded axis's own size*, which is what the structural
+        size checks compare against), but instead of emitting findings
+        it records events: an equation that would smear the axis
+        inside a declared choke function is a ``collective`` event and
+        its outputs are treated as axis-constant (the collective
+        re-replicates them); anywhere else it is a ``mix``; a tainted
+        primitive without a rule is an ``unknown`` degradation."""
+
+        def __init__(self, flat, audit, axis_size, chokes=CHOKE_FNS):
+            super().__init__(flat, audit, axis_size)
+            self.chokes = chokes
+            self.events: List[Tuple[str, Any, str]] = []
+
+        def _sub(self, flat):
+            return AxisTaint(flat, self.audit, self.lanes, self.chokes)
+
+        def _merge_sub(self, sub):
+            self.events.extend(sub.events)
+
+        def _record(self, kind, eqn, why):
+            self.events.append((kind, eqn, why))
+
+        def run(self):
+            for eqn in self.flat:
+                in_taints = [self.read(a) for a in eqn.invars]
+                if any(t == MIXED for t in in_taints):
+                    # propagate silently: the creating event is already
+                    # recorded, and post-choke values were re-set clean
+                    outs = [MIXED] * len(eqn.outvars)
+                else:
+                    err = None
+                    try:
+                        res = self.transfer(eqn)
+                    except Exception as e:
+                        res, err = MIXED, f"taint rule error ({e!r})"
+                    if res == MIXED:
+                        if err is not None:
+                            self._record(_ERROR, eqn, err)
+                            outs = [MIXED] * len(eqn.outvars)
+                        elif eqn.prim not in known:
+                            self._record(
+                                _UNKNOWN, eqn,
+                                "no transfer rule for this primitive",
+                            )
+                            outs = [MIXED] * len(eqn.outvars)
+                        elif eqn.src[1] in self.chokes:
+                            # inside a declared choke point the mix IS
+                            # the planned collective; after it every
+                            # shard holds the full value again
+                            self._record(
+                                _COLL, eqn,
+                                f"axis mixes inside choke `{eqn.src[1]}`",
+                            )
+                            outs = [None] * len(eqn.outvars)
+                        else:
+                            self._record(
+                                _MIX, eqn,
+                                "positions along the axis combine here",
+                            )
+                            outs = [MIXED] * len(eqn.outvars)
+                    else:
+                        outs = res
+                for v, t in zip(eqn.outvars, outs):
+                    self.env[v] = t
+            return self.findings
+
+    return AxisTaint
+
+
+def plane_names(trace) -> List[str]:
+    """Dotted names for every root input leaf of a traced step, in
+    flatten (= jaxpr invar) order: ``state.ps.clock``,
+    ``ctx.delay_pp`` ... — the names GL501's ledger keys and
+    ``parallel/specs.py``'s partition-rule regexes match."""
+    import jax
+
+    leaves, _ = jax.tree_util.tree_flatten_with_path(
+        (trace.state, trace.ctx)
+    )
+    names = []
+    for path, _leaf in leaves:
+        parts = []
+        for i, p in enumerate(path):
+            if i == 0:
+                parts.append("state" if p.idx == 0 else "ctx")
+            elif hasattr(p, "key"):
+                parts.append(str(p.key))
+            elif hasattr(p, "idx"):
+                parts.append(str(p.idx))
+            else:  # pragma: no cover — future key types
+                parts.append(str(p))
+        names.append(".".join(parts))
+    return names
+
+
+def axis_labels(dims) -> Dict[int, str]:
+    """Size -> dim-name map over :data:`TRACKED_DIMS`; sizes shared by
+    two tracked dims get a joined ``"N/RR"`` label (labels are
+    cosmetic — ledger keys are positional)."""
+    by_size: Dict[int, List[str]] = {}
+    for nm in TRACKED_DIMS:
+        by_size.setdefault(int(getattr(dims, nm)), []).append(nm)
+    return {s: "/".join(nms) for s, nms in sorted(by_size.items())}
+
+
+def shard_trace(name: str, shards: int = 1, cache=None):
+    """The shard family's trace of ``name`` at :data:`SHARD_SHAPE`
+    (cache key ``("shard", audit)`` when a TraceCache is supplied)."""
+    from .jaxpr import build_protocol_trace
+
+    audit = name if shards == 1 else f"{name}@{shards}shards"
+    build = lambda: build_protocol_trace(  # noqa: E731
+        name, shards=shards, audit=audit, **SHARD_SHAPE
+    )
+    if cache is None:
+        return build()
+    return cache.get(("shard", audit), build)
+
+
+def axis_ledger(
+    trace, lanes: int = SHARD_LANES, chokes=CHOKE_FNS,
+) -> Tuple[Dict[str, Dict[str, str]], List[Tuple[str, Any, str]]]:
+    """GL501 over one traced step: one independent taint run per
+    (plane, tracked-axis) pair over the batched replay. Returns
+    ``(entries, degradations)`` — entries keyed
+    ``"<plane>:<axis pos>:<label>"`` (position counts *unbatched* plane
+    axes) with ``{"verdict", "reason"}`` values; degradations are the
+    deduplicated unknown-primitive / rule-error events."""
+    AxisTaint = _make_axis_taint()
+    flat, invars, _outvars = trace.batched_flat_parts(lanes)
+    names = plane_names(trace)
+    assert len(names) == len(invars), (len(names), len(invars))
+    labels = axis_labels(trace.dims)
+
+    entries: Dict[str, Dict[str, str]] = {}
+    degradations: List[Tuple[str, Any, str]] = []
+    seen_deg = set()
+    for var, pname in zip(invars, names):
+        shape = tuple(getattr(var.aval, "shape", ()) or ())
+        for k in range(1, len(shape)):  # axis 0 is the vmapped lane axis
+            label = labels.get(int(shape[k]))
+            if label is None:
+                continue
+            ana = AxisTaint(flat, trace.name, int(shape[k]), chokes)
+            ana.env[var] = k
+            ana.run()
+            verdict, reason = _verdict(ana.events)
+            entries[f"{pname}:{k - 1}:{label}"] = {
+                "verdict": verdict,
+                "reason": reason,
+            }
+            for ev in ana.events:
+                if ev[0] in (_UNKNOWN, _ERROR):
+                    eqn = ev[1]
+                    key = (eqn.src[0], eqn.src[1], eqn.prim)
+                    if key not in seen_deg:
+                        seen_deg.add(key)
+                        degradations.append(ev)
+    return entries, degradations
+
+
+def _verdict(events) -> Tuple[str, str]:
+    """Collapse one taint run's events into (verdict, evidence reason)."""
+    for kind, eqn, why in events:
+        if kind in (_MIX, _UNKNOWN, _ERROR):
+            return REPLICATED, (
+                f"first out-of-choke mix: {eqn.src[0]}:{eqn.src[1]}:"
+                f"{eqn.prim} (line {eqn.src[2]}) — {why}"
+            )
+    chokes_hit = sorted({e[1].src[1] for e in events if e[0] == _COLL})
+    if chokes_hit:
+        return COLLECTIVE, (
+            "mixes only inside declared choke points: "
+            + ", ".join(chokes_hit)
+        )
+    return SHARDABLE, (
+        "no equation combines positions along this axis anywhere in "
+        "the batched step"
+    )
+
+
+# ----------------------------------------------------------------------
+# GL501: baseline ledger gate (mirrors the GL4xx reason-required gate)
+# ----------------------------------------------------------------------
+
+
+def load_shard_baseline(
+    path: str = DEFAULT_SHARD_BASELINE,
+) -> Dict[str, Any]:
+    """``{"lanes", "shape", "ledgers": {audit: {key: {verdict,
+    reason}}}}``; a missing file is an empty ledger (every audit then
+    raises a no-ledger finding, which is how the first
+    ``--write-shard-baseline`` run is bootstrapped)."""
+    if not os.path.exists(path):
+        return {"ledgers": {}}
+    with open(path) as fh:
+        data = json.load(fh)
+    return {
+        "lanes": int(data.get("lanes", SHARD_LANES)),
+        "shape": dict(data.get("shape", {})),
+        "ledgers": {
+            str(a): {str(k): dict(v) for k, v in led.items()}
+            for a, led in data.get("ledgers", {}).items()
+            if not str(a).startswith("_")
+        },
+    }
+
+
+def write_shard_baseline(
+    path: str, ledgers: Dict[str, Dict[str, Dict[str, str]]],
+) -> None:
+    """Write the verdict ledger. Regeneration preserves a hand-edited
+    reason when the verdict did not change (the auto reason is
+    machine-derived evidence, so annotating over it is allowed but
+    never required — unlike GL4xx there is no UNREVIEWED placeholder:
+    stripping a reason by hand is what the reasonless gate catches)."""
+    from ..engine.checkpoint import atomic_write, canonical_json
+
+    existing = (
+        load_shard_baseline(path)["ledgers"]
+        if os.path.exists(path)
+        else {}
+    )
+    out: Dict[str, Any] = {}
+    for audit in sorted(ledgers):
+        prev = existing.get(audit, {})
+        led = {}
+        for key in sorted(ledgers[audit]):
+            ent = dict(ledgers[audit][key])
+            old = prev.get(key)
+            if (
+                old is not None
+                and old.get("verdict") == ent["verdict"]
+                and str(old.get("reason", "")).strip()
+            ):
+                ent["reason"] = old["reason"]
+            led[key] = ent
+        out[audit] = led
+    payload = {
+        "_comment": (
+            "GL501 axis-shardability ledger: audit -> "
+            "'plane:axis:label' -> {verdict, reason}. SHARDABLE = no "
+            "equation mixes positions along the axis; COLLECTIVE = "
+            "mixes only inside the declared choke points "
+            "(emit_broadcast/pack_outbox/oh_route/merge_emissions, "
+            "where ROADMAP item 3 places its cross-device hops); "
+            "REPLICATED = mixes in open code. Regenerate with "
+            "`python -m fantoch_tpu.cli lint --write-shard-baseline` "
+            "and REVIEW the diff — a verdict change is the regression "
+            "this file exists to catch, and an entry without a reason "
+            "fails the gate itself (docs/LINT.md#gl501)."
+        ),
+        "lanes": SHARD_LANES,
+        "shape": SHARD_SHAPE,
+        "ledgers": out,
+    }
+    atomic_write(path, canonical_json(payload, indent=2) + "\n")
+
+
+def degradation_findings(audit: str, degradations) -> List[Finding]:
+    """Unknown-primitive / rule-error events are GL501 findings
+    regardless of the baseline — each names the transfer rule to add
+    (a degraded verdict must never silently baseline as REPLICATED)."""
+    findings = []
+    for kind, eqn, why in degradations:
+        findings.append(
+            Finding(
+                "GL501",
+                audit,
+                f"{eqn.src[0]}:{eqn.src[1]}:{eqn.prim}",
+                f"axis-taint degradation: {why} — add a transfer rule "
+                f"for `{eqn.prim}` to lint/lanes.py (the verdict for "
+                "every axis reaching it is conservative, not proven; "
+                "docs/LINT.md#gl501)",
+                detail=f"line {eqn.src[2]}",
+            )
+        )
+    return findings
+
+
+def gate_shard_ledger(
+    audit: str,
+    entries: Dict[str, Dict[str, str]],
+    baseline: Dict[str, Any],
+) -> Tuple[List[Finding], List[str]]:
+    """Compare one audit's computed ledger to the checked-in one.
+    Returns (findings, stale-keys). A new (plane, axis) pair, a
+    verdict change in EITHER direction (an upgrade must be regenerated
+    deliberately, not absorbed), and a reasonless entry all fail;
+    stale keys stay advisory (audits can be narrowed)."""
+    findings: List[Finding] = []
+    base = baseline.get("ledgers", {}).get(audit)
+    if base is None:
+        findings.append(
+            Finding(
+                "GL501",
+                audit,
+                "shard_baseline",
+                "no axis ledger checked in for this audit — run "
+                "`python -m fantoch_tpu.cli lint "
+                "--write-shard-baseline` and review the verdicts",
+            )
+        )
+        return findings, []
+    for key in sorted(entries):
+        ent, old = entries[key], base.get(key)
+        if old is None:
+            findings.append(
+                Finding(
+                    "GL501",
+                    audit,
+                    key,
+                    f"NEW axis pair (verdict {ent['verdict']}) absent "
+                    "from lint/shard_baseline.json — regenerate with "
+                    "--write-shard-baseline and review",
+                )
+            )
+        elif old.get("verdict") != ent["verdict"]:
+            findings.append(
+                Finding(
+                    "GL501",
+                    audit,
+                    key,
+                    f"shardability verdict changed: "
+                    f"{old.get('verdict')} -> {ent['verdict']} "
+                    f"({ent['reason']}) — if intentional, regenerate "
+                    "the baseline and re-audit every partition rule "
+                    "that shards this axis",
+                )
+            )
+    for key in sorted(base):
+        if not str(base[key].get("reason", "")).strip() or str(
+            base[key].get("reason", "")
+        ).startswith("UNREVIEWED"):
+            findings.append(
+                Finding(
+                    "GL501",
+                    audit,
+                    f"{key}:reasonless",
+                    f"baselined verdict {key} carries no evidence "
+                    "reason — every entry in lint/shard_baseline.json "
+                    "must say WHY the verdict holds",
+                )
+            )
+    stale = sorted(k for k in base if k not in entries)
+    return findings, stale
+
+
+# ----------------------------------------------------------------------
+# GL502: partition-rule auditor
+# ----------------------------------------------------------------------
+
+
+def audit_partition_rules(
+    audit: str,
+    entries: Dict[str, Dict[str, str]],
+    rules: Sequence[Tuple[str, Any]],
+    planes: "Sequence[str] | None" = None,
+) -> List[Finding]:
+    """Prove one protocol's declared regex -> PartitionSpec rules
+    against its GL501 ledger. Every plane must match a rule; every
+    sharded state-axis position must carry a SHARDABLE or COLLECTIVE
+    verdict; every non-catch-all rule must match at least one plane.
+    ``entries`` may come from a live ledger or the checked-in
+    baseline — the keys are identical. Pass ``planes`` (the full
+    dotted plane list) when available: planes with no tracked axis at
+    all (scalars, capacity-dim vectors) carry no ledger entry, and
+    without the explicit list a rule sharding one would escape the
+    no-verdict check."""
+    import re
+
+    from ..parallel.specs import LANES_AXIS, STATE_AXIS
+
+    findings: List[Finding] = []
+    if planes is None:
+        planes = {k.split(":", 1)[0] for k in entries}
+    planes = sorted(set(planes))
+    by_plane_pos: Dict[Tuple[str, int], Dict[str, str]] = {}
+    for key, ent in entries.items():
+        plane, pos, _label = key.rsplit(":", 2)
+        by_plane_pos[(plane, int(pos))] = ent
+
+    matched = [0] * len(rules)
+    for plane in planes:
+        spec = None
+        for ridx, (pat, s) in enumerate(rules):
+            if re.search(pat, plane):
+                spec, rule_pat = s, pat
+                matched[ridx] += 1
+                break
+        if spec is None:
+            findings.append(
+                Finding(
+                    "GL502",
+                    audit,
+                    f"specs:{plane}",
+                    "no partition rule matches this plane — "
+                    "parallel/specs.py rule lists must end with a "
+                    "catch-all (an unmatched plane has no declared "
+                    "layout)",
+                )
+            )
+            continue
+        for pos, part in enumerate(tuple(spec)):
+            if part is None:
+                continue
+            if pos == 0:
+                if part != LANES_AXIS:
+                    findings.append(
+                        Finding(
+                            "GL502",
+                            audit,
+                            f"specs:{plane}:0",
+                            f"rule `{rule_pat}` places mesh axis "
+                            f"`{part}` on the leading dimension — "
+                            "that position is the vmapped lane axis "
+                            f"(`{LANES_AXIS}`, proven by GL203), "
+                            "never a state axis",
+                        )
+                    )
+                continue
+            if part != STATE_AXIS:
+                findings.append(
+                    Finding(
+                        "GL502",
+                        audit,
+                        f"specs:{plane}:{pos}",
+                        f"rule `{rule_pat}` uses unsupported mesh "
+                        f"axis `{part}` — the 2-D mesh has exactly "
+                        f"`{LANES_AXIS}` and `{STATE_AXIS}`",
+                    )
+                )
+                continue
+            ent = by_plane_pos.get((plane, pos - 1))
+            if ent is None:
+                findings.append(
+                    Finding(
+                        "GL502",
+                        audit,
+                        f"specs:{plane}:{pos}",
+                        f"rule `{rule_pat}` shards plane axis "
+                        f"{pos - 1} of `{plane}`, which has NO GL501 "
+                        "verdict (untracked or unnamed axis) — only "
+                        "proven axes may be partitioned",
+                    )
+                )
+            elif ent["verdict"] == REPLICATED:
+                findings.append(
+                    Finding(
+                        "GL502",
+                        audit,
+                        f"specs:{plane}:{pos}",
+                        f"rule `{rule_pat}` shards plane axis "
+                        f"{pos - 1} of `{plane}`, which GL501 proves "
+                        f"REPLICATED ({ent['reason']}) — compiling "
+                        "this layout would silently change results",
+                    )
+                )
+    for ridx, ((pat, _s), hit) in enumerate(zip(rules, matched)):
+        if hit == 0:
+            findings.append(
+                Finding(
+                    "GL502",
+                    audit,
+                    f"specs:rule{ridx}",
+                    f"dead partition rule `{pat}` matches no plane of "
+                    "this protocol — remove it or fix the regex (a "
+                    "dead rule is a layout that silently never "
+                    "applies)",
+                )
+            )
+    return findings
+
+
+def prove_step_state_shardable(
+    protocol, dims, state, ctx, rules, faults=None,
+    monitor_keys: int = 0, reorder: bool = False,
+    audit: "str | None" = None, lanes: int = SHARD_LANES,
+) -> List[Finding]:
+    """The sweep driver's gate for ``state_shards > 1``: trace the
+    EXACT step a 2-D-meshed ``run_sweep`` would compile (same fault
+    flags, same monitor capacity, same reorder mode, same per-lane
+    state/ctx structure), build its GL501 axis ledger and prove the
+    declared partition rules against it (GL502). Unknown-primitive
+    degradations are findings here too — in the runtime gate a
+    degraded verdict is conservative, not proven, so it refuses like
+    a mix would. Returns the findings (empty = layout proven for this
+    step)."""
+    from .jaxpr import trace_step
+
+    trace = trace_step(
+        protocol, dims, state, ctx, faults, monitor_keys,
+        name=audit or f"{type(protocol).__name__}:sweep",
+        reorder=reorder,
+    )
+    entries, degradations = axis_ledger(trace, lanes=lanes)
+    findings = degradation_findings(trace.name, degradations)
+    findings += audit_partition_rules(
+        trace.name, entries, rules, planes=plane_names(trace)
+    )
+    return findings
+
+
+# ----------------------------------------------------------------------
+# GL503: per-shard footprint gate
+# ----------------------------------------------------------------------
+
+
+def footprint_check(
+    audit: str,
+    trace,
+    rules: Sequence[Tuple[str, Any]],
+    candidate: Dict[str, Any],
+    lanes: int = SHARD_LANES,
+) -> Tuple[List[Finding], Dict[str, Any]]:
+    """GL202's fused-group liveness analysis under the shard-divided
+    shapes a candidate ``{"lanes": L, "state": S, "budget_mib": B}``
+    mesh implies: a value's bytes divide by L if it provably carries
+    the lane axis, and by S if it provably carries a spec-sharded
+    state axis — everything else (axis-constant or smeared) counts
+    full-size per device, conservatively. Returns (findings,
+    summary). The verdict is about *resident* footprint per fused
+    group; planes the runtime streams (scan windows) are charged as
+    resident, so a pass here is sufficient, not necessary."""
+    import re
+
+    from .cost import _bytes, _fusion_groups, _group_stat
+    from .jaxpr import _is_literal
+    from .lanes import LaneTaint
+    from ..parallel.specs import STATE_AXIS
+
+    AxisTaint = _make_axis_taint()
+    L = int(candidate.get("lanes", 1))
+    S = int(candidate.get("state", 1))
+    budget_mib = float(candidate["budget_mib"])
+
+    flat, invars, _outvars = trace.batched_flat_parts(lanes)
+    names = plane_names(trace)
+
+    lane_ana = LaneTaint(flat, trace.name, lanes)
+    for v in invars:
+        lane_ana.env[v] = 0
+    lane_ana.run()
+
+    # seed every spec-sharded (plane, axis) jointly, one run per axis
+    # size (the structural size checks compare against one size per
+    # run; a cross-size interaction degrades to MIXED = no division)
+    seeds_by_size: Dict[int, List[Tuple[Any, int]]] = {}
+    for var, pname in zip(invars, names):
+        spec = None
+        for pat, s in rules:
+            if re.search(pat, pname):
+                spec = s
+                break
+        if spec is None:
+            continue
+        shape = tuple(getattr(var.aval, "shape", ()) or ())
+        for pos, part in enumerate(tuple(spec)):
+            if part == STATE_AXIS and 0 < pos < len(shape):
+                seeds_by_size.setdefault(int(shape[pos]), []).append(
+                    (var, pos)
+                )
+    state_envs = []
+    for size in sorted(seeds_by_size):
+        ana = AxisTaint(flat, trace.name, size)
+        for var, pos in seeds_by_size[size]:
+            ana.env[var] = pos
+        ana.run()
+        state_envs.append(ana.env)
+
+    def shard_bytes(v):
+        b = _bytes(v.aval)
+        if b == 0:
+            return 0
+        if lane_ana.env.get(v) == 0:
+            b = -(-b // L)
+        if any(isinstance(env.get(v), int) for env in state_envs):
+            b = -(-b // S)
+        return b
+
+    uses: Dict[Any, List[int]] = {}
+    for i, e in enumerate(flat):
+        for v in e.invars:
+            if not _is_literal(v):
+                uses.setdefault(v, []).append(i)
+    stats = [
+        _group_stat(flat, g, uses, nbytes=shard_bytes)
+        for g in _fusion_groups(flat)
+    ]
+    peak = max(stats, key=lambda g: g.peak_bytes, default=None)
+    peak_mib = (peak.peak_bytes / (1 << 20)) if peak else 0.0
+    summary = {
+        "mesh": {"lanes": L, "state": S},
+        "budget_mib": budget_mib,
+        "peak_shard_mib": round(peak_mib, 3),
+    }
+    findings = []
+    if peak is not None and peak_mib > budget_mib:
+        findings.append(
+            Finding(
+                "GL503",
+                audit,
+                f"{peak.anchor[0]}:{peak.anchor[1]}:{peak.anchor[2]}",
+                f"per-shard fused-group footprint {peak_mib:.1f} MiB "
+                f"exceeds the candidate mesh budget {budget_mib:.1f} "
+                f"MiB (lanes={L} x state={S}; largest value "
+                f"{peak.largest_shape}) — this layout cannot fit; "
+                "raise the shard counts in parallel/specs.py "
+                "CANDIDATES or shrink the plane (docs/LINT.md#gl503)",
+                detail=f"line {peak.line}",
+            )
+        )
+    return findings, summary
+
+
+# ----------------------------------------------------------------------
+# the driver
+# ----------------------------------------------------------------------
+
+
+def run_shard(
+    protocols: "Sequence[str] | None" = None,
+    *,
+    include_partial: bool = True,
+    cache=None,
+    baseline: "Dict[str, Any] | None" = None,
+    rules: "Dict[str, Sequence] | None" = None,
+    candidates: "Dict[str, Dict[str, Any]] | None" = None,
+    progress=None,
+) -> Tuple[List[Finding], Dict[str, Any]]:
+    """GL501 + GL502 + GL503 over the audited protocol grid. Returns
+    ``(findings, summary)`` and, via ``summary["ledgers"]``, the live
+    verdict ledgers (the CLI's ``--write-shard-baseline`` consumes
+    them so the write never re-traces)."""
+    from ..parallel import specs
+    from ..registry import DEV_PROTOCOLS, PARTIAL_DEV_PROTOCOLS
+
+    say = progress or (lambda *_: None)
+    if baseline is None:
+        baseline = load_shard_baseline()
+    if rules is None:
+        rules = specs.RULES
+    if candidates is None:
+        candidates = specs.CANDIDATES
+
+    names = list(protocols or DEV_PROTOCOLS)
+    audits = [(n, 1) for n in names]
+    if include_partial:
+        audits += [
+            (n, 2)
+            for n in PARTIAL_DEV_PROTOCOLS
+            if not protocols or n in protocols
+        ]
+
+    findings: List[Finding] = []
+    summary: Dict[str, Any] = {
+        "lanes": SHARD_LANES,
+        "audits": {},
+        "ledgers": {},
+    }
+    lanes = int(baseline.get("lanes", SHARD_LANES))
+    for name, shards in audits:
+        audit = name if shards == 1 else f"{name}@{shards}shards"
+        say(f"shardability: {audit} ({lanes} lanes) ...")
+        trace = shard_trace(name, shards, cache)
+        entries, degradations = axis_ledger(trace, lanes)
+        findings.extend(degradation_findings(audit, degradations))
+        gate_findings, stale = gate_shard_ledger(
+            audit, entries, baseline
+        )
+        findings.extend(gate_findings)
+        proto_rules = specs.rules_for(audit, rules)
+        gl502 = audit_partition_rules(
+            audit, entries, proto_rules, planes=plane_names(trace)
+        )
+        findings.extend(gl502)
+        verdicts = {SHARDABLE: 0, COLLECTIVE: 0, REPLICATED: 0}
+        for ent in entries.values():
+            verdicts[ent["verdict"]] += 1
+        audit_summary: Dict[str, Any] = {
+            "axes": len(entries),
+            "verdicts": verdicts,
+            "degradations": len(degradations),
+            "gl502_findings": len(gl502),
+            "stale_baseline": stale,
+        }
+        cand = specs.candidate_for(audit, candidates)
+        if cand is not None:
+            say(f"per-shard footprint: {audit} ...")
+            gl503, fp = footprint_check(
+                audit, trace, proto_rules, cand, lanes
+            )
+            findings.extend(gl503)
+            audit_summary["footprint"] = fp
+        summary["audits"][audit] = audit_summary
+        summary["ledgers"][audit] = entries
+    return findings, summary
+
+
+# ----------------------------------------------------------------------
+# selfcheck: the gate must be able to fail
+# ----------------------------------------------------------------------
+
+_SELFCHECK_FIXTURES = {
+    "axis": ("shard_bad_axis.py", "GL501"),
+    "spec": ("shard_bad_spec.py", "GL502"),
+    "vmem": ("shard_bad_vmem.py", "GL503"),
+}
+
+
+def _load_fixture(kind: str):
+    import importlib.util
+
+    from .determinism import REPO_ROOT
+
+    fixture, rule = _SELFCHECK_FIXTURES[kind]
+    path = os.path.join(REPO_ROOT, "tests", "fixtures", fixture)
+    spec = importlib.util.spec_from_file_location(
+        f"_shard_fixture_{kind}", path
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod, rule
+
+
+def run_shard_selfcheck(
+    kind: str,
+) -> Tuple[List[Finding], Dict[str, Any]]:
+    """The CI broken-fixture check: each seeded defect must produce at
+    least one finding *naming its rule* against the real checked-in
+    artifacts, or the gate is vacuously green. ``axis`` audits a
+    defective tempo trace (a cross-process read outside every choke)
+    against the real baseline ledger; ``spec`` audits a rule list that
+    shards a non-provable axis against the real ledger; ``vmem``
+    checks a candidate mesh whose budget cannot hold tempo's step."""
+    from ..parallel import specs
+
+    mod, rule = _load_fixture(kind)
+    baseline = load_shard_baseline()
+    if kind == "axis":
+        trace = mod.build_trace()
+        entries, degradations = axis_ledger(trace)
+        findings, _stale = gate_shard_ledger("tempo", entries, baseline)
+        findings = degradation_findings("tempo", degradations) + findings
+    elif kind == "spec":
+        entries = baseline.get("ledgers", {}).get("tempo", {})
+        findings = audit_partition_rules(
+            "tempo", entries, specs.rules_for("tempo", mod.RULES)
+        )
+    else:
+        trace = shard_trace("tempo")
+        findings, _fp = footprint_check(
+            "tempo",
+            trace,
+            specs.rules_for("tempo", specs.RULES),
+            specs.candidate_for("tempo", mod.CANDIDATES),
+        )
+    findings = [f for f in findings if f.rule == rule]
+    summary = {"selfcheck_rule": rule, "findings": len(findings)}
+    return findings, summary
+
+
+# ----------------------------------------------------------------------
+# bench.py metric (device-free, jax-free)
+# ----------------------------------------------------------------------
+
+
+def shard_axis_ledger_summary(
+    path: str = DEFAULT_SHARD_BASELINE,
+) -> Dict[str, Any]:
+    """Per-protocol SHARDABLE/COLLECTIVE/REPLICATED axis counts from
+    the *checked-in* ledger — bench.py's ``shard_axis_ledger`` metric.
+    Reads only the JSON artifact (no jax, no trace): the lint gate
+    proves the artifact matches HEAD, so the static counts are honest
+    even where no device is reachable."""
+    baseline = load_shard_baseline(path)
+    audits: Dict[str, Any] = {}
+    for audit in sorted(baseline.get("ledgers", {})):
+        led = baseline["ledgers"][audit]
+        counts = {SHARDABLE: 0, COLLECTIVE: 0, REPLICATED: 0}
+        for ent in led.values():
+            v = str(ent.get("verdict", ""))
+            if v in counts:
+                counts[v] += 1
+        audits[audit] = {"axes": len(led), **counts}
+    return {"audits": audits, "lanes": baseline.get("lanes")}

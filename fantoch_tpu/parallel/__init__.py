@@ -7,9 +7,13 @@ each chip advances its shard of lanes, and results gather back to host.
 Two layouts share one per-lane trace: the implicit ``jit`` +
 ``NamedSharding`` path, and ``partition.py``'s explicit ``shard_map``
 partitioning (``run_sweep(mesh_shard=True)``, docs/PERF.md
-§ "Mesh-partitioned megabatches").
+§ "Mesh-partitioned megabatches"). ``run_sweep(state_shards > 1)``
+adds the 2-D (lanes x state) layout: per-process state planes split
+over a second mesh axis under the layouts ``specs.py`` declares and
+the GL501/GL502 shardability proof (lint/shard.py) admits — an
+unproven layout raises ``StateShardingError`` instead of compiling.
 """
 
-from .sweep import make_sweep_specs, run_sweep
+from .sweep import StateShardingError, make_sweep_specs, run_sweep
 
-__all__ = ["make_sweep_specs", "run_sweep"]
+__all__ = ["StateShardingError", "make_sweep_specs", "run_sweep"]

@@ -51,8 +51,70 @@ def fleet_mesh(devices=None) -> Mesh:
 
 def lane_sharding(mesh: Mesh) -> NamedSharding:
     """The batched lane state/ctx placement: leading (lane) axis split
-    over the mesh, everything else replicated per shard."""
+    over the mesh, everything else replicated per shard. On the 2-D
+    (lanes x state) mesh the same spec shards lanes and replicates
+    over the state axis — the ctx layout of ``state_shards > 1``."""
     return NamedSharding(mesh, PartitionSpec(MESH_AXIS))
+
+
+def fleet_mesh_2d(state_shards: int, devices=None) -> Mesh:
+    """The 2-D mesh for ``run_sweep(state_shards > 1)``: the local
+    devices folded into an ``(L, S)`` grid named
+    ``("lanes", "state")`` (deterministic device order, lanes-major —
+    lane shards stay contiguous so the 1-D and 2-D layouts place lane
+    0 on device 0)."""
+    from .specs import STATE_AXIS
+
+    devs = tuple(devices) if devices is not None else tuple(jax.devices())
+    S = int(state_shards)
+    if S < 1 or len(devs) % S:
+        raise ValueError(
+            f"state_shards={state_shards} does not divide the "
+            f"{len(devs)}-device fleet — the 2-D mesh folds devices "
+            "into a (lanes, state) grid"
+        )
+    grid = np.asarray(devs).reshape(len(devs) // S, S)
+    return Mesh(grid, (MESH_AXIS, STATE_AXIS))
+
+
+def state_shardings(mesh: Mesh, state, rules):
+    """Per-leaf :class:`NamedSharding` tree for the *batched* lane
+    state under the declared partition rules (parallel/specs.py).
+    Leaves resolve by the same dotted ``state.*`` names GL501's ledger
+    and GL502's auditor use, each spec truncates to its leaf's rank,
+    and the rule list's catch-all guarantees every leaf a layout —
+    this is the placement side of the proof ``run_sweep`` consults
+    before calling it."""
+    from .specs import spec_for
+
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(
+        {"state": state}
+    )
+    shardings = []
+    for path, leaf in leaves:
+        parts = []
+        for p in path:
+            if hasattr(p, "key"):
+                parts.append(str(p.key))
+            elif hasattr(p, "idx"):
+                parts.append(str(p.idx))
+            else:  # pragma: no cover — future key types
+                parts.append(str(p))
+        spec = spec_for(".".join(parts), rules)
+        shape = np.shape(leaf)
+        entries = []
+        for i, part in enumerate(tuple(spec)[: len(shape)]):
+            if part is not None and shape[i] % int(mesh.shape[part]):
+                # GSPMD wants even input shards on the pinned jax: an
+                # axis the mesh-axis size does not divide (n=3 planes
+                # on a 2-way state axis) falls back to replicated on
+                # that axis — a PLACEMENT downgrade only, never a
+                # correctness one (results are layout-independent and
+                # the proof already admitted the layout)
+                part = None
+            entries.append(part)
+        shardings.append(NamedSharding(mesh, PartitionSpec(*entries)))
+    return jax.tree_util.tree_unflatten(treedef, shardings)["state"]
 
 
 @functools.lru_cache(maxsize=None)

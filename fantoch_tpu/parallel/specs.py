@@ -1,0 +1,170 @@
+"""Declared partition rules for the 2-D (lanes x state) mesh.
+
+ROADMAP item 3's model parallelism needs every batched state plane to
+carry an explicit layout: which mesh axis (if any) each array
+dimension shards over. This module is the single place those layouts
+are *declared* — as per-protocol ordered ``(regex, PartitionSpec)``
+rule lists over the dotted plane names GL501's ledger uses
+(``state.ps.clock``, ``ctx.delay_pp``, ...) — and the GL502 auditor
+(:mod:`fantoch_tpu.lint.shard`) is the place they are *proven*: a
+rule that shards an axis whose GL501 verdict is not SHARDABLE or
+COLLECTIVE fails CI by name, and ``run_sweep(state_shards > 1)``
+refuses to compile it (``StateShardingError``). Declaration without
+proof is exactly the guessing the ROADMAP forbids.
+
+Rule-list contract (the ``match_partition_rules`` idiom): first match
+wins, every list ends with a catch-all ``(r"", P(LANES_AXIS))`` so no
+plane is ever unmatched; spec position 0 is always the vmapped lane
+axis (``lanes`` or None, never ``state``); positions >= 1 name plane
+axes 0, 1, ... of the *unbatched* leaf.
+"""
+
+from __future__ import annotations
+
+import re
+
+from jax.sharding import PartitionSpec
+
+#: mesh axis names — the 2-D mesh is ``Mesh(devices.reshape(L, S),
+#: (LANES_AXIS, STATE_AXIS))``; the 1-D lane mesh keeps its axis name
+LANES_AXIS = "lanes"
+STATE_AXIS = "state"
+
+
+def _p(*parts) -> PartitionSpec:
+    return PartitionSpec(*parts)
+
+
+# ----------------------------------------------------------------------
+# declared layouts
+# ----------------------------------------------------------------------
+
+#: The N-sharded layout shared by every protocol: per-process planes
+#: (``state.ps.*``) split their process axis over ``state`` — GL501
+#: proves each listed plane's N axis mixes only inside the declared
+#: emission/routing choke points — while client planes, pool rows and
+#: the execution spine stay lane-sharded only (their leading axes are
+#: C/M/D, whose handlers reduce across them in open code, or they feed
+#: the global min-spine). Planes proven REPLICATED on N
+#: (``next_periodic``/``reach``-style min-reduced scalars) must NOT
+#: appear above the catch-all with a ``state`` entry: GL502 enforces
+#: that, per protocol, from the checked-in ledger.
+def _n_sharded_rules(*extra):
+    return [
+        *extra,
+        (r"^state\.ps\.", _p(LANES_AXIS, STATE_AXIS)),
+        (r"", _p(LANES_AXIS)),
+    ]
+
+
+#: protocol -> ordered (regex, PartitionSpec) list. Partial twins
+#: (``tempo@2shards``) resolve through :func:`rules_for` to their base
+#: protocol's list — the plane trees are supersets with the same
+#: ``state.ps.*`` shape contract.
+RULES = {
+    "basic": _n_sharded_rules(),
+    "fpaxos": _n_sharded_rules(),
+    "tempo": _n_sharded_rules(),
+    "atlas": _n_sharded_rules(),
+    "epaxos": _n_sharded_rules(),
+    "caesar": _n_sharded_rules(),
+}
+
+#: Candidate meshes for the GL503 per-shard footprint gate:
+#: ``{"lanes": L, "state": S, "budget_mib": B}``. L*S = 8 matches the
+#: CPU fleet the sharded pins run on. Each budget is the measured
+#: per-shard fused-group peak at the GL501 audit shape plus ~25%
+#: headroom — a *regression pin* on the shard-divided footprint, not
+#: a literal VMEM capacity (the audit shape is far smaller than a
+#: planet; docs/LINT.md#gl503 spells out the streaming-vs-resident
+#: caveat). Partial twins are audited at their own (larger) shapes,
+#: hence the explicit ``@2shards`` entries.
+CANDIDATES = {
+    "basic": {"lanes": 4, "state": 2, "budget_mib": 16.0},
+    "fpaxos": {"lanes": 4, "state": 2, "budget_mib": 16.0},
+    "tempo": {"lanes": 4, "state": 2, "budget_mib": 208.0},
+    "atlas": {"lanes": 4, "state": 2, "budget_mib": 32.0},
+    "epaxos": {"lanes": 4, "state": 2, "budget_mib": 32.0},
+    "caesar": {"lanes": 4, "state": 2, "budget_mib": 768.0},
+    "tempo@2shards": {"lanes": 4, "state": 2, "budget_mib": 896.0},
+    "atlas@2shards": {"lanes": 4, "state": 2, "budget_mib": 1280.0},
+}
+
+
+def _base_name(audit: str) -> str:
+    return audit.split("@", 1)[0]
+
+
+def protocol_name(protocol) -> str:
+    """Registry name of a device protocol instance or class
+    (``TempoDev`` -> ``tempo``, ``AtlasPartialDev`` -> ``atlas``) —
+    how ``run_sweep`` resolves a protocol object to its declared rule
+    list. The naming convention is pinned by the registry test, so a
+    rename cannot silently detach a protocol from its layout."""
+    cls = protocol if isinstance(protocol, type) else type(protocol)
+    low = cls.__name__.lower()
+    for suffix in ("partialdev", "dev"):
+        if low.endswith(suffix):
+            return low[: -len(suffix)]
+    return low
+
+
+def rules_for(audit: str, rules=None):
+    """The rule list for an audit name (``tempo``, ``tempo@2shards``),
+    partial twins falling back to their base protocol. No declared
+    list means the conservative lane-only catch-all."""
+    rules = RULES if rules is None else rules
+    if audit in rules:
+        return rules[audit]
+    base = _base_name(audit)
+    if base in rules:
+        return rules[base]
+    return [(r"", _p(LANES_AXIS))]
+
+
+def candidate_for(audit: str, candidates=None):
+    """The GL503 candidate mesh for an audit, or None (no footprint
+    gate declared)."""
+    candidates = CANDIDATES if candidates is None else candidates
+    return candidates.get(audit, candidates.get(_base_name(audit)))
+
+
+def spec_for(name: str, rules) -> PartitionSpec:
+    """First-match-wins spec lookup for one dotted plane name."""
+    for pat, spec in rules:
+        if re.search(pat, name):
+            return spec
+    return _p(LANES_AXIS)
+
+
+def match_partition_rules(rules, tree):
+    """Map an ordered ``(regex, PartitionSpec)`` rule list over a
+    pytree of *batched* leaves, keyed by dotted path — the SNIPPETS
+    ``match_partition_rules`` idiom. Returns a pytree of
+    PartitionSpecs with the same structure, each spec truncated to its
+    leaf's rank (a rank-1 leaf under ``P("lanes", "state")`` is just
+    ``P("lanes")`` — the state entry names a plane axis the leaf does
+    not have only when the regex was too broad, and GL502's
+    no-verdict check catches that statically)."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    specs = []
+    for path, leaf in leaves:
+        name = _dotted(path)
+        spec = spec_for(name, rules)
+        rank = len(getattr(leaf, "shape", ()))
+        specs.append(PartitionSpec(*tuple(spec)[:rank]))
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def _dotted(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:  # pragma: no cover — future key types
+            parts.append(str(p))
+    return ".".join(parts)

@@ -204,6 +204,22 @@ class LaneMixingError(RuntimeError):
         )
 
 
+class StateShardingError(RuntimeError):
+    """The state-shardability proof (GL501 axis ledger + GL502 rule
+    audit, lint/shard.py) failed: the declared partition layout
+    (parallel/specs.py) shards an axis the prover cannot show
+    SHARDABLE or COLLECTIVE for this exact step, so compiling it
+    could silently change results. Carries the findings."""
+
+    def __init__(self, findings):
+        self.findings = list(findings)
+        lines = "\n".join(f.render() for f in self.findings[:8])
+        super().__init__(
+            f"declared state layout is unproven for this step "
+            f"({len(self.findings)} finding(s)):\n{lines}"
+        )
+
+
 # one GL203 proof per compiled-runner key extended with the per-lane
 # (state, ctx) structure signature — lane mixing is a property of the
 # traced graph, not of lane values, but the graph itself varies with
@@ -212,6 +228,12 @@ class LaneMixingError(RuntimeError):
 # the signature keeps a proof from covering a graph it never saw; a
 # sweep loop pays the ~5 s trace + taint once per variant per process
 _LANE_PROOFS: dict = {}
+
+# one GL501+GL502 proof per runner key (the _LANE_PROOFS signature
+# contract) extended with the declared rule list's identity: the proof
+# covers (exact traced graph, exact layout declaration), so swapping
+# either re-proves instead of reusing a verdict it never earned
+_STATE_PROOFS: dict = {}
 
 
 def _tree_sig(tree) -> tuple:
@@ -243,6 +265,31 @@ def _prove_lane_independent(protocol, dims: EngineDims, reorder: bool,
             )
         )
     return _LANE_PROOFS[key]
+
+
+def _rules_sig(rules) -> tuple:
+    """Hashable identity of a partition-rule list (regex strings +
+    spec entries) for the _STATE_PROOFS key."""
+    return tuple((pat, tuple(spec)) for pat, spec in rules)
+
+
+def _prove_state_shardable(protocol, dims: EngineDims, reorder: bool,
+                           faults, monitor_keys: int, state, ctx,
+                           rules) -> tuple:
+    key = (
+        protocol, dims, reorder, faults, monitor_keys,
+        _tree_sig(state), _tree_sig(ctx), _rules_sig(rules),
+    )
+    if key not in _STATE_PROOFS:
+        from ..lint.shard import prove_step_state_shardable
+
+        _STATE_PROOFS[key] = tuple(
+            prove_step_state_shardable(
+                protocol, dims, state, ctx, rules, faults=faults,
+                monitor_keys=monitor_keys, reorder=reorder,
+            )
+        )
+    return _STATE_PROOFS[key]
 
 
 @functools.lru_cache(maxsize=None)
@@ -282,6 +329,7 @@ def run_sweep(
     monitor_keys: int = 0,
     shard_lanes: "bool | None" = None,
     mesh_shard: bool = False,
+    state_shards: int = 1,
     checkpoint: "CheckpointSpec | str | None" = None,
     pipeline_depth: int = 2,
     narrow: bool = True,
@@ -348,6 +396,24 @@ def run_sweep(
     meta key — checkpoints interchange across layouts). Incompatible
     with an explicit ``mesh`` argument and with ``shard_lanes=False``.
 
+    ``state_shards > 1`` (requires ``mesh_shard=True``) folds the
+    fleet into the 2-D ``(lanes x state)`` mesh
+    (parallel/partition.py :func:`~fantoch_tpu.parallel.partition
+    .fleet_mesh_2d`) and additionally splits the *state* axes the
+    protocol's declared layout (parallel/specs.py ``RULES``) names —
+    today the per-process ``state.ps.*`` planes' N axis. Before
+    compiling anything it consults the shardability proof (GL501 axis
+    ledger + GL502 rule audit over the EXACT per-lane trace, cached
+    like the lane proof per (runner key, rule list)) and raises
+    :class:`StateShardingError` if the declared layout shards any
+    axis the prover cannot show SHARDABLE or COLLECTIVE — an unproven
+    layout is never compiled. Execution rides GSPMD: the proven
+    per-leaf ``NamedSharding`` placements land on the inputs and the
+    jit runner propagates them (the explicit shard_map port of the
+    2-D layout is ROADMAP item 3's remaining work), so results stay
+    bit-identical to the reference (pinned) while the dominant
+    per-process planes occupy 1/S of each device.
+
     ``scan_window`` fuses that many consecutive segments into ONE
     device call — a ``lax.scan`` over the segment body
     (engine/core.py ``build_window_runner``), liveness carried through
@@ -409,8 +475,8 @@ def run_sweep(
     try:
         return _run_sweep(
             protocol, dims, specs, mesh, max_steps, segment_steps,
-            monitor_keys, shard_lanes, mesh_shard, checkpoint,
-            pipeline_depth, narrow, scan_window, aot, mark,
+            monitor_keys, shard_lanes, mesh_shard, state_shards,
+            checkpoint, pipeline_depth, narrow, scan_window, aot, mark,
         )
     finally:
         # the per-phase timings land on EVERY exit path — an early
@@ -427,8 +493,8 @@ def run_sweep(
 
 def _run_sweep(
     protocol, dims, specs, mesh, max_steps, segment_steps, monitor_keys,
-    shard_lanes, mesh_shard, checkpoint, pipeline_depth, narrow,
-    scan_window, aot, mark,
+    shard_lanes, mesh_shard, state_shards, checkpoint, pipeline_depth,
+    narrow, scan_window, aot, mark,
 ) -> List[LaneResults]:
     from . import aot as aot_mod
     from . import partition
@@ -457,6 +523,15 @@ def _run_sweep(
     # identical to the segment loop)
     windowed = win > 1 or aot_spec is not None
 
+    state_shards = int(state_shards)
+    if state_shards < 1:
+        raise ValueError(f"state_shards={state_shards} must be >= 1")
+    if state_shards > 1 and not mesh_shard:
+        raise ValueError(
+            "state_shards > 1 is the 2-D (lanes x state) layout; it "
+            "requires mesh_shard=True (the explicitly partitioned "
+            "path) — the implicit/unsharded paths have no state axis"
+        )
     if mesh_shard:
         if shard_lanes is False:
             raise ValueError(
@@ -469,13 +544,23 @@ def _run_sweep(
                 "mesh_shard=True builds its own named all-device mesh "
                 "(parallel/partition.py); drop the explicit mesh"
             )
-        mesh = partition.fleet_mesh()
+        mesh = (
+            partition.fleet_mesh_2d(state_shards)
+            if state_shards > 1
+            else partition.fleet_mesh()
+        )
     elif mesh is None:
         devices = jax.devices()
         if shard_lanes is False:
             devices = devices[:1]
         mesh = Mesh(np.asarray(devices), ("sweep",))
-    shards = mesh.devices.size
+    # lanes pad to the LANE axis of the mesh — on the 2-D mesh the
+    # state axis multiplies devices, not lanes
+    shards = (
+        int(mesh.shape[partition.MESH_AXIS])
+        if state_shards > 1
+        else mesh.devices.size
+    )
     pad = (-len(specs)) % shards
     padded = list(specs) + [specs[-1]] * pad
 
@@ -537,6 +622,27 @@ def _run_sweep(
         if findings:
             raise LaneMixingError(findings)
         mark("lane_proof")
+
+    state_rules = None
+    if state_shards > 1:
+        # the 2-D layout's second gate: GL501 axis ledger over THIS
+        # exact step + GL502 audit of the protocol's declared rules
+        # (lint/shard.py), cached per (runner key, rule list) like
+        # the lane proof — an unproven layout raises instead of
+        # compiling
+        from . import specs as specs_mod
+
+        state_rules = specs_mod.rules_for(
+            specs_mod.protocol_name(protocol)
+        )
+        ctx0 = {k: np.asarray(v)[0] for k, v in ctx.items()}
+        sfindings = _prove_state_shardable(
+            protocol, dims, reorder_flag, fault_flags, monitor_keys,
+            states[0], ctx0, state_rules,
+        )
+        if sfindings:
+            raise StateShardingError(sfindings)
+        mark("state_proof")
 
     ck = None
     sig = None
@@ -670,12 +776,23 @@ def _run_sweep(
             mark("checkpoint_load")
 
     if mesh_shard:
+        # on the 2-D mesh lane_sharding still reads P("lanes"): ctx
+        # planes shard over lanes and replicate over the state axis
         sharding = partition.lane_sharding(mesh)
     else:
         sharding = NamedSharding(mesh, PartitionSpec("sweep"))
     put = lambda tree: jax.tree_util.tree_map(
         lambda a: jax.device_put(a, sharding), tree
     )
+    if state_shards > 1:
+        # per-leaf placements from the proven rules: state.ps.* planes
+        # land (lanes, state)-split, everything else lane-split
+        per_leaf = partition.state_shardings(mesh, state, state_rules)
+        put_state = lambda tree: jax.tree_util.tree_map(
+            jax.device_put, tree, per_leaf
+        )
+    else:
+        put_state = put
     # buffer donation engages whenever the process is donation-safe
     # (cache-free — engine/core.py donation_safe; FANTOCH_SWEEP_DONATE
     # overrides): segments then update the lane state in place instead
@@ -687,7 +804,18 @@ def _run_sweep(
         # executable reads freed buffers); the AOT path trades the
         # in-place update for the zero-trace start until the pin moves
         donate = False
-    if mesh_shard:
+    if mesh_shard and state_shards > 1:
+        # the 2-D layout's vehicle is GSPMD: the proven per-leaf
+        # shardings ride in on the inputs and jit propagates them
+        # through the (psum-free) batched runner — the explicit
+        # shard_map port of the 2-D layout is ROADMAP item 3's
+        # remaining work. Same runner cache as the implicit path:
+        # jit re-lowers per input sharding on its own.
+        runner, _alive = _cached_runner(
+            protocol, dims, max_steps, reorder_flag,
+            fault_flags, monitor_keys, nspec, donate, windowed,
+        )
+    elif mesh_shard:
         runner, _pmesh = partition.build_partitioned_runner(
             protocol, dims, max_steps, reorder_flag, fault_flags,
             monitor_keys, narrow=nspec, donate=donate,
@@ -698,7 +826,7 @@ def _run_sweep(
             protocol, dims, max_steps, reorder_flag,
             fault_flags, monitor_keys, nspec, donate, windowed,
         )
-    state = put(state)
+    state = put_state(state)
     ctx = put(ctx)
     mark("device_put")
     if aot_spec is not None:

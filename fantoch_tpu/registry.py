@@ -58,6 +58,13 @@ TRACED_SCAN_PATHS = (
     "fantoch_tpu/fleet",
     "fantoch_tpu/mc/coverage.py",
     "fantoch_tpu/mc/covmap.py",
+    # the shardability prover replays batched jaxprs and seeds taints —
+    # the one lint module that manipulates traced graphs directly, so
+    # it submits to the traced-discipline scan (the rest of lint/ stays
+    # excluded: the analyzers necessarily mention the patterns they
+    # detect; shard.py's taint rules live in data tables, not code
+    # that would trip them)
+    "fantoch_tpu/lint/shard.py",
 )
 
 # the host orchestration layers whose device<->host traffic the GL301
@@ -82,16 +89,24 @@ TRANSFER_SCAN_PATHS = (
 # subcommands write repro artifacts and result files directly; the
 # lint package itself is excluded for the same reason it is excluded
 # from the GL1xx scan — the analyzers necessarily mention the very
-# patterns they detect.
+# patterns they detect. lint/shard.py is the one exception: its
+# ``write_shard_baseline`` emits a checked-in artifact
+# (lint/shard_baseline.json), so its serialization must go through
+# the same canonical_json/atomic_write choke points the scan proves
+# for every other artifact writer (its taint *rules* are data tables,
+# not code that mentions the GL4xx patterns).
 DETERMINISM_SCAN_PATHS = (
     "fantoch_tpu/campaign",
     "fantoch_tpu/fleet",
     "fantoch_tpu/mc",
+    # covers parallel/specs.py too: the declared partition-rule lists
+    # feed the checked-in shard baseline and the sweep's layout proofs
     "fantoch_tpu/parallel",
     "fantoch_tpu/bote",
     "fantoch_tpu/serving",
     "fantoch_tpu/engine/checkpoint.py",
     "fantoch_tpu/cli.py",
+    "fantoch_tpu/lint/shard.py",
 )
 
 # fleet worker ids (fantoch_tpu/fleet, docs/FLEET.md) become lease and

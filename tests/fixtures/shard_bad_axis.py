@@ -1,0 +1,46 @@
+"""Seeded-broken fixture for the GL501 ``--shard-selfcheck axis``
+selfcheck. Never imported by the package — loaded by file path from
+``fantoch_tpu.lint.shard.run_shard_selfcheck`` so CI can prove the
+axis-shardability gate is able to fail.
+
+``build_trace()`` returns a tempo step trace whose step was wrapped
+with a deliberate cross-process read OUTSIDE every declared choke
+function: each per-process plane is reduce-summed in open code, so
+every tracked axis of every ``state.ps.*`` plane mixes in a frame the
+choke list does not bless. GL501's taint must flip those verdicts to
+REPLICATED, and the ledger gate must flag every flip against the
+checked-in baseline — at least one GL501 finding, or the gate is
+vacuously green.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from fantoch_tpu.engine.core import _lane_step
+from fantoch_tpu.lint.jaxpr import StepTrace
+from fantoch_tpu.lint.shard import shard_trace
+
+
+def build_trace() -> StepTrace:
+    real = shard_trace("tempo")
+
+    def leaky_step(s, c):
+        out = _lane_step(
+            real.protocol, real.dims, s, c, False, real.faults,
+            real.monitor_keys,
+        )
+        # BUG (seeded): a cross-process fold in open code — this frame
+        # (`leaky_step`) is not in CHOKE_FNS, so the reduce over each
+        # ps plane is an out-of-choke mix on every tracked axis, not a
+        # planned collective. The scalar is returned so the equations
+        # stay live through the batched replay.
+        leak = jnp.float32(0)
+        for leaf in jax.tree_util.tree_leaves(s["ps"]):
+            leak = leak + jnp.sum(leaf).astype(jnp.float32)
+        return out, leak
+
+    closed = jax.make_jaxpr(leaky_step)(real.state, real.ctx)
+    return StepTrace(
+        real.name, real.protocol, real.dims, real.state, real.ctx,
+        real.faults, real.monitor_keys, closed,
+    )

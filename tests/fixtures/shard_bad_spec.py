@@ -1,0 +1,28 @@
+"""Seeded-broken fixture for the GL502 ``--shard-selfcheck spec``
+selfcheck. Never imported by the package — loaded by file path from
+``fantoch_tpu.lint.shard.run_shard_selfcheck`` so CI can prove the
+partition-rule auditor is able to fail.
+
+``RULES`` declares a tempo layout that shards the first state axis of
+EVERY plane — including the planes GL501's checked-in ledger proves
+REPLICATED (min-reduced spines, ``next_periodic``-style scalars) —
+plus a dead rule whose regex matches no plane. The auditor must
+refuse both by name: at least one GL502 finding, or the gate is
+vacuously green.
+"""
+
+from jax.sharding import PartitionSpec as P
+
+from fantoch_tpu.parallel.specs import LANES_AXIS, STATE_AXIS
+
+RULES = {
+    "tempo": [
+        # BUG (seeded): dead rule — no tempo plane is named this, so
+        # this layout silently never applies
+        (r"^state\.nonexistent_plane\.", P(LANES_AXIS, STATE_AXIS)),
+        # BUG (seeded): catch-all that shards plane axis 0 of every
+        # plane; GL501 proves many of those axes REPLICATED, and a
+        # REPLICATED axis behind a `state` entry would change results
+        (r"", P(LANES_AXIS, STATE_AXIS)),
+    ],
+}

@@ -1,0 +1,17 @@
+"""Seeded-broken fixture for the GL503 ``--shard-selfcheck vmem``
+selfcheck. Never imported by the package — loaded by file path from
+``fantoch_tpu.lint.shard.run_shard_selfcheck`` so CI can prove the
+per-shard footprint gate is able to fail.
+
+``CANDIDATES`` declares a tempo mesh whose per-shard budget cannot
+hold even one fused group of the shard-divided step (the measured
+peak at the audit shape is ~164 MiB): the footprint check must reject
+the layout by name — at least one GL503 finding, or the gate is
+vacuously green.
+"""
+
+# BUG (seeded): a quarter-MiB budget on a step whose largest
+# shard-divided fused group measures ~164 MiB at the audit shape
+CANDIDATES = {
+    "tempo": {"lanes": 4, "state": 2, "budget_mib": 0.25},
+}

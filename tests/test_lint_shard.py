@@ -1,0 +1,604 @@
+"""The GL501-GL503 shardability family (lint/shard.py +
+parallel/specs.py + the run_sweep state-proof consult): taint-rule
+units over synthetic jaxprs, the ledger gate's refusal semantics, the
+clean-at-HEAD pins against the checked-in ``lint/shard_baseline.json``,
+the GL502 partition-rule auditor, ``StateShardingError`` wiring, and
+the empirical pin the whole family exists for — a GL502-proven
+PartitionSpec for tempo's N axis driving a ``shard_map`` run
+bit-identical to the single-device reference on the 8-device CPU
+mesh."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from fantoch_tpu.lint.report import Finding
+from fantoch_tpu.lint.shard import (
+    CHOKE_FNS,
+    COLLECTIVE,
+    DEFAULT_SHARD_BASELINE,
+    REPLICATED,
+    SHARDABLE,
+    _make_axis_taint,
+    _verdict,
+    audit_partition_rules,
+    gate_shard_ledger,
+    load_shard_baseline,
+    run_shard,
+    run_shard_selfcheck,
+    shard_axis_ledger_summary,
+)
+from fantoch_tpu.registry import DEV_PROTOCOLS, PARTIAL_DEV_PROTOCOLS
+
+ALL_AUDITS = tuple(DEV_PROTOCOLS) + tuple(
+    f"{n}@2shards" for n in PARTIAL_DEV_PROTOCOLS
+)
+
+
+# ----------------------------------------------------------------------
+# GL501 taint-rule units (synthetic jaxprs)
+# ----------------------------------------------------------------------
+
+
+def _taint_events(fn, args, axis, axis_size):
+    """Run one AxisTaint pass over ``fn``'s jaxpr with the taint
+    seeded on ``axis`` of the first argument."""
+    import jax
+
+    from fantoch_tpu.lint.jaxpr import flatten_jaxpr
+
+    closed = jax.make_jaxpr(fn)(*args)
+    flat, invars, _outvars = flatten_jaxpr(closed)
+    AxisTaint = _make_axis_taint()
+    ana = AxisTaint(flat, "unit", axis_size, CHOKE_FNS)
+    ana.env[invars[0]] = axis
+    ana.run()
+    return ana.events
+
+
+def test_cross_axis_reduce_is_replicated():
+    import jax.numpy as jnp
+
+    x = np.zeros((4, 3), np.float32)
+    events = _taint_events(lambda x: jnp.sum(x, axis=0), (x,), 0, 4)
+    verdict, reason = _verdict(events)
+    assert verdict == REPLICATED
+    assert "reduce_sum" in reason
+
+
+def test_cross_axis_gather_is_replicated():
+    import jax.numpy as jnp
+
+    x = np.zeros((4, 3), np.float32)
+    idx = np.array([1, 0, 3, 2], np.int32)
+    events = _taint_events(
+        lambda x: jnp.take(x, jnp.asarray(idx), axis=0), (x,), 0, 4
+    )
+    verdict, _reason = _verdict(events)
+    assert verdict == REPLICATED
+
+
+def test_choke_point_mixing_is_collective():
+    import jax.numpy as jnp
+
+    # the frame NAME is the trust boundary: the same reduce inside a
+    # declared choke function classifies COLLECTIVE, not REPLICATED
+    def frontier_min(x):
+        return jnp.min(x, axis=0)
+
+    assert "frontier_min" in CHOKE_FNS
+    x = np.zeros((4, 3), np.float32)
+    events = _taint_events(
+        lambda x: frontier_min(x * 2) + 1.0, (x,), 0, 4
+    )
+    verdict, reason = _verdict(events)
+    assert verdict == COLLECTIVE
+    assert "frontier_min" in reason
+    # and post-choke values are re-replicated: no later event fired
+    assert all(kind == "collective" for kind, _e, _w in events)
+
+
+def test_elementwise_and_off_axis_scan_are_shardable():
+    import jax
+    import jax.numpy as jnp
+
+    x = np.zeros((4, 3), np.float32)
+    verdict, _ = _verdict(
+        _taint_events(lambda x: x * 2.0 + 1.0, (x,), 0, 4)
+    )
+    assert verdict == SHARDABLE
+
+    # a scan over the OTHER axis slices only untainted positions; the
+    # carry stays per-position along the tainted axis
+    def scanned(x):
+        def body(c, row):
+            return c + row, row * 2.0
+
+        return jax.lax.scan(body, jnp.zeros_like(x[:, 0]), x.T)
+
+    verdict, _ = _verdict(_taint_events(scanned, (x,), 0, 4))
+    assert verdict == SHARDABLE
+
+
+# ----------------------------------------------------------------------
+# GL501 ledger gate units
+# ----------------------------------------------------------------------
+
+_ENT = {"verdict": SHARDABLE, "reason": "synthetic evidence"}
+
+
+def test_gate_missing_audit_ledger_is_a_finding():
+    findings, stale = gate_shard_ledger("tempo", {"p:0:N": _ENT}, {})
+    assert len(findings) == 1 and findings[0].rule == "GL501"
+    assert "no axis ledger" in findings[0].message
+    assert stale == []
+
+
+def test_gate_new_pair_and_verdict_change_fail():
+    base = {"ledgers": {"tempo": {"p:0:N": dict(_ENT)}}}
+    findings, _ = gate_shard_ledger(
+        "tempo", {"p:0:N": dict(_ENT), "q:0:N": dict(_ENT)}, base
+    )
+    assert len(findings) == 1 and "NEW axis pair" in findings[0].message
+
+    # a change in EITHER direction fails — upgrades are regenerated
+    # deliberately, never absorbed
+    up = {"p:0:N": {"verdict": REPLICATED, "reason": "x"}}
+    findings, _ = gate_shard_ledger("tempo", up, base)
+    assert len(findings) == 1 and "verdict changed" in findings[0].message
+    base2 = {
+        "ledgers": {"tempo": {"p:0:N": {"verdict": REPLICATED,
+                                        "reason": "x"}}}
+    }
+    findings, _ = gate_shard_ledger("tempo", {"p:0:N": dict(_ENT)}, base2)
+    assert len(findings) == 1 and "verdict changed" in findings[0].message
+
+
+def test_gate_reasonless_entry_fails_and_stale_is_advisory():
+    base = {
+        "ledgers": {
+            "tempo": {
+                "p:0:N": {"verdict": SHARDABLE, "reason": ""},
+                "gone:0:N": dict(_ENT),
+            }
+        }
+    }
+    findings, stale = gate_shard_ledger(
+        "tempo", {"p:0:N": dict(_ENT)}, base
+    )
+    assert len(findings) == 1 and "no evidence reason" in findings[0].message
+    assert stale == ["gone:0:N"]
+
+    # UNREVIEWED placeholders (a thoughtless regen) also fail
+    base["ledgers"]["tempo"]["p:0:N"]["reason"] = "UNREVIEWED todo"
+    findings, _ = gate_shard_ledger("tempo", {"p:0:N": dict(_ENT)}, base)
+    assert any("no evidence reason" in f.message for f in findings)
+
+
+# ----------------------------------------------------------------------
+# GL502 partition-rule auditor units
+# ----------------------------------------------------------------------
+
+
+def _p(*parts):
+    from jax.sharding import PartitionSpec
+
+    return PartitionSpec(*parts)
+
+
+_SYN_ENTRIES = {
+    "state.ps.clock:0:N": {"verdict": COLLECTIVE, "reason": "r"},
+    "state.spine:0:N": {"verdict": REPLICATED, "reason": "r"},
+}
+
+
+def test_rule_sharding_replicated_axis_refused():
+    findings = audit_partition_rules(
+        "tempo",
+        _SYN_ENTRIES,
+        [(r"", _p("lanes", "state"))],
+    )
+    assert [f.rule for f in findings] == ["GL502"]
+    assert "REPLICATED" in findings[0].message
+    assert "state.spine" in findings[0].id
+
+
+def test_rule_sharding_unverdicted_axis_refused():
+    findings = audit_partition_rules(
+        "tempo",
+        _SYN_ENTRIES,
+        [(r"", _p("lanes", None, "state"))],
+        planes=["state.ps.clock", "state.spine", "ctx.scalar"],
+    )
+    # no plane has a verdict at axis 1, and ctx.scalar has none at all
+    assert findings and all(f.rule == "GL502" for f in findings)
+    assert any("NO GL501 verdict" in f.message for f in findings)
+
+
+def test_dead_rule_and_bad_mesh_axes_refused():
+    findings = audit_partition_rules(
+        "tempo",
+        _SYN_ENTRIES,
+        [
+            (r"^state\.nope\.", _p("lanes", "state")),
+            (r"^state\.ps\.", _p("state")),
+            (r"", _p("lanes", "model")),
+        ],
+    )
+    rules_hit = sorted(f.message.split("—")[0] for f in findings)
+    assert any("dead partition rule" in m for m in rules_hit)
+    assert any("leading dimension" in f.message for f in findings)
+    assert any("unsupported mesh axis" in f.message for f in findings)
+    assert all(f.rule == "GL502" for f in findings)
+
+
+def test_unmatched_plane_refused():
+    findings = audit_partition_rules(
+        "tempo",
+        _SYN_ENTRIES,
+        [(r"^state\.ps\.", _p("lanes", "state"))],
+    )
+    assert any("no partition rule matches" in f.message for f in findings)
+
+
+# ----------------------------------------------------------------------
+# clean-at-HEAD pins
+# ----------------------------------------------------------------------
+
+
+def test_shard_baseline_is_checked_in_and_reviewed():
+    assert os.path.exists(DEFAULT_SHARD_BASELINE)
+    base = load_shard_baseline()
+    assert sorted(base["ledgers"]) == sorted(ALL_AUDITS)
+    for audit, led in base["ledgers"].items():
+        assert led, f"empty ledger for {audit}"
+        for key, ent in led.items():
+            assert ent["verdict"] in (
+                SHARDABLE, COLLECTIVE, REPLICATED,
+            ), (audit, key)
+            reason = str(ent.get("reason", ""))
+            assert reason.strip(), (audit, key)
+            assert not reason.startswith("UNREVIEWED"), (audit, key)
+
+
+def test_shard_axis_ledger_summary_is_jax_free():
+    import subprocess
+    import sys
+
+    # the bench.py metric must stay importable and computable without
+    # jax ever loading — proven in a subprocess, not by sys.modules
+    # luck in this process
+    code = (
+        "import sys\n"
+        "from fantoch_tpu.lint.shard import shard_axis_ledger_summary\n"
+        "s = shard_axis_ledger_summary()\n"
+        "assert 'jax' not in sys.modules, 'jax leaked'\n"
+        "import json; print(json.dumps(s))\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stderr
+    s = json.loads(out.stdout)
+    assert sorted(s["audits"]) == sorted(ALL_AUDITS)
+    for counts in s["audits"].values():
+        assert counts["axes"] == (
+            counts[SHARDABLE] + counts[COLLECTIVE] + counts[REPLICATED]
+        )
+
+
+def test_basic_axis_ledger_clean_at_head():
+    """The fast in-tier pin: basic re-proves against the checked-in
+    ledger with zero degradations (the full 8-audit pin is the slow
+    twin below + the CI shard-gate job)."""
+    findings, summary = run_shard(["basic"], include_partial=False)
+    assert findings == [], [f.render() for f in findings]
+    a = summary["audits"]["basic"]
+    assert a["degradations"] == 0 and a["gl502_findings"] == 0
+
+
+@pytest.mark.slow
+def test_all_audits_clean_at_head():
+    findings, summary = run_shard()
+    assert findings == [], [f.render() for f in findings]
+    assert sorted(summary["audits"]) == sorted(ALL_AUDITS)
+    base = load_shard_baseline()
+    for audit, a in summary["audits"].items():
+        assert a["degradations"] == 0, audit
+        assert a["stale_baseline"] == [], audit
+        assert a["axes"] == len(base["ledgers"][audit]), audit
+        if "footprint" in a:
+            fp = a["footprint"]
+            assert fp["peak_shard_mib"] <= fp["budget_mib"], audit
+
+
+# ----------------------------------------------------------------------
+# baseline cross-pollination guard (report.py write_baseline)
+# ----------------------------------------------------------------------
+
+
+def test_write_baseline_refuses_gl5xx_absorption(tmp_path):
+    from fantoch_tpu.lint.report import (
+        LintReport, load_baseline, write_baseline,
+    )
+
+    report = LintReport()
+    report.extend([
+        Finding("GL001", "tempo", "a.py:f:add", "keep"),
+        Finding("GL501", "tempo", "state.ps.clock:0:N", "drop"),
+        Finding("GL502", "tempo", "specs:state.spine:1", "drop"),
+        Finding("GL503", "tempo", "core.py:step:group", "drop"),
+    ])
+    path = str(tmp_path / "baseline.json")
+    write_baseline(path, report)
+    assert set(load_baseline(path)) == {"GL001:tempo:a.py:f:add"}
+
+
+# ----------------------------------------------------------------------
+# run_sweep wiring: StateShardingError + proof caching + bit-identity
+# ----------------------------------------------------------------------
+
+
+COMMANDS = 2
+
+
+def _sweep_specs(name, n, lanes=4, conflicts=(0, 100)):
+    from fantoch_tpu.core import Config, Planet
+    from fantoch_tpu.engine import EngineDims
+    from fantoch_tpu.engine.protocols import (
+        dev_config_kwargs,
+        dev_protocol,
+    )
+    from fantoch_tpu.parallel.sweep import make_sweep_specs
+
+    planet = Planet.new()
+    regions = planet.regions()
+    clients = n  # clients_per_region=1 over n-region sets
+    dev = dev_protocol(name, clients)
+    total = COMMANDS * clients
+    dims = EngineDims.for_protocol(
+        dev, n=n, clients=clients, payload=dev.payload_width(n),
+        total_commands=total, dot_slots=total + 1, regions=n,
+    )
+    specs = make_sweep_specs(
+        dev,
+        planet,
+        region_sets=[
+            regions[i : i + n] for i in range(lanes // len(conflicts))
+        ],
+        fs=[1],
+        conflicts=list(conflicts),
+        commands_per_client=COMMANDS,
+        clients_per_region=1,
+        dims=dims,
+        config_base=Config(**dev_config_kwargs(name, n, 1)),
+    )
+    return dev, dims, specs
+
+
+def _assert_results_equal(xs, ys):
+    assert len(xs) == len(ys)
+    for a, b in zip(xs, ys):
+        assert a.err == b.err
+        assert a.completed == b.completed
+        assert a.steps == b.steps
+        np.testing.assert_array_equal(np.asarray(a.hist), np.asarray(b.hist))
+        for key in a.protocol_metrics:
+            np.testing.assert_array_equal(
+                np.asarray(a.protocol_metrics[key]),
+                np.asarray(b.protocol_metrics[key]),
+            )
+
+
+def test_state_shards_requires_mesh_shard_and_divisible_fleet():
+    from fantoch_tpu.parallel import partition, run_sweep
+
+    dev, dims, specs = _sweep_specs("basic", 3, lanes=2)
+    with pytest.raises(ValueError, match="mesh_shard=True"):
+        run_sweep(dev, dims, specs, state_shards=2)
+    with pytest.raises(ValueError, match="must be >= 1"):
+        run_sweep(dev, dims, specs, mesh_shard=True, state_shards=0)
+    with pytest.raises(ValueError, match="does not divide"):
+        partition.fleet_mesh_2d(3)  # 8 CPU devices
+
+
+def test_unproven_layout_raises_state_sharding_error(monkeypatch):
+    from fantoch_tpu.parallel import StateShardingError, run_sweep
+    from fantoch_tpu.parallel import sweep as sweep_mod
+
+    monkeypatch.setattr(
+        "fantoch_tpu.lint.shard.prove_step_state_shardable",
+        lambda *a, **k: [
+            Finding("GL502", "syn", "specs:state.spine:1",
+                    "shards a REPLICATED axis")
+        ],
+    )
+    sweep_mod._STATE_PROOFS.clear()
+    dev, dims, specs = _sweep_specs("basic", 3, lanes=2)
+    try:
+        with pytest.raises(StateShardingError, match="GL502"):
+            run_sweep(dev, dims, specs, mesh_shard=True, state_shards=2)
+    finally:
+        sweep_mod._STATE_PROOFS.clear()
+
+
+def test_state_proof_is_cached_per_layout(monkeypatch):
+    from fantoch_tpu.engine.faults import NO_FAULTS
+    from fantoch_tpu.parallel import sweep as sweep_mod
+    from fantoch_tpu.parallel.specs import rules_for
+
+    calls = []
+    monkeypatch.setattr(
+        "fantoch_tpu.lint.shard.prove_step_state_shardable",
+        lambda *a, **k: calls.append(1) or [],
+    )
+    sweep_mod._STATE_PROOFS.clear()
+    try:
+        from fantoch_tpu.engine.core import init_lane_state
+
+        dev, dims, specs = _sweep_specs("basic", 3, lanes=2)
+        state = init_lane_state(dev, dims, specs[0].ctx)
+        rules = rules_for("basic")
+        args = (dev, dims, False, NO_FAULTS, 0, state, specs[0].ctx,
+                rules)
+        assert sweep_mod._prove_state_shardable(*args) == ()
+        assert sweep_mod._prove_state_shardable(*args) == ()
+        assert len(calls) == 1, "proof must be consulted, not re-run"
+        # a different declared layout is a different proof
+        sweep_mod._prove_state_shardable(
+            *args[:-1], [(r"", _p("lanes"))]
+        )
+        assert len(calls) == 2
+    finally:
+        sweep_mod._STATE_PROOFS.clear()
+
+
+def test_state_sharded_sweep_bit_identical_basic():
+    """End-to-end 2-D layout on the 8-device mesh: the proof admits
+    basic's declared rules, the (4, 2) mesh compiles, and results are
+    bit-identical to the single-device reference (n=3 planes fall
+    back to replicated placement on the 2-way state axis — the
+    divisibility downgrade must never change results)."""
+    from fantoch_tpu.parallel import run_sweep
+
+    dev, dims, specs = _sweep_specs("basic", 3, lanes=4)
+    sharded = run_sweep(dev, dims, specs, mesh_shard=True, state_shards=2)
+    reference = run_sweep(dev, dims, specs, shard_lanes=False)
+    _assert_results_equal(sharded, reference)
+
+
+@pytest.mark.slow
+def test_state_sharded_sweep_bit_identical_tempo():
+    """The acceptance pin at protocol scale: tempo with n=4 (divisible
+    by the 2-way state axis, so ``state.ps.*`` planes REALLY shard
+    their N axis) is bit-identical across the 2-D layout."""
+    from fantoch_tpu.parallel import run_sweep
+
+    dev, dims, specs = _sweep_specs("tempo", 4, lanes=4)
+    sharded = run_sweep(dev, dims, specs, mesh_shard=True, state_shards=2)
+    reference = run_sweep(dev, dims, specs, shard_lanes=False)
+    _assert_results_equal(sharded, reference)
+
+
+def test_tempo_n_axis_shard_map_bit_identical():
+    """A GL502-proven PartitionSpec for tempo's N axis drives a
+    ``shard_map`` run bit-identical to the single-device reference on
+    the 8-device CPU mesh — the item-3 pattern in miniature: shard
+    the per-process planes over the ``state`` mesh axis, do the
+    per-process work shard-locally, and cross processes only through
+    one explicit collective at the declared choke."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from fantoch_tpu.parallel import partition, specs
+
+    # the declared + proven layout for tempo's per-process planes
+    rules = specs.rules_for("tempo")
+    spec = specs.spec_for("state.ps.clocks", rules)
+    assert tuple(spec) == (specs.LANES_AXIS, specs.STATE_AXIS)
+    led = load_shard_baseline()["ledgers"]["tempo"]
+    ents = [v for k, v in led.items()
+            if k.startswith("state.ps.clocks:0:")]
+    assert ents and ents[0]["verdict"] in (SHARDABLE, COLLECTIVE)
+    assert audit_partition_rules("tempo", led, rules) == []
+
+    mesh = partition.fleet_mesh_2d(2)  # (4, 2): lanes x state
+    lanes, n, width = 4, 4, 6
+    x = np.arange(lanes * n * width, dtype=np.int64)
+    x = x.reshape(lanes, n, width) % 97
+
+    def reference(x):
+        bumped = x * 3 + 1  # per-process clock work (elementwise)
+        # the frontier choke: a cross-process min every shard needs
+        lo = jnp.min(bumped, axis=-2, keepdims=True)
+        return bumped - lo
+
+    def sharded_body(x):
+        bumped = x * 3 + 1
+        local = jnp.min(bumped, axis=-2, keepdims=True)
+        lo = jax.lax.pmin(local, specs.STATE_AXIS)
+        return bumped - lo
+
+    run = jax.jit(
+        partition.shard_map(
+            sharded_body,
+            mesh=mesh,
+            in_specs=(P(specs.LANES_AXIS, specs.STATE_AXIS),),
+            out_specs=P(specs.LANES_AXIS, specs.STATE_AXIS),
+        )
+    )
+    np.testing.assert_array_equal(
+        np.asarray(run(x)), np.asarray(jax.jit(reference)(x))
+    )
+
+
+# ----------------------------------------------------------------------
+# selfchecks + CLI (slow: each traces tempo at the audit shape)
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kind,rule", [
+    ("axis", "GL501"),
+    ("spec", "GL502"),
+    ("vmem", "GL503"),
+])
+def test_selfcheck_fixture_names_its_rule(kind, rule):
+    findings, summary = run_shard_selfcheck(kind)
+    assert findings, f"selfcheck {kind} is vacuously green"
+    assert all(f.rule == rule for f in findings)
+    assert summary["selfcheck_rule"] == rule
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kind,rule", [
+    ("axis", "GL501"),
+    ("spec", "GL502"),
+    ("vmem", "GL503"),
+])
+def test_cli_selfcheck_exits_nonzero_naming_rule(kind, rule, capsys):
+    from fantoch_tpu import cli
+
+    with pytest.raises(SystemExit) as e:
+        cli.main(["lint", "--shard-selfcheck", kind])
+    assert e.value.code == 1
+    captured = capsys.readouterr()
+    assert rule in captured.err
+    out = json.loads(captured.out.strip().splitlines()[-1])
+    assert out["selfcheck"] == kind and out["regressions"] > 0
+
+
+# ----------------------------------------------------------------------
+# registry / naming pins
+# ----------------------------------------------------------------------
+
+
+def test_traced_scan_covers_shard_py_and_specs_py():
+    from fantoch_tpu.lint.rules import REPO_ROOT, expand_paths
+    from fantoch_tpu.registry import TRACED_SCAN_PATHS
+
+    rels = [
+        os.path.relpath(f, REPO_ROOT)
+        for f in expand_paths(TRACED_SCAN_PATHS)
+    ]
+    assert "fantoch_tpu/lint/shard.py" in rels
+    assert "fantoch_tpu/parallel/specs.py" in rels
+
+
+def test_protocol_name_pins_the_naming_convention():
+    from fantoch_tpu.engine.protocols import (
+        dev_protocol,
+        partial_dev_protocol,
+    )
+    from fantoch_tpu.parallel.specs import RULES, protocol_name
+
+    for name in DEV_PROTOCOLS:
+        dev = dev_protocol(name, 3)
+        assert protocol_name(dev) == name
+        assert name in RULES  # every protocol has a declared layout
+    for name in PARTIAL_DEV_PROTOCOLS:
+        dev = partial_dev_protocol(name, 4, 2)
+        assert protocol_name(dev) == name
