@@ -990,6 +990,26 @@ def _shard_axis_ledger() -> "dict | None":
         return {"error": repr(e)}
 
 
+def _skeleton_waste_ratio() -> "dict | None":
+    """Megabatch padding-amplification ratios (the GL601/GL603 ledger,
+    fantoch_tpu/lint/skeleton.py) — unified-skeleton bytes over native
+    per-protocol bytes for every grid composition declared in
+    engine/dims.py SKELETON_GRIDS, from the checked-in skeleton
+    baseline. Reads only the JSON artifact (imports no jax), so it is
+    honest even when the device backend is unreachable; degrades to an
+    error record, never an exception."""
+    try:
+        from fantoch_tpu.lint.skeleton import skeleton_waste_summary
+
+        return skeleton_waste_summary()
+    except Exception as e:  # noqa: BLE001
+        import sys as _sys
+
+        print(f"bench: skeleton waste ledger unavailable: {e!r}",
+              file=_sys.stderr)
+        return {"error": repr(e)}
+
+
 def _fuzz_selfcheck() -> float:
     from fantoch_tpu.mc.fuzz import FuzzSpec, run_fuzz_point
 
@@ -1662,6 +1682,10 @@ def main() -> None:
                 # (GL501 ledger) — the static twin of the 2-D-mesh
                 # sweep numbers, proving which state planes may shard
                 "shard_axis_ledger": _shard_axis_ledger(),
+                # per-grid megabatch amplification ratios (GL601/GL603
+                # ledger) — unified-skeleton bytes over native bytes,
+                # the static cost of a heterogeneous lax.switch batch
+                "skeleton_waste_ratio": _skeleton_waste_ratio(),
             }
         )
     )
@@ -1883,12 +1907,14 @@ def _emit_unreachable(reason: str = "unreachable at startup") -> None:
                     if static_cost
                     else {}
                 ),
-                # the sync + determinism + shard ledgers are static
-                # (pure AST / checked-in JSON) — real numbers even in
-                # this dead-backend artifact, not placeholder zeros
+                # the sync + determinism + shard + skeleton ledgers
+                # are static (pure AST / checked-in JSON) — real
+                # numbers even in this dead-backend artifact, not
+                # placeholder zeros
                 "host_sync_ledger": _host_sync_ledger(),
                 "determinism_ledger": _determinism_ledger(),
                 "shard_axis_ledger": _shard_axis_ledger(),
+                "skeleton_waste_ratio": _skeleton_waste_ratio(),
             }
         )
     )

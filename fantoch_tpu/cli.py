@@ -950,9 +950,10 @@ def cmd_lint(args) -> None:
     hook-registry rules, (``--cost``) the kernel/VMEM/lane cost
     family, (``--transfer``) the sync-ledger/donation/backend
     transfer family, (``--determinism``) the GL401-GL404
-    byte-identity prover, and (``--shard``) the GL501-GL503
-    shardability family. Exits non-zero on any finding not covered
-    by the baseline (docs/LINT.md)."""
+    byte-identity prover, (``--shard``) the GL501-GL503
+    shardability family, and (``--skeleton``) the GL601-GL604
+    megabatch state-unification family. Exits non-zero on any
+    finding not covered by the baseline (docs/LINT.md)."""
     from .lint import (
         DEFAULT_BASELINE,
         load_baseline,
@@ -1037,6 +1038,27 @@ def cmd_lint(args) -> None:
             json.dumps(
                 {
                     "selfcheck": args.shard_selfcheck,
+                    "regressions": len(findings),
+                }
+            )
+        )
+        raise SystemExit(1 if findings else 0)
+
+    if args.skeleton_selfcheck:
+        # same contract for the skeleton gate: the seeded fixture
+        # (verdict-drifting dtype widen / union extent below native /
+        # over-budget grid composition) must produce findings NAMING
+        # GL601/GL602/GL603, or the unification prover is vacuously
+        # green
+        from .lint.skeleton import run_skeleton_selfcheck
+
+        findings, _ = run_skeleton_selfcheck(args.skeleton_selfcheck)
+        for f in findings:
+            print(f.render(), file=sys.stderr)
+        print(
+            json.dumps(
+                {
+                    "selfcheck": args.skeleton_selfcheck,
                     "regressions": len(findings),
                 }
             )
@@ -1182,6 +1204,45 @@ def cmd_lint(args) -> None:
         )
         return
 
+    if args.write_skeleton_baseline:
+        from .lint.skeleton import (
+            DEFAULT_SKELETON_BASELINE,
+            run_skeleton,
+            write_skeleton_baseline,
+        )
+
+        if protocols:
+            raise SystemExit(
+                "refusing to write the skeleton baseline from a run "
+                "narrowed by --protocols (missing audits would turn "
+                "their planes PRIVATE or drop them entirely, and the "
+                "drift would land as CI regressions); run without it"
+            )
+        findings, summary = run_skeleton(progress=say)
+        blocking = [f for f in findings if f.rule != "GL601"]
+        if blocking:
+            # GL601 drift is exactly what a rewrite reviews away, but a
+            # baseline written while branches don't unify (GL602) or a
+            # declared grid is over budget (GL603) would pin a broken
+            # skeleton as the reviewed truth
+            for f in blocking:
+                print(f.render(), file=sys.stderr)
+            raise SystemExit(
+                "refusing to write the skeleton baseline while the "
+                "branch/padding provers report findings; fix those "
+                "first — the ledger only records the union taxonomy"
+            )
+        write_skeleton_baseline(DEFAULT_SKELETON_BASELINE, summary["ledger"])
+        print(
+            json.dumps(
+                {
+                    "skeleton_baseline": DEFAULT_SKELETON_BASELINE,
+                    "planes": summary["planes"],
+                }
+            )
+        )
+        return
+
     report = run_lint(
         protocols,
         ast_paths=args.paths or None,
@@ -1189,11 +1250,13 @@ def cmd_lint(args) -> None:
         and not args.cost_only
         and not args.transfer_only
         and not args.determinism_only
-        and not args.shard_only,
+        and not args.shard_only
+        and not args.skeleton_only,
         cost=args.cost or args.cost_only,
         transfer=args.transfer or args.transfer_only,
         determinism=args.determinism or args.determinism_only,
         shard=args.shard or args.shard_only,
+        skeleton=args.skeleton or args.skeleton_only,
         progress=say,
     )
 
@@ -1204,6 +1267,7 @@ def cmd_lint(args) -> None:
             or args.cost_only
             or args.transfer_only
             or args.shard_only
+            or args.skeleton_only
             or protocols
             or args.paths
         )
@@ -1249,6 +1313,10 @@ def cmd_lint(args) -> None:
     if report.shard:
         out["shard"] = {
             k: v for k, v in report.shard.items() if k != "ledgers"
+        }
+    if report.skeleton:
+        out["skeleton"] = {
+            k: v for k, v in report.skeleton.items() if k != "ledger"
         }
     if args.json:
         out["detail"] = report.to_json(baseline)
@@ -2103,6 +2171,26 @@ def main(argv=None) -> None:
                     "this run (hand-edited reasons survive while the "
                     "verdict is unchanged; refuses to write while the "
                     "axis taint degrades on unknown primitives)")
+    ln.add_argument("--skeleton", action="store_true",
+                    help="add the skeleton family: GL601 megabatch "
+                    "state-unification ledger (vs lint/"
+                    "skeleton_baseline.json) + GL602 branch-"
+                    "compatibility prover + GL603 padding-"
+                    "amplification gate + GL604 single-protocol "
+                    "no-regression pin")
+    ln.add_argument("--skeleton-only", action="store_true",
+                    help="skeleton family without the interval/gating "
+                    "audits (the CI skeleton-gate job)")
+    ln.add_argument("--skeleton-selfcheck", default=None,
+                    choices=["union", "branch", "pad"],
+                    help="CI broken-fixture check: audit the named "
+                    "seeded-defect fixture; must exit non-zero naming "
+                    "GL601/GL602/GL603")
+    ln.add_argument("--write-skeleton-baseline", action="store_true",
+                    help="regenerate lint/skeleton_baseline.json from "
+                    "this run (hand-edited reasons survive while the "
+                    "plane's verdict/specs are unchanged; new entries "
+                    "get an UNREVIEWED placeholder the gate rejects)")
     ln.add_argument("--json", action="store_true",
                     help="include full finding detail in the output")
     ln.set_defaults(fn=cmd_lint)

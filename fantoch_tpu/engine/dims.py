@@ -95,6 +95,52 @@ BACKEND_PROFILES = {
     ),
 }
 
+# ----------------------------------------------------------------------
+# declared heterogeneous-megabatch grid compositions — the ROADMAP
+# item-1 seam. A ``lax.switch`` megabatch packs every lane of a grid
+# into ONE union state skeleton (engine/skeleton.py), so each lane pays
+# the union's resident bytes instead of its own protocol's: a
+# caesar-shaped union silently multiplies a tempo-only sweep's HBM
+# footprint unless the composition is declared and budgeted here. The
+# GL603 padding-amplification gate (fantoch_tpu/lint/skeleton.py)
+# computes, per composition, union-resident bytes / native per-protocol
+# bytes over the GL601 ledger and fails by name when any member exceeds
+# ``max_amplification``. Audit names follow the lint grid: a bare
+# protocol name is its single-shard audit, ``<name>@2shards`` the
+# partial-replication variant. Budgets are declared against measured
+# HEAD ratios with headroom (docs/PERF.md "Skeleton amplification"),
+# like the GL202/GL503 VMEM budgets — raising one is a reviewed diff,
+# never a silent drift.
+SKELETON_GRIDS = {
+    # the cheapest real megabatch: one protocol, both replication
+    # modes (measured 4.45x at HEAD — the 2-shard pool/dot extents
+    # dominate the single-shard lanes)
+    "tempo-mixed": {
+        "audits": ("tempo", "tempo@2shards"),
+        "max_amplification": 6.0,
+    },
+    # the paper's core grid: every full-replication protocol in
+    # lockstep (measured 35.6x at HEAD for fpaxos — tiny native state,
+    # union shaped by caesar/tempo extents plus every ps slot)
+    "full-replication": {
+        "audits": (
+            "basic", "fpaxos", "tempo", "atlas", "epaxos", "caesar",
+        ),
+        "max_amplification": 40.0,
+    },
+    # everything the lint families audit — the worst-case union
+    # (measured 109x at HEAD for fpaxos: declared here so the cost of
+    # an everything-batch is a number in a reviewed file, not a
+    # surprise OOM; real campaigns should compose narrower grids)
+    "full-grid": {
+        "audits": (
+            "basic", "fpaxos", "tempo", "atlas", "epaxos", "caesar",
+            "tempo@2shards", "atlas@2shards",
+        ),
+        "max_amplification": 120.0,
+    },
+}
+
 # per-lane error taxonomy: the engine and the protocol modules OR these
 # bits into int32 error words (per process for protocol state, per lane
 # for engine conditions), so a failing lane names its cause instead of

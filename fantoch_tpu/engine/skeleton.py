@@ -1,0 +1,457 @@
+"""Union state skeleton for heterogeneous protocol megabatches.
+
+ROADMAP item 1 wants every (protocol, config) lane of a campaign grid
+advancing in ONE compiled step, dispatched per lane by ``lax.switch``.
+The switch precondition is brutal: every branch must consume and
+produce *identical avals*, but the eight audited protocol variants
+carry eight different lane-state trees (different ``ps`` fields,
+different pool/dot/fanout extents). This module is the proven
+unification layer underneath that runner:
+
+- :func:`classify_planes` decides, per dotted state/ctx plane, how the
+  cross-protocol union stores it — ``SHARED`` (same rank + dtype in
+  every audit, padded to the elementwise-max extent), ``CASTABLE``
+  (same rank everywhere, storage widened to a dtype every native dtype
+  casts to losslessly), or ``PRIVATE`` (protocol-specific, slotted
+  per-audit into union storage). The GL601 lint gate
+  (fantoch_tpu/lint/skeleton.py) ledgers these verdicts in a
+  checked-in baseline with reviewed reasons, so the taxonomy below is
+  machine-pinned, not folklore.
+- :func:`build_skeleton` turns classified planes into a
+  :class:`Skeleton` — the union pytree spec all eight protocols share.
+- :func:`pack_state` / :func:`unpack_state` (and the ``ctx`` twins)
+  are the adapters: byte-exact round-trip for every audit (zero-pad up
+  / slice back, widen up / cast back — both value-preserving by
+  construction), refusing by name on any plane the skeleton does not
+  know (a monitored state, a drifted dtype) instead of silently
+  truncating. ``protocol_id`` rides in the packed state as the lane
+  plane the eventual ``lax.switch`` dispatches on.
+
+The switch-dispatched runner itself is NOT here — it lands in a later
+PR on top of these proofs, exactly as ``parallel/partition.py`` landed
+on the GL5xx shardability ledger. Until then the adapters are exercised
+by the GL602/GL604 provers and their tests only.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Any, Dict, Mapping, Tuple
+
+import numpy as np
+
+# plane verdicts — the GL601 taxonomy
+SHARED = "SHARED"        # every audit: same rank, same dtype; pad to max
+CASTABLE = "CASTABLE"    # every audit: same rank; storage dtype widened
+PRIVATE = "PRIVATE"      # protocol-specific: per-audit slot in the union
+VERDICTS = (SHARED, CASTABLE, PRIVATE)
+
+
+class SkeletonMismatchError(RuntimeError):
+    """A tree handed to the pack/unpack adapters disagrees with the
+    proven skeleton (unknown plane, missing plane, drifted shape or
+    dtype, foreign ``protocol_id``). Always refused by name — a
+    silently truncated or zero-filled plane would be a wrong-result
+    bug, not a crash."""
+
+
+# ----------------------------------------------------------------------
+# dotted-plane walking (dict-only trees, the engine's state/ctx shape)
+# ----------------------------------------------------------------------
+
+def walk_planes(tree, prefix: str) -> Dict[str, Any]:
+    """Flatten a nested-dict tree into ``{dotted-name: leaf}`` with
+    ``prefix`` as the root segment — the same names GL501/GL601 ledger
+    (``state.ps.clock``, ``ctx.delay_pp``). Engine state and ctx are
+    pure nested dicts; any other container (and any key containing a
+    ``.``) is refused by name so dotted paths stay invertible."""
+    out: Dict[str, Any] = {}
+
+    def rec(node, path):
+        if isinstance(node, dict):
+            for k in sorted(node):
+                if not isinstance(k, str) or "." in k:
+                    raise SkeletonMismatchError(
+                        f"skeleton planes need dot-free string keys; "
+                        f"got {k!r} under {path}"
+                    )
+                rec(node[k], f"{path}.{k}")
+        elif isinstance(node, (list, tuple)):
+            raise SkeletonMismatchError(
+                f"skeleton trees are nested dicts of arrays; {path} "
+                f"is a {type(node).__name__}"
+            )
+        else:
+            out[path] = node
+
+    rec(tree, prefix)
+    return out
+
+
+def unflatten_planes(leaves: Mapping[str, Any]) -> dict:
+    """Invert :func:`walk_planes` (names WITHOUT the root prefix)."""
+    root: dict = {}
+    for name in sorted(leaves):
+        parts = name.split(".")
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = leaves[name]
+    return root
+
+
+# ----------------------------------------------------------------------
+# classification — the GL601 taxonomy over per-audit plane specs
+# ----------------------------------------------------------------------
+
+def _lossless_cast(src: np.dtype, dst: np.dtype) -> bool:
+    """True iff every value of ``src`` survives a round-trip through
+    ``dst``. Stricter than ``np.can_cast(..., casting="safe")``, which
+    blesses int64 -> float64 even though float64's 52-bit mantissa
+    cannot hold every int64 — for integer -> float widens we require
+    the mantissa to cover the integer's value bits."""
+    if src == dst:
+        return True
+    if src.kind in "iu" and dst.kind == "f":
+        value_bits = src.itemsize * 8 - (1 if src.kind == "i" else 0)
+        return value_bits <= np.finfo(dst).nmant
+    return np.can_cast(src, dst, casting="safe")
+
+
+def classify_planes(
+    specs: Mapping[str, Mapping[str, Tuple[tuple, str]]],
+) -> Dict[str, dict]:
+    """Classify every plane of ``{audit: {name: (shape, dtype)}}``
+    against the cross-audit union. Returns ``{name: entry}`` where an
+    entry carries ``verdict``, per-audit ``native`` specs, and (for
+    SHARED/CASTABLE) the ``union`` storage spec. Pure shape/dtype
+    arithmetic — no jax, no tracing — so the lint gate, the selfcheck
+    fixtures, and the unit tests all share one classifier."""
+    audits = sorted(specs)
+    assert audits, "classify_planes needs at least one audit"
+    names = sorted({n for a in audits for n in specs[a]})
+    entries: Dict[str, dict] = {}
+    for name in names:
+        native = {
+            a: {
+                "shape": [int(d) for d in specs[a][name][0]],
+                "dtype": str(specs[a][name][1]),
+            }
+            for a in audits
+            if name in specs[a]
+        }
+        entry: Dict[str, Any] = {"native": native}
+        ranks = {len(v["shape"]) for v in native.values()}
+        dtypes = sorted({v["dtype"] for v in native.values()})
+        if len(native) < len(audits) or len(ranks) != 1:
+            # absent from some audit, or rank disagrees: there is no
+            # single union plane both sides can index — per-audit slot
+            entry["verdict"] = PRIVATE
+        else:
+            shape = [
+                max(v["shape"][i] for v in native.values())
+                for i in range(ranks.pop())
+            ]
+            if len(dtypes) == 1:
+                entry["verdict"] = SHARED
+                entry["union"] = {"shape": shape, "dtype": dtypes[0]}
+            else:
+                try:
+                    union_dt = np.dtype(dtypes[0])
+                    for d in dtypes[1:]:
+                        union_dt = np.promote_types(union_dt, d)
+                    lossless = all(
+                        _lossless_cast(np.dtype(d), union_dt)
+                        for d in dtypes
+                    )
+                except TypeError:  # pragma: no cover — exotic dtypes
+                    lossless = False
+                if lossless:
+                    entry["verdict"] = CASTABLE
+                    entry["union"] = {
+                        "shape": shape,
+                        "dtype": str(union_dt),
+                    }
+                else:
+                    # no value-preserving widen exists (e.g. i64 + f32:
+                    # the promotion target f64 cannot hold every i64)
+                    entry["verdict"] = PRIVATE
+        entries[name] = entry
+    return entries
+
+
+# ----------------------------------------------------------------------
+# the skeleton — union pytree spec shared by every audit
+# ----------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Skeleton:
+    """The proven union: ordered audits (index = ``protocol_id``) and
+    classified planes. Built from live classification
+    (:func:`build_skeleton`) or from the checked-in GL601 ledger —
+    both roads produce the same spec or the lint gate fails."""
+
+    audits: Tuple[str, ...]
+    planes: Mapping[str, dict]
+
+    def protocol_id(self, audit: str) -> int:
+        try:
+            return self.audits.index(audit)
+        except ValueError:
+            raise SkeletonMismatchError(
+                f"audit {audit!r} is not in this skeleton's grid "
+                f"{list(self.audits)}"
+            ) from None
+
+    def slots(self, prefix: str):
+        """``(sub-name, entry)`` pairs under ``prefix`` ("state" or
+        "ctx"), sub-names stripped of the prefix, sorted."""
+        p = prefix + "."
+        for name in sorted(self.planes):
+            if name.startswith(p):
+                yield name[len(p):], self.planes[name]
+
+
+def build_skeleton(entries: Mapping[str, dict],
+                   audits=None) -> Skeleton:
+    """Assemble a :class:`Skeleton` from classified plane entries (live
+    :func:`classify_planes` output or the checked-in ledger's
+    ``planes`` map). Validates the taxonomy instead of trusting it:
+    unknown verdicts, SHARED/CASTABLE entries without a union spec, or
+    native specs for audits outside the grid are refused by name."""
+    if audits is None:
+        audits = sorted(
+            {a for e in entries.values() for a in e.get("native", {})}
+        )
+    audits = tuple(audits)
+    for name, ent in sorted(entries.items()):
+        v = ent.get("verdict")
+        if v not in VERDICTS:
+            raise SkeletonMismatchError(
+                f"plane {name}: unknown verdict {v!r}"
+            )
+        if v in (SHARED, CASTABLE) and not ent.get("union"):
+            raise SkeletonMismatchError(
+                f"plane {name}: {v} without a union storage spec"
+            )
+        if not ent.get("native"):
+            raise SkeletonMismatchError(
+                f"plane {name}: no native specs"
+            )
+        stray = sorted(set(ent["native"]) - set(audits))
+        if stray:
+            raise SkeletonMismatchError(
+                f"plane {name}: native specs for audits outside the "
+                f"grid: {stray}"
+            )
+    return Skeleton(audits=audits, planes=dict(entries))
+
+
+def skeleton_fingerprint(skeleton: Skeleton) -> str:
+    """Content hash of the union spec (audit order + every slot's
+    verdict/union/native shapes and dtypes) — the marker threaded
+    through AOT executable signatures and checkpoint manifests so a
+    megabatch artifact can never be loaded by a worker holding a
+    different (or no) skeleton."""
+    from .checkpoint import canonical_json
+
+    spec = {
+        "audits": list(skeleton.audits),
+        "planes": {
+            name: {
+                "verdict": ent["verdict"],
+                **({"union": ent["union"]} if ent.get("union") else {}),
+                "native": ent["native"],
+            }
+            for name, ent in skeleton.planes.items()
+        },
+    }
+    return hashlib.sha256(canonical_json(spec).encode()).hexdigest()
+
+
+def packed_spec(skeleton: Skeleton, prefix: str = "state") -> dict:
+    """Shape/dtype spec of the packed union tree — identical for every
+    audit by construction (the ``lax.switch`` operand contract). Layout
+    mirrors :func:`pack_state`: ``shared`` slots at union extents,
+    ``priv`` per-audit slots at native extents, plus the
+    ``protocol_id`` lane plane for the state tree."""
+    shared: Dict[str, tuple] = {}
+    priv: Dict[str, Dict[str, tuple]] = {a: {} for a in skeleton.audits}
+    for sub, ent in skeleton.slots(prefix):
+        if ent["verdict"] == PRIVATE:
+            for a, nat in sorted(ent["native"].items()):
+                priv[a][sub] = (tuple(nat["shape"]), nat["dtype"])
+        else:
+            u = ent["union"]
+            shared[sub] = (tuple(u["shape"]), u["dtype"])
+    spec: Dict[str, Any] = {"shared": shared, "priv": priv}
+    if prefix == "state":
+        spec["protocol_id"] = ((), "int32")
+    return spec
+
+
+# ----------------------------------------------------------------------
+# pack / unpack adapters — byte-exact round-trip, refusal by name
+# ----------------------------------------------------------------------
+
+def _pad_to(arr, shape, xp):
+    if tuple(arr.shape) == tuple(shape):
+        return arr
+    pads = tuple((0, t - s) for s, t in zip(arr.shape, shape))
+    if any(p[1] < 0 for p in pads):  # pragma: no cover — gated earlier
+        raise SkeletonMismatchError(
+            f"cannot pad {tuple(arr.shape)} down to {tuple(shape)}"
+        )
+    return xp.pad(arr, pads)
+
+
+def _pack_tree(skeleton: Skeleton, audit: str, tree, prefix: str, xp):
+    skeleton.protocol_id(audit)  # refuse a foreign audit before any
+    # plane-level message can misattribute the mismatch to a plane
+    leaves = walk_planes(tree, prefix)
+    shared: Dict[str, Any] = {}
+    priv: Dict[str, Dict[str, Any]] = {a: {} for a in skeleton.audits}
+    for sub, ent in skeleton.slots(prefix):
+        name = f"{prefix}.{sub}"
+        nat = ent["native"].get(audit)
+        arr = None
+        if nat is not None:
+            if name not in leaves:
+                raise SkeletonMismatchError(
+                    f"{audit}: {prefix} tree is missing plane {name} "
+                    f"the skeleton expects"
+                )
+            arr = xp.asarray(leaves.pop(name))
+            if (tuple(arr.shape) != tuple(nat["shape"])
+                    or str(arr.dtype) != nat["dtype"]):
+                raise SkeletonMismatchError(
+                    f"{audit}: plane {name} is "
+                    f"{tuple(arr.shape)}/{arr.dtype}, skeleton native "
+                    f"spec says {tuple(nat['shape'])}/{nat['dtype']}"
+                )
+        elif name in leaves:
+            raise SkeletonMismatchError(
+                f"{audit}: plane {name} is not carried by this audit "
+                f"in the skeleton, yet the {prefix} tree has it"
+            )
+        if ent["verdict"] == PRIVATE:
+            # every audit's slot is materialised in every lane — that
+            # is the amplification GL603 budgets, not an accident
+            for a, na in sorted(ent["native"].items()):
+                if a == audit and arr is not None:
+                    priv[a][sub] = arr
+                else:
+                    priv[a][sub] = xp.zeros(
+                        tuple(na["shape"]), dtype=na["dtype"]
+                    )
+        else:
+            u = ent["union"]
+            shared[sub] = _pad_to(arr, u["shape"], xp).astype(u["dtype"])
+    if leaves:
+        raise SkeletonMismatchError(
+            f"{audit}: {prefix} tree carries planes the skeleton does "
+            f"not know (would be silently dropped): "
+            f"{sorted(leaves)}"
+        )
+    return {"shared": shared, "priv": priv}
+
+
+def _unpack_tree(skeleton: Skeleton, audit: str, packed, prefix: str,
+                 xp):
+    for part in ("shared", "priv"):
+        if part not in packed:
+            raise SkeletonMismatchError(
+                f"{audit}: packed {prefix} tree has no {part!r} slot"
+            )
+    out: Dict[str, Any] = {}
+    for sub, ent in skeleton.slots(prefix):
+        nat = ent["native"].get(audit)
+        if nat is None:
+            continue
+        if ent["verdict"] == PRIVATE:
+            try:
+                arr = packed["priv"][audit][sub]
+            except KeyError:
+                raise SkeletonMismatchError(
+                    f"{audit}: packed tree is missing private slot "
+                    f"{prefix}.{sub}"
+                ) from None
+        else:
+            u = ent["union"]
+            try:
+                arr = packed["shared"][sub]
+            except KeyError:
+                raise SkeletonMismatchError(
+                    f"{audit}: packed tree is missing shared slot "
+                    f"{prefix}.{sub}"
+                ) from None
+            if (tuple(arr.shape) != tuple(u["shape"])
+                    or str(arr.dtype) != u["dtype"]):
+                raise SkeletonMismatchError(
+                    f"{audit}: shared slot {prefix}.{sub} is "
+                    f"{tuple(arr.shape)}/{arr.dtype}, union spec says "
+                    f"{tuple(u['shape'])}/{u['dtype']}"
+                )
+            arr = arr[tuple(slice(0, s) for s in nat["shape"])]
+        arr = xp.asarray(arr).astype(nat["dtype"])
+        if tuple(arr.shape) != tuple(nat["shape"]):
+            raise SkeletonMismatchError(
+                f"{audit}: slot {prefix}.{sub} unpacked to "
+                f"{tuple(arr.shape)}, native spec says "
+                f"{tuple(nat['shape'])} — the union extent does not "
+                f"cover the native extent"
+            )
+        out[sub] = arr
+    return unflatten_planes(out)
+
+
+def pack_state(skeleton: Skeleton, audit: str, state, *, xp=np):
+    """Pack one audit's native lane state into the union skeleton:
+    SHARED/CASTABLE planes zero-padded to union extents and widened to
+    union storage, PRIVATE planes into this audit's slots (every other
+    audit's slots zero-filled so the packed structure is identical
+    across protocols), plus the ``protocol_id`` dispatch plane. Pass
+    ``xp=jax.numpy`` to trace it; the default keeps host round-trips
+    pure numpy (byte-exact, no device transfer)."""
+    packed = _pack_tree(skeleton, audit, state, "state", xp)
+    packed["protocol_id"] = xp.asarray(
+        skeleton.protocol_id(audit), dtype=np.int32
+    )
+    return packed
+
+
+def unpack_state(skeleton: Skeleton, audit: str, packed, *, xp=np):
+    """Invert :func:`pack_state` for ``audit``: slice padded planes
+    back to native extents, cast widened storage back to native dtypes
+    (both exact for values that came through :func:`pack_state`).
+    A concrete ``protocol_id`` that names a different audit is refused
+    by name; a traced one is left to the eventual ``lax.switch``."""
+    pid = packed.get("protocol_id")
+    if pid is None:
+        raise SkeletonMismatchError(
+            f"{audit}: packed state has no protocol_id plane"
+        )
+    want = skeleton.protocol_id(audit)
+    try:
+        got = int(pid)
+    except Exception:  # a tracer — dispatch happens at the switch
+        got = None
+    if got is not None and got != want:
+        raise SkeletonMismatchError(
+            f"packed state carries protocol_id {got} "
+            f"({skeleton.audits[got] if 0 <= got < len(skeleton.audits) else '?'}), "
+            f"but unpack was asked for {audit!r} (id {want})"
+        )
+    return _unpack_tree(skeleton, audit, packed, "state", xp)
+
+
+def pack_ctx(skeleton: Skeleton, audit: str, ctx, *, xp=np):
+    """The ctx twin of :func:`pack_state` (no ``protocol_id`` — the
+    dispatch plane rides in the state tree)."""
+    return _pack_tree(skeleton, audit, ctx, "ctx", xp)
+
+
+def unpack_ctx(skeleton: Skeleton, audit: str, packed, *, xp=np):
+    """The ctx twin of :func:`unpack_state`."""
+    return _unpack_tree(skeleton, audit, packed, "ctx", xp)

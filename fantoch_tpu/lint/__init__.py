@@ -54,6 +54,21 @@ Four analysis families, one driver (``python -m fantoch_tpu.cli lint``):
    consults before compiling a layout), GL503 per-shard footprint
    gate (GL202's fused-group VMEM analysis under shard-divided
    shapes for the declared candidate meshes).
+8. **Skeleton family** (:mod:`.skeleton`; opt-in ``--skeleton``) —
+   the static prerequisite for ROADMAP item 1's heterogeneous
+   ``lax.switch`` megabatch: GL601 skeleton-unification ledger
+   (per-plane SHARED / CASTABLE / PRIVATE verdicts against the
+   cross-protocol union, gated against
+   ``lint/skeleton_baseline.json`` with per-entry evidence reasons),
+   GL602 branch-compatibility prover (every protocol's step traced
+   against the unified abstract state must produce identical avals —
+   the ``lax.switch`` precondition — plus fault-mask and
+   monitor-gating composition), GL603 padding-amplification gate
+   (union bytes vs native bytes per declared ``engine/dims.py
+   SKELETON_GRIDS`` composition), GL604 single-protocol
+   no-regression pin (a homogeneous batch packed through
+   ``engine/skeleton.py`` round-trips byte-exact and re-traces
+   alpha-equivalent to the legacy step).
 
 Every pass shares one cached trace per protocol variant
 (:class:`.jaxpr.TraceCache`), so adding passes does not multiply the
@@ -105,6 +120,8 @@ def run_lint(
     determinism_baseline: "str | None" = None,
     shard: bool = False,
     shard_baseline: "dict | None" = None,
+    skeleton: bool = False,
+    skeleton_baseline: "dict | None" = None,
     cache=None,
     progress=None,
 ) -> LintReport:
@@ -177,7 +194,7 @@ def run_lint(
         if not protocols or n in protocols
     ]
 
-    if jaxpr_audits or cost or shard:
+    if jaxpr_audits or cost or shard or skeleton:
         from .jaxpr import TraceCache, build_protocol_trace
 
         cache = cache or TraceCache()
@@ -278,6 +295,29 @@ def run_lint(
         report.shard = summary
         report.audits_run.extend(
             f"shard:{a}" for a in summary.get("audits", {})
+        )
+
+    if skeleton:
+        # GL601-GL604 gate against skeleton_baseline.json (findings
+        # exist only on violation — never written to baseline.json);
+        # traces at SHARD_SHAPE, shared via the same TraceCache under
+        # the shard family's ("shard", audit) keys, so running both
+        # families re-traces nothing
+        from .skeleton import load_skeleton_baseline, run_skeleton
+
+        if skeleton_baseline is None:
+            skeleton_baseline = load_skeleton_baseline()
+        findings, summary = run_skeleton(
+            protocols,
+            include_partial=include_partial,
+            cache=cache,
+            baseline=skeleton_baseline,
+            progress=say,
+        )
+        report.extend(findings)
+        report.skeleton = summary
+        report.audits_run.extend(
+            f"skeleton:{a}" for a in summary.get("audits", {})
         )
 
     say(f"lint done in {time.perf_counter() - t0:.1f}s")

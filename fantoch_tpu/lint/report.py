@@ -56,6 +56,9 @@ class LintReport:
     # shardability ledger summary (per-audit axis verdict counts)
     # when the GL5xx family ran
     shard: Dict[str, object] = field(default_factory=dict)
+    # skeleton-unification summary (plane verdict counts, per-grid
+    # amplification) when the GL6xx family ran
+    skeleton: Dict[str, object] = field(default_factory=dict)
 
     def extend(self, fs) -> None:
         self.findings.extend(fs)
@@ -108,6 +111,19 @@ class LintReport:
                 if self.shard
                 else {}
             ),
+            # same treatment for the GL601 unification ledger: it
+            # rides on the report only for --write-skeleton-baseline
+            **(
+                {
+                    "skeleton": {
+                        k: v
+                        for k, v in self.skeleton.items()
+                        if k != "ledger"
+                    }
+                }
+                if self.skeleton
+                else {}
+            ),
             "findings": [
                 {
                     "id": f.id,
@@ -145,11 +161,12 @@ def write_baseline(path: str, report: LintReport) -> None:
     # (GL0xx structural + GL1xx AST/jaxpr). Every other family has
     # its own ledger — GL2xx cost_baseline.json, GL3xx
     # transfer_baseline.json, GL4xx determinism_baseline.json, GL5xx
-    # shard_baseline.json — and emits findings ONLY on violation, so
-    # baking one in here would permanently suppress a live
-    # kernel/VMEM/sync/donation/determinism/shardability regression.
-    # An allowlist (not a denylist of known foreign prefixes) so the
-    # NEXT family can't cross-pollinate either.
+    # shard_baseline.json, GL6xx skeleton_baseline.json — and emits
+    # findings ONLY on violation, so baking one in here would
+    # permanently suppress a live kernel/VMEM/sync/donation/
+    # determinism/shardability/unification regression. An allowlist
+    # (not a denylist of known foreign prefixes) so the NEXT family
+    # can't cross-pollinate either.
     counts = {
         fid: n
         for fid, n in sorted(report.counts().items())
@@ -163,8 +180,8 @@ def write_baseline(path: str, report: LintReport) -> None:
             "deliberately accepted finding (docs/LINT.md documents why "
             "each current entry is sound). Only GL0xx/GL1xx ids are "
             "ever written: the cost (GL2xx), transfer (GL3xx), "
-            "determinism (GL4xx), and shardability (GL5xx) families "
-            "gate against their own ledgers."
+            "determinism (GL4xx), shardability (GL5xx), and skeleton "
+            "(GL6xx) families gate against their own ledgers."
         ),
         "findings": counts,
     }

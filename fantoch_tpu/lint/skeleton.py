@@ -1,0 +1,1072 @@
+"""GL601-GL604: the heterogeneous-megabatch skeleton family.
+
+ROADMAP item 1's ``lax.switch`` megabatch packs every protocol's lane
+state into ONE union skeleton (engine/skeleton.py). Done naively that
+is a silent catastrophe three different ways: a union shaped by the
+biggest protocol multiplies every other protocol's HBM footprint; a
+branch whose avals drift breaks the switch precondition at compile
+time (or worse, pads/truncates at pack time); and a repacked
+homogeneous batch that traces even one equation differently invalidates
+every existing checkpoint signature, AOT key and XLA cache entry. Like
+GL2xx before donation, GL3xx before the transfer tiers, GL4xx before
+the fleet and GL5xx before the 2-D mesh, this family proves the
+skeleton BEFORE the runner exists:
+
+- **GL601 skeleton-unification ledger** — walk every audited
+  protocol's stacked lane-state tree (the 512-lane batched replay from
+  lint/shard.py, flattened by lint/jaxpr.py) and classify each plane
+  against the cross-protocol union: SHARED (pad to max extent),
+  CASTABLE (lossless dtype widen; GL001 bounds and ``narrow_spec``
+  storage must be re-derived at the widened dtype), or PRIVATE
+  (per-protocol slot in union storage). Verdicts live in the
+  checked-in ``lint/skeleton_baseline.json``; every entry carries a
+  reviewed reason (a reasonless or UNREVIEWED entry fails the gate),
+  and any drift — verdict, union storage slot, native spec, audit
+  grid, or declared grid composition — fails by name in either
+  direction.
+- **GL602 branch-compatibility prover** — trace each protocol's step
+  against the *unified* abstract state (pack -> unpack -> step ->
+  repack under ``jax.eval_shape``) and prove the input/output avals
+  identical across all branches (the ``lax.switch`` precondition),
+  citing the first incompatible leaf by plane, protocol and dtype.
+  Also proves a fully-flagged fault plan traces to the same unified
+  signature (fault masks compose) and that a monitored state is
+  refused by name rather than silently absorbed (monitor gating
+  composes by structure-refusal, exactly like engine/spec.py ctx
+  gating).
+- **GL603 padding-amplification gate** — per declared grid composition
+  (``engine/dims.py SKELETON_GRIDS``), union-resident bytes / native
+  per-protocol bytes must stay under the declared budget, GL202/GL503
+  style, so a caesar-shaped union can never silently 3x a tempo-only
+  sweep.
+- **GL604 single-protocol no-regression pin** — pack a homogeneous
+  batch through the skeleton, unpack it, and prove the round-trip
+  byte-exact AND the re-traced step alpha-equivalent (GL005's
+  ``alpha_equivalent``) to the legacy per-protocol step, so existing
+  checkpoints, AOT keys and XLA cache entries survive the skeleton
+  landing.
+
+Import cost discipline matches lint/shard.py: module import is
+stdlib-only (bench.py's ``skeleton_waste_ratio`` metric reads the
+checked-in ledger with no jax anywhere); jax and the engine load
+lazily inside the provers.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Any, Dict, List, Tuple
+
+from .report import Finding
+from .shard import SHARD_LANES, SHARD_SHAPE, plane_names, shard_trace
+
+DEFAULT_SKELETON_BASELINE = os.path.join(
+    os.path.dirname(__file__), "skeleton_baseline.json"
+)
+
+# the GL601 taxonomy (string-identical to engine/skeleton.py's — kept
+# as literals here so the jax-free paths never import the engine)
+SHARED = "SHARED"
+CASTABLE = "CASTABLE"
+PRIVATE = "PRIVATE"
+VERDICTS = (SHARED, CASTABLE, PRIVATE)
+
+# the fully-flagged fault plan GL602 proves composition with: every
+# device-supported capability at once (crash + degradation window +
+# probabilistic drops + horizon + jitter). Flags select traced graphs,
+# never avals — which is exactly what the prover checks.
+_COMPOSE_FAULTS = dict(
+    crashes={1: 500},
+    drop_bp=100,
+    drop_seed=7,
+    horizon_ms=4000,
+    jitter_max=4,
+    jitter_seed=3,
+)
+
+
+def full_grid_audits() -> Tuple[str, ...]:
+    """Every audit the skeleton unifies — the shard family's grid:
+    all dev protocols single-shard plus the partial-replication
+    variants at 2 shards."""
+    from ..registry import DEV_PROTOCOLS, PARTIAL_DEV_PROTOCOLS
+
+    return tuple(DEV_PROTOCOLS) + tuple(
+        f"{n}@2shards" for n in PARTIAL_DEV_PROTOCOLS
+    )
+
+
+# ----------------------------------------------------------------------
+# plane specs from the batched replay
+# ----------------------------------------------------------------------
+
+def plane_specs(trace, lanes: int = SHARD_LANES) -> Dict[str, tuple]:
+    """``{dotted-plane: (per-lane shape, dtype)}`` read off the
+    ``lanes``-wide batched replay's invars — the stacked lane-state
+    tree the megabatch actually allocates. Going through the replay
+    (rather than the unbatched avals) keeps GL601 honest about what
+    vmap materialises per plane and reuses the flatten + replay the
+    GL5xx family already memoizes on the shared TraceCache."""
+    _flat, invars, _outvars = trace.batched_flat_parts(lanes)
+    names = plane_names(trace)
+    assert len(names) == len(invars), (len(names), len(invars))
+    specs: Dict[str, tuple] = {}
+    for name, v in zip(names, invars):
+        shape = tuple(int(d) for d in v.aval.shape)
+        assert shape and shape[0] == lanes, (name, shape)
+        specs[name] = (shape[1:], str(v.aval.dtype))
+    return specs
+
+
+def specs_from_baseline(baseline: Dict[str, Any]) -> Dict[str, dict]:
+    """Rebuild ``{audit: {plane: (shape, dtype)}}`` from the
+    checked-in ledger's native specs — how narrowed runs (and the
+    selfcheck fixtures) recover the peers they did not trace."""
+    out: Dict[str, dict] = {}
+    for name, ent in baseline.get("planes", {}).items():
+        for audit, nat in ent.get("native", {}).items():
+            out.setdefault(audit, {})[name] = (
+                tuple(int(d) for d in nat["shape"]),
+                str(nat["dtype"]),
+            )
+    return out
+
+
+def attach_reasons(entries: Dict[str, dict], total_audits: int) -> None:
+    """Machine-derived evidence reasons, in place (hand annotation over
+    them is allowed and survives regeneration while the entry is
+    unchanged — write_skeleton_baseline)."""
+    for name, ent in entries.items():
+        nat = ent["native"]
+        dtypes = sorted({v["dtype"] for v in nat.values()})
+        ranks = sorted({len(v["shape"]) for v in nat.values()})
+        if ent["verdict"] == SHARED:
+            ent["reason"] = (
+                f"carried by all {total_audits} audits at rank "
+                f"{ranks[0]} {dtypes[0]}; union zero-pads to the "
+                f"elementwise max {ent['union']['shape']} — a "
+                "homogeneous lane never indexes the pad, which GL604 "
+                "pins by alpha-equivalence"
+            )
+        elif ent["verdict"] == CASTABLE:
+            ent["reason"] = (
+                f"dtypes {dtypes} widen losslessly to "
+                f"{ent['union']['dtype']}; pack casts up and unpack "
+                "casts back exactly, but GL001 interval bounds and "
+                "narrow_spec storage classes are derived at the NATIVE "
+                "dtype — re-derive both at the widened storage before "
+                "any in-union arithmetic"
+            )
+        elif len(nat) < total_audits:
+            ent["reason"] = (
+                f"carried by {len(nat)}/{total_audits} audits "
+                f"({', '.join(sorted(nat))}); per-audit slot in union "
+                "storage — every lane of a megabatch pays these bytes, "
+                "which GL603 budgets per declared grid"
+            )
+        else:
+            shapes = sorted(
+                {f"rank-{len(v['shape'])}" for v in nat.values()}
+            )
+            ent["reason"] = (
+                f"rank disagrees across audits ({', '.join(shapes)}) "
+                "or no lossless widen exists — no single union plane "
+                "both sides can index, so each audit gets its own "
+                "slot (GL603 budgets the bytes)"
+            )
+
+
+# ----------------------------------------------------------------------
+# baseline load / write / gate (GL601)
+# ----------------------------------------------------------------------
+
+def _norm(obj):
+    """Canonical JSON-ish form (tuples -> lists, keys -> str) so live
+    entries and checked-in entries compare equal."""
+    if isinstance(obj, dict):
+        return {str(k): _norm(v) for k, v in sorted(obj.items())}
+    if isinstance(obj, (list, tuple)):
+        return [_norm(v) for v in obj]
+    return obj
+
+
+def norm_grids(grids: Dict[str, Any]) -> Dict[str, Any]:
+    return {
+        str(g): {
+            "audits": sorted(str(a) for a in spec["audits"]),
+            "max_amplification": float(spec["max_amplification"]),
+        }
+        for g, spec in grids.items()
+    }
+
+
+def load_skeleton_baseline(
+    path: str = DEFAULT_SKELETON_BASELINE,
+) -> Dict[str, Any]:
+    """``{"lanes", "shape", "audits", "grids", "planes": {name:
+    {verdict, reason, union?, native}}}``; a missing file is an empty
+    ledger (the gate then raises a bootstrap finding, which is how the
+    first ``--write-skeleton-baseline`` run is seeded)."""
+    if not os.path.exists(path):
+        return {"audits": [], "grids": {}, "planes": {}}
+    with open(path) as fh:
+        data = json.load(fh)
+    return {
+        "lanes": int(data.get("lanes", SHARD_LANES)),
+        "shape": dict(data.get("shape", {})),
+        "audits": [str(a) for a in data.get("audits", [])],
+        "grids": {
+            str(g): dict(v)
+            for g, v in data.get("grids", {}).items()
+            if not str(g).startswith("_")
+        },
+        "planes": {
+            str(k): dict(v)
+            for k, v in data.get("planes", {}).items()
+            if not str(k).startswith("_")
+        },
+    }
+
+
+def write_skeleton_baseline(path: str, ledger: Dict[str, Any]) -> None:
+    """Write the unification ledger. Regeneration preserves a
+    hand-edited reason while the entry (verdict + union slot + native
+    specs) is unchanged — the auto reason is machine-derived evidence,
+    so annotating over it is allowed but never required; stripping a
+    reason by hand is what the reasonless gate catches."""
+    from ..engine.checkpoint import atomic_write, canonical_json
+
+    existing = (
+        load_skeleton_baseline(path)["planes"]
+        if os.path.exists(path)
+        else {}
+    )
+    planes: Dict[str, Any] = {}
+    for name in sorted(ledger["planes"]):
+        ent = dict(_norm(ledger["planes"][name]))
+        old = existing.get(name)
+        if (
+            old is not None
+            and _norm(old.get("verdict")) == ent.get("verdict")
+            and _norm(old.get("union")) == ent.get("union")
+            and _norm(old.get("native")) == ent.get("native")
+            and str(old.get("reason", "")).strip()
+            and not str(old.get("reason", "")).startswith("UNREVIEWED")
+        ):
+            ent["reason"] = old["reason"]
+        planes[name] = ent
+    payload = {
+        "_comment": (
+            "GL601 skeleton-unification ledger: dotted plane -> "
+            "{verdict, reason, union storage slot, per-audit native "
+            "specs}. SHARED = same rank+dtype in every audit, padded "
+            "to the elementwise max; CASTABLE = storage widened to a "
+            "dtype every native dtype casts to losslessly; PRIVATE = "
+            "per-audit slot in union storage (the bytes GL603 budgets "
+            "per engine/dims.py SKELETON_GRIDS composition, also "
+            "recorded here for the jax-free bench metric). Regenerate "
+            "with `python -m fantoch_tpu.cli lint "
+            "--write-skeleton-baseline` and REVIEW the diff — any "
+            "drift is the regression this file exists to catch, and "
+            "an entry without a reason fails the gate itself "
+            "(docs/LINT.md#gl601)."
+        ),
+        "lanes": SHARD_LANES,
+        "shape": SHARD_SHAPE,
+        "audits": sorted(str(a) for a in ledger["audits"]),
+        "grids": norm_grids(ledger["grids"]),
+        "planes": planes,
+    }
+    atomic_write(path, canonical_json(payload, indent=2) + "\n")
+
+
+def gate_skeleton_ledger(
+    entries: Dict[str, dict],
+    audits,
+    grids: Dict[str, Any],
+    baseline: Dict[str, Any],
+) -> Tuple[List[Finding], List[str]]:
+    """Compare the computed unification ledger to the checked-in one.
+    Returns (findings, stale-planes). A new plane, drift in EITHER
+    direction (verdict, union slot, native specs, the audit grid, or a
+    declared grid composition), and a reasonless/UNREVIEWED entry all
+    fail; stale planes stay advisory (runs can be narrowed)."""
+    findings: List[Finding] = []
+    base = baseline.get("planes") or {}
+    if not base:
+        findings.append(
+            Finding(
+                "GL601",
+                "skeleton",
+                "skeleton_baseline",
+                "no unification ledger checked in — run `python -m "
+                "fantoch_tpu.cli lint --write-skeleton-baseline` and "
+                "review every verdict",
+            )
+        )
+        return findings, []
+    if sorted(baseline.get("audits", [])) != sorted(audits):
+        findings.append(
+            Finding(
+                "GL601",
+                "skeleton",
+                "audits",
+                f"audit grid drift: ledger unifies "
+                f"{sorted(baseline.get('audits', []))}, this run "
+                f"unifies {sorted(audits)} — regenerate with "
+                "--write-skeleton-baseline and review",
+            )
+        )
+    base_grids = norm_grids(baseline.get("grids", {}))
+    live_grids = norm_grids(grids)
+    for g in sorted(set(base_grids) | set(live_grids)):
+        if base_grids.get(g) != live_grids.get(g):
+            findings.append(
+                Finding(
+                    "GL601",
+                    "skeleton",
+                    f"grids:{g}",
+                    f"declared grid composition drift for {g!r}: "
+                    f"ledger says {base_grids.get(g)}, "
+                    f"engine/dims.py SKELETON_GRIDS says "
+                    f"{live_grids.get(g)} — regenerate and review "
+                    "(budget changes are reviewed diffs, never silent)",
+                )
+            )
+    for name in sorted(entries):
+        ent, old = _norm(entries[name]), base.get(name)
+        if old is None:
+            findings.append(
+                Finding(
+                    "GL601",
+                    "skeleton",
+                    name,
+                    f"NEW state plane (verdict {ent['verdict']}) "
+                    "absent from lint/skeleton_baseline.json — "
+                    "regenerate with --write-skeleton-baseline and "
+                    "review",
+                )
+            )
+            continue
+        old = _norm(old)
+        if old.get("verdict") != ent["verdict"]:
+            findings.append(
+                Finding(
+                    "GL601",
+                    "skeleton",
+                    name,
+                    f"skeleton verdict changed: {old.get('verdict')} "
+                    f"-> {ent['verdict']} ({ent.get('reason', '')}) — "
+                    "if intentional, regenerate the baseline and "
+                    "re-review every consumer of this plane's slot",
+                )
+            )
+        elif old.get("union") != ent.get("union"):
+            findings.append(
+                Finding(
+                    "GL601",
+                    "skeleton",
+                    name,
+                    f"union storage slot changed: {old.get('union')} "
+                    f"-> {ent.get('union')} — a slot change "
+                    "invalidates every packed artifact; regenerate "
+                    "and review",
+                )
+            )
+        elif old.get("native") != ent.get("native"):
+            drifted = sorted(
+                a
+                for a in set(old.get("native", {}))
+                | set(ent.get("native", {}))
+                if _norm(old.get("native", {}).get(a))
+                != _norm(ent.get("native", {}).get(a))
+            )
+            findings.append(
+                Finding(
+                    "GL601",
+                    "skeleton",
+                    name,
+                    f"native spec drift for {drifted}: the audited "
+                    "step's plane shape/dtype no longer matches the "
+                    "ledger — regenerate with "
+                    "--write-skeleton-baseline and review",
+                )
+            )
+    for name in sorted(base):
+        reason = str(base[name].get("reason", ""))
+        if not reason.strip() or reason.startswith("UNREVIEWED"):
+            findings.append(
+                Finding(
+                    "GL601",
+                    "skeleton",
+                    f"{name}:reasonless",
+                    f"baselined plane {name} carries no evidence "
+                    "reason — every entry in "
+                    "lint/skeleton_baseline.json must say WHY the "
+                    "verdict holds",
+                )
+            )
+    stale = sorted(k for k in base if k not in entries)
+    return findings, stale
+
+
+# ----------------------------------------------------------------------
+# GL602: branch-compatibility prover
+# ----------------------------------------------------------------------
+
+def _sig_leaves(tree, prefix="") -> Dict[str, tuple]:
+    """Flatten a nested dict of ShapeDtypeStructs/arrays to
+    ``{dotted: (shape, dtype)}``."""
+    out: Dict[str, tuple] = {}
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            sub = f"{prefix}.{k}" if prefix else str(k)
+            out.update(_sig_leaves(tree[k], sub))
+    else:
+        out[prefix] = (
+            tuple(int(d) for d in tree.shape), str(tree.dtype)
+        )
+    return out
+
+
+def _union_avals(skeleton, prefix: str):
+    """The packed union tree as ShapeDtypeStructs — identical for
+    every audit, which is the half of the switch precondition
+    :func:`branch_signature` gets by construction."""
+    import jax
+
+    from ..engine.skeleton import packed_spec
+
+    def to_avals(node):
+        if isinstance(node, dict):
+            return {k: to_avals(v) for k, v in node.items()}
+        shape, dtype = node
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    return to_avals(packed_spec(skeleton, prefix))
+
+
+def branch_signature(skeleton, trace) -> Dict[str, tuple]:
+    """Abstractly trace one audit's branch through the unified
+    signature — unpack union state/ctx, run the legacy step, repack —
+    and return the flattened output avals. Raises
+    ``SkeletonMismatchError`` (refusal by name) when the union cannot
+    cover the audit's native planes."""
+    import jax
+
+    from ..engine.core import _lane_step
+    from ..engine.skeleton import (
+        pack_state,
+        unpack_ctx,
+        unpack_state,
+    )
+
+    audit = trace.name
+
+    def branch(packed_state, packed_ctx):
+        import jax.numpy as jnp
+
+        st = unpack_state(skeleton, audit, packed_state, xp=jnp)
+        cx = unpack_ctx(skeleton, audit, packed_ctx, xp=jnp)
+        out = _lane_step(
+            trace.protocol, trace.dims, st, cx, False, trace.faults,
+            trace.monitor_keys,
+        )
+        return pack_state(skeleton, audit, out, xp=jnp)
+
+    out = jax.eval_shape(
+        branch,
+        _union_avals(skeleton, "state"),
+        _union_avals(skeleton, "ctx"),
+    )
+    return _sig_leaves(out)
+
+
+def check_branches(
+    traces: Dict[str, Any], skeleton, progress=None,
+) -> List[Finding]:
+    """GL602 proper: every audited branch, traced against the unified
+    abstract state, must produce the union's own avals — which makes
+    all branches pairwise identical AND re-packable, the full
+    ``lax.switch`` precondition. The first incompatible leaf is cited
+    by plane, protocol and dtype."""
+    from ..engine.skeleton import SkeletonMismatchError, packed_spec
+
+    say = progress or (lambda msg: None)
+    findings: List[Finding] = []
+    want = _spec_leaves(packed_spec(skeleton, "state"))
+    for audit in sorted(traces):
+        say(f"skeleton: proving branch {audit}")
+        try:
+            got = branch_signature(skeleton, traces[audit])
+        except SkeletonMismatchError as e:
+            findings.append(
+                Finding(
+                    "GL602",
+                    audit,
+                    "pack",
+                    f"branch cannot trace through the unified "
+                    f"signature — {e}",
+                )
+            )
+            continue
+        except Exception as e:  # noqa: BLE001 — cited, not swallowed
+            findings.append(
+                Finding(
+                    "GL602",
+                    audit,
+                    "trace",
+                    f"branch failed to trace against the unified "
+                    f"abstract state: {type(e).__name__}: {e}",
+                )
+            )
+            continue
+        for leaf in sorted(set(want) | set(got)):
+            if want.get(leaf) == got.get(leaf):
+                continue
+            findings.append(
+                Finding(
+                    "GL602",
+                    audit,
+                    leaf,
+                    f"branch output aval for plane {leaf} is "
+                    f"{got.get(leaf)}, the union signature says "
+                    f"{want.get(leaf)} — lax.switch requires "
+                    "identical avals across all branches",
+                )
+            )
+            break  # cite the FIRST incompatible leaf per audit
+    return findings
+
+
+def _spec_leaves(spec, prefix="") -> Dict[str, tuple]:
+    out: Dict[str, tuple] = {}
+    if isinstance(spec, dict):
+        for k in sorted(spec):
+            sub = f"{prefix}.{k}" if prefix else str(k)
+            out.update(_spec_leaves(spec[k], sub))
+    else:
+        shape, dtype = spec
+        out[prefix] = (tuple(int(d) for d in shape), str(dtype))
+    return out
+
+
+def check_fault_composition(skeleton, cache=None) -> List[Finding]:
+    """GL602's fault-mask leg: a tempo trace with EVERY device fault
+    capability flagged on must produce the same unified signature as
+    the plain branch — flags select traced graphs, never avals, so
+    fault-free and faulty lanes of one megabatch share the switch."""
+    from ..engine.faults import FaultPlan, LinkWindow
+    from ..engine.skeleton import SkeletonMismatchError
+    from .jaxpr import build_protocol_trace
+
+    plan = FaultPlan(
+        windows=(LinkWindow(0, 1, 100, 200, mult=2),),
+        **_COMPOSE_FAULTS,
+    )
+    build = lambda: build_protocol_trace(  # noqa: E731
+        "tempo", faults=plan, audit="tempo", **SHARD_SHAPE
+    )
+    trace = (
+        cache.get(("skeleton-faulted", "tempo"), build)
+        if cache is not None
+        else build()
+    )
+    plain = shard_trace("tempo", cache=cache)
+    try:
+        faulted_sig = branch_signature(skeleton, trace)
+        plain_sig = branch_signature(skeleton, plain)
+    except SkeletonMismatchError as e:
+        return [
+            Finding(
+                "GL602",
+                "tempo",
+                "faults",
+                f"fault masks do not compose through the unified "
+                f"signature — {e}",
+            )
+        ]
+    for leaf in sorted(set(plain_sig) | set(faulted_sig)):
+        if plain_sig.get(leaf) != faulted_sig.get(leaf):
+            return [
+                Finding(
+                    "GL602",
+                    "tempo",
+                    "faults",
+                    f"fully-flagged fault plan changes the unified "
+                    f"signature at plane {leaf}: "
+                    f"{plain_sig.get(leaf)} -> "
+                    f"{faulted_sig.get(leaf)} — fault flags must "
+                    "select graphs, never avals",
+                )
+            ]
+    return []
+
+
+def check_monitor_refusal(skeleton, trace) -> List[Finding]:
+    """GL602's monitor leg: the skeleton's grid is monitor-free
+    (monitor planes are fuzz-run state, structure-gated like ctx
+    keys), so a state carrying planes the skeleton does not know must
+    be REFUSED by name — silent absorption would drop a fuzz run's
+    monitor verdicts on the floor."""
+    import numpy as np
+
+    from ..engine.skeleton import SkeletonMismatchError, pack_state
+
+    probed = dict(trace.state)
+    probed["monitor_probe"] = np.zeros((2,), np.int32)
+    try:
+        pack_state(skeleton, trace.name, probed)
+    except SkeletonMismatchError:
+        return []  # refusal by name: monitor gating composes
+    return [
+        Finding(
+            "GL602",
+            trace.name,
+            "monitor",
+            "a state carrying a plane outside the proven skeleton "
+            "(a monitored fuzz state) was silently absorbed by "
+            "pack_state instead of refused by name — monitor gating "
+            "no longer composes through the unified signature",
+        )
+    ]
+
+
+# ----------------------------------------------------------------------
+# GL603: padding-amplification gate (stdlib arithmetic — shared by the
+# live gate and the jax-free bench metric)
+# ----------------------------------------------------------------------
+
+def _dtype_bytes(dtype: str) -> int:
+    if dtype == "bool":
+        return 1
+    digits = "".join(ch for ch in str(dtype) if ch.isdigit())
+    assert digits, f"cannot size dtype {dtype!r}"
+    return max(1, int(digits) // 8)
+
+
+def _plane_bytes(shape, dtype: str) -> int:
+    return math.prod(int(d) for d in shape) * _dtype_bytes(dtype)
+
+
+def grid_amplification(
+    planes: Dict[str, dict], grid_audits,
+) -> Dict[str, Any]:
+    """Per-lane resident bytes of the union skeleton RESTRICTED to one
+    grid composition, vs each member's native bytes. The restriction
+    matters: a per-grid skeleton pads shared planes only to the grid
+    members' max and slots only their private planes, so a tempo-only
+    grid never pays caesar's extents. Streaming caveat: this counts
+    resident state/ctx planes, not transient fusion intermediates —
+    GL202 budgets those; the two gates are complementary, not
+    redundant."""
+    grid_audits = sorted(grid_audits)
+    union_bytes = 4  # the protocol_id i32 lane plane
+    native = {a: 0 for a in grid_audits}
+    for name in sorted(planes):
+        ent = planes[name]
+        nat = ent.get("native", {})
+        carriers = [a for a in grid_audits if a in nat]
+        if not carriers:
+            continue
+        for a in carriers:
+            native[a] += _plane_bytes(
+                nat[a]["shape"], nat[a]["dtype"]
+            )
+        if ent["verdict"] == PRIVATE:
+            union_bytes += sum(
+                _plane_bytes(nat[a]["shape"], nat[a]["dtype"])
+                for a in carriers
+            )
+        else:
+            rank = len(nat[carriers[0]]["shape"])
+            shape = [
+                max(int(nat[a]["shape"][i]) for a in carriers)
+                for i in range(rank)
+            ]
+            union_bytes += _plane_bytes(
+                shape, ent["union"]["dtype"]
+            )
+    audits = {
+        a: {
+            "native_bytes": native[a],
+            "amplification": round(union_bytes / max(1, native[a]), 3),
+        }
+        for a in grid_audits
+    }
+    worst = max(
+        audits, key=lambda a: audits[a]["amplification"]
+    )
+    return {
+        "union_bytes": union_bytes,
+        "audits": audits,
+        "worst": worst,
+        "max_amplification": audits[worst]["amplification"],
+    }
+
+
+def amplification_findings(
+    planes: Dict[str, dict], grids: Dict[str, Any],
+) -> Tuple[List[Finding], Dict[str, Any]]:
+    """GL603 over every declared grid composition: the worst member's
+    amplification must stay under the declared budget, and a grid
+    naming an audit the ledger does not know is itself a finding (a
+    budget against nothing proves nothing)."""
+    findings: List[Finding] = []
+    summary: Dict[str, Any] = {}
+    known = {
+        a
+        for ent in planes.values()
+        for a in ent.get("native", {})
+    }
+    for gname in sorted(grids):
+        spec = grids[gname]
+        audits = sorted(str(a) for a in spec["audits"])
+        budget = float(spec["max_amplification"])
+        unknown = sorted(set(audits) - known)
+        if unknown:
+            findings.append(
+                Finding(
+                    "GL603",
+                    gname,
+                    "audits",
+                    f"grid composition {gname!r} names audits the "
+                    f"GL601 ledger does not cover: {unknown} — the "
+                    "amplification budget is unverifiable",
+                )
+            )
+            continue
+        amp = grid_amplification(planes, audits)
+        amp["budget"] = budget
+        summary[gname] = amp
+        if amp["max_amplification"] > budget:
+            findings.append(
+                Finding(
+                    "GL603",
+                    amp["worst"],
+                    gname,
+                    f"grid {gname!r} amplifies {amp['worst']} "
+                    f"{amp['max_amplification']}x (union "
+                    f"{amp['union_bytes']}B over native "
+                    f"{amp['audits'][amp['worst']]['native_bytes']}B)"
+                    f" past the declared budget {budget}x "
+                    "(engine/dims.py SKELETON_GRIDS) — shrink the "
+                    "composition or raise the budget in a reviewed "
+                    "diff",
+                )
+            )
+    return findings, summary
+
+
+# ----------------------------------------------------------------------
+# GL604: single-protocol no-regression pin
+# ----------------------------------------------------------------------
+
+def check_no_regression(trace, skeleton) -> List[Finding]:
+    """Pack one audit's state and ctx through the skeleton, unpack,
+    and prove (a) the round-trip byte-exact per plane and (b) the
+    step re-traced over the round-tripped trees alpha-equivalent
+    (GL005's prover) to the legacy trace — the property that keeps
+    existing checkpoints, AOT keys and XLA cache entries valid for
+    homogeneous batches."""
+    import numpy as np
+
+    from ..engine.skeleton import (
+        SkeletonMismatchError,
+        pack_ctx,
+        pack_state,
+        unpack_ctx,
+        unpack_state,
+        walk_planes,
+    )
+    from .gating import alpha_equivalent
+    from .jaxpr import trace_step
+
+    audit = trace.name
+    findings: List[Finding] = []
+    try:
+        rt_state = unpack_state(
+            skeleton, audit, pack_state(skeleton, audit, trace.state)
+        )
+        rt_ctx = unpack_ctx(
+            skeleton, audit, pack_ctx(skeleton, audit, trace.ctx)
+        )
+    except SkeletonMismatchError as e:
+        return [
+            Finding(
+                "GL604",
+                audit,
+                "roundtrip",
+                f"pack/unpack refused the audited step's own trees — "
+                f"{e}",
+            )
+        ]
+    for native, rt, prefix in (
+        (trace.state, rt_state, "state"),
+        (trace.ctx, rt_ctx, "ctx"),
+    ):
+        a, b = walk_planes(native, prefix), walk_planes(rt, prefix)
+        if sorted(a) != sorted(b):
+            findings.append(
+                Finding(
+                    "GL604",
+                    audit,
+                    prefix,
+                    f"round-trip changed the {prefix} tree structure: "
+                    f"lost {sorted(set(a) - set(b))}, grew "
+                    f"{sorted(set(b) - set(a))}",
+                )
+            )
+            continue
+        for name in sorted(a):
+            na, nb = np.asarray(a[name]), np.asarray(b[name])
+            if (
+                na.shape != nb.shape
+                or na.dtype != nb.dtype
+                or na.tobytes() != nb.tobytes()
+            ):
+                findings.append(
+                    Finding(
+                        "GL604",
+                        audit,
+                        name,
+                        f"round-trip is not byte-exact at {name}: "
+                        f"{na.shape}/{na.dtype} -> "
+                        f"{nb.shape}/{nb.dtype}",
+                    )
+                )
+                break  # first plane is the story; the rest is noise
+    if findings:
+        return findings
+    rt_closed = trace_step(
+        trace.protocol, trace.dims, rt_state, rt_ctx, trace.faults,
+        trace.monitor_keys, name=audit,
+    ).closed
+    ok, why = alpha_equivalent(trace.closed, rt_closed)
+    if not ok:
+        findings.append(
+            Finding(
+                "GL604",
+                audit,
+                "step",
+                f"a homogeneous batch packed through the skeleton no "
+                f"longer traces the legacy step: {why} — existing "
+                "checkpoints, AOT keys and XLA cache entries would "
+                "not survive",
+            )
+        )
+    return findings
+
+
+# ----------------------------------------------------------------------
+# the driver
+# ----------------------------------------------------------------------
+
+def run_skeleton(
+    protocols=None,
+    include_partial: bool = True,
+    cache=None,
+    baseline: "Dict[str, Any] | None" = None,
+    progress=None,
+) -> Tuple[List[Finding], Dict[str, Any]]:
+    """The full GL601-GL604 pass. Narrowed runs (``protocols=``) trace
+    only the named audits and take the peers' native specs from the
+    checked-in ledger, so the cross-protocol union stays the full
+    grid; GL602/GL604 then prove only the live audits (which is why
+    --write-skeleton-baseline refuses narrowed runs)."""
+    from ..engine.dims import SKELETON_GRIDS
+    from ..engine.skeleton import build_skeleton, classify_planes
+    from ..registry import DEV_PROTOCOLS, PARTIAL_DEV_PROTOCOLS
+
+    say = progress or (lambda msg: None)
+    if baseline is None:
+        baseline = load_skeleton_baseline()
+
+    names = list(protocols) if protocols else list(DEV_PROTOCOLS)
+    audits = [(n, 1) for n in names]
+    if include_partial:
+        audits += [
+            (n, 2) for n in PARTIAL_DEV_PROTOCOLS if n in names
+        ]
+
+    findings: List[Finding] = []
+    traces: Dict[str, Any] = {}
+    for name, shards in audits:
+        audit = name if shards == 1 else f"{name}@{shards}shards"
+        say(f"skeleton: tracing {audit}")
+        traces[audit] = shard_trace(name, shards, cache)
+
+    live_specs = {a: plane_specs(t) for a, t in traces.items()}
+    specs = dict(live_specs)
+    base_specs = specs_from_baseline(baseline)
+    for audit in full_grid_audits():
+        if audit not in specs and audit in base_specs:
+            specs[audit] = base_specs[audit]
+    missing = sorted(set(full_grid_audits()) - set(specs))
+    if missing:
+        findings.append(
+            Finding(
+                "GL601",
+                "skeleton",
+                "skeleton_baseline",
+                f"cannot form the cross-protocol union: audits "
+                f"{missing} are neither traced by this run nor "
+                "covered by the checked-in ledger — run unnarrowed "
+                "(or --write-skeleton-baseline first)",
+            )
+        )
+        return findings, {
+            "lanes": SHARD_LANES,
+            "audits": {a: {"planes": len(s)} for a, s in
+                       sorted(live_specs.items())},
+            "planes": {},
+            "amplification": {},
+        }
+
+    say("skeleton: classifying the cross-protocol union")
+    entries = classify_planes(specs)
+    attach_reasons(entries, len(specs))
+
+    f601, stale = gate_skeleton_ledger(
+        entries, sorted(specs), SKELETON_GRIDS, baseline
+    )
+    findings.extend(f601)
+
+    skeleton = build_skeleton(entries, audits=sorted(specs))
+    findings.extend(check_branches(traces, skeleton, progress=say))
+    if "tempo" in traces:
+        say("skeleton: proving fault/monitor composition")
+        findings.extend(check_fault_composition(skeleton, cache))
+        findings.extend(
+            check_monitor_refusal(skeleton, traces["tempo"])
+        )
+
+    f603, amp = amplification_findings(entries, SKELETON_GRIDS)
+    findings.extend(f603)
+
+    for audit in sorted(traces):
+        say(f"skeleton: pinning no-regression for {audit}")
+        findings.extend(check_no_regression(traces[audit], skeleton))
+
+    counts = {v: 0 for v in VERDICTS}
+    for ent in entries.values():
+        counts[ent["verdict"]] += 1
+    summary = {
+        "lanes": SHARD_LANES,
+        "audits": {
+            a: {"planes": len(live_specs[a])}
+            for a in sorted(live_specs)
+        },
+        "planes": counts,
+        "amplification": amp,
+        "stale": stale,
+        # the live ledger rides on the summary only for
+        # --write-skeleton-baseline (never re-traced for the write)
+        "ledger": {
+            "audits": sorted(specs),
+            "grids": SKELETON_GRIDS,
+            "planes": entries,
+        },
+    }
+    return findings, summary
+
+
+# ----------------------------------------------------------------------
+# selfchecks (CI broken-fixture contract)
+# ----------------------------------------------------------------------
+
+_SELFCHECK_FIXTURES = {
+    "union": ("skeleton_bad_union.py", "GL601"),
+    "branch": ("skeleton_bad_branch.py", "GL602"),
+    "pad": ("skeleton_bad_pad.py", "GL603"),
+}
+
+
+def _load_fixture(kind: str):
+    import importlib.util
+
+    from .determinism import REPO_ROOT
+
+    fixture, rule = _SELFCHECK_FIXTURES[kind]
+    path = os.path.join(REPO_ROOT, "tests", "fixtures", fixture)
+    spec = importlib.util.spec_from_file_location(
+        f"_skeleton_fixture_{kind}", path
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod, rule
+
+
+def run_skeleton_selfcheck(
+    kind: str,
+) -> Tuple[List[Finding], Dict[str, Any]]:
+    """The CI broken-fixture check: each seeded defect must produce at
+    least one finding *naming its rule* against the real checked-in
+    artifacts, or the gate is vacuously green. ``union`` reclassifies
+    specs with one plane's dtype drifted against the real ledger;
+    ``branch`` proves a tempo branch against a skeleton whose union
+    extent was shrunk below the native extent; ``pad`` budgets the
+    real ledger against an impossible amplification declaration."""
+    from ..engine.dims import SKELETON_GRIDS
+    from ..engine.skeleton import build_skeleton, classify_planes
+
+    mod, rule = _load_fixture(kind)
+    baseline = load_skeleton_baseline()
+    if kind == "union":
+        specs = mod.plane_specs()
+        entries = classify_planes(specs)
+        attach_reasons(entries, len(specs))
+        findings, _stale = gate_skeleton_ledger(
+            entries, sorted(specs), SKELETON_GRIDS, baseline
+        )
+    elif kind == "branch":
+        entries = mod.mutate_planes(
+            {k: dict(v) for k, v in baseline["planes"].items()}
+        )
+        skeleton = build_skeleton(
+            entries, audits=baseline["audits"]
+        )
+        findings = check_branches(
+            {"tempo": shard_trace("tempo")}, skeleton
+        )
+    else:
+        findings, _summary = amplification_findings(
+            baseline["planes"], mod.GRIDS
+        )
+    findings = [f for f in findings if f.rule == rule]
+    summary = {"selfcheck_rule": rule, "findings": len(findings)}
+    return findings, summary
+
+
+# ----------------------------------------------------------------------
+# bench.py metric (device-free, jax-free)
+# ----------------------------------------------------------------------
+
+def skeleton_waste_summary(
+    path: str = DEFAULT_SKELETON_BASELINE,
+) -> Dict[str, Any]:
+    """Unified bytes / native bytes per protocol, for every declared
+    grid composition in the *checked-in* GL601 ledger — bench.py's
+    ``skeleton_waste_ratio`` metric. Reads only the JSON artifact (no
+    jax, no trace): the lint gate proves the artifact matches HEAD, so
+    the static ratios are honest even where no device is reachable."""
+    baseline = load_skeleton_baseline(path)
+    planes = baseline.get("planes", {})
+    counts = {v: 0 for v in VERDICTS}
+    for ent in planes.values():
+        v = str(ent.get("verdict", ""))
+        if v in counts:
+            counts[v] += 1
+    grids: Dict[str, Any] = {}
+    for gname, spec in sorted(baseline.get("grids", {}).items()):
+        amp = grid_amplification(planes, spec["audits"])
+        amp["budget"] = float(spec["max_amplification"])
+        grids[gname] = amp
+    return {
+        "audits": len(baseline.get("audits", [])),
+        "planes": counts,
+        "grids": grids,
+        "lanes": baseline.get("lanes"),
+    }

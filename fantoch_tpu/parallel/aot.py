@@ -112,6 +112,7 @@ def _slot_hash(signature: Dict[str, str]) -> str:
 def executable_signature(step_sig: Dict[str, str], *, lanes: int,
                          window: int, donate: bool, narrow: tuple,
                          sharding: str = "",
+                         skeleton: str = "",
                          ) -> Dict[str, str]:
     """The full identity of one batched sweep executable. ``step_sig``
     is the checkpoint-layer per-lane signature
@@ -124,7 +125,12 @@ def executable_signature(step_sig: Dict[str, str], *, lanes: int,
     ``shard_lanes=False`` single-device run and a lane-sharded run of
     the same padded lane count compile genuinely different
     executables, so they must occupy different slots rather than
-    mis-load each other's artifact."""
+    mis-load each other's artifact. ``skeleton`` is the megabatch
+    union-state fingerprint (engine/skeleton.py
+    ``skeleton_fingerprint``) when the executable was compiled over
+    the packed union trees rather than a protocol's native state; the
+    key is present only when set, so every legacy artifact's
+    signature — and the slot hash naming its files — is unchanged."""
     import jax
     import jaxlib
 
@@ -136,6 +142,7 @@ def executable_signature(step_sig: Dict[str, str], *, lanes: int,
         donate=repr(bool(donate)),
         narrow=repr(tuple(tuple(e) for e in narrow)),
         sharding=str(sharding),
+        **({"skeleton": str(skeleton)} if skeleton else {}),
         jaxlib=jaxlib.__version__,
         platform=jax.default_backend(),
         device_count=repr(jax.device_count()),
@@ -330,7 +337,7 @@ def _compile_self_contained(build, state, ctx, untils, *,
 
 def get_runner(spec: "AotSpec", step_sig: Dict[str, str], *,
                build, state, ctx, untils, window: int, donate: bool,
-               narrow: tuple):
+               narrow: tuple, skeleton: str = ""):
     """The one entry point ``run_sweep`` uses: return a windowed sweep
     runner ``(state, ctx, untils) -> (state, any_alive)`` for this
     exact batch, loading a serialized executable when the campaign dir
@@ -353,6 +360,7 @@ def get_runner(spec: "AotSpec", step_sig: Dict[str, str], *,
         # already device_put by the caller); NamedSharding reprs are
         # stable across processes for the same mesh topology
         sharding=repr(getattr(leaf, "sharding", "")),
+        skeleton=skeleton,
     )
     example_out = (state, jnp.asarray(True))
     t0 = time.perf_counter()

@@ -151,12 +151,22 @@ SCAN_WINDOW_TARGET_STEPS = 1 << 15
 SCAN_WINDOW_MAX = 8
 
 
-def default_scan_window(segment_steps: int) -> int:
-    """The `scan_window=None` resolution rule (documented above)."""
+def default_scan_window(segment_steps: int, skeleton: bool = False) -> int:
+    """The `scan_window=None` resolution rule (documented above).
+
+    The cap assumes homogeneous lane trees: a megabatch lane packed
+    into the union skeleton (engine/skeleton.py) holds the union's
+    resident bytes — up to the declared `max_amplification` of its grid
+    (engine/dims.py SKELETON_GRIDS) more than its native state — so a
+    window that was a bounded device execution for native lanes is not
+    one for skeleton lanes. `skeleton=True` halves the cap; the target
+    -steps packing rule is unchanged (per-step cost, not per-window,
+    is what amplification does not touch)."""
+    cap = SCAN_WINDOW_MAX // 2 if skeleton else SCAN_WINDOW_MAX
     return max(
         1,
         min(
-            SCAN_WINDOW_MAX,
+            max(1, cap),
             SCAN_WINDOW_TARGET_STEPS // max(1, int(segment_steps)),
         ),
     )
@@ -335,6 +345,7 @@ def run_sweep(
     narrow: bool = True,
     scan_window: "int | None" = None,
     aot=None,
+    skeleton=None,
 ) -> List[LaneResults]:
     """Run a sweep batch, sharded over ``mesh`` (default: all local
     devices on one axis). The device loop runs in ``segment_steps``
@@ -444,6 +455,21 @@ def run_sweep(
     .AotMismatchError`), never silently misloaded. Incompatible with
     ``mesh_shard`` (the shard_map layout is not serialized).
 
+    ``skeleton`` marks a run whose lane state is packed through the
+    megabatch union skeleton (engine/skeleton.py) rather than the
+    protocol's native trees: pass the :class:`~fantoch_tpu.engine
+    .skeleton.Skeleton` (fingerprinted via ``skeleton_fingerprint``)
+    or a precomputed fingerprint string. The marker rides in the AOT
+    executable signature and the checkpoint manifest, so a resume or
+    AOT load across *different* skeletons — or between a skeleton and
+    a native run — is refused BY NAME instead of misinterpreting the
+    packed planes; unmarked (legacy) artifacts are untouched because
+    the key exists only when the marker is set. It also halves the
+    default scan-window cap (:func:`default_scan_window`): union lanes
+    carry up to their grid's declared amplification more resident
+    bytes per lane, so a bounded window for native lanes is not one
+    for skeleton lanes.
+
     ``checkpoint`` (a :class:`~fantoch_tpu.engine.checkpoint
     .CheckpointSpec` or a bare path) makes the run durable: the full
     batched state is saved at window boundaries (the existing
@@ -476,7 +502,8 @@ def run_sweep(
         return _run_sweep(
             protocol, dims, specs, mesh, max_steps, segment_steps,
             monitor_keys, shard_lanes, mesh_shard, state_shards,
-            checkpoint, pipeline_depth, narrow, scan_window, aot, mark,
+            checkpoint, pipeline_depth, narrow, scan_window, aot,
+            skeleton, mark,
         )
     finally:
         # the per-phase timings land on EVERY exit path — an early
@@ -494,13 +521,22 @@ def run_sweep(
 def _run_sweep(
     protocol, dims, specs, mesh, max_steps, segment_steps, monitor_keys,
     shard_lanes, mesh_shard, state_shards, checkpoint, pipeline_depth,
-    narrow, scan_window, aot, mark,
+    narrow, scan_window, aot, skeleton, mark,
 ) -> List[LaneResults]:
     from . import aot as aot_mod
     from . import partition
 
+    skeleton_marker = ""
+    if skeleton is not None:
+        from ..engine.skeleton import Skeleton, skeleton_fingerprint
+
+        skeleton_marker = (
+            skeleton_fingerprint(skeleton)
+            if isinstance(skeleton, Skeleton)
+            else str(skeleton)
+        )
     win = (
-        default_scan_window(segment_steps)
+        default_scan_window(segment_steps, skeleton=bool(skeleton_marker))
         if scan_window is None
         else max(1, int(scan_window))
     )
@@ -716,6 +752,14 @@ def _run_sweep(
             # BY NAME instead of dying on a carry-dtype mismatch deep
             # inside the runner trace
             "narrow": [list(e) for e in nspec],
+            # the megabatch union-state fingerprint, present ONLY when
+            # this run packs lanes through a skeleton: a native resume
+            # of a skeleton checkpoint (or vice versa, or a different
+            # skeleton) is refused BY NAME below, while every legacy
+            # artifact — which has no such key — stays loadable
+            **(
+                {"skeleton": skeleton_marker} if skeleton_marker else {}
+            ),
             "specs": [
                 {
                     "n": s.config.n,
@@ -748,6 +792,13 @@ def _run_sweep(
             # onto a different arrival schedule is refused by name
             # (the ol_arrival table is also bit-compared via the ctx)
             expect_keys.append("arrivals")
+        if skeleton_marker:
+            # skeleton-packed runs demand the marker by name; native
+            # runs leave the key out entirely (legacy-compat, same rule
+            # as `traffic`/`arrivals`) — the reverse direction (a
+            # skeleton checkpoint resumed by a native run) is caught by
+            # the two-way compare below
+            expect_keys.append("skeleton")
         if ck.resume and checkpoint_exists(ck.path):
             # a stale/corrupted artifact raises here — refusal, not a
             # silent from-scratch rerun. Artifacts are pad-free (the
@@ -771,6 +822,20 @@ def _run_sweep(
                     f"checkpoint narrowing {saved_narrow!r} does not "
                     f"match the current run's {ckpt_meta['narrow']!r} "
                     "— resume with matching narrow settings/budgets"
+                )
+            # two-way skeleton compare (same shape as `narrow`): a
+            # checkpoint written by a skeleton-packed run must never
+            # resume into a native runner (the saved planes are union
+            # slots, not this protocol's trees), and vice versa; a
+            # legacy checkpoint has no key and reads as un-marked —
+            # compatible with exactly an un-marked run
+            saved_skeleton = str(loaded_meta.get("skeleton") or "")
+            if skeleton_marker != saved_skeleton:
+                raise CheckpointMismatchError(
+                    f"checkpoint skeleton marker {saved_skeleton!r} "
+                    f"does not match the current run's "
+                    f"{skeleton_marker!r} — a union-packed state and "
+                    "a native state are not interchangeable"
                 )
             resume_until = int(loaded_meta["until"])
             mark("checkpoint_load")
@@ -850,6 +915,7 @@ def _run_sweep(
             window=win,
             donate=donate,
             narrow=nspec,
+            skeleton=skeleton_marker,
         )
         LAST_STATS["aot"] = dict(aot_mod.LAST_AOT)
         mark(f"aot_{aot_mod.LAST_AOT.get('source', '?')}")

@@ -65,6 +65,11 @@ TRACED_SCAN_PATHS = (
     # detect; shard.py's taint rules live in data tables, not code
     # that would trip them)
     "fantoch_tpu/lint/shard.py",
+    # the skeleton family traces branch signatures through eval_shape
+    # and the pack/unpack adapters run under jit inside the megabatch
+    # runner — both submit to the traced-discipline scan like shard.py
+    "fantoch_tpu/lint/skeleton.py",
+    "fantoch_tpu/engine/skeleton.py",
 )
 
 # the host orchestration layers whose device<->host traffic the GL301
@@ -107,6 +112,12 @@ DETERMINISM_SCAN_PATHS = (
     "fantoch_tpu/engine/checkpoint.py",
     "fantoch_tpu/cli.py",
     "fantoch_tpu/lint/shard.py",
+    # lint/skeleton.py writes lint/skeleton_baseline.json (a checked-in
+    # artifact) via write_skeleton_baseline, so it submits to the same
+    # canonical_json/atomic_write discipline as shard.py; engine/
+    # skeleton.py's fingerprint feeds AOT keys and checkpoint manifests
+    "fantoch_tpu/lint/skeleton.py",
+    "fantoch_tpu/engine/skeleton.py",
 )
 
 # fleet worker ids (fantoch_tpu/fleet, docs/FLEET.md) become lease and

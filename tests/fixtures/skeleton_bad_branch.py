@@ -1,0 +1,37 @@
+"""Seeded GL602 defect: a union storage extent below a native extent.
+
+The skeleton selfcheck (``lint --skeleton-selfcheck branch``) loads the
+real checked-in ledger, lets this fixture shrink ONE shared state
+plane's union extent below tempo's native extent, and then proves the
+tempo branch against the mutated skeleton. unpack_state's post-slice
+shape check refuses by name ("the union extent does not cover the
+native extent"), so the branch-compatibility prover must fail GL602 —
+exactly what a hand-edited ledger that under-declares a plane would do
+to the ``lax.switch`` megabatch.
+"""
+
+
+def mutate_planes(entries):
+    for name in sorted(entries):
+        if not name.startswith("state."):
+            continue
+        ent = entries[name]
+        if ent.get("verdict") != "SHARED":
+            continue
+        native = ent.get("native", {}).get("tempo")
+        if native is None or not native.get("shape"):
+            continue
+        # shrink the first axis of the union below tempo's native
+        # extent: the unpack slice can no longer cover the plane
+        shape, _ = list(native["shape"]), native["dtype"]
+        if shape[0] < 1:
+            continue
+        union = dict(ent["union"])
+        ushape = list(union["shape"])
+        ushape[0] = shape[0] - 1
+        union["shape"] = ushape
+        entries[name] = dict(ent, union=union)
+        return entries
+    raise AssertionError(
+        "no SHARED state plane with a shrinkable extent found"
+    )

@@ -1,0 +1,19 @@
+"""Seeded GL603 defect: an impossible amplification budget.
+
+The skeleton selfcheck (``lint --skeleton-selfcheck pad``) budgets the
+real checked-in GL601 ledger against this grid declaration. A
+heterogeneous union is never free — every protocol pays at least the
+other members' private slots — so a 1.01x budget over the full grid
+must trip the padding-amplification gate naming GL603 and the worst
+member. If it ever passes, the byte model (or the gate) is broken.
+"""
+
+GRIDS = {
+    "full-grid": {
+        "audits": (
+            "basic", "fpaxos", "tempo", "atlas", "epaxos", "caesar",
+            "tempo@2shards", "atlas@2shards",
+        ),
+        "max_amplification": 1.01,
+    },
+}

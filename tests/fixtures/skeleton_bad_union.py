@@ -1,0 +1,38 @@
+"""Seeded GL601 defect: one protocol's plane dtype silently widened.
+
+The skeleton selfcheck (``lint --skeleton-selfcheck union``) loads this
+fixture, asks it for per-audit plane specs, and reclassifies them
+against the real checked-in ledger. The fixture reconstructs the specs
+from the ledger itself, then widens ONE audit's copy of a plane that is
+SHARED at HEAD to int64 — exactly the drift a protocol edit would
+introduce — so the reclassification flips the plane's verdict
+(SHARED -> CASTABLE) and the GL601 gate must fail naming the plane.
+"""
+
+
+def plane_specs():
+    from fantoch_tpu.lint.skeleton import (
+        load_skeleton_baseline,
+        specs_from_baseline,
+    )
+
+    specs = specs_from_baseline(load_skeleton_baseline())
+    audits = sorted(specs)
+    assert audits, "checked-in skeleton ledger is empty"
+    victim_audit = "tempo" if "tempo" in specs else audits[0]
+    # find a plane that is SHARED at HEAD: present in every audit, one
+    # rank, every copy int32 — widening one copy makes it CASTABLE
+    for name in sorted(specs[victim_audit]):
+        copies = [specs[a].get(name) for a in audits]
+        if any(c is None for c in copies):
+            continue
+        ranks = {len(shape) for shape, _ in copies}
+        dtypes = {dtype for _, dtype in copies}
+        if ranks != {len(copies[0][0])} or dtypes != {"int32"}:
+            continue
+        shape, _ = specs[victim_audit][name]
+        specs[victim_audit][name] = (shape, "int64")
+        return specs
+    raise AssertionError(
+        "no SHARED int32 plane found to seed the dtype drift"
+    )

@@ -1,0 +1,699 @@
+"""The GL601-GL604 skeleton family (lint/skeleton.py +
+engine/skeleton.py + the run_sweep/aot skeleton marker): taxonomy units
+over synthetic plane specs, the unification ledger gate's refusal
+semantics, the clean-at-HEAD pins against the checked-in
+``lint/skeleton_baseline.json``, byte-exact pack/unpack round-trips,
+the GL604 alpha-equivalence pin the whole family exists for, the GL603
+amplification budget refusals, and the satellite wiring — the
+conditional ``skeleton`` key in AOT signatures and checkpoint meta,
+and the halved default scan-window cap for union-packed lanes."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from fantoch_tpu.engine.skeleton import (
+    CASTABLE,
+    PRIVATE,
+    SHARED,
+    SkeletonMismatchError,
+    build_skeleton,
+    classify_planes,
+    pack_ctx,
+    pack_state,
+    packed_spec,
+    skeleton_fingerprint,
+    unflatten_planes,
+    unpack_ctx,
+    unpack_state,
+    walk_planes,
+)
+from fantoch_tpu.lint.report import Finding
+from fantoch_tpu.lint.skeleton import (
+    DEFAULT_SKELETON_BASELINE,
+    amplification_findings,
+    attach_reasons,
+    gate_skeleton_ledger,
+    grid_amplification,
+    load_skeleton_baseline,
+    norm_grids,
+    run_skeleton,
+    run_skeleton_selfcheck,
+    specs_from_baseline,
+    write_skeleton_baseline,
+)
+from fantoch_tpu.registry import DEV_PROTOCOLS, PARTIAL_DEV_PROTOCOLS
+
+ALL_AUDITS = tuple(DEV_PROTOCOLS) + tuple(
+    f"{n}@2shards" for n in PARTIAL_DEV_PROTOCOLS
+)
+
+
+# ----------------------------------------------------------------------
+# GL601 taxonomy units (synthetic plane specs — no tracing)
+# ----------------------------------------------------------------------
+
+
+def test_shared_plane_pads_to_elementwise_max():
+    entries = classify_planes({
+        "a": {"state.x": ((3, 4), "int32")},
+        "b": {"state.x": ((5, 2), "int32")},
+    })
+    ent = entries["state.x"]
+    assert ent["verdict"] == SHARED
+    assert ent["union"] == {"shape": [5, 4], "dtype": "int32"}
+
+
+def test_castable_widen_is_lossless_and_order_free():
+    for specs in (
+        {"a": {"state.x": ((2,), "int16")},
+         "b": {"state.x": ((2,), "int32")}},
+        {"a": {"state.x": ((2,), "int32")},
+         "b": {"state.x": ((2,), "int16")}},
+    ):
+        ent = classify_planes(specs)["state.x"]
+        assert ent["verdict"] == CASTABLE
+        assert ent["union"]["dtype"] == "int32"
+    # three-way chain widens to the top
+    ent = classify_planes({
+        "a": {"state.x": ((2,), "int8")},
+        "b": {"state.x": ((2,), "int16")},
+        "c": {"state.x": ((2,), "int32")},
+    })["state.x"]
+    assert ent["verdict"] == CASTABLE
+    assert ent["union"]["dtype"] == "int32"
+
+
+def test_no_lossless_widen_is_private():
+    # i64 + f32 promote to f64, which cannot hold every i64 — there is
+    # no value-preserving union storage, so the plane stays per-audit
+    ent = classify_planes({
+        "a": {"state.x": ((2,), "int64")},
+        "b": {"state.x": ((2,), "float32")},
+    })["state.x"]
+    assert ent["verdict"] == PRIVATE
+    assert "union" not in ent
+
+
+def test_partial_presence_and_rank_mismatch_are_private():
+    entries = classify_planes({
+        "a": {"state.only_a": ((2,), "int32"),
+              "state.r": ((2, 3), "int32")},
+        "b": {"state.r": ((6,), "int32")},
+    })
+    assert entries["state.only_a"]["verdict"] == PRIVATE
+    assert sorted(entries["state.only_a"]["native"]) == ["a"]
+    assert entries["state.r"]["verdict"] == PRIVATE  # rank 2 vs rank 1
+
+
+# ----------------------------------------------------------------------
+# GL601 ledger gate units
+# ----------------------------------------------------------------------
+
+_GRIDS = {"g": {"audits": ("a", "b"), "max_amplification": 9.0}}
+
+
+def _entries():
+    entries = classify_planes({
+        "a": {"state.x": ((3,), "int32")},
+        "b": {"state.x": ((5,), "int32")},
+    })
+    attach_reasons(entries, 2)
+    return entries
+
+
+def _baseline():
+    return {
+        "audits": ["a", "b"],
+        "grids": dict(_GRIDS),
+        "planes": {
+            k: json.loads(json.dumps(v)) for k, v in _entries().items()
+        },
+    }
+
+
+def test_gate_missing_ledger_is_a_bootstrap_finding():
+    findings, stale = gate_skeleton_ledger(
+        _entries(), ["a", "b"], _GRIDS, {"planes": {}}
+    )
+    assert len(findings) == 1 and findings[0].rule == "GL601"
+    assert findings[0].anchor == "skeleton_baseline"
+    assert stale == []
+
+
+def test_gate_new_plane_and_verdict_drift_fail_both_ways():
+    base = _baseline()
+    entries = _entries()
+    entries["state.y"] = dict(entries["state.x"])
+    findings, _ = gate_skeleton_ledger(entries, ["a", "b"], _GRIDS, base)
+    assert [f.anchor for f in findings] == ["state.y"]
+    assert "NEW state plane" in findings[0].message
+
+    # drift in EITHER direction fails — regenerated deliberately,
+    # never absorbed
+    entries = _entries()
+    entries["state.x"]["verdict"] = PRIVATE
+    entries["state.x"].pop("union")
+    findings, _ = gate_skeleton_ledger(entries, ["a", "b"], _GRIDS, base)
+    assert any("verdict changed" in f.message for f in findings)
+    base2 = _baseline()
+    base2["planes"]["state.x"]["verdict"] = PRIVATE
+    findings, _ = gate_skeleton_ledger(
+        _entries(), ["a", "b"], _GRIDS, base2
+    )
+    assert any("verdict changed" in f.message for f in findings)
+
+
+def test_gate_union_and_native_drift_fail():
+    base = _baseline()
+    entries = _entries()
+    entries["state.x"]["union"] = {"shape": [7], "dtype": "int32"}
+    findings, _ = gate_skeleton_ledger(entries, ["a", "b"], _GRIDS, base)
+    assert any("union storage slot changed" in f.message for f in findings)
+
+    # a native drift below the union max leaves the slot intact but
+    # still fails, naming the drifted audit
+    entries = _entries()
+    entries["state.x"]["native"]["a"]["shape"] = [4]
+    findings, _ = gate_skeleton_ledger(entries, ["a", "b"], _GRIDS, base)
+    msgs = [f.message for f in findings]
+    assert any("native spec drift for ['a']" in m for m in msgs)
+
+
+def test_gate_audit_grid_and_declared_grid_drift_fail():
+    base = _baseline()
+    findings, _ = gate_skeleton_ledger(
+        _entries(), ["a", "b", "c"], _GRIDS, base
+    )
+    assert any(f.anchor == "audits" for f in findings)
+
+    grids = {"g": {"audits": ("a", "b"), "max_amplification": 99.0}}
+    findings, _ = gate_skeleton_ledger(_entries(), ["a", "b"], grids, base)
+    assert any(f.anchor == "grids:g" for f in findings)
+    # a grid added or removed drifts too
+    findings, _ = gate_skeleton_ledger(_entries(), ["a", "b"], {}, base)
+    assert any(f.anchor == "grids:g" for f in findings)
+
+
+def test_gate_reasonless_entry_fails_and_stale_is_advisory():
+    base = _baseline()
+    base["planes"]["state.x"]["reason"] = ""
+    base["planes"]["state.gone"] = dict(base["planes"]["state.x"])
+    base["planes"]["state.gone"]["reason"] = "kept"
+    findings, stale = gate_skeleton_ledger(
+        _entries(), ["a", "b"], _GRIDS, base
+    )
+    assert any(f.anchor == "state.x:reasonless" for f in findings)
+    assert stale == ["state.gone"]
+
+    base["planes"]["state.x"]["reason"] = "UNREVIEWED todo"
+    findings, _ = gate_skeleton_ledger(_entries(), ["a", "b"], _GRIDS, base)
+    assert any(f.anchor == "state.x:reasonless" for f in findings)
+
+
+def test_write_baseline_preserves_hand_reasons_until_drift(tmp_path):
+    path = str(tmp_path / "skeleton_baseline.json")
+    ledger = {"audits": ["a", "b"], "grids": _GRIDS, "planes": _entries()}
+    write_skeleton_baseline(path, ledger)
+    base = load_skeleton_baseline(path)
+    assert base["planes"]["state.x"]["reason"].strip()
+
+    # hand-annotate, regenerate with NO drift: the annotation survives
+    base_raw = json.load(open(path))
+    base_raw["planes"]["state.x"]["reason"] = "hand-reviewed: fine"
+    with open(path, "w") as fh:
+        json.dump(base_raw, fh)
+    write_skeleton_baseline(path, ledger)
+    assert (
+        load_skeleton_baseline(path)["planes"]["state.x"]["reason"]
+        == "hand-reviewed: fine"
+    )
+
+    # a drifted entry gets the fresh machine reason, not the stale note
+    drifted = {
+        "audits": ["a", "b"],
+        "grids": _GRIDS,
+        "planes": classify_planes({
+            "a": {"state.x": ((3,), "int32")},
+            "b": {"state.x": ((9,), "int32")},
+        }),
+    }
+    attach_reasons(drifted["planes"], 2)
+    write_skeleton_baseline(path, drifted)
+    assert (
+        load_skeleton_baseline(path)["planes"]["state.x"]["reason"]
+        != "hand-reviewed: fine"
+    )
+
+
+# ----------------------------------------------------------------------
+# GL603 amplification units (stdlib arithmetic)
+# ----------------------------------------------------------------------
+
+
+def _amp_planes():
+    entries = classify_planes({
+        "a": {"state.x": ((4,), "int32"),
+              "state.mine": ((100,), "int32")},
+        "b": {"state.x": ((8,), "int32")},
+    })
+    attach_reasons(entries, 2)
+    return entries
+
+
+def test_grid_amplification_restricts_to_the_grid():
+    planes = _amp_planes()
+    both = grid_amplification(planes, ["a", "b"])
+    # union: shared x at max(4,8)*4B + a's private 400B + 4B pid
+    assert both["union_bytes"] == 8 * 4 + 400 + 4
+    assert both["worst"] == "b"  # b's native is tiny, pays a's slot
+    solo = grid_amplification(planes, ["b"])
+    # a b-only grid never pays a's private plane; shared pads only to
+    # the grid members' max (8)
+    assert solo["union_bytes"] == 8 * 4 + 4
+    assert solo["max_amplification"] < both["max_amplification"]
+
+
+def test_amplification_budget_refused_by_name():
+    planes = _amp_planes()
+    grids = {"tight": {"audits": ("a", "b"), "max_amplification": 1.5}}
+    findings, summary = amplification_findings(planes, grids)
+    assert len(findings) == 1 and findings[0].rule == "GL603"
+    assert findings[0].anchor == "tight" and findings[0].audit == "b"
+    assert "past the declared budget 1.5x" in findings[0].message
+    assert summary["tight"]["budget"] == 1.5
+
+    # a grid naming an unledgered audit is itself a finding — a budget
+    # against nothing proves nothing
+    findings, _ = amplification_findings(
+        planes, {"ghost": {"audits": ("a", "zz"), "max_amplification": 9}}
+    )
+    assert len(findings) == 1 and findings[0].anchor == "audits"
+    assert "zz" in findings[0].message
+
+
+# ----------------------------------------------------------------------
+# pack/unpack adapters (synthetic skeleton — no tracing)
+# ----------------------------------------------------------------------
+
+
+def _syn_skeleton():
+    entries = classify_planes({
+        "a": {
+            "state.pad": ((3, 2), "int32"),
+            "state.cast": ((4,), "int16"),
+            "state.mine": ((5,), "int8"),
+            "ctx.shared": ((2,), "float32"),
+        },
+        "b": {
+            "state.pad": ((6, 2), "int32"),
+            "state.cast": ((4,), "int32"),
+            "ctx.shared": ((2,), "float32"),
+        },
+    })
+    attach_reasons(entries, 2)
+    return build_skeleton(entries, audits=["a", "b"])
+
+
+def _syn_state_a():
+    return {
+        "pad": np.arange(6, dtype=np.int32).reshape(3, 2),
+        "cast": np.array([1, -2, 3, 32767], np.int16),
+        "mine": np.arange(5, dtype=np.int8),
+    }
+
+
+def test_roundtrip_is_byte_exact_through_pad_and_cast():
+    sk = _syn_skeleton()
+    state = _syn_state_a()
+    ctx = {"shared": np.array([1.5, -2.25], np.float32)}
+    rt = unpack_state(sk, "a", pack_state(sk, "a", state))
+    rt_ctx = unpack_ctx(sk, "a", pack_ctx(sk, "a", ctx))
+    for name, leaf in walk_planes(state, "state").items():
+        got = walk_planes(rt, "state")[name]
+        assert got.dtype == leaf.dtype and got.shape == leaf.shape
+        assert got.tobytes() == leaf.tobytes(), name
+    assert rt_ctx["shared"].tobytes() == ctx["shared"].tobytes()
+
+
+def test_packed_structure_is_identical_across_audits():
+    sk = _syn_skeleton()
+    pa = pack_state(sk, "a", _syn_state_a())
+    pb = pack_state(sk, "b", {
+        "pad": np.zeros((6, 2), np.int32),
+        "cast": np.zeros((4,), np.int32),
+    })
+
+    def spec_of(packed):
+        return {
+            k: (tuple(v.shape), str(v.dtype))
+            for k, v in walk_planes(packed, "p").items()
+        }
+
+    assert spec_of(pa) == spec_of(pb)  # the lax.switch precondition
+    assert int(pa["protocol_id"]) == 0 and int(pb["protocol_id"]) == 1
+    # and it matches the declared packed_spec
+    want = packed_spec(sk, "state")
+    assert ("pad" in want["shared"]) and ("mine" in want["priv"]["a"])
+    assert want["protocol_id"] == ((), "int32")
+
+
+def test_adapters_refuse_by_name():
+    sk = _syn_skeleton()
+    state = _syn_state_a()
+
+    probed = dict(state, monitor_probe=np.zeros((2,), np.int32))
+    with pytest.raises(SkeletonMismatchError, match="monitor_probe"):
+        pack_state(sk, "a", probed)
+
+    missing = {k: v for k, v in state.items() if k != "cast"}
+    with pytest.raises(SkeletonMismatchError, match="state.cast"):
+        pack_state(sk, "a", missing)
+
+    drifted = dict(state, cast=state["cast"].astype(np.int64))
+    with pytest.raises(SkeletonMismatchError, match="native spec"):
+        pack_state(sk, "a", drifted)
+
+    packed = pack_state(sk, "a", state)
+    with pytest.raises(SkeletonMismatchError, match="protocol_id 0"):
+        unpack_state(sk, "b", packed)
+    with pytest.raises(SkeletonMismatchError, match="not in this"):
+        pack_state(sk, "zz", state)
+
+
+def test_walk_planes_refuses_non_dict_containers_and_dotted_keys():
+    with pytest.raises(SkeletonMismatchError, match="nested dicts"):
+        walk_planes({"a": [1, 2]}, "state")
+    with pytest.raises(SkeletonMismatchError, match="dot-free"):
+        walk_planes({"a.b": np.zeros(1)}, "state")
+    leaves = walk_planes({"a": {"b": 1, "c": 2}}, "state")
+    assert unflatten_planes(
+        {k[len("state."):]: v for k, v in leaves.items()}
+    ) == {"a": {"b": 1, "c": 2}}
+
+
+def test_fingerprint_pins_the_union_spec():
+    fp = skeleton_fingerprint(_syn_skeleton())
+    assert fp == skeleton_fingerprint(_syn_skeleton())
+    entries = classify_planes({
+        "a": {"state.pad": ((3, 2), "int32")},
+        "b": {"state.pad": ((7, 2), "int32")},
+    })
+    other = build_skeleton(entries, audits=["a", "b"])
+    assert skeleton_fingerprint(other) != fp
+
+
+# ----------------------------------------------------------------------
+# clean-at-HEAD pins
+# ----------------------------------------------------------------------
+
+
+def test_skeleton_baseline_is_checked_in_and_reviewed():
+    from fantoch_tpu.engine.dims import SKELETON_GRIDS
+
+    assert os.path.exists(DEFAULT_SKELETON_BASELINE)
+    base = load_skeleton_baseline()
+    assert sorted(base["audits"]) == sorted(ALL_AUDITS)
+    assert norm_grids(base["grids"]) == norm_grids(SKELETON_GRIDS)
+    assert base["planes"], "empty unification ledger"
+    for name, ent in base["planes"].items():
+        assert ent["verdict"] in (SHARED, CASTABLE, PRIVATE), name
+        reason = str(ent.get("reason", ""))
+        assert reason.strip(), name
+        assert not reason.startswith("UNREVIEWED"), name
+        if ent["verdict"] in (SHARED, CASTABLE):
+            assert sorted(ent["native"]) == sorted(ALL_AUDITS), name
+            assert ent.get("union"), name
+    # the checked-in ledger builds a valid skeleton covering both trees
+    sk = build_skeleton(base["planes"], audits=base["audits"])
+    names = set(base["planes"])
+    assert any(n.startswith("state.") for n in names)
+    assert any(n.startswith("ctx.") for n in names)
+    assert specs_from_baseline(base).keys() == set(ALL_AUDITS)
+    assert len(skeleton_fingerprint(sk)) == 64
+
+
+def test_skeleton_waste_summary_is_jax_free():
+    import subprocess
+    import sys
+
+    code = (
+        "import sys\n"
+        "from fantoch_tpu.lint.skeleton import skeleton_waste_summary\n"
+        "s = skeleton_waste_summary()\n"
+        "assert 'jax' not in sys.modules, 'jax leaked'\n"
+        "import json; print(json.dumps(s))\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stderr
+    s = json.loads(out.stdout)
+    from fantoch_tpu.engine.dims import SKELETON_GRIDS
+
+    assert sorted(s["grids"]) == sorted(SKELETON_GRIDS)
+    for gname, amp in s["grids"].items():
+        assert amp["max_amplification"] <= amp["budget"], gname
+        assert set(amp["audits"]) == set(
+            SKELETON_GRIDS[gname]["audits"]
+        )
+    assert sum(s["planes"].values()) == len(
+        load_skeleton_baseline()["planes"]
+    )
+
+
+def test_basic_skeleton_clean_at_head():
+    """The fast in-tier pin: basic re-proves against the checked-in
+    ledger (peers' native specs come from the baseline, so the union
+    is still the full grid) with zero findings — the full 8-audit pin
+    is the slow twin below + the CI skeleton-gate job."""
+    findings, summary = run_skeleton(["basic"], include_partial=False)
+    assert findings == [], [f.render() for f in findings]
+    assert list(summary["audits"]) == ["basic"]
+    assert summary["planes"]["SHARED"] > 0
+
+
+@pytest.mark.slow
+def test_all_audits_clean_at_head():
+    findings, summary = run_skeleton()
+    assert findings == [], [f.render() for f in findings]
+    assert sorted(summary["audits"]) == sorted(ALL_AUDITS)
+    assert summary["stale"] == []
+    for gname, amp in summary["amplification"].items():
+        assert amp["max_amplification"] <= amp["budget"], gname
+
+
+@pytest.mark.slow
+def test_roundtrip_byte_exact_full_matrix():
+    """Pack/unpack every audited protocol's real state and ctx through
+    the checked-in skeleton — byte-exact per plane, all eight audits
+    (the GL604 alpha-equivalence leg rides in the clean-at-HEAD pin
+    above; this is the raw adapter matrix)."""
+    from fantoch_tpu.lint.jaxpr import TraceCache
+    from fantoch_tpu.lint.shard import shard_trace
+
+    base = load_skeleton_baseline()
+    sk = build_skeleton(base["planes"], audits=base["audits"])
+    cache = TraceCache()
+    for audit in ALL_AUDITS:
+        name, shards = (
+            (audit[: -len("@2shards")], 2)
+            if audit.endswith("@2shards")
+            else (audit, 1)
+        )
+        trace = shard_trace(name, shards, cache)
+        rt = unpack_state(
+            sk, audit, pack_state(sk, audit, trace.state)
+        )
+        rt_ctx = unpack_ctx(sk, audit, pack_ctx(sk, audit, trace.ctx))
+        for native, got, prefix in (
+            (trace.state, rt, "state"), (trace.ctx, rt_ctx, "ctx"),
+        ):
+            a = walk_planes(native, prefix)
+            b = walk_planes(got, prefix)
+            assert sorted(a) == sorted(b), (audit, prefix)
+            for pname in a:
+                na, nb = np.asarray(a[pname]), np.asarray(b[pname])
+                assert na.dtype == nb.dtype and na.shape == nb.shape
+                assert na.tobytes() == nb.tobytes(), (audit, pname)
+
+
+def test_gl604_no_regression_tempo_and_basic():
+    """The tier-1 GL604 pin: tempo and basic round-trip byte-exact AND
+    re-trace alpha-equivalent to the legacy step through the checked-in
+    skeleton (full matrix in the slow clean-at-HEAD pin)."""
+    from fantoch_tpu.lint.jaxpr import TraceCache
+    from fantoch_tpu.lint.shard import shard_trace
+    from fantoch_tpu.lint.skeleton import check_no_regression
+
+    base = load_skeleton_baseline()
+    sk = build_skeleton(base["planes"], audits=base["audits"])
+    cache = TraceCache()
+    for name in ("tempo", "basic"):
+        findings = check_no_regression(shard_trace(name, 1, cache), sk)
+        assert findings == [], [f.render() for f in findings]
+
+
+# ----------------------------------------------------------------------
+# baseline cross-pollination guard (report.py write_baseline)
+# ----------------------------------------------------------------------
+
+
+def test_write_baseline_refuses_gl6xx_absorption(tmp_path):
+    from fantoch_tpu.lint.report import (
+        LintReport, load_baseline, write_baseline,
+    )
+
+    report = LintReport()
+    report.extend([
+        Finding("GL001", "tempo", "a.py:f:add", "keep"),
+        Finding("GL601", "skeleton", "state.ps.clock", "drop"),
+        Finding("GL602", "tempo", "state.shared.pool", "drop"),
+        Finding("GL603", "fpaxos", "full-grid", "drop"),
+        Finding("GL604", "tempo", "step", "drop"),
+    ])
+    path = str(tmp_path / "baseline.json")
+    write_baseline(path, report)
+    assert set(load_baseline(path)) == {"GL001:tempo:a.py:f:add"}
+
+
+# ----------------------------------------------------------------------
+# selfchecks + CLI (slow: branch traces tempo at the audit shape)
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kind,rule", [
+    ("union", "GL601"),
+    ("branch", "GL602"),
+    ("pad", "GL603"),
+])
+def test_selfcheck_fixture_names_its_rule(kind, rule):
+    findings, summary = run_skeleton_selfcheck(kind)
+    assert findings, f"selfcheck {kind} is vacuously green"
+    assert all(f.rule == rule for f in findings)
+    assert summary["selfcheck_rule"] == rule
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kind,rule", [
+    ("union", "GL601"),
+    ("branch", "GL602"),
+    ("pad", "GL603"),
+])
+def test_cli_selfcheck_exits_nonzero_naming_rule(kind, rule, capsys):
+    from fantoch_tpu import cli
+
+    with pytest.raises(SystemExit) as e:
+        cli.main(["lint", "--skeleton-selfcheck", kind])
+    assert e.value.code == 1
+    captured = capsys.readouterr()
+    assert rule in captured.err
+    out = json.loads(captured.out.strip().splitlines()[-1])
+    assert out["selfcheck"] == kind and out["regressions"] > 0
+
+
+# ----------------------------------------------------------------------
+# registry / scan-set pins
+# ----------------------------------------------------------------------
+
+
+def test_scan_sets_cover_the_skeleton_modules():
+    from fantoch_tpu.lint.rules import REPO_ROOT, expand_paths
+    from fantoch_tpu.registry import (
+        DETERMINISM_SCAN_PATHS,
+        TRACED_SCAN_PATHS,
+    )
+
+    for paths in (TRACED_SCAN_PATHS, DETERMINISM_SCAN_PATHS):
+        rels = [
+            os.path.relpath(f, REPO_ROOT) for f in expand_paths(paths)
+        ]
+        assert "fantoch_tpu/lint/skeleton.py" in rels
+        assert "fantoch_tpu/engine/skeleton.py" in rels
+
+
+# ----------------------------------------------------------------------
+# satellite wiring: AOT signature + checkpoint meta + scan window
+# ----------------------------------------------------------------------
+
+
+def test_executable_signature_skeleton_key_is_conditional():
+    from fantoch_tpu.parallel.aot import executable_signature
+
+    step_sig = {"protocol": "tempo"}
+    kwargs = dict(lanes=4, window=2, donate=False, narrow=())
+    legacy = executable_signature(step_sig, **kwargs)
+    assert "skeleton" not in legacy  # legacy slots stay byte-identical
+    marked = executable_signature(step_sig, skeleton="f" * 64, **kwargs)
+    assert marked["skeleton"] == "f" * 64
+    # the marker is part of the slot identity: a skeleton-packed
+    # executable and a native one can never share an artifact file
+    from fantoch_tpu.parallel.aot import _slot_hash
+
+    assert _slot_hash(marked) != _slot_hash(legacy)
+
+
+def test_default_scan_window_skeleton_halves_the_cap():
+    from fantoch_tpu.parallel.sweep import (
+        SCAN_WINDOW_MAX,
+        default_scan_window,
+    )
+
+    assert default_scan_window(1) == SCAN_WINDOW_MAX
+    assert default_scan_window(1, skeleton=True) == SCAN_WINDOW_MAX // 2
+    # the target-steps packing rule still applies below the cap, and
+    # the floor stays 1
+    assert default_scan_window(1 << 14, skeleton=True) == 2
+    assert default_scan_window(1 << 30, skeleton=True) == 1
+
+
+def test_checkpoint_skeleton_marker_refused_by_name(tmp_path):
+    from fantoch_tpu.core import Config, Planet
+    from fantoch_tpu.engine import EngineDims
+    from fantoch_tpu.engine.checkpoint import (
+        CheckpointMismatchError,
+        CheckpointSpec,
+        SweepInterrupted,
+    )
+    from fantoch_tpu.engine.protocols import (
+        dev_config_kwargs,
+        dev_protocol,
+    )
+    from fantoch_tpu.parallel.sweep import make_sweep_specs, run_sweep
+
+    planet = Planet.new()
+    regions = planet.regions()
+    clients = 3
+    dev = dev_protocol("basic", clients)
+    total = 2 * clients
+    dims = EngineDims.for_protocol(
+        dev, n=3, clients=clients, payload=dev.payload_width(3),
+        total_commands=total, dot_slots=total + 1, regions=3,
+    )
+    specs = make_sweep_specs(
+        dev, planet, region_sets=[regions[:3], regions[1:4]], fs=[1],
+        conflicts=[0, 100], commands_per_client=2, clients_per_region=1,
+        dims=dims, config_base=Config(**dev_config_kwargs("basic", 3, 1)),
+    )
+    ck = str(tmp_path / "ck")
+    with pytest.raises(SweepInterrupted):
+        run_sweep(
+            dev, dims, specs, segment_steps=8, scan_window=1,
+            checkpoint=CheckpointSpec(path=ck, stop_after_segments=1),
+        )
+    # a native (unmarked) checkpoint must not resume into a
+    # skeleton-marked runner — refusal by name, not a trace error
+    with pytest.raises(CheckpointMismatchError, match="skeleton"):
+        run_sweep(
+            dev, dims, specs, segment_steps=8, scan_window=1,
+            checkpoint=CheckpointSpec(path=ck), skeleton="cafe" * 16,
+        )
+    # and the unmarked resume still works (legacy artifacts unaffected)
+    results = run_sweep(
+        dev, dims, specs, segment_steps=8, scan_window=1,
+        checkpoint=CheckpointSpec(path=ck),
+    )
+    assert results and not any(r.err for r in results)
