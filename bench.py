@@ -125,6 +125,30 @@ AOT_MIN_BUDGET_S = float(
     _os.environ.get("FANTOCH_BENCH_AOT_MIN_BUDGET", "300")
 )
 
+# heterogeneous-megabatch self-check shape (engine/hetero.py): the same
+# fixed small grid run (a) as ONE protocol_id-switched mixed batch and
+# (b) as per-protocol homogeneous batches — hetero_points_per_sec vs
+# the homogeneous control at identical total lane count, per-lane byte
+# identity asserted in the same breath (the GL605 property, measured).
+# The cold-start twin runs both layouts in fresh subprocesses with no
+# compile cache, so `hetero_cold_start_s` vs `hetero_cold_start_homo_s`
+# is the compile-collapse the switch buys a cold fleet worker: one
+# executable instead of one per protocol.
+HETERO_PROTOCOLS = tuple(
+    _os.environ.get(
+        "FANTOCH_BENCH_HETERO_PROTOCOLS", "basic,fpaxos,tempo,atlas"
+    ).split(",")
+)
+HETERO_COMMANDS = int(_os.environ.get("FANTOCH_BENCH_HETERO_COMMANDS", "10"))
+HETERO_SUBSETS = int(_os.environ.get("FANTOCH_BENCH_HETERO_SUBSETS", "2"))
+HETERO_MIN_BUDGET_S = float(
+    _os.environ.get("FANTOCH_BENCH_HETERO_MIN_BUDGET", "420")
+)
+# each cold child pays |protocols|+1 deliberate compiles between them
+HETERO_COLD_MIN_BUDGET_S = float(
+    _os.environ.get("FANTOCH_BENCH_HETERO_COLD_MIN_BUDGET", "600")
+)
+
 # ms/step shapes: the documented ~512-lane sweet spot plus the
 # 2048-lane bandwidth-bound regime docs/PERF.md measured at 30 vs
 # 230 ms/step — the two points the narrowing pass targets. The 512
@@ -822,6 +846,216 @@ def _aot_cold_start() -> "tuple[float, float, str | None] | None":
         return None
 
 
+_HETERO_CHILD = r"""
+import hashlib
+import json
+import time
+
+from fantoch_tpu.core import Config, Planet
+from fantoch_tpu.engine import EngineDims
+from fantoch_tpu.engine.protocols import dev_config_kwargs, dev_protocol
+from fantoch_tpu.parallel.sweep import make_sweep_specs, run_sweep
+
+protocols = {protocols!r}
+planet = Planet.new()
+regions = planet.regions()
+clients = {clients}
+protos, dmap, lanes = {{}}, {{}}, {{}}
+for name in protocols:
+    dev = dev_protocol(name, clients)
+    total = {commands} * clients
+    dims = EngineDims.for_protocol(
+        dev, n=3, clients=clients, payload=dev.payload_width(3),
+        total_commands=total, dot_slots=total + 1, regions=3,
+    )
+    specs = make_sweep_specs(
+        dev, planet,
+        region_sets=[regions[i:i + 3] for i in range({subsets})],
+        fs=[1], conflicts=[0, 100], commands_per_client={commands},
+        clients_per_region=1, dims=dims,
+        config_base=Config(**dev_config_kwargs(name, 3, 1)),
+    )
+    protos[name], dmap[name], lanes[name] = dev, dims, specs
+
+# the timed window is runner acquisition + execution, cold: exactly
+# what a fresh fleet worker pays before its first unit completes
+t0 = time.perf_counter()
+by_name = {{}}
+if {hetero}:
+    mixed = []
+    for i in range(max(len(v) for v in lanes.values())):
+        for name in protocols:
+            if i < len(lanes[name]):
+                mixed.append((name, lanes[name][i]))
+    results = run_sweep(
+        protos, dmap, mixed, hetero=True, segment_steps={segment}
+    )
+    for (name, _spec), r in zip(mixed, results):
+        by_name.setdefault(name, []).append(r.to_json())
+else:
+    for name in protocols:
+        rs = run_sweep(
+            protos[name], dmap[name], lanes[name],
+            segment_steps={segment},
+        )
+        by_name[name] = [r.to_json() for r in rs]
+dt = time.perf_counter() - t0
+blob = json.dumps(by_name, sort_keys=True)
+print("HETERO-COLD " + json.dumps(
+    dict(
+        seconds=dt,
+        layout="hetero" if {hetero} else "homo",
+        compiles=1 if {hetero} else len(protocols),
+        blob_sha=hashlib.sha256(blob.encode()).hexdigest(),
+    )
+))
+"""
+
+
+def _hetero_rate() -> "tuple[float, float, str | None] | None":
+    """hetero_points_per_sec: the fixed small HETERO_PROTOCOLS grid as
+    one protocol_id-switched mixed batch vs the same lanes as
+    per-protocol homogeneous batches, both warmed — so the delta
+    isolates the switch's compute amplification (every branch runs for
+    every lane) against what fuller batches and one dispatch stream buy
+    back. Per-lane byte identity against the homogeneous controls is
+    asserted in the same breath (GL605's property); a divergence rides
+    in the note, other failures return None."""
+    import sys
+
+    try:
+        from fantoch_tpu.engine.checkpoint import canonical_json
+        from fantoch_tpu.parallel.sweep import run_sweep as _run
+
+        planet = Planet.new()
+        region_sets = _region_subsets(planet, HETERO_SUBSETS)
+        clients = N * CLIENTS_PER_REGION
+        protos, dmap, lanes = {}, {}, {}
+        for name in HETERO_PROTOCOLS:
+            dev, base = _build(name, clients)
+            dims = _bench_dims(dev)
+            specs = make_sweep_specs(
+                dev, planet, region_sets=region_sets, fs=FS,
+                conflicts=CONFLICTS,
+                commands_per_client=HETERO_COMMANDS,
+                clients_per_region=CLIENTS_PER_REGION, dims=dims,
+                config_base=base,
+            )
+            specs.sort(
+                key=lambda s: (s.config.f, int(s.ctx["conflict_rate"]))
+            )
+            protos[name], dmap[name], lanes[name] = dev, dims, specs
+        mixed = []
+        for i in range(max(len(v) for v in lanes.values())):
+            for name in HETERO_PROTOCOLS:
+                if i < len(lanes[name]):
+                    mixed.append((name, lanes[name][i]))
+        _run(protos, dmap, mixed, hetero=True)  # warmup (compile)
+        for name in HETERO_PROTOCOLS:  # warm each homogeneous shape
+            _run(protos[name], dmap[name], lanes[name])
+        t0 = time.perf_counter()
+        hres = _run(protos, dmap, mixed, hetero=True)
+        dt_h = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        cres = {
+            name: _run(protos[name], dmap[name], lanes[name])
+            for name in HETERO_PROTOCOLS
+        }
+        dt_c = time.perf_counter() - t0
+        seen = {name: 0 for name in HETERO_PROTOCOLS}
+        diverged = 0
+        for (name, _spec), r in zip(mixed, hres):
+            ctrl = cres[name][seen[name]]
+            seen[name] += 1
+            if canonical_json(r.to_json()) != canonical_json(
+                ctrl.to_json()
+            ):
+                diverged += 1
+        if diverged:
+            print(
+                "bench: IDENTITY VIOLATION: mixed-batch lanes diverged "
+                "from their homogeneous controls",
+                file=sys.stderr,
+            )
+            return 0.0, 0.0, (
+                f"IDENTITY VIOLATION: {diverged}/{len(mixed)} mixed "
+                "lanes diverged from their homogeneous controls — "
+                "correctness bug, not a transient skip (see stderr)"
+            )
+        return len(mixed) / dt_h, len(mixed) / dt_c, None
+    except Exception as e:  # noqa: BLE001
+        import traceback
+
+        traceback.print_exc()
+        print(f"bench: hetero rate unavailable: {e!r}", file=sys.stderr)
+        return None
+
+
+def _hetero_cold_collapse() -> "tuple[float, float, str | None] | None":
+    """Fresh-subprocess cold wall time of the same small grid as one
+    mixed switch batch (ONE compile) vs per-protocol homogeneous
+    batches (one compile EACH) — the compile-collapse a cold fleet
+    worker pockets. Returns ``(hetero_cold_s, homo_cold_s, note)``;
+    byte identity of the two layouts' results asserted via sha256, a
+    violation rides in the note, other failures return None."""
+    import subprocess
+    import sys
+
+    try:
+        env = dict(_os.environ)
+        # both children must pay their real compiles: no persistent
+        # compile cache (it would hide exactly the collapse measured)
+        env.pop("FANTOCH_COMPILE_CACHE", None)
+        env.pop("JAX_COMPILATION_CACHE_DIR", None)
+
+        def child(hetero: bool):
+            script = _HETERO_CHILD.format(
+                protocols=list(HETERO_PROTOCOLS),
+                clients=3 * CLIENTS_PER_REGION,
+                commands=AOT_COMMANDS,
+                subsets=AOT_SUBSETS,
+                segment=DISPATCH_SEGMENT,
+                hetero=hetero,
+            )
+            out = subprocess.run(
+                [sys.executable, "-c", script],
+                capture_output=True, text=True, timeout=600, env=env,
+            )
+            if out.returncode != 0:
+                raise RuntimeError(
+                    f"hetero cold child failed: {out.stderr[-1500:]}"
+                )
+            line = [
+                ln for ln in out.stdout.splitlines()
+                if ln.startswith("HETERO-COLD ")
+            ][0]
+            return json.loads(line[len("HETERO-COLD "):])
+
+        hot = child(True)
+        homo = child(False)
+        if hot["blob_sha"] != homo["blob_sha"]:
+            print(
+                "bench: IDENTITY VIOLATION: cold mixed-batch results "
+                "diverged from the homogeneous layout",
+                file=sys.stderr,
+            )
+            return 0.0, 0.0, (
+                "IDENTITY VIOLATION: cold mixed-batch results diverged "
+                "from the homogeneous layout — correctness bug, not a "
+                "transient skip (see stderr)"
+            )
+        return float(hot["seconds"]), float(homo["seconds"]), None
+    except Exception as e:  # noqa: BLE001
+        import traceback
+
+        traceback.print_exc()
+        print(
+            f"bench: hetero cold-start unavailable: {e!r}",
+            file=sys.stderr,
+        )
+        return None
+
+
 def _checkpoint_roundtrip() -> "float | None":
     """Save + restore + bit-exact compare of a ``CKPT_LANES``-lane
     tempo state through engine/checkpoint.py — the durability tax a
@@ -1476,6 +1710,54 @@ def main() -> None:
                 flush=True,
             )
 
+    # heterogeneous megabatch (engine/hetero.py): mixed switch batch vs
+    # per-protocol homogeneous batches, warm rate + cold compile
+    # collapse — each its own compiles, so each rides a budget guard
+    hetero_rates, hetero_note = None, None
+    if TOTAL_BUDGET_S - _since_birth() < HETERO_MIN_BUDGET_S:
+        hetero_note = (
+            "skipped: insufficient budget for the hetero runner compile"
+        )
+        print(f"hetero self-check {hetero_note}", file=sys.stderr,
+              flush=True)
+    else:
+        hetero_rates = _hetero_rate()
+        if hetero_rates is None:
+            hetero_note = "failed (see stderr)"
+        elif hetero_rates[2] is not None:
+            hetero_note, hetero_rates = hetero_rates[2], None
+        else:
+            print(
+                f"hetero self-check: {hetero_rates[0]:.2f} points/s "
+                f"mixed vs {hetero_rates[1]:.2f} points/s homogeneous "
+                "(byte-identical per lane)",
+                file=sys.stderr,
+                flush=True,
+            )
+
+    hetero_cold, hetero_cold_note = None, None
+    if TOTAL_BUDGET_S - _since_birth() < HETERO_COLD_MIN_BUDGET_S:
+        hetero_cold_note = (
+            "skipped: insufficient budget for the hetero cold-start "
+            "subprocess runs"
+        )
+        print(f"hetero cold-start {hetero_cold_note}", file=sys.stderr,
+              flush=True)
+    else:
+        hetero_cold = _hetero_cold_collapse()
+        if hetero_cold is None:
+            hetero_cold_note = "failed (see stderr)"
+        elif hetero_cold[2] is not None:
+            hetero_cold_note, hetero_cold = hetero_cold[2], None
+        else:
+            print(
+                f"hetero cold start: 1 compile {hetero_cold[0]:.2f}s "
+                f"vs {len(HETERO_PROTOCOLS)} compiles "
+                f"{hetero_cold[1]:.2f}s (byte-identical results)",
+                file=sys.stderr,
+                flush=True,
+            )
+
     # durability tax: one checkpointed segment's save+restore+compare
     # (device-state fetch excluded — measured on host arrays)
     ckpt_s = _checkpoint_roundtrip()
@@ -1665,6 +1947,36 @@ def main() -> None:
                     round(aot_times[1], 3) if aot_times else 0.0
                 ),
                 **({"aot_note": aot_note} if aot_note else {}),
+                # the protocol_id-switched mixed batch vs per-protocol
+                # homogeneous batches at identical total lanes, warm
+                # (0.0 = skipped/failed; note carries the reason — an
+                # IDENTITY-VIOLATION note means a mixed lane diverged
+                # from its homogeneous control)
+                "hetero_points_per_sec": (
+                    round(hetero_rates[0], 2) if hetero_rates else 0.0
+                ),
+                "hetero_points_per_sec_homo": (
+                    round(hetero_rates[1], 2) if hetero_rates else 0.0
+                ),
+                "hetero_protocols": list(HETERO_PROTOCOLS),
+                **({"hetero_note": hetero_note} if hetero_note else {}),
+                # cold-subprocess compile collapse: the same grid as
+                # ONE switch executable vs one executable per protocol
+                # (0.0 = skipped/failed; note carries the reason)
+                "hetero_cold_start_s": (
+                    round(hetero_cold[0], 3) if hetero_cold else 0.0
+                ),
+                "hetero_cold_start_homo_s": (
+                    round(hetero_cold[1], 3) if hetero_cold else 0.0
+                ),
+                "hetero_compile_collapse": (
+                    [1, len(HETERO_PROTOCOLS)] if hetero_cold else [0, 0]
+                ),
+                **(
+                    {"hetero_cold_note": hetero_cold_note}
+                    if hetero_cold_note
+                    else {}
+                ),
                 **(
                     {"static_kernel_cost": static_cost}
                     if static_cost
@@ -1902,6 +2214,16 @@ def _emit_unreachable(reason: str = "unreachable at startup") -> None:
                 "trace_compile_s": 0.0,
                 "aot_load_s": 0.0,
                 "aot_note": f"skipped: TPU backend {reason}",
+                # the mixed switch batch compiles against the device
+                # runner too — honest zeros with the shared reason
+                "hetero_points_per_sec": 0.0,
+                "hetero_points_per_sec_homo": 0.0,
+                "hetero_protocols": list(HETERO_PROTOCOLS),
+                "hetero_note": f"skipped: TPU backend {reason}",
+                "hetero_cold_start_s": 0.0,
+                "hetero_cold_start_homo_s": 0.0,
+                "hetero_compile_collapse": [0, 0],
+                "hetero_cold_note": f"skipped: TPU backend {reason}",
                 **(
                     {"static_kernel_cost": static_cost}
                     if static_cost
@@ -1963,6 +2285,13 @@ _CPU_FALLBACK_ENV = {
     # few commands) for two subprocess compiles to fit the budget
     "FANTOCH_BENCH_AOT_COMMANDS": "5",
     "FANTOCH_BENCH_AOT_SUBSETS": "1",
+    # hetero self-checks on the host mesh: two protocols (the switch
+    # still exercises real cross-branch routing), one subset, short
+    # lanes — the cold twin pays 3 deliberate compiles between its
+    # children, so the shapes must stay minimal
+    "FANTOCH_BENCH_HETERO_PROTOCOLS": "basic,tempo",
+    "FANTOCH_BENCH_HETERO_SUBSETS": "1",
+    "FANTOCH_BENCH_HETERO_COMMANDS": "5",
 }
 
 # below this remaining total budget a CPU fallback run cannot plausibly

@@ -166,6 +166,15 @@ class SweepCampaign:
     # (parallel/aot.py; signature drift refused by name). The first
     # worker pays the one trace+compile, the fleet shares it.
     aot: bool = False
+    # heterogeneous megabatch packing (engine/hetero.py): the grid's
+    # per-protocol batches are interleaved into always-full mixed
+    # units of `batch_lanes` lanes, all advanced by ONE compiled
+    # protocol_id-switched runner over the grid-wide union skeleton —
+    # with `aot`, ONE serialized executable serves every unit and
+    # every fleet worker. Per-lane results (and the merged
+    # results.jsonl) stay byte-identical to the homogeneous layout
+    # (the GL605 pin); only unit ids/journal layout differ.
+    hetero: bool = False
     aws: bool = False
 
     kind = "sweep"
@@ -355,6 +364,12 @@ def campaign_from_json(obj: dict):
             )
         if spec.scan_window is not None and int(spec.scan_window) < 1:
             raise CampaignError("scan_window must be >= 1 when set")
+        if spec.hetero and spec.mesh_shard:
+            raise CampaignError(
+                "hetero packs mixed units through the protocol_id-"
+                "switched runner, which is not proven for the "
+                "shard_map mesh_shard layout — drop one"
+            )
     if kind == "fuzz":
         from ..registry import FAULT_CLASSES
 
@@ -628,6 +643,117 @@ def _sweep_batches(spec: SweepCampaign):
     return batches
 
 
+def hetero_plan(spec: SweepCampaign, batches):
+    """Mixed-unit packing of a sweep grid (``hetero: true``): the
+    homogeneous batch enumeration is flattened to per-lane rows,
+    round-robin interleaved across the grid's (protocol, n, traffic,
+    arrival) groups in first-appearance order, and re-chunked into
+    ALWAYS-FULL units of ``batch_lanes`` mixed lanes (the final unit
+    pads with copies of its own last row; padded results are dropped
+    at regroup time). Returns ``(protocols, dims, reps, units,
+    positions)``:
+
+    * ``protocols``/``dims`` — group key → device protocol / dims (the
+      mappings ``run_sweep(hetero=True)`` takes),
+    * ``reps`` — group key → one representative ``LaneSpec`` (what
+      ``engine.hetero.build_grid_skeleton`` classifies),
+    * ``units`` — ordered ``(unit_key, [(group, LaneSpec), ...])``
+      with ids in their own ``hetero/b<u>`` namespace (never colliding
+      with homogeneous journal ids),
+    * ``positions`` — unit_key → ``[(homog_batch_key, lane_idx), ...]``
+      for the unit's REAL rows (pads excluded), the permutation
+      :func:`hetero_regroup` inverts so ``results.jsonl`` comes out in
+      the homogeneous enumeration's exact order and bytes.
+
+    Deterministic pure function of (spec, batches): the manager, every
+    fleet worker and the merge all derive the identical plan."""
+    groups: Dict[str, tuple] = {}
+    order: List[str] = []
+    rows_by_g: Dict[str, list] = {}
+    for key, dev, dims, lanes in batches:
+        # group names become skeleton audit keys, which live inside
+        # checkpointed pytrees — "/" would collide with the checkpoint
+        # flattener's path separator, so it is mapped out here
+        gkey = key.rsplit("/b", 1)[0].replace("/", "_")
+        if gkey not in groups:
+            groups[gkey] = (dev, dims)
+            order.append(gkey)
+        rows_by_g.setdefault(gkey, []).extend(
+            (key, li, lane) for li, lane in enumerate(lanes)
+        )
+    flat = []
+    cursors = {g: 0 for g in order}
+    remaining = sum(len(v) for v in rows_by_g.values())
+    while remaining:
+        for g in order:
+            rows = rows_by_g[g]
+            c = cursors[g]
+            if c < len(rows):
+                bk, li, lane = rows[c]
+                flat.append((g, bk, li, lane))
+                cursors[g] = c + 1
+                remaining -= 1
+    units = []
+    positions: Dict[str, list] = {}
+    B = int(spec.batch_lanes)
+    for u in range(0, len(flat), B):
+        chunk = flat[u : u + B]
+        ukey = f"hetero/b{u // B}"
+        positions[ukey] = [(bk, li) for _, bk, li, _ in chunk]
+        lanes_u = [(g, lane) for g, _, _, lane in chunk]
+        while len(lanes_u) < B:
+            lanes_u.append(lanes_u[-1])
+        units.append((ukey, lanes_u))
+    protocols = {g: groups[g][0] for g in order}
+    dims = {g: groups[g][1] for g in order}
+    reps = {g: rows_by_g[g][0][2] for g in order}
+    return protocols, dims, reps, units, positions
+
+
+def hetero_regroup(batches, units, positions, done):
+    """Invert :func:`hetero_plan`'s permutation: journaled mixed-unit
+    result rows → per-homogeneous-batch row lists in the homogeneous
+    enumeration's lane order — so a hetero campaign's ``results.jsonl``
+    (and the fleet merge's) is byte-identical to the homogeneous
+    layout's, line for line. Every unit must be present in ``done``."""
+    by_batch = {key: [None] * len(lanes) for key, _, _, lanes in batches}
+    for ukey, _lanes in units:
+        rows = done[ukey]
+        pos = positions[ukey]
+        if len(rows) != len(pos):
+            raise CampaignError(
+                f"unit {ukey!r} journaled {len(rows)} rows but the "
+                f"plan places {len(pos)} — the stored campaign and "
+                "the journal disagree"
+            )
+        for (bk, li), row in zip(pos, rows):
+            by_batch[bk][li] = row
+    for key, rows in by_batch.items():
+        if any(r is None for r in rows):
+            raise CampaignError(
+                f"hetero regroup left holes in batch {key!r} — the "
+                "plan does not cover the grid"
+            )
+    return by_batch
+
+
+def _hetero_grid(spec: SweepCampaign, batches):
+    """The per-campaign hetero setup shared by the manager loop, every
+    fleet worker and the merge: the plan plus the grid-wide skeleton
+    and narrowing spec (engine/hetero.py build_grid_skeleton — ONE
+    skeleton, ONE narrow tuple, therefore one compiled runner and one
+    AOT slot for every unit whatever its composition)."""
+    from ..engine.hetero import build_grid_skeleton
+    from ..parallel.sweep import KEY_TABLE_LIMIT
+
+    protocols, dims, reps, units, positions = hetero_plan(spec, batches)
+    skeleton, grid_narrow = build_grid_skeleton(
+        protocols, dims, reps, batch_lanes=spec.batch_lanes,
+        key_table_limit=KEY_TABLE_LIMIT,
+    )
+    return protocols, dims, units, positions, skeleton, grid_narrow
+
+
 def _run_sweep_campaign(path: str, spec: SweepCampaign, deadline,
                         stop_after_segments, stop_flag) -> dict:
     from ..engine.checkpoint import (
@@ -638,6 +764,18 @@ def _run_sweep_campaign(path: str, spec: SweepCampaign, deadline,
     from ..parallel.sweep import run_sweep
 
     batches = _sweep_batches(spec)
+    hetero = bool(getattr(spec, "hetero", False))
+    if hetero:
+        # mixed-unit layout: the work list is the plan's always-full
+        # units; every unit runs through the ONE switch-dispatched
+        # runner (one skeleton, one grid-wide narrow tuple, one AOT
+        # slot), and results.jsonl is regrouped back into the
+        # homogeneous enumeration below — byte-identical output
+        protos, dmap, units, positions, skeleton, grid_narrow = \
+            _hetero_grid(spec, batches)
+        work = [(key, protos, dmap, lanes) for key, lanes in units]
+    else:
+        work = batches
     done: Dict[str, List[dict]] = {}
     for entry in _read_journal(path):
         if entry.get("kind") == "batch":
@@ -645,7 +783,7 @@ def _run_sweep_campaign(path: str, spec: SweepCampaign, deadline,
 
     interrupted = None
     progressed = 0
-    for key, dev, dims, lanes in batches:
+    for key, dev, dims, lanes in work:
         if key in done:
             continue
         if stop_flag["sig"] is not None:
@@ -685,12 +823,25 @@ def _run_sweep_campaign(path: str, spec: SweepCampaign, deadline,
                 pipeline_depth=spec.pipeline_depth,
                 scan_window=spec.scan_window,
                 aot=campaign_aot_dir(path, spec),
+                **(
+                    {
+                        "hetero": True,
+                        "skeleton": skeleton,
+                        "narrow": grid_narrow,
+                    }
+                    if hetero
+                    else {}
+                ),
             )
         except SweepInterrupted as e:
             interrupted = e.reason
             break
         assert len(results) == len(lanes)
         rows = [r.to_json() for r in results]
+        if hetero:
+            # the final unit is padded to batch_lanes with copies of
+            # its own last row; only the plan's REAL rows are journaled
+            rows = rows[: len(positions[key])]
         _append_journal(path, {"kind": "batch", "id": key, "results": rows})
         discard_checkpoint(ckpt_path)
         done[key] = rows
@@ -701,8 +852,8 @@ def _run_sweep_campaign(path: str, spec: SweepCampaign, deadline,
 
     summary = {
         "kind": "sweep",
-        "batches_total": len(batches),
-        "batches_done": sum(1 for k, *_ in batches if k in done),
+        "batches_total": len(work),
+        "batches_done": sum(1 for k, *_ in work if k in done),
         "done": interrupted is None,
         "interrupted": interrupted,
         "dir": path,
@@ -714,6 +865,13 @@ def _run_sweep_campaign(path: str, spec: SweepCampaign, deadline,
         # checkpoints (kill between a journal append and its discard)
         # go with the transient directory
         shutil.rmtree(os.path.join(path, _CKPT), ignore_errors=True)
+        if hetero:
+            # invert the mixed-unit permutation: results.jsonl is
+            # written in the homogeneous enumeration's exact order with
+            # homogeneous batch keys — byte-identical to the legacy
+            # layout's output for the same grid
+            by_batch = hetero_regroup(batches, units, positions, done)
+            done = by_batch
         lines = []
         for key, *_ in batches:
             for lane, res in enumerate(done[key]):

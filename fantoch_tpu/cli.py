@@ -951,8 +951,9 @@ def cmd_lint(args) -> None:
     family, (``--transfer``) the sync-ledger/donation/backend
     transfer family, (``--determinism``) the GL401-GL404
     byte-identity prover, (``--shard``) the GL501-GL503
-    shardability family, and (``--skeleton``) the GL601-GL604
-    megabatch state-unification family. Exits non-zero on any
+    shardability family, and (``--skeleton``) the GL601-GL605
+    megabatch state-unification family (GL605's runtime mixed-batch
+    pin only with ``--skeleton-mixed``). Exits non-zero on any
     finding not covered by the baseline (docs/LINT.md)."""
     from .lint import (
         DEFAULT_BASELINE,
@@ -1257,6 +1258,7 @@ def cmd_lint(args) -> None:
         determinism=args.determinism or args.determinism_only,
         shard=args.shard or args.shard_only,
         skeleton=args.skeleton or args.skeleton_only,
+        skeleton_mixed=args.skeleton_mixed,
         progress=say,
     )
 
@@ -2181,11 +2183,19 @@ def main(argv=None) -> None:
     ln.add_argument("--skeleton-only", action="store_true",
                     help="skeleton family without the interval/gating "
                     "audits (the CI skeleton-gate job)")
+    ln.add_argument("--skeleton-mixed", action="store_true",
+                    help="add the GL605 mixed-batch identity pin: "
+                    "actually run a tiny basic+tempo mixed batch "
+                    "through the protocol_id-switched runner and "
+                    "require every lane byte-identical to its "
+                    "homogeneous control (the CI skeleton-gate job "
+                    "turns this on; off by default because it "
+                    "compiles and executes rather than tracing)")
     ln.add_argument("--skeleton-selfcheck", default=None,
-                    choices=["union", "branch", "pad"],
+                    choices=["union", "branch", "pad", "mixed"],
                     help="CI broken-fixture check: audit the named "
                     "seeded-defect fixture; must exit non-zero naming "
-                    "GL601/GL602/GL603")
+                    "GL601/GL602/GL603/GL605")
     ln.add_argument("--write-skeleton-baseline", action="store_true",
                     help="regenerate lint/skeleton_baseline.json from "
                     "this run (hand-edited reasons survive while the "

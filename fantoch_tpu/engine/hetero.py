@@ -1,0 +1,818 @@
+"""The heterogeneous protocol megabatch runner — ROADMAP item 1's
+switch-dispatched step over skeleton-packed lanes.
+
+``engine/skeleton.py`` proved the unification (GL601 ledger, GL602
+branch avals, GL603 amplification budgets, GL604 homogeneous
+round-trips); this module is the runner that proof layer exists for:
+
+- :func:`hetero_switch_step` routes one ``lax.switch`` over the grid's
+  audits, each branch exactly ``unpack -> _lane_step -> pack`` — legal
+  because GL602 proved every branch consumes and produces the union's
+  own avals. Fault flags compose through the switch the same way they
+  compose through a homogeneous batch (the batch union selects traced
+  graphs, never avals — GL602's fault leg); monitored states compose
+  by *structure refusal* (GL602's monitor leg): the skeleton does not
+  know monitor planes, so ``monitor_keys > 0`` is refused by name here
+  rather than silently absorbed.
+- :func:`hetero_segment_lane_fn` mirrors ``engine/core.py
+  segment_lane_fn`` on packed trees: the while-loop condition reads
+  the engine-common liveness scalars (``done_time``/``now``/``err``/
+  ``steps``, ``extra_time``, ``fault_horizon``) straight from the
+  union's SHARED slots — proven SHARED at build time, refused by name
+  otherwise — so liveness never pays an unpack.
+- :func:`build_hetero_segment_runner` / :func:`build_hetero_window_runner`
+  are the batched flavors (vmap + jit, ``donate_argnums`` donation,
+  scan-fused checkpoint windows) with exactly the native builders'
+  contracts, including the fixed-point property the pipelined sweep
+  driver and the scan windows lean on: a finished batch re-running a
+  segment is a byte-exact no-op.
+- :func:`prepare_batch` is the host-side adapter ``parallel/sweep.py``
+  calls in ``hetero=True`` mode: group the mixed lanes by audit, stack
+  each group's ctx (the per-group twin of ``stack_lanes`` — which by
+  design refuses cross-protocol batches), precompute key tables with
+  the same bit-identity contract as the native driver, init native
+  lane states, then pack everything through the skeleton.
+- :func:`collect_hetero_results` inverts the packing on the fetched
+  result sub-tree and hands each group's native planes to the
+  unchanged ``collect_results`` — per-lane results are byte-identical
+  to each lane's homogeneous-control run, which is exactly what the
+  GL605 lint pin (lint/skeleton.py) and tests/test_hetero.py gate.
+
+Amplification caveat (docs/PERF.md "Heterogeneous megabatch"): under a
+batched ``protocol_id``, ``lax.switch`` lowers to computing EVERY
+branch and selecting — a mixed step costs roughly the sum of its
+audits' steps, on top of the GL603-budgeted resident-byte padding. The
+win is batch *fullness*, one compile, and one fleet-wide AOT artifact,
+not per-step FLOPs; homogeneous batching still wins when a grid is
+dominated by one protocol.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+from typing import Any, Dict, List, Mapping, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .checkpoint import canonical_json, step_signature
+from .core import (
+    _lane_running,
+    _lane_step,
+    finish_segmented,
+    init_lane_state,
+    key_table_fn,
+    keygen_ctx_fields,
+)
+from .faults import NO_FAULTS, FaultFlags
+from .skeleton import (
+    PRIVATE,
+    SHARED,
+    Skeleton,
+    SkeletonMismatchError,
+    build_skeleton,
+    classify_planes,
+    pack_ctx,
+    pack_state,
+    skeleton_fingerprint,
+    unflatten_planes,
+    unpack_ctx,
+    unpack_state,
+    walk_planes,
+)
+from .spec import narrow_spec, stack_lanes
+
+#: signature ``kind`` of a hetero megabatch (vs checkpoint.py's native
+#: kind) — a native artifact can never satisfy a hetero signature or
+#: vice versa, before any field-level compare even runs
+HETERO_KIND = "fantoch-hetero-sweep-v1"
+
+#: the engine-common liveness scalars the while-loop condition reads —
+#: served from the union's SHARED slots (proven at build time)
+_RUNNING_STATE_PLANES = ("done_time", "err", "now", "steps")
+
+
+class HeteroBatchError(RuntimeError):
+    """A mixed batch cannot be built or run as asked — always refused
+    by name (a silently mis-grouped or mis-monitored megabatch would
+    be a wrong-result bug, not a crash)."""
+
+
+class HeteroBatch:
+    """The grid-wide identity of a heterogeneous megabatch: the proven
+    union :class:`~fantoch_tpu.engine.skeleton.Skeleton` plus each
+    audit's ``(protocol, dims)`` pair, in skeleton audit order (index =
+    ``protocol_id``). Hashable — it keys the cached compiled runners in
+    ``parallel/sweep.py`` the way ``(protocol, dims)`` keys the native
+    ones — via the skeleton fingerprint and the protocols' value
+    identity, never via the (unhashable) plane mapping itself."""
+
+    def __init__(self, skeleton: Skeleton, protocols: Mapping[str, Any],
+                 dims: Mapping[str, Any]):
+        missing = sorted(
+            set(skeleton.audits) - (set(protocols) & set(dims))
+        )
+        if missing:
+            raise HeteroBatchError(
+                f"skeleton grid audits {missing} have no (protocol, "
+                "dims) mapping entry — the switch must enumerate every "
+                "audit of the skeleton, present in this batch or not"
+            )
+        slashed = sorted(a for a in skeleton.audits if "/" in a)
+        if slashed:
+            raise HeteroBatchError(
+                f"audit key(s) {slashed} contain '/', the checkpoint "
+                "flattener's path separator — packed state keyed by "
+                "them would not survive a checkpoint round trip; "
+                "rename the groups (campaign.manager.hetero_plan maps "
+                "'/' to '_')"
+            )
+        self.skeleton = skeleton
+        self.audits: Tuple[str, ...] = skeleton.audits
+        self.protocols = {a: protocols[a] for a in self.audits}
+        self.dims = {a: dims[a] for a in self.audits}
+        self.fingerprint = skeleton_fingerprint(skeleton)
+        self._key = (
+            self.fingerprint,
+            self.audits,
+            tuple(self.protocols[a] for a in self.audits),
+            tuple(self.dims[a] for a in self.audits),
+        )
+
+    def __hash__(self):
+        return hash(self._key)
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, HeteroBatch) and self._key == other._key
+        )
+
+    def __repr__(self):
+        return (
+            f"HeteroBatch(audits={list(self.audits)}, "
+            f"skeleton={self.fingerprint[:12]}...)"
+        )
+
+
+def _check_unmonitored(monitor_keys: int) -> None:
+    if monitor_keys:
+        raise HeteroBatchError(
+            "monitored fuzz states carry planes outside the proven "
+            "skeleton (monitor gating composes by structure refusal — "
+            "GL602's monitor leg); run monitored batches homogeneous"
+        )
+
+
+# ----------------------------------------------------------------------
+# the switch-dispatched step
+# ----------------------------------------------------------------------
+
+def hetero_switch_step(hb: HeteroBatch, reorder: bool = False,
+                       faults: FaultFlags = NO_FAULTS,
+                       monitor_keys: int = 0):
+    """One packed-state step: ``step(packed_st, packed_ctx) ->
+    packed_st`` dispatching on the lane's ``protocol_id`` plane over
+    one branch per skeleton audit, each branch exactly ``unpack ->
+    _lane_step -> pack`` (the traced composition GL602 proves aval-
+    identical across branches). ``faults`` is the whole mixed batch's
+    capability union — flags select traced graphs, never avals, and a
+    fault-free lane's ctx planes are inert, so every branch compiles
+    the union graph and results stay byte-identical to each lane's
+    homogeneous control (the GL605 pin). The switch enumerates EVERY
+    skeleton audit whether or not this batch carries lanes of it,
+    which is what keeps the traced graph — and therefore the AOT slot
+    hash — a function of the grid, not of one batch's composition."""
+    _check_unmonitored(monitor_keys)
+    skeleton = hb.skeleton
+
+    def make_branch(audit):
+        protocol, dims = hb.protocols[audit], hb.dims[audit]
+
+        def branch(packed_st, packed_cx):
+            st = unpack_state(skeleton, audit, packed_st, xp=jnp)
+            cx = unpack_ctx(skeleton, audit, packed_cx, xp=jnp)
+            out = _lane_step(
+                protocol, dims, st, cx, reorder, faults, monitor_keys
+            )
+            # pack_state re-stamps this branch's own protocol_id — for
+            # the selected branch that is exactly the lane's input id,
+            # so the dispatch plane is a per-lane constant of the run
+            return pack_state(skeleton, audit, out, xp=jnp)
+
+        return branch
+
+    branches = tuple(make_branch(a) for a in skeleton.audits)
+
+    def step(packed_st, packed_cx):
+        return jax.lax.switch(
+            packed_st["protocol_id"], branches, packed_st, packed_cx
+        )
+
+    return step
+
+
+def _running_views(skeleton: Skeleton, faults: FaultFlags):
+    """Build-time proof + view builder for the while-loop condition:
+    every liveness scalar ``_lane_running`` reads must live in a SHARED
+    union slot (same dtype and extent in every audit), so the condition
+    reads it straight off the packed tree with no unpack and no switch.
+    A skeleton that stores one of them any other way is refused by
+    name — the condition would otherwise need per-audit dispatch."""
+    needed = [("state", n) for n in _RUNNING_STATE_PLANES]
+    needed.append(("ctx", "extra_time"))
+    if faults.horizon:
+        needed.append(("ctx", "fault_horizon"))
+    for prefix, name in needed:
+        ent = skeleton.planes.get(f"{prefix}.{name}")
+        verdict = ent["verdict"] if ent else "ABSENT"
+        if verdict != SHARED:
+            raise HeteroBatchError(
+                f"the megabatch loop condition reads {prefix}.{name} "
+                f"from the union's shared slots, but this skeleton "
+                f"stores it as {verdict} — liveness must be SHARED "
+                "across every audit of the grid"
+            )
+
+    def views(packed_st, packed_cx):
+        st = {
+            n: packed_st["shared"][n] for n in _RUNNING_STATE_PLANES
+        }
+        cx = {"extra_time": packed_cx["shared"]["extra_time"]}
+        if faults.horizon:
+            cx["fault_horizon"] = packed_cx["shared"]["fault_horizon"]
+        return st, cx
+
+    return views
+
+
+def cast_packed_planes(packed, narrow: tuple, *, store: bool):
+    """The packed twin of ``engine/core.py cast_state_planes``: cast
+    the SHARED union slots named by ``narrow`` (``("clients/issued",
+    "int16")``-style entries from :func:`hetero_narrow_spec`) to their
+    storage dtype (``store=True``) or back to the i32 union dtype
+    (``store=False``). Only shared slots are ever narrowed (private
+    slots are per-audit storage the native spec already sized), and
+    paths missing from the tree are skipped — result fetches carry
+    only a sub-tree."""
+    if not narrow:
+        return packed
+    shared = dict(packed["shared"])
+    for path, dtname in narrow:
+        sub = path.replace("/", ".")
+        if sub in shared:
+            shared[sub] = shared[sub].astype(
+                dtname if store else jnp.int32
+            )
+    return dict(packed, shared=shared)
+
+
+def hetero_narrow_spec(hb: HeteroBatch,
+                       group_ctxs: Mapping[str, dict]) -> tuple:
+    """The mixed batch's dtype-narrowing spec: the *intersection* of
+    every group's own ``narrow_spec`` (a path every group proves
+    narrowable under its own host-known budget), restricted to planes
+    the skeleton stores in an i32 shared/castable union slot, at the
+    *widest* storage dtype any group chose (each group's bound fits its
+    own dtype, so the widest holds every group exactly). Deterministic
+    (sorted by path) and hashable like the native spec."""
+    per_group = {
+        a: dict(narrow_spec(hb.protocols[a], cx))
+        for a, cx in group_ctxs.items()
+    }
+    if not per_group:
+        return ()
+    paths = set.intersection(*[set(d) for d in per_group.values()])
+    out = []
+    for path in sorted(paths):
+        ent = hb.skeleton.planes.get(
+            "state." + path.replace("/", ".")
+        )
+        if ent is None or ent["verdict"] == PRIVATE:
+            continue
+        if ent["union"]["dtype"] != "int32":
+            continue
+        widest = max(
+            (d[path] for d in per_group.values()),
+            key=lambda dt: np.dtype(dt).itemsize,
+        )
+        out.append((path, widest))
+    return tuple(out)
+
+
+# ----------------------------------------------------------------------
+# segment / window runners — the packed mirrors of engine/core.py's
+# ----------------------------------------------------------------------
+
+def hetero_segment_lane_fn(hb: HeteroBatch, max_steps: int = 1 << 22,
+                           reorder: bool = False,
+                           faults: FaultFlags = NO_FAULTS,
+                           monitor_keys: int = 0, narrow: tuple = ()):
+    """The packed per-lane bounded-segment function:
+    ``run_lane(packed_st, packed_ctx, until) -> (packed_st, running)``
+    with exactly ``segment_lane_fn``'s contract — while-loop over the
+    switch step, narrow storage widened around the step, liveness from
+    the shared views (never a narrowed plane) — so the batched runners
+    below inherit the fixed-point property byte-for-byte."""
+    _check_unmonitored(monitor_keys)
+    step = hetero_switch_step(hb, reorder, faults, monitor_keys)
+    views = _running_views(hb.skeleton, faults)
+    # _lane_running never reads dims (liveness is engine-common); any
+    # audit's dims satisfies its signature
+    dims0 = hb.dims[hb.audits[0]]
+
+    def running(packed_st, packed_cx):
+        st, cx = views(packed_st, packed_cx)
+        return _lane_running(dims0, st, cx, max_steps, faults)
+
+    def run_lane(st, ctx, until):
+        lim = jnp.minimum(until, max_steps)
+
+        def body(s):
+            wide = cast_packed_planes(s, narrow, store=False)
+            out = step(wide, ctx)
+            return cast_packed_planes(out, narrow, store=True)
+
+        out = jax.lax.while_loop(
+            lambda s: running(s, ctx)
+            & (s["shared"]["steps"] < lim),
+            body,
+            st,
+        )
+        return out, running(out, ctx)
+
+    return run_lane
+
+
+def build_hetero_segment_runner(
+    hb: HeteroBatch, max_steps: int = 1 << 22, reorder: bool = False,
+    faults: FaultFlags = NO_FAULTS, monitor_keys: int = 0,
+    narrow: tuple = (), donate: bool = False,
+):
+    """The packed mirror of ``build_segment_runner``: ``runner(state,
+    ctx, until) -> (state, any_alive)`` plus a standalone ``alive``
+    probe, vmapped over the mixed lane batch, one liveness flag riding
+    home per call, ``donate=True`` consuming the input state exactly
+    like the native runner (same GL302 lifetime discipline)."""
+    run_lane = hetero_segment_lane_fn(
+        hb, max_steps, reorder, faults, monitor_keys, narrow=narrow
+    )
+    views = _running_views(hb.skeleton, faults)
+    dims0 = hb.dims[hb.audits[0]]
+
+    def run_batch(st, ctx, until):
+        out, alive = jax.vmap(run_lane, in_axes=(0, 0, None))(
+            st, ctx, until
+        )
+        return out, jnp.any(alive)
+
+    runner = jax.jit(
+        run_batch, donate_argnums=(0,) if donate else ()
+    )
+
+    def lane_alive(s, c):
+        sv, cv = views(s, c)
+        return _lane_running(dims0, sv, cv, max_steps, faults)
+
+    alive = jax.jit(
+        lambda st, ctx: jnp.any(jax.vmap(lane_alive)(st, ctx))
+    )
+    return runner, alive
+
+
+def hetero_window_batch_fn(
+    hb: HeteroBatch, max_steps: int = 1 << 22, reorder: bool = False,
+    faults: FaultFlags = NO_FAULTS, monitor_keys: int = 0,
+    narrow: tuple = (),
+):
+    """The packed mirror of ``window_batch_fn``: a ``lax.scan`` over
+    the batched segment step advancing the mixed batch through a whole
+    ``[W]`` boundary ladder in one device call, liveness carried
+    through the scan — safe for exactly the native reason (a finished
+    batch's dead tail segments are byte-exact no-ops)."""
+    run_lane = hetero_segment_lane_fn(
+        hb, max_steps, reorder, faults, monitor_keys, narrow=narrow
+    )
+
+    def run_window(st, ctx, untils):
+        def seg(carry, until):
+            s, _alive = carry
+            out, running = jax.vmap(run_lane, in_axes=(0, 0, None))(
+                s, ctx, until
+            )
+            return (out, jnp.any(running)), ()
+
+        (out, alive), _ = jax.lax.scan(
+            seg, (st, jnp.asarray(True)), untils
+        )
+        return out, alive
+
+    return run_window
+
+
+def build_hetero_window_runner(
+    hb: HeteroBatch, max_steps: int = 1 << 22, reorder: bool = False,
+    faults: FaultFlags = NO_FAULTS, monitor_keys: int = 0,
+    narrow: tuple = (), donate: bool = False,
+):
+    """The packed mirror of ``build_window_runner`` — the flavor
+    ``parallel/aot.py`` serializes, so ONE artifact format serves every
+    window size of a hetero campaign exactly as it does natively."""
+    run_window = hetero_window_batch_fn(
+        hb, max_steps, reorder, faults, monitor_keys, narrow=narrow
+    )
+    views = _running_views(hb.skeleton, faults)
+    dims0 = hb.dims[hb.audits[0]]
+    runner = jax.jit(
+        run_window, donate_argnums=(0,) if donate else ()
+    )
+
+    def lane_alive(s, c):
+        sv, cv = views(s, c)
+        return _lane_running(dims0, sv, cv, max_steps, faults)
+
+    alive = jax.jit(
+        lambda st, ctx: jnp.any(jax.vmap(lane_alive)(st, ctx))
+    )
+    return runner, alive
+
+
+# ----------------------------------------------------------------------
+# host-side batch preparation
+# ----------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _cached_key_table(C: int, T: int):
+    # hetero's own cache of the batched key-table builder (the engine
+    # layer cannot import parallel/sweep.py's); same bit-identical
+    # keygen contract either way
+    return jax.jit(jax.vmap(key_table_fn(C, T)))
+
+
+def _group_lanes(lane_specs) -> "Dict[str, list]":
+    groups: Dict[str, list] = {}
+    for i, item in enumerate(lane_specs):
+        try:
+            audit, spec = item
+        except (TypeError, ValueError):
+            raise HeteroBatchError(
+                "hetero batches take (group, LaneSpec) pairs — got "
+                f"{type(item).__name__} at lane {i}"
+            ) from None
+        groups.setdefault(str(audit), []).append((i, spec))
+    return groups
+
+
+def _keys_budget_T(group_ctxs: Mapping[str, dict]) -> int:
+    """One key-table seq extent across the whole batch (bit-identical
+    keys whatever T is, so a grid-wide T keeps shapes uniform)."""
+    return int(
+        max(
+            [2]
+            + [
+                int(np.asarray(cx["cmd_budget"]).max()) + 2
+                for cx in group_ctxs.values()
+            ]
+        )
+    )
+
+
+def _lane0_ctx(stacked: Mapping[str, np.ndarray]) -> dict:
+    return {k: np.asarray(v)[0] for k, v in stacked.items()}
+
+
+def _classify_specs(probes: Mapping[str, tuple]) -> Dict[str, dict]:
+    out: Dict[str, dict] = {}
+    for a in sorted(probes):
+        st0, cx0 = probes[a]
+        leaves = {
+            **walk_planes(st0, "state"),
+            **walk_planes(cx0, "ctx"),
+        }
+        out[a] = {
+            n: (tuple(np.shape(v)), str(np.asarray(v).dtype))
+            for n, v in leaves.items()
+        }
+    return out
+
+
+def _group_key_tables(skeleton, audit, dims, ctx_a, T, table_on):
+    """Attach the group's key table (at its native seq extent when a
+    skeleton dictates one) and return the per-lane first-key rows —
+    the same precompute-vs-in-loop contract as the native driver,
+    bit-identical keys either way."""
+    C = dims.C
+    kctx = {k: ctx_a[k] for k in keygen_ctx_fields(ctx_a)}
+    if table_on:
+        T_a = T
+        if skeleton is not None:
+            nat = skeleton.planes["ctx.key_table"]["native"].get(audit)
+            if nat is None:
+                raise SkeletonMismatchError(
+                    f"skeleton carries ctx.key_table but has no native "
+                    f"spec for group {audit!r}"
+                )
+            T_a = int(nat["shape"][1])
+        table = np.asarray(_cached_key_table(C, T_a)(kctx))
+        ctx_a["key_table"] = table
+        return table[:, :, 1]
+    return np.asarray(_cached_key_table(C, 2)(kctx))[:, :, 1]
+
+
+def prepare_batch(
+    protocols: Mapping[str, Any],
+    dims: Mapping[str, Any],
+    lane_specs: Sequence[tuple],
+    *,
+    monitor_keys: int = 0,
+    skeleton: "Skeleton | None" = None,
+    key_table_limit: int = 1 << 24,
+):
+    """Host-side prep for one mixed batch. ``lane_specs`` is the
+    (already padded) ordered ``[(group, LaneSpec), ...]`` list;
+    ``protocols``/``dims`` map every group — and, when ``skeleton`` is
+    given, every skeleton audit — to its device protocol and dims.
+
+    Returns ``(hb, packed_state, packed_ctx, probes, nspec)``:
+    the :class:`HeteroBatch`, the lane-stacked packed state/ctx numpy
+    trees, one native ``(state, ctx)`` probe per group present in the
+    batch (what the GL203 proof and the step signature trace over),
+    and the batch's :func:`hetero_narrow_spec`.
+
+    When ``skeleton`` is None it is derived from the batch itself —
+    each group's lane-0 native trees classified across groups (the
+    same classifier the GL601 ledger pins); the key-table decision
+    then uses this batch's own total (``sum(lanes_g * C_g) * T`` vs
+    ``key_table_limit``). When a skeleton IS given (the campaign path:
+    one grid-wide skeleton for every unit), the key-table decision and
+    per-group seq extents are read off the skeleton so every batch of
+    the grid packs — and traces — identically."""
+    _check_unmonitored(monitor_keys)
+    groups = _group_lanes(lane_specs)
+    order = sorted(groups)
+    for a in order:
+        if a not in protocols or a not in dims:
+            raise HeteroBatchError(
+                f"mixed batch names group {a!r} with no (protocol, "
+                "dims) mapping entry"
+            )
+    if skeleton is not None:
+        stray = sorted(set(order) - set(skeleton.audits))
+        if stray:
+            raise SkeletonMismatchError(
+                f"batch carries groups {stray} outside the skeleton "
+                f"grid {list(skeleton.audits)}"
+            )
+
+    gctx = {
+        a: stack_lanes([s for _, s in groups[a]]) for a in order
+    }
+    T = _keys_budget_T(gctx)
+    if skeleton is not None:
+        table_on = "ctx.key_table" in skeleton.planes
+    else:
+        total = sum(len(groups[a]) * dims[a].C for a in order) * T
+        table_on = total <= key_table_limit
+
+    gstate: Dict[str, list] = {}
+    for a in order:
+        first = _group_key_tables(
+            skeleton, a, dims[a], gctx[a], T, table_on
+        )
+        gstate[a] = [
+            init_lane_state(
+                protocols[a], dims[a], s.ctx, first_keys=first[j],
+                monitor_keys=0,
+            )
+            for j, (_, s) in enumerate(groups[a])
+        ]
+
+    probes = {
+        a: (gstate[a][0], _lane0_ctx(gctx[a])) for a in order
+    }
+    if skeleton is None:
+        skeleton = build_skeleton(
+            classify_planes(_classify_specs(probes)),
+            audits=tuple(order),
+        )
+    hb = HeteroBatch(skeleton, protocols, dims)
+
+    packed: List[tuple] = [None] * len(lane_specs)
+    for a in order:
+        ctx_a = gctx[a]
+        for j, (i, _s) in enumerate(groups[a]):
+            cx = {k: np.asarray(v)[j] for k, v in ctx_a.items()}
+            packed[i] = (
+                pack_state(skeleton, a, gstate[a][j]),
+                pack_ctx(skeleton, a, cx),
+            )
+    state = jax.tree_util.tree_map(
+        lambda *xs: np.stack(xs), *[p[0] for p in packed]
+    )
+    ctx = jax.tree_util.tree_map(
+        lambda *xs: np.stack(xs), *[p[1] for p in packed]
+    )
+    nspec = hetero_narrow_spec(hb, gctx)
+    return hb, state, ctx, probes, nspec
+
+
+def build_grid_skeleton(
+    protocols: Mapping[str, Any],
+    dims: Mapping[str, Any],
+    rep_specs: Mapping[str, Any],
+    *,
+    batch_lanes: int,
+    key_table_limit: int = 1 << 24,
+):
+    """The campaign manager's skeleton builder: classify ONE
+    representative lane per grid group into the grid-wide union, with
+    the key-table decision taken at the campaign's real unit size
+    (``batch_lanes``) so every unit of the grid packs through the same
+    structure whatever its own composition. Returns ``(skeleton,
+    nspec)`` — the grid-wide narrowing spec ships with the skeleton so
+    every unit (and therefore the single AOT slot) narrows
+    identically."""
+    order = sorted(rep_specs)
+    if not order:
+        raise HeteroBatchError("a hetero grid needs at least one group")
+    gctx = {a: stack_lanes([rep_specs[a]]) for a in order}
+    T = _keys_budget_T(gctx)
+    C_max = max(dims[a].C for a in order)
+    table_on = batch_lanes * C_max * T <= key_table_limit
+    probes: Dict[str, tuple] = {}
+    for a in order:
+        first = _group_key_tables(
+            None, a, dims[a], gctx[a], T, table_on
+        )
+        st0 = init_lane_state(
+            protocols[a], dims[a], rep_specs[a].ctx,
+            first_keys=first[0], monitor_keys=0,
+        )
+        probes[a] = (st0, _lane0_ctx(gctx[a]))
+    skeleton = build_skeleton(
+        classify_planes(_classify_specs(probes)),
+        audits=tuple(order),
+    )
+    hb = HeteroBatch(skeleton, protocols, dims)
+    return skeleton, hetero_narrow_spec(hb, gctx)
+
+
+# ----------------------------------------------------------------------
+# signature — checkpoint staleness refusal + AOT slot identity
+# ----------------------------------------------------------------------
+
+def _zero_native_tree(skeleton: Skeleton, audit: str, prefix: str):
+    leaves = {
+        sub: np.zeros(tuple(nat["shape"]), dtype=nat["dtype"])
+        for sub, ent in skeleton.slots(prefix)
+        for a, nat in ent["native"].items()
+        if a == audit
+    }
+    return unflatten_planes(leaves)
+
+
+def hetero_step_signature(
+    hb: HeteroBatch, probes: Mapping[str, tuple], *,
+    reorder: bool, faults: FaultFlags, monitor_keys: int = 0,
+) -> Dict[str, str]:
+    """The hetero twin of ``engine/checkpoint.py step_signature``: one
+    per-audit native signature for EVERY skeleton audit (absent groups
+    trace over zero trees synthesized from the skeleton's native specs
+    — ``make_jaxpr`` reads avals only, so the hash is identical to a
+    probe-backed trace), folded with the skeleton fingerprint into one
+    all-string dict the checkpoint loader and the AOT slot hash consume
+    unchanged. Being composition-independent is the point: every unit
+    of a hetero campaign — whatever lanes it happens to carry — shares
+    one signature and therefore ONE serialized executable."""
+    _check_unmonitored(monitor_keys)
+    parts = {}
+    for a in hb.audits:
+        if a in probes:
+            st0, cx0 = probes[a]
+        else:
+            st0 = _zero_native_tree(hb.skeleton, a, "state")
+            cx0 = _zero_native_tree(hb.skeleton, a, "ctx")
+        parts[a] = step_signature(
+            hb.protocols[a], hb.dims[a], reorder=reorder,
+            faults=faults, monitor_keys=monitor_keys, state=st0,
+            ctx=cx0,
+        )
+    payload = {
+        "skeleton": hb.fingerprint,
+        "audits": {
+            a: {
+                "protocol": parts[a]["protocol"],
+                "dims": parts[a]["dims"],
+                "step_jaxpr_sha256": parts[a]["step_jaxpr_sha256"],
+            }
+            for a in hb.audits
+        },
+    }
+    return {
+        "kind": HETERO_KIND,
+        "protocol": "hetero[" + "+".join(hb.audits) + "]",
+        "dims": "+".join(
+            f"{a}={parts[a]['dims']}" for a in hb.audits
+        ),
+        "skeleton": hb.fingerprint,
+        "jax": jax.__version__,
+        "reorder": repr(bool(reorder)),
+        "faults": repr(faults),
+        "monitor_keys": repr(int(monitor_keys)),
+        "step_jaxpr_sha256": hashlib.sha256(
+            canonical_json(payload).encode()
+        ).hexdigest(),
+    }
+
+
+# ----------------------------------------------------------------------
+# result fetch + collection — inverting the packing at the seam the
+# native driver fetches (same GL301-audited host_fetch site)
+# ----------------------------------------------------------------------
+
+#: the engine-common state planes result collection reads (the packed
+#: twin of the native driver's fetch dict), dotted sub-names
+_RESULT_SUBS = (
+    "clients.completed",
+    "done_time",
+    "err",
+    "fault_dropped",
+    "metrics.hist",
+    "metrics.lat_count",
+    "metrics.lat_sum",
+    "pool_peak",
+    "requeues",
+    "steps",
+)
+
+
+def _result_subs(skeleton: Skeleton, audit: str) -> List[str]:
+    subs = list(_RESULT_SUBS)
+    for sub, ent in skeleton.slots("state"):
+        if sub.startswith("ps.m_") and audit in ent["native"]:
+            subs.append(sub)
+    return subs
+
+
+def result_fetch_tree(hb: HeteroBatch, state) -> dict:
+    """The device-side sub-tree one ``host_fetch`` brings home for
+    result collection: every audit's needed shared slots plus its
+    private ``ps.m_*`` metric slots — the packed mirror of the native
+    driver's ~10-plane fetch dict (never the full ~100 MB state)."""
+    shared: Dict[str, Any] = {}
+    priv: Dict[str, Dict[str, Any]] = {a: {} for a in hb.audits}
+    for a in hb.audits:
+        for sub in _result_subs(hb.skeleton, a):
+            ent = hb.skeleton.planes.get("state." + sub)
+            if ent is None or a not in ent["native"]:
+                continue
+            if ent["verdict"] == PRIVATE:
+                priv[a][sub] = state["priv"][a][sub]
+            else:
+                shared[sub] = state["shared"][sub]
+    return {"shared": shared, "priv": priv}
+
+
+def collect_hetero_results(
+    hb: HeteroBatch, lane_specs: Sequence[tuple], fetched,
+    max_steps: int, narrow: tuple = (),
+):
+    """Invert the packing on the fetched result sub-tree and run each
+    group's lanes through the unchanged native ``collect_results`` —
+    slicing shared slots back to native extents, casting storage back
+    to native dtypes (both exact, the GL604-pinned round-trip), and
+    applying ``finish_segmented`` per group exactly where the native
+    driver applies it. Lane order is the caller's."""
+    from .results import collect_results
+
+    fetched = cast_packed_planes(fetched, narrow, store=False)
+    out: List[Any] = [None] * len(lane_specs)
+    groups = _group_lanes(lane_specs)
+    for a in sorted(groups):
+        items = groups[a]
+        idx = np.asarray([i for i, _ in items])
+        leaves: Dict[str, np.ndarray] = {}
+        for sub in _result_subs(hb.skeleton, a):
+            ent = hb.skeleton.planes.get("state." + sub)
+            if ent is None or a not in ent["native"]:
+                continue
+            nat = ent["native"][a]
+            if ent["verdict"] == PRIVATE:
+                arr = np.asarray(fetched["priv"][a][sub])[idx]
+            else:
+                arr = np.asarray(fetched["shared"][sub])[idx]
+                arr = arr[
+                    (slice(None),)
+                    + tuple(slice(0, d) for d in nat["shape"])
+                ]
+            leaves[sub] = arr.astype(nat["dtype"])
+        tree = finish_segmented(
+            unflatten_planes(leaves), max_steps
+        )
+        res = collect_results(
+            hb.protocols[a], hb.dims[a], tree, [s for _, s in items]
+        )
+        for (i, _), r in zip(items, res):
+            out[i] = r
+    return out

@@ -44,18 +44,41 @@ def _merge_sweep(path: str, spec) -> dict:
     from ..campaign.manager import _RESULTS, _atomic_write, _sweep_batches
 
     batches = _sweep_batches(spec)
-    done = sweep_done_units(read_all_journals(path))
-    missing = [key for key, *_ in batches if key not in done]
-    summary = {
-        "kind": "sweep",
-        "units_total": len(batches),
-        "units_done": len(batches) - len(missing),
-        "merged": not missing,
-        "dir": path,
-    }
-    if missing:
-        summary["missing_units"] = missing[:8]
-        return summary
+    if getattr(spec, "hetero", False):
+        # mixed-unit layout: workers journal under the plan's
+        # `hetero/b<u>` unit ids; the merge regroups the unit rows back
+        # into the homogeneous enumeration, so the merged results.jsonl
+        # is byte-identical to a homogeneous-layout campaign (or merge)
+        # of the same grid
+        from ..campaign.manager import hetero_plan, hetero_regroup
+
+        _protos, _dmap, _reps, units, positions = hetero_plan(spec, batches)
+        done = sweep_done_units(read_all_journals(path))
+        missing = [key for key, _ in units if key not in done]
+        summary = {
+            "kind": "sweep",
+            "units_total": len(units),
+            "units_done": len(units) - len(missing),
+            "merged": not missing,
+            "dir": path,
+        }
+        if missing:
+            summary["missing_units"] = missing[:8]
+            return summary
+        done = hetero_regroup(batches, units, positions, done)
+    else:
+        done = sweep_done_units(read_all_journals(path))
+        missing = [key for key, *_ in batches if key not in done]
+        summary = {
+            "kind": "sweep",
+            "units_total": len(batches),
+            "units_done": len(batches) - len(missing),
+            "merged": not missing,
+            "dir": path,
+        }
+        if missing:
+            summary["missing_units"] = missing[:8]
+            return summary
     from ..engine.checkpoint import canonical_json
 
     lines: List[str] = []

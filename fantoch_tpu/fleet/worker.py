@@ -197,13 +197,34 @@ def _run_sweep_units(path, spec, worker_id, deadline, stop_flag,
     aot_dir = campaign_aot_dir(path, spec)
 
     batches = _sweep_batches(spec)
-    by_key = {key: (dev, dims, lanes) for key, dev, dims, lanes in batches}
+    hetero = bool(getattr(spec, "hetero", False))
+    hetero_kwargs = {}
+    positions = None
+    if hetero:
+        # mixed-unit layout: every worker derives the SAME plan,
+        # skeleton and grid-wide narrow tuple from the stored spec (a
+        # pure function of it), so every unit — whatever its protocol
+        # composition — runs through the one switch-dispatched runner
+        # and the one serialized AOT executable under the shared dir
+        from ..campaign.manager import _hetero_grid
+
+        protos, dmap, units, positions, skeleton, grid_narrow = \
+            _hetero_grid(spec, batches)
+        work = [(key, protos, dmap, lanes) for key, lanes in units]
+        hetero_kwargs = {
+            "hetero": True,
+            "skeleton": skeleton,
+            "narrow": grid_narrow,
+        }
+    else:
+        work = batches
+    by_key = {key: (dev, dims, lanes) for key, dev, dims, lanes in work}
     # work-stealing scan: each worker walks the SAME unit set in a
     # worker-id-rotated order, so early canonical units stop being a
     # contention hot spot (every claim miss is a wasted lease-dir
     # round trip); completion/merge order is unaffected
     scan_keys = worker_scan_order(
-        [key for key, *_ in batches], worker_id
+        [key for key, *_ in work], worker_id
     )
     interrupted = None
     completed = 0
@@ -295,6 +316,7 @@ def _run_sweep_units(path, spec, worker_id, deadline, stop_flag,
                                 spec, "scan_window", None
                             ),
                             aot=aot_dir,
+                            **hetero_kwargs,
                         )
                 except SweepInterrupted as e:
                     # the unit's state is durably checkpointed under
@@ -304,6 +326,12 @@ def _run_sweep_units(path, spec, worker_id, deadline, stop_flag,
                     interrupted = e.reason
                     break
                 rows = [r.to_json() for r in results]
+                if positions is not None:
+                    # drop the final unit's padding rows — only the
+                    # plan's real (batch, lane) rows are journaled, so
+                    # duplicate completions across workers stay
+                    # byte-identical and the merge regroups cleanly
+                    rows = rows[: len(positions[key])]
                 append_worker_journal(
                     path, worker_id,
                     {"kind": "batch", "id": key, "results": rows},
@@ -319,7 +347,7 @@ def _run_sweep_units(path, spec, worker_id, deadline, stop_flag,
                 break
         done = sweep_done_units(read_all_journals(path))
         if interrupted or not pass_completed or all(
-            k in done for k, *_ in batches
+            k in done for k, *_ in work
         ):
             skipped_held = pass_held
             break
@@ -327,12 +355,12 @@ def _run_sweep_units(path, spec, worker_id, deadline, stop_flag,
     return {
         "kind": "sweep",
         "worker": worker_id,
-        "units_total": len(batches),
-        "units_done": sum(1 for k, *_ in batches if k in done),
+        "units_total": len(work),
+        "units_done": sum(1 for k, *_ in work if k in done),
         "units_completed_here": completed,
         "units_held_elsewhere": skipped_held,
         "claim_attempts": claim_attempts,
-        "done": all(k in done for k, *_ in batches),
+        "done": all(k in done for k, *_ in work),
         "interrupted": interrupted,
         "dir": path,
     }

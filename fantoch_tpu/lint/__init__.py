@@ -122,6 +122,7 @@ def run_lint(
     shard_baseline: "dict | None" = None,
     skeleton: bool = False,
     skeleton_baseline: "dict | None" = None,
+    skeleton_mixed: bool = False,
     cache=None,
     progress=None,
 ) -> LintReport:
@@ -298,7 +299,7 @@ def run_lint(
         )
 
     if skeleton:
-        # GL601-GL604 gate against skeleton_baseline.json (findings
+        # GL601-GL605 gate against skeleton_baseline.json (findings
         # exist only on violation — never written to baseline.json);
         # traces at SHARD_SHAPE, shared via the same TraceCache under
         # the shard family's ("shard", audit) keys, so running both
@@ -313,6 +314,7 @@ def run_lint(
             cache=cache,
             baseline=skeleton_baseline,
             progress=say,
+            include_mixed=skeleton_mixed,
         )
         report.extend(findings)
         report.skeleton = summary

@@ -1,4 +1,4 @@
-"""GL601-GL604: the heterogeneous-megabatch skeleton family.
+"""GL601-GL605: the heterogeneous-megabatch skeleton family.
 
 ROADMAP item 1's ``lax.switch`` megabatch packs every protocol's lane
 state into ONE union skeleton (engine/skeleton.py). Done naively that
@@ -45,6 +45,12 @@ skeleton BEFORE the runner exists:
   ``alpha_equivalent``) to the legacy per-protocol step, so existing
   checkpoints, AOT keys and XLA cache entries survive the skeleton
   landing.
+- **GL605 mixed-batch identity pin** — now that the switch runner
+  exists (engine/hetero.py), actually *run* a tiny basic+tempo mixed
+  batch through ``run_sweep(hetero=True)`` and prove every lane's
+  result byte-identical to its homogeneous control run. Gated behind
+  ``include_mixed`` (the skeleton-gate CI job turns it on) because it
+  compiles and executes three runners rather than tracing.
 
 Import cost discipline matches lint/shard.py: module import is
 stdlib-only (bench.py's ``skeleton_waste_ratio`` metric reads the
@@ -861,6 +867,99 @@ def check_no_regression(trace, skeleton) -> List[Finding]:
 
 
 # ----------------------------------------------------------------------
+# GL605: mixed-batch identity pin
+# ----------------------------------------------------------------------
+
+def _gl605_lane(name: str, conflict: int):
+    """One tiny (n=3, 3 clients × 2 commands) lane of ``name`` — small
+    enough that the pin's three compiles stay in CI budget, real enough
+    that the full step (conflict handling included) executes."""
+    from ..core.config import Config
+    from ..core.planet import Planet
+    from ..engine import EngineDims, make_lane
+    from ..engine.protocols import dev_config_kwargs, dev_protocol
+
+    n, clients, commands = 3, 3, 2
+    planet = Planet.new()
+    regions = planet.regions()[:n]
+    total = commands * clients
+    dev = dev_protocol(name, clients)
+    config = Config(**dev_config_kwargs(name, n, 1))
+    dims = EngineDims.for_protocol(
+        dev, n=n, clients=clients, payload=dev.payload_width(n),
+        total_commands=total, dot_slots=total + 1, regions=n,
+    )
+    spec = make_lane(
+        dev, planet, config, conflict_rate=conflict, pool_size=1,
+        commands_per_client=commands, clients_per_region=1,
+        process_regions=regions, client_regions=regions, dims=dims,
+    )
+    return dev, dims, spec
+
+
+def check_mixed_batch(mutate=None, progress=None) -> List[Finding]:
+    """GL605: run a real (tiny) basic+tempo mixed batch through the
+    ``protocol_id``-switched runner (``run_sweep(hetero=True)``) and
+    prove every lane's result byte-identical — canonical JSON — to the
+    same lane's homogeneous control run. GL602 proves the switch *can*
+    be built (aval compatibility); this pin proves what it *computes*:
+    the switch, the packed liveness views, the grid-wide narrowing and
+    the unpacking seam together change no lane's arithmetic. ``mutate``
+    is the selfcheck hook — it corrupts the mixed rows before the
+    compare, proving the gate is not vacuously green."""
+    from ..engine.checkpoint import canonical_json
+    from ..parallel.sweep import run_sweep
+
+    say = progress or (lambda msg: None)
+    protocols: Dict[str, Any] = {}
+    dims: Dict[str, Any] = {}
+    lanes: Dict[str, list] = {}
+    for name in ("basic", "tempo"):
+        dev, d, s0 = _gl605_lane(name, 100)
+        _, _, s1 = _gl605_lane(name, 0)
+        protocols[name], dims[name] = dev, d
+        lanes[name] = [s0, s1]
+    # interleaved composition: the switch must route consecutive lanes
+    # to different branches, the layout the homogeneous path never sees
+    mixed = [
+        ("basic", lanes["basic"][0]),
+        ("tempo", lanes["tempo"][0]),
+        ("basic", lanes["basic"][1]),
+        ("tempo", lanes["tempo"][1]),
+    ]
+    say("skeleton: GL605 running the mixed batch")
+    res = run_sweep(
+        protocols, dims, mixed, hetero=True,
+        max_steps=1 << 20, segment_steps=4096,
+    )
+    rows = [canonical_json(r.to_json()) for r in res]
+    if mutate is not None:
+        rows = mutate(rows)
+    findings: List[Finding] = []
+    positions = {"basic": (0, 2), "tempo": (1, 3)}
+    for name in ("basic", "tempo"):
+        say(f"skeleton: GL605 homogeneous control for {name}")
+        ctrl = run_sweep(
+            protocols[name], dims[name], lanes[name],
+            max_steps=1 << 20, segment_steps=4096,
+        )
+        for ci, mi in enumerate(positions[name]):
+            if rows[mi] != canonical_json(ctrl[ci].to_json()):
+                findings.append(
+                    Finding(
+                        "GL605",
+                        name,
+                        f"lane{mi}",
+                        f"mixed-batch lane {mi} is not byte-identical "
+                        f"to its homogeneous {name} control — the "
+                        "protocol_id switch (or the packed liveness / "
+                        "narrowing seam) changed the lane's arithmetic",
+                    )
+                )
+    return findings
+
+
+# ----------------------------------------------------------------------
 # the driver
 # ----------------------------------------------------------------------
 
@@ -870,8 +969,11 @@ def run_skeleton(
     cache=None,
     baseline: "Dict[str, Any] | None" = None,
     progress=None,
+    include_mixed: bool = False,
 ) -> Tuple[List[Finding], Dict[str, Any]]:
-    """The full GL601-GL604 pass. Narrowed runs (``protocols=``) trace
+    """The full GL601-GL604 pass (plus GL605 with ``include_mixed``,
+    which actually *runs* a tiny mixed batch — the CI gate turns it on,
+    quick local runs keep it off). Narrowed runs (``protocols=``) trace
     only the named audits and take the peers' native specs from the
     checked-in ledger, so the cross-protocol union stays the full
     grid; GL602/GL604 then prove only the live audits (which is why
@@ -950,6 +1052,12 @@ def run_skeleton(
         say(f"skeleton: pinning no-regression for {audit}")
         findings.extend(check_no_regression(traces[audit], skeleton))
 
+    if include_mixed and {"basic", "tempo"} <= set(traces):
+        # narrowed runs missing either audit skip the pin (the CI gate
+        # runs unnarrowed, so it always executes there)
+        say("skeleton: GL605 mixed-batch identity pin")
+        findings.extend(check_mixed_batch(progress=say))
+
     counts = {v: 0 for v in VERDICTS}
     for ent in entries.values():
         counts[ent["verdict"]] += 1
@@ -981,6 +1089,7 @@ _SELFCHECK_FIXTURES = {
     "union": ("skeleton_bad_union.py", "GL601"),
     "branch": ("skeleton_bad_branch.py", "GL602"),
     "pad": ("skeleton_bad_pad.py", "GL603"),
+    "mixed": ("skeleton_bad_mixed.py", "GL605"),
 }
 
 
@@ -1008,12 +1117,20 @@ def run_skeleton_selfcheck(
     specs with one plane's dtype drifted against the real ledger;
     ``branch`` proves a tempo branch against a skeleton whose union
     extent was shrunk below the native extent; ``pad`` budgets the
-    real ledger against an impossible amplification declaration."""
+    real ledger against an impossible amplification declaration;
+    ``mixed`` corrupts a real mixed batch's rows before the GL605
+    compare."""
     from ..engine.dims import SKELETON_GRIDS
     from ..engine.skeleton import build_skeleton, classify_planes
 
     mod, rule = _load_fixture(kind)
     baseline = load_skeleton_baseline()
+    if kind == "mixed":
+        findings = check_mixed_batch(mutate=mod.mutate_rows)
+        findings = [f for f in findings if f.rule == rule]
+        return findings, {
+            "selfcheck_rule": rule, "findings": len(findings),
+        }
     if kind == "union":
         specs = mod.plane_specs()
         entries = classify_planes(specs)

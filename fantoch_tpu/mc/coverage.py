@@ -553,7 +553,8 @@ def discovery_rate(entry: Optional[dict]) -> float:
 def rank_points(points: Sequence[Tuple],
                 progress: Dict[str, dict], schedules: int,
                 min_share: float = MIN_SHARE,
-                retired: Optional[Sequence[str]] = None) -> List[str]:
+                retired: Optional[Sequence[str]] = None,
+                composition: Optional[Dict[str, int]] = None) -> List[str]:
     """Order a campaign's incomplete points for the next chunk of
     budget: starved points first (never tried, or more than
     ``1 - min_share`` behind the most-fuzzed point — the floor that
@@ -563,9 +564,19 @@ def rank_points(points: Sequence[Tuple],
     ``(protocol, n, fault_class)`` triples; ``retired`` keys (plateau
     retirement, docs/MC.md "Standing farm") drop out entirely — their
     counts no longer feed the starvation floor, so their budget
-    recycles into the live grid. Pure function of journaled counters —
-    every resumed session and every fleet worker reading the same
-    journals ranks identically."""
+    recycles into the live grid.
+
+    ``composition`` makes the ranking skeleton-aware for heterogeneous
+    megabatch campaigns: a protocol-name → journaled-lane-count map
+    (the running mixed batch's protocol composition). Among unstarved
+    points, protocols over-represented in the batch rank later — their
+    share of the composition sorts ascending ahead of the discovery
+    rate — so steered points rebalance *within* the mixed batch rather
+    than piling onto the protocol that already fills it. ``None`` (the
+    default, and every homogeneous campaign) leaves the legacy order
+    untouched. Pure function of journaled counters either way — every
+    resumed session and every fleet worker reading the same journals
+    ranks identically."""
     keys = [
         point_key(*p) if len(p) == 2 else point_class_key(*p)
         for p in points
@@ -577,14 +588,21 @@ def rank_points(points: Sequence[Tuple],
     }
     todo = [k for k in keys if tried[k] < schedules]
     floor = min_share * max(tried.values(), default=0)
+    comp_total = sum(int(v) for v in (composition or {}).values())
+
+    def comp_share(k: str) -> float:
+        if not comp_total:
+            return 0.0
+        return int(composition.get(k.split("/", 1)[0], 0)) / comp_total
 
     def order(k: str):
         starved = tried[k] == 0 or tried[k] < floor
         # starved points rank purely by canonical position (the floor
         # is about fairness, not promise); only unstarved points
-        # compete on their discovery rate
+        # compete on composition balance, then their discovery rate
         return (
             0 if starved else 1,
+            0.0 if starved else comp_share(k),
             0.0 if starved else -discovery_rate(progress.get(k)),
             keys.index(k),
         )

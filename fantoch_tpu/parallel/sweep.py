@@ -329,6 +329,29 @@ def _cached_runner(protocol, dims: EngineDims, max_steps: int,
                  faults, monitor_keys, narrow=narrow, donate=donate)
 
 
+@functools.lru_cache(maxsize=None)
+def _cached_hetero_runner(hb, max_steps: int, reorder: bool, faults,
+                          monitor_keys: int = 0, narrow: tuple = (),
+                          donate: bool = False,
+                          windowed: bool = False):
+    """The heterogeneous twin of :func:`_cached_runner`: one compiled
+    switch runner per (:class:`~fantoch_tpu.engine.hetero.HeteroBatch`,
+    max_steps, flags, narrowing, donation, flavor). ``HeteroBatch``
+    hashes by skeleton fingerprint + the protocols' value identity, so
+    every mixed batch of the same grid — whatever its composition —
+    shares ONE compiled executable (the compile-collapse this
+    subsystem exists for)."""
+    from ..engine import hetero as hetero_mod
+
+    build = (
+        hetero_mod.build_hetero_window_runner
+        if windowed
+        else hetero_mod.build_hetero_segment_runner
+    )
+    return build(hb, max_steps, reorder, faults, monitor_keys,
+                 narrow=narrow, donate=donate)
+
+
 def run_sweep(
     protocol,
     dims: EngineDims,
@@ -342,10 +365,11 @@ def run_sweep(
     state_shards: int = 1,
     checkpoint: "CheckpointSpec | str | None" = None,
     pipeline_depth: int = 2,
-    narrow: bool = True,
+    narrow: "bool | tuple" = True,
     scan_window: "int | None" = None,
     aot=None,
     skeleton=None,
+    hetero: bool = False,
 ) -> List[LaneResults]:
     """Run a sweep batch, sharded over ``mesh`` (default: all local
     devices on one axis). The device loop runs in ``segment_steps``
@@ -372,7 +396,13 @@ def run_sweep(
     between steps and widened inside the step, shrinking the bytes
     every while-loop iteration moves through HBM (and every checkpoint
     moves over the tunnel) without touching handler arithmetic —
-    results stay bit-identical to ``narrow=False``.
+    results stay bit-identical to ``narrow=False``. Passing an
+    explicit narrowing *tuple* (``(("clients/issued", "int8"), ...)``
+    — the ``narrow_spec``/``hetero_narrow_spec`` format) pins the
+    storage spec instead of deriving it from this batch's own budgets:
+    the campaign manager uses this so every unit of a grid — whatever
+    its own composition — narrows identically and shares one compiled
+    runner and one AOT slot.
 
     Buffer donation (the segment updating lane state in place instead
     of allocating a second full copy per call) engages automatically
@@ -470,6 +500,24 @@ def run_sweep(
     bytes per lane, so a bounded window for native lanes is not one
     for skeleton lanes.
 
+    ``hetero=True`` is the heterogeneous megabatch mode
+    (engine/hetero.py): ``specs`` becomes an ordered list of
+    ``(group, LaneSpec)`` pairs whose groups may name DIFFERENT
+    protocols, and ``protocol``/``dims`` become mappings from group
+    name to that group's device protocol and dims. The lanes are
+    packed through the union skeleton (passed via ``skeleton``, or
+    derived from this batch when ``None``) and advanced by ONE
+    compiled runner — a ``protocol_id``-routed ``lax.switch`` over
+    every audit's step — so a mixed (protocol × n × conflict × fault ×
+    traffic) batch fills completely and compiles once. Per-lane
+    results are byte-identical to each lane's homogeneous-control run
+    (the GL605 pin). Composes with ``scan_window``, ``pipeline_depth``,
+    ``narrow``, ``checkpoint`` and ``aot`` (one serialized executable
+    per grid); refuses ``mesh_shard``/``state_shards > 1`` (the
+    switch runner is not proven for the explicit 2-D layouts) and
+    ``monitor_keys > 0`` (monitor planes live outside the skeleton)
+    by name.
+
     ``checkpoint`` (a :class:`~fantoch_tpu.engine.checkpoint
     .CheckpointSpec` or a bare path) makes the run durable: the full
     batched state is saved at window boundaries (the existing
@@ -503,7 +551,7 @@ def run_sweep(
             protocol, dims, specs, mesh, max_steps, segment_steps,
             monitor_keys, shard_lanes, mesh_shard, state_shards,
             checkpoint, pipeline_depth, narrow, scan_window, aot,
-            skeleton, mark,
+            skeleton, hetero, mark,
         )
     finally:
         # the per-phase timings land on EVERY exit path — an early
@@ -521,11 +569,28 @@ def run_sweep(
 def _run_sweep(
     protocol, dims, specs, mesh, max_steps, segment_steps, monitor_keys,
     shard_lanes, mesh_shard, state_shards, checkpoint, pipeline_depth,
-    narrow, scan_window, aot, skeleton, mark,
+    narrow, scan_window, aot, skeleton, hetero, mark,
 ) -> List[LaneResults]:
     from . import aot as aot_mod
     from . import partition
 
+    if hetero:
+        from ..engine import hetero as hetero_mod
+        from ..engine.skeleton import Skeleton
+
+        if mesh_shard or state_shards > 1:
+            raise ValueError(
+                "hetero=True runs the protocol_id-switched packed "
+                "runner, which is not proven for the explicit "
+                "mesh_shard / 2-D state-sharded layouts — run those "
+                "grids homogeneous"
+            )
+        if skeleton is not None and not isinstance(skeleton, Skeleton):
+            raise ValueError(
+                "hetero=True packs lanes through the skeleton itself; "
+                "pass the Skeleton object (or None to derive one from "
+                "this batch), not a bare fingerprint string"
+            )
     skeleton_marker = ""
     if skeleton is not None:
         from ..engine.skeleton import Skeleton, skeleton_fingerprint
@@ -536,7 +601,9 @@ def _run_sweep(
             else str(skeleton)
         )
     win = (
-        default_scan_window(segment_steps, skeleton=bool(skeleton_marker))
+        default_scan_window(
+            segment_steps, skeleton=bool(skeleton_marker) or hetero
+        )
         if scan_window is None
         else max(1, int(scan_window))
     )
@@ -600,47 +667,83 @@ def _run_sweep(
     pad = (-len(specs)) % shards
     padded = list(specs) + [specs[-1]] * pad
 
-    ctx = stack_lanes(padded)
-    mark("stack_lanes")
-    # one batched device call precomputes every lane's full
-    # (client, seq) → key table: the engine step gathers keys instead
-    # of re-deriving them with threefry (the dominant per-step cost),
-    # and lane-state init reuses column 1 as each client's first key.
-    # Huge command budgets (the 100k-command stress shape) would
-    # materialize a lanes × clients × budget table, so past the cap the
-    # engine falls back to in-loop gen_key (bit-identical keys).
-    T_keys = int(max(2, ctx["cmd_budget"].max() + 2))
-    kctx = {k: ctx[k] for k in keygen_ctx_fields(ctx)}
-    if len(padded) * dims.C * T_keys <= KEY_TABLE_LIMIT:
-        key_table = np.asarray(_cached_key_table(dims.C, T_keys)(kctx))
-        ctx["key_table"] = key_table
-        first = lambda i: key_table[i, :, 1]
-    else:
-        first_keys = np.asarray(_cached_key_table(dims.C, 2)(kctx))
-        first = lambda i: first_keys[i, :, 1]
-    mark("key_table")
-    states = [
-        init_lane_state(
-            protocol, dims, s.ctx, first_keys=first(i),
-            monitor_keys=monitor_keys,
+    hb = None
+    probes = None
+    bare = [s[1] for s in padded] if hetero else padded
+    if hetero:
+        # the heterogeneous megabatch path: group the mixed lanes by
+        # audit, stack/init each group natively, then pack everything
+        # through the union skeleton (engine/hetero.py prepare_batch —
+        # its own per-group twin of the key-table precompute below,
+        # same bit-identical keygen contract). The returned packed
+        # state/ctx trees ride the UNCHANGED machinery from here on:
+        # device_put, pipelined segment loop, checkpoints, AOT.
+        hb, state, ctx, probes, hetero_nspec = hetero_mod.prepare_batch(
+            protocol, dims, padded, monitor_keys=monitor_keys,
+            skeleton=skeleton, key_table_limit=KEY_TABLE_LIMIT,
         )
-        for i, s in enumerate(padded)
-    ]
-    state = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *states)
-    mark("init+stack_states")
+        skeleton_marker = hb.fingerprint
+        mark("hetero_pack")
+    else:
+        ctx = stack_lanes(padded)
+        mark("stack_lanes")
+        # one batched device call precomputes every lane's full
+        # (client, seq) → key table: the engine step gathers keys
+        # instead of re-deriving them with threefry (the dominant
+        # per-step cost), and lane-state init reuses column 1 as each
+        # client's first key. Huge command budgets (the 100k-command
+        # stress shape) would materialize a lanes × clients × budget
+        # table, so past the cap the engine falls back to in-loop
+        # gen_key (bit-identical keys).
+        T_keys = int(max(2, ctx["cmd_budget"].max() + 2))
+        kctx = {k: ctx[k] for k in keygen_ctx_fields(ctx)}
+        if len(padded) * dims.C * T_keys <= KEY_TABLE_LIMIT:
+            key_table = np.asarray(
+                _cached_key_table(dims.C, T_keys)(kctx)
+            )
+            ctx["key_table"] = key_table
+            first = lambda i: key_table[i, :, 1]
+        else:
+            first_keys = np.asarray(_cached_key_table(dims.C, 2)(kctx))
+            first = lambda i: first_keys[i, :, 1]
+        mark("key_table")
+        states = [
+            init_lane_state(
+                protocol, dims, s.ctx, first_keys=first(i),
+                monitor_keys=monitor_keys,
+            )
+            for i, s in enumerate(padded)
+        ]
+        state = jax.tree_util.tree_map(
+            lambda *xs: np.stack(xs), *states
+        )
+        mark("init+stack_states")
 
-    reorder_flag = batch_reorder_flag(padded)
-    fault_flags = batch_fault_flags(padded)
+    reorder_flag = batch_reorder_flag(bare)
+    fault_flags = batch_fault_flags(bare)
 
     # dtype narrowing (engine/spec.py): storage-narrow the cold counter
     # planes the batch's host-known budgets bound, BEFORE the proof /
     # signature / device_put — every consumer below sees one consistent
     # storage format. The GL203 proof and the checkpoint signature
     # still run on the wide per-lane state: they cover the step
-    # function, which computes in i32 either way.
-    nspec = narrow_spec(protocol, ctx) if narrow else ()
+    # function, which computes in i32 either way. An explicit tuple
+    # pins the spec grid-wide (campaign units must all narrow alike to
+    # share one compiled runner / AOT slot).
+    if isinstance(narrow, tuple):
+        nspec = narrow
+    elif not narrow:
+        nspec = ()
+    elif hetero:
+        nspec = hetero_nspec
+    else:
+        nspec = narrow_spec(protocol, ctx)
     if nspec:
-        state = cast_state_planes(state, nspec, store=True)
+        state = (
+            hetero_mod.cast_packed_planes(state, nspec, store=True)
+            if hetero
+            else cast_state_planes(state, nspec, store=True)
+        )
         mark("narrow")
 
     if shard_lanes or mesh_shard:
@@ -649,12 +752,26 @@ def _run_sweep(
         # shared between the NamedSharding and shard_map layouts, which
         # vmap the identical per-lane function). The proof runs on the
         # exact per-lane (state, ctx) the batched runner sees —
-        # including the key table when present.
-        ctx0 = {k: np.asarray(v)[0] for k, v in ctx.items()}
-        findings = _prove_lane_independent(
-            protocol, dims, reorder_flag,
-            fault_flags, monitor_keys, states[0], ctx0,
-        )
+        # including the key table when present. A hetero batch proves
+        # every GROUP's native step on its own probe: the switch only
+        # composes per-lane functions (unpack → step → pack are all
+        # lane-local), so lane independence of every branch is lane
+        # independence of the switch.
+        if hetero:
+            findings = tuple(
+                f
+                for a in sorted(probes)
+                for f in _prove_lane_independent(
+                    hb.protocols[a], hb.dims[a], reorder_flag,
+                    fault_flags, monitor_keys, *probes[a],
+                )
+            )
+        else:
+            ctx0 = {k: np.asarray(v)[0] for k, v in ctx.items()}
+            findings = _prove_lane_independent(
+                protocol, dims, reorder_flag,
+                fault_flags, monitor_keys, states[0], ctx0,
+            )
         if findings:
             raise LaneMixingError(findings)
         mark("lane_proof")
@@ -711,18 +828,31 @@ def _run_sweep(
     if checkpoint is not None or aot_spec is not None:
         # the per-lane step signature serves double duty: checkpoint
         # staleness refusal AND the AOT executable identity
-        # (parallel/aot.py extends it with the batch-level components)
-        ctx0 = {k: np.asarray(v)[0] for k, v in ctx.items()}
-        sig = step_signature(
-            protocol, dims, reorder=reorder_flag, faults=fault_flags,
-            monitor_keys=monitor_keys, state=states[0], ctx=ctx0,
-        )
+        # (parallel/aot.py extends it with the batch-level components).
+        # The hetero flavor folds EVERY skeleton audit's native
+        # signature (absent groups traced over zero probes — avals
+        # only) with the skeleton fingerprint, so every unit of a grid
+        # shares one signature and therefore one AOT slot.
+        if hetero:
+            sig = hetero_mod.hetero_step_signature(
+                hb, probes, reorder=reorder_flag, faults=fault_flags,
+                monitor_keys=monitor_keys,
+            )
+        else:
+            ctx0 = {k: np.asarray(v)[0] for k, v in ctx.items()}
+            sig = step_signature(
+                protocol, dims, reorder=reorder_flag,
+                faults=fault_flags, monitor_keys=monitor_keys,
+                state=states[0], ctx=ctx0,
+            )
     if checkpoint is not None:
         ck = (
             checkpoint
             if isinstance(checkpoint, CheckpointSpec)
             else CheckpointSpec(path=str(checkpoint))
         )
+        meta_specs = [s[1] for s in specs] if hetero else specs
+        meta_groups = [s[0] for s in specs] if hetero else None
         ckpt_meta = {
             "lanes": len(specs),
             "max_steps": int(max_steps),
@@ -735,7 +865,7 @@ def _run_sweep(
             "traffic": sorted(
                 {
                     (s.traffic_meta or {"name": "flat"})["name"]
-                    for s in specs
+                    for s in meta_specs
                 }
             ),
             # arrival-process names (open-loop client mode), with the
@@ -743,7 +873,7 @@ def _run_sweep(
             "arrivals": sorted(
                 {
                     (s.arrival_meta or {"name": "closed"})["name"]
-                    for s in specs
+                    for s in meta_specs
                 }
             ),
             # the storage-dtype spec of the saved state planes: a
@@ -769,8 +899,17 @@ def _run_sweep(
                     "faults": s.fault_meta,
                     "traffic": s.traffic_meta,
                     "arrivals": s.arrival_meta,
+                    # a mixed batch additionally names each lane's
+                    # group: a resume whose lane→protocol assignment
+                    # drifted is refused by the meta compare, not by a
+                    # garbage switch dispatch
+                    **(
+                        {"group": meta_groups[i]}
+                        if meta_groups is not None
+                        else {}
+                    ),
                 }
-                for s in specs
+                for i, s in enumerate(meta_specs)
             ],
         }
         expect_keys = [
@@ -887,10 +1026,16 @@ def _run_sweep(
             devices=tuple(mesh.devices.flat), window=win,
         )
     elif aot_spec is None:
-        runner, alive = _cached_runner(
-            protocol, dims, max_steps, reorder_flag,
-            fault_flags, monitor_keys, nspec, donate, windowed,
-        )
+        if hetero:
+            runner, alive = _cached_hetero_runner(
+                hb, max_steps, reorder_flag, fault_flags,
+                monitor_keys, nspec, donate, windowed,
+            )
+        else:
+            runner, alive = _cached_runner(
+                protocol, dims, max_steps, reorder_flag,
+                fault_flags, monitor_keys, nspec, donate, windowed,
+            )
     state = put_state(state)
     ctx = put(ctx)
     mark("device_put")
@@ -903,9 +1048,17 @@ def _run_sweep(
         runner = aot_mod.get_runner(
             aot_spec,
             sig,
-            build=lambda: build_window_runner(
-                protocol, dims, max_steps, reorder_flag, fault_flags,
-                monitor_keys, narrow=nspec, donate=donate,
+            build=lambda: (
+                hetero_mod.build_hetero_window_runner(
+                    hb, max_steps, reorder_flag, fault_flags,
+                    monitor_keys, narrow=nspec, donate=donate,
+                )
+                if hetero
+                else build_window_runner(
+                    protocol, dims, max_steps, reorder_flag,
+                    fault_flags, monitor_keys, narrow=nspec,
+                    donate=donate,
+                )
             )[0],
             state=state,
             ctx=ctx,
@@ -1067,39 +1220,55 @@ def _run_sweep(
         discard_checkpoint(ck.path)
     # fetch only what result collection reads (protocol metric fields
     # follow the m_* convention) — the full state is ~100 MB per 512
-    # lanes and the tunnel moves ~30 MB/s
-    fetch = {
-        "metrics": state["metrics"],
-        "steps": state["steps"],
-        "err": state["err"],
-        "done_time": state["done_time"],
-        "clients": {"completed": state["clients"]["completed"]},
-        "pool_peak": state["pool_peak"],
-        "requeues": state["requeues"],
-        "fault_dropped": state["fault_dropped"],
-        "ps": {
-            k: v for k, v in state["ps"].items() if k.startswith("m_")
-        },
-    }
-    if monitor_keys:
-        # the monitor reduction already ran on device: three scalars
-        # per lane (violation bits + first violating step + coverage
-        # digest) ride home instead of [N, K] hash/count planes
-        fetch["viol"] = state["viol"]
-        fetch["viol_step"] = state["viol_step"]
-        fetch["cov"] = state["cov"]
-    final = finish_segmented(
-        host_fetch(fetch, tier="sweep", reason="final results fetch"),
-        max_steps,
-    )
-    # undo the storage narrowing on whatever narrowed planes the fetch
-    # carries: results are ALWAYS the wide i32 arrays the collectors
-    # and the byte-identity contracts predate narrowing with
-    final = cast_state_planes(final, nspec, store=False)
+    # lanes and the tunnel moves ~30 MB/s. The hetero flavor fetches
+    # the packed mirror of the same sub-tree (every group's shared
+    # result slots + private m_* metric slots) through the SAME
+    # GL301-audited choke-point call below.
+    if hetero:
+        fetch = hetero_mod.result_fetch_tree(hb, state)
+    else:
+        fetch = {
+            "metrics": state["metrics"],
+            "steps": state["steps"],
+            "err": state["err"],
+            "done_time": state["done_time"],
+            "clients": {"completed": state["clients"]["completed"]},
+            "pool_peak": state["pool_peak"],
+            "requeues": state["requeues"],
+            "fault_dropped": state["fault_dropped"],
+            "ps": {
+                k: v
+                for k, v in state["ps"].items()
+                if k.startswith("m_")
+            },
+        }
+        if monitor_keys:
+            # the monitor reduction already ran on device: three
+            # scalars per lane (violation bits + first violating step
+            # + coverage digest) ride home instead of [N, K]
+            # hash/count planes
+            fetch["viol"] = state["viol"]
+            fetch["viol_step"] = state["viol_step"]
+            fetch["cov"] = state["cov"]
+    fetched = host_fetch(fetch, tier="sweep", reason="final results fetch")
     mark("host_fetch")
-    # the tail-padding seam: duplicate lanes were computed, but exactly
-    # the caller's specs come back — never a padded twin's results
-    out = collect_results(protocol, dims, final, padded)[: len(specs)]
+    if hetero:
+        # unpack per group back to native planes (exact — the
+        # GL604-pinned round-trip), finish + collect with the
+        # unchanged native collectors; caller order preserved
+        out = hetero_mod.collect_hetero_results(
+            hb, padded, fetched, max_steps, narrow=nspec
+        )[: len(specs)]
+    else:
+        final = finish_segmented(fetched, max_steps)
+        # undo the storage narrowing on whatever narrowed planes the
+        # fetch carries: results are ALWAYS the wide i32 arrays the
+        # collectors and the byte-identity contracts predate
+        # narrowing with
+        final = cast_state_planes(final, nspec, store=False)
+        out = collect_results(protocol, dims, final, padded)[
+            : len(specs)
+        ]
     assert len(out) == len(specs), (
         f"padded sweep returned {len(out)} results for {len(specs)} "
         f"specs (pad={pad}) — padding must never leak"

@@ -70,6 +70,9 @@ TRACED_SCAN_PATHS = (
     # runner — both submit to the traced-discipline scan like shard.py
     "fantoch_tpu/lint/skeleton.py",
     "fantoch_tpu/engine/skeleton.py",
+    # the protocol_id-switched heterogeneous runner: its switch
+    # branches, packed liveness views and casting seams are all traced
+    "fantoch_tpu/engine/hetero.py",
 )
 
 # the host orchestration layers whose device<->host traffic the GL301
@@ -118,6 +121,9 @@ DETERMINISM_SCAN_PATHS = (
     # skeleton.py's fingerprint feeds AOT keys and checkpoint manifests
     "fantoch_tpu/lint/skeleton.py",
     "fantoch_tpu/engine/skeleton.py",
+    # engine/hetero.py's step signature and grid skeleton feed AOT slot
+    # hashes and checkpoint manifests, byte-identity surfaces both
+    "fantoch_tpu/engine/hetero.py",
 )
 
 # fleet worker ids (fantoch_tpu/fleet, docs/FLEET.md) become lease and

@@ -1,0 +1,18 @@
+"""Seeded GL605 defect: a mixed batch whose lanes were mis-routed.
+
+The skeleton selfcheck (``lint --skeleton-selfcheck mixed``) runs the
+REAL tiny basic+tempo mixed batch through the protocol_id-switched
+runner, then lets this fixture swap two lanes' canonical result rows —
+exactly what a switch that routed a lane to the wrong branch (or a
+regroup that inverted the wrong permutation) would produce. The GL605
+compare against the homogeneous controls must fail by name, or the
+mixed-batch identity gate is vacuously green.
+"""
+
+
+def mutate_rows(rows):
+    rows = list(rows)
+    # lane 0 is basic, lane 1 is tempo: swapping them is the smallest
+    # cross-branch mis-route, guaranteed to diverge from both controls
+    rows[0], rows[1] = rows[1], rows[0]
+    return rows

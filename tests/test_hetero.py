@@ -1,0 +1,491 @@
+"""Heterogeneous megabatch engine (engine/hetero.py, run_sweep(hetero=True),
+campaign mixed units, fleet one-executable layout).
+
+The contracts under test:
+
+* a mixed (protocol-switched) batch produces **byte-identical**
+  ``LaneResults`` to each lane's homogeneous control — through the
+  ``protocol_id``-routed ``lax.switch`` over skeleton-packed state, the
+  packed liveness views, and the grid-narrowing seam — composing with
+  ``scan_window``, ``pipeline_depth`` and checkpoints;
+* a single-protocol mixed batch matches the native path byte-exactly
+  (the alpha-equivalence property GL005/GL605 prove at trace level,
+  pinned here at the results level);
+* checkpoint manifests carry the skeleton fingerprint: a foreign-grid
+  resume and a mixed<->homogeneous interchange are refused BY NAME
+  (``skeleton`` / ``kind``), never silently misloaded;
+* ONE AOT slot serves every composition of a grid skeleton — two
+  permuted mixed batches share one serialized executable and stay
+  byte-identical to their controls;
+* ``hetero: true`` campaigns write a ``results.jsonl`` byte-identical
+  to the homogeneous layout (manager, interrupted+resumed, and the
+  fleet-worker + merge path), with exactly one ``aot/exe-*.bin``;
+* refusals: ``stack_lanes`` on structure-mixed lanes, slashed group
+  keys (the checkpoint flattener's separator), monitored batches,
+  ``mesh_shard``/2-D sharded layouts, bare-string skeletons, and
+  ``hetero`` x ``mesh_shard`` campaign specs — all by name;
+* ``hetero_plan``/``hetero_regroup`` are pure functions of
+  (spec, batches): always-full units, pad rows dropped, the inverse
+  permutation hole-free; ``rank_points(composition=...)`` rebalances
+  steering toward under-represented protocols and ``None`` keeps the
+  legacy order byte-stable.
+
+Tier-1 pins basic + tempo at the engine layer plus every host-only
+contract; the full single-shard protocol matrix and the campaign /
+fleet / AOT-slot pins ride in the slow tier — the CI ``hetero-smoke``
+job re-runs the campaign/fleet byte-identity story (with a real
+kill -9) on every push, so tier-1 stays inside its wall-clock budget
+without losing the pin. The sharded variants (tempo/atlas @2shards) are
+deliberately absent: ``hetero=True`` refuses ``mesh_shard`` and
+``state_shards > 1`` (pinned below) — sharded grids run homogeneous.
+"""
+
+import glob
+import json
+import os
+
+import pytest
+
+from fantoch_tpu.campaign import (
+    CampaignError,
+    campaign_from_json,
+    run_campaign,
+)
+from fantoch_tpu.campaign.manager import (
+    _sweep_batches,
+    hetero_plan,
+    hetero_regroup,
+)
+from fantoch_tpu.core import Config, Planet
+from fantoch_tpu.engine import EngineDims, make_lane
+from fantoch_tpu.engine import hetero as hetero_mod
+from fantoch_tpu.engine.checkpoint import (
+    CheckpointMismatchError,
+    CheckpointSpec,
+    SweepInterrupted,
+    canonical_json,
+)
+from fantoch_tpu.engine.hetero import HeteroBatchError
+from fantoch_tpu.engine.protocols import dev_config_kwargs, dev_protocol
+from fantoch_tpu.engine.spec import stack_lanes
+from fantoch_tpu.fleet import merge_campaign, run_fleet_worker
+from fantoch_tpu.mc.coverage import rank_points
+from fantoch_tpu.parallel.sweep import run_sweep
+from fantoch_tpu.registry import DEV_PROTOCOLS
+
+COMMANDS = 2
+MAX = 1 << 20
+
+# mirrors tests/test_campaign.py SWEEP_GRID (plus tempo + aot) so the
+# campaign units reuse the suite's compiled runners; scan_window=1 pins
+# the per-segment ladder the interruption tests count on
+HETERO_GRID = {
+    "kind": "sweep",
+    "protocols": ["basic", "tempo"],
+    "ns": [3],
+    "conflicts": [0, 100],
+    "subsets": 2,
+    "commands_per_client": 2,
+    "batch_lanes": 2,
+    "segment_steps": 8,
+    "scan_window": 1,
+    "aot": True,
+}
+
+
+def _build(name: str, conflict: int = 100):
+    planet = Planet.new()
+    regions = planet.regions()[:3]
+    clients = 3
+    total = COMMANDS * clients
+    dev = dev_protocol(name, clients)
+    config = Config(**dev_config_kwargs(name, 3, 1))
+    dims = EngineDims.for_protocol(
+        dev, n=3, clients=clients, payload=dev.payload_width(3),
+        total_commands=total, dot_slots=total + 1, regions=3,
+    )
+    spec = make_lane(
+        dev, planet, config, conflict_rate=conflict, pool_size=1,
+        commands_per_client=COMMANDS, clients_per_region=1,
+        process_regions=regions, client_regions=regions, dims=dims,
+    )
+    return dev, dims, spec
+
+
+def _grid(names=("basic", "tempo")):
+    """(protocols, dims, specs) maps over ``names``, two conflict
+    points each, plus the canonical interleaved mixed lane list."""
+    protocols, dims, specs = {}, {}, {}
+    for name in names:
+        dev, d, s100 = _build(name)
+        _, _, s0 = _build(name, conflict=0)
+        protocols[name], dims[name], specs[name] = dev, d, [s100, s0]
+    mixed = []
+    for i in range(2):
+        for name in names:
+            mixed.append((name, specs[name][i]))
+    return protocols, dims, specs, mixed
+
+
+def _blob(r) -> str:
+    return canonical_json(r.to_json())
+
+
+def _controls(protocols, dims, specs, **kw):
+    return {
+        name: run_sweep(protocols[name], dims[name], specs[name],
+                        max_steps=MAX, **kw)
+        for name in protocols
+    }
+
+
+# ----------------------------------------------------------------------
+# mixed == homogeneous, byte-exact
+# ----------------------------------------------------------------------
+
+
+def test_mixed_batch_byte_identical_to_homogeneous():
+    protocols, dims, specs, mixed = _grid()
+    res = run_sweep(protocols, dims, mixed, hetero=True,
+                    max_steps=MAX, segment_steps=4096)
+    ctrl = _controls(protocols, dims, specs, segment_steps=4096)
+    for mi, (name, _) in enumerate(mixed):
+        ci = mi // len(protocols)
+        assert _blob(res[mi]) == _blob(ctrl[name][ci]), (
+            f"mixed lane {mi} ({name}) diverged from its homogeneous "
+            "control"
+        )
+
+
+def test_single_protocol_hetero_matches_native():
+    # the GL005/GL605 alpha-equivalence property at the results level:
+    # routing a homogeneous batch through the protocol_id switch
+    # changes nothing about any lane's arithmetic
+    protocols, dims, specs, _ = _grid(("basic",))
+    res = run_sweep(protocols, dims,
+                    [("basic", s) for s in specs["basic"]],
+                    hetero=True, max_steps=MAX, segment_steps=4096)
+    native = run_sweep(protocols["basic"], dims["basic"], specs["basic"],
+                       max_steps=MAX, segment_steps=4096)
+    assert [_blob(r) for r in res] == [_blob(r) for r in native]
+
+
+@pytest.mark.slow
+def test_all_protocols_mixed_byte_identical():
+    # every single-shard dev protocol through ONE switch; the sharded
+    # audits are excluded by construction (hetero refuses mesh_shard /
+    # state_shards > 1 — pinned in test_run_sweep_hetero_refusals)
+    protocols, dims, specs, mixed = _grid(tuple(DEV_PROTOCOLS))
+    res = run_sweep(protocols, dims, mixed, hetero=True,
+                    max_steps=MAX, segment_steps=4096)
+    ctrl = _controls(protocols, dims, specs, segment_steps=4096)
+    for mi, (name, _) in enumerate(mixed):
+        ci = mi // len(protocols)
+        assert _blob(res[mi]) == _blob(ctrl[name][ci])
+
+
+# ----------------------------------------------------------------------
+# composition: windows x pipeline x checkpoints
+# ----------------------------------------------------------------------
+
+
+def test_hetero_composes_with_windows_and_pipeline():
+    protocols, dims, specs, mixed = _grid()
+    base = run_sweep(protocols, dims, mixed, hetero=True,
+                     max_steps=MAX, segment_steps=4096)
+    want = [_blob(r) for r in base]
+    for kw in (
+        {"segment_steps": 64, "scan_window": 1},
+        {"segment_steps": 64, "scan_window": 4},
+        {"segment_steps": 64, "scan_window": 1, "pipeline_depth": 1},
+    ):
+        got = run_sweep(protocols, dims, mixed, hetero=True,
+                        max_steps=MAX, **kw)
+        assert [_blob(r) for r in got] == want, f"diverged under {kw}"
+
+
+def test_hetero_checkpoint_interrupt_resume_byte_identical(tmp_path):
+    protocols, dims, specs, mixed = _grid()
+    base = run_sweep(protocols, dims, mixed, hetero=True,
+                     max_steps=MAX, segment_steps=4096)
+    ck = str(tmp_path / "ck.npz")
+    with pytest.raises(SweepInterrupted):
+        run_sweep(protocols, dims, mixed, hetero=True, max_steps=MAX,
+                  segment_steps=16, scan_window=1,
+                  checkpoint=CheckpointSpec(path=ck,
+                                            stop_after_segments=1))
+    res = run_sweep(protocols, dims, mixed, hetero=True, max_steps=MAX,
+                    segment_steps=16, scan_window=1,
+                    checkpoint=CheckpointSpec(path=ck))
+    assert [_blob(r) for r in res] == [_blob(r) for r in base]
+
+
+def test_foreign_skeleton_and_layout_interchange_refused(tmp_path):
+    protocols, dims, specs, mixed = _grid()
+    ck = str(tmp_path / "ck.npz")
+    with pytest.raises(SweepInterrupted):
+        run_sweep(protocols, dims, mixed, hetero=True, max_steps=MAX,
+                  segment_steps=16, scan_window=1,
+                  checkpoint=CheckpointSpec(path=ck,
+                                            stop_after_segments=1))
+
+    # a WIDER grid skeleton (+fpaxos) is a different union state — the
+    # manifest's fingerprint refuses the resume by name
+    p3, d3, s3, _ = _grid(("basic", "tempo", "fpaxos"))
+    skel, nspec = hetero_mod.build_grid_skeleton(
+        p3, d3, {name: s3[name][0] for name in p3}, batch_lanes=4)
+    with pytest.raises(CheckpointMismatchError, match="skeleton"):
+        run_sweep(p3, d3, mixed, hetero=True, skeleton=skel,
+                  narrow=nspec, max_steps=MAX, segment_steps=16,
+                  scan_window=1, checkpoint=CheckpointSpec(path=ck))
+
+    # mixed -> homogeneous interchange: the native runner refuses the
+    # packed artifact by kind (and vice versa below)
+    with pytest.raises(CheckpointMismatchError, match="kind"):
+        run_sweep(protocols["basic"], dims["basic"],
+                  [specs["basic"][0]] * 4, max_steps=MAX,
+                  segment_steps=16, scan_window=1,
+                  checkpoint=CheckpointSpec(path=ck))
+
+    ck2 = str(tmp_path / "ck2.npz")
+    with pytest.raises(SweepInterrupted):
+        run_sweep(protocols["basic"], dims["basic"],
+                  [specs["basic"][0]] * 4, max_steps=MAX,
+                  segment_steps=16, scan_window=1,
+                  checkpoint=CheckpointSpec(path=ck2,
+                                            stop_after_segments=1))
+    with pytest.raises(CheckpointMismatchError, match="kind"):
+        run_sweep(protocols, dims,
+                  [("basic", specs["basic"][0])] * 4, hetero=True,
+                  max_steps=MAX, segment_steps=16, scan_window=1,
+                  checkpoint=CheckpointSpec(path=ck2))
+
+
+# ----------------------------------------------------------------------
+# one AOT slot per grid skeleton
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_one_aot_slot_serves_permuted_compositions(tmp_path):
+    protocols, dims, specs, mixed = _grid()
+    base = run_sweep(protocols, dims, mixed, hetero=True,
+                     max_steps=MAX, segment_steps=4096)
+    skel, nspec = hetero_mod.build_grid_skeleton(
+        protocols, dims,
+        {name: specs[name][0] for name in protocols}, batch_lanes=4)
+    aot_dir = str(tmp_path / "aot")
+    # mixed2[i] == mixed[perm[i]] — a different composition of the
+    # same grid must hit the SAME serialized executable
+    perm = [1, 3, 0, 2]
+    mixed2 = [mixed[i] for i in perm]
+    r1 = run_sweep(protocols, dims, mixed, hetero=True, skeleton=skel,
+                   narrow=nspec, max_steps=MAX, segment_steps=4096,
+                   aot=aot_dir)
+    r2 = run_sweep(protocols, dims, mixed2, hetero=True, skeleton=skel,
+                   narrow=nspec, max_steps=MAX, segment_steps=4096,
+                   aot=aot_dir)
+    exes = glob.glob(os.path.join(aot_dir, "exe-*.bin"))
+    assert len(exes) == 1, f"expected one executable, got {exes}"
+    assert [_blob(r) for r in r1] == [_blob(r) for r in base]
+    assert [_blob(r) for r in r2] == [_blob(base[i]) for i in perm]
+
+
+# ----------------------------------------------------------------------
+# refusals, by name
+# ----------------------------------------------------------------------
+
+
+def test_stack_lanes_refuses_structure_mixed_lanes():
+    _, _, b = _build("basic")
+    _, _, t = _build("tempo")
+    with pytest.raises(AssertionError, match="cannot share a batch"):
+        stack_lanes([b, t])
+
+
+def test_run_sweep_hetero_refusals():
+    protocols, dims, specs, mixed = _grid()
+    with pytest.raises(ValueError, match="mesh_shard"):
+        run_sweep(protocols, dims, mixed, hetero=True, max_steps=MAX,
+                  segment_steps=64, mesh_shard=True)
+    with pytest.raises(ValueError, match="state-sharded"):
+        run_sweep(protocols, dims, mixed, hetero=True, max_steps=MAX,
+                  segment_steps=64, state_shards=2)
+    with pytest.raises(ValueError, match="bare fingerprint"):
+        run_sweep(protocols, dims, mixed, hetero=True, max_steps=MAX,
+                  segment_steps=64, skeleton="deadbeef" * 8)
+    with pytest.raises(HeteroBatchError, match="monitor"):
+        run_sweep(protocols, dims, mixed, hetero=True, max_steps=MAX,
+                  segment_steps=64, monitor_keys=2)
+
+
+def test_slashed_group_key_refused_by_name():
+    # '/' is the checkpoint flattener's path separator — a packed
+    # state keyed by it would not survive a manifest round trip
+    protocols, dims, specs, _ = _grid(("basic",))
+    with pytest.raises(HeteroBatchError, match="flattener"):
+        hetero_mod.prepare_batch(
+            {"basic/n3": protocols["basic"]},
+            {"basic/n3": dims["basic"]},
+            [("basic/n3", specs["basic"][0])],
+        )
+
+
+def test_campaign_refuses_hetero_mesh_shard():
+    with pytest.raises(CampaignError, match="hetero"):
+        campaign_from_json(
+            dict(HETERO_GRID, aot=False, hetero=True, mesh_shard=True))
+
+
+# ----------------------------------------------------------------------
+# mixed-unit packing: plan/regroup purity
+# ----------------------------------------------------------------------
+
+
+def test_hetero_plan_full_units_and_regroup_inverts():
+    spec = campaign_from_json(dict(HETERO_GRID, hetero=True))
+    batches = _sweep_batches(spec)
+    protos, dmap, reps, units, positions = hetero_plan(spec, batches)
+    again = hetero_plan(spec, batches)
+    assert [k for k, _ in units] == [k for k, _ in again[3]]
+    assert positions == again[4], "hetero_plan must be deterministic"
+
+    B = spec.batch_lanes
+    total = sum(len(lanes) for _, _, _, lanes in batches)
+    assert all(len(lanes) == B for _, lanes in units), (
+        "every mixed unit must be packed full (the last one padded)"
+    )
+    assert sum(len(v) for v in positions.values()) == total, (
+        "positions must index exactly the real (unpadded) rows"
+    )
+    assert all(k.startswith("hetero/b") for k, _ in units)
+    # group keys that reach the packed state are '/'-free
+    assert all("/" not in g for g, _ in units[0][1])
+
+    # regroup inverts the permutation: synthesize per-unit rows that
+    # name their origin, then demand the homogeneous layout back
+    done = {
+        k: [json.dumps([k, i]) for i in range(len(positions[k]))]
+        for k, _ in units
+    }
+    by_batch = hetero_regroup(batches, units, positions, done)
+    assert sorted(by_batch) == sorted(k for k, _, _, lanes in batches)
+    flat = [r for k, _, _, lanes in batches for r in by_batch[k]]
+    assert len(flat) == total and all(r is not None for r in flat)
+
+    # a torn journal (one row short) is a named error, not a hole
+    short = dict(done)
+    first = units[0][0]
+    short[first] = done[first][:-1]
+    with pytest.raises(CampaignError, match="journal"):
+        hetero_regroup(batches, units, positions, short)
+
+
+# ----------------------------------------------------------------------
+# campaign / fleet byte-identity
+# ----------------------------------------------------------------------
+
+
+def _results_bytes(path: str) -> bytes:
+    with open(os.path.join(path, "results.jsonl"), "rb") as fh:
+        return fh.read()
+
+
+@pytest.mark.slow
+def test_hetero_campaign_byte_identical_one_executable(tmp_path):
+    homo = str(tmp_path / "homo")
+    ctrl = run_campaign(homo, campaign_from_json(HETERO_GRID))
+    assert ctrl["done"] and ctrl["errors"] == 0
+
+    het = str(tmp_path / "het")
+    summary = run_campaign(
+        het, campaign_from_json(dict(HETERO_GRID, hetero=True)))
+    assert summary["done"] and summary["errors"] == 0
+
+    control = _results_bytes(homo)
+    assert control and _results_bytes(het) == control
+
+    # the whole mixed grid compiled into ONE serialized executable;
+    # the homogeneous layout needs one per protocol
+    assert len(glob.glob(os.path.join(het, "aot", "exe-*.bin"))) == 1
+    assert len(glob.glob(os.path.join(homo, "aot", "exe-*.bin"))) == 2
+
+    # interrupted + resumed, still byte-identical
+    intr = str(tmp_path / "intr")
+    s1 = run_campaign(intr,
+                      campaign_from_json(dict(HETERO_GRID, hetero=True)),
+                      stop_after_segments=1)
+    assert not s1["done"]
+    s2 = run_campaign(intr, resume=True)
+    assert s2["done"]
+    assert _results_bytes(intr) == control
+
+
+@pytest.mark.slow
+def test_hetero_fleet_merge_byte_identical(tmp_path):
+    homo = str(tmp_path / "homo")
+    run_fleet_worker(homo, campaign_from_json(HETERO_GRID),
+                     worker_id="w1")
+    assert merge_campaign(homo)["merged"]
+    control = _results_bytes(homo)
+    assert control
+
+    fleet = str(tmp_path / "fleet")
+    spec = campaign_from_json(dict(HETERO_GRID, hetero=True))
+    run_fleet_worker(fleet, spec, worker_id="w1", stop_after_units=1)
+    run_fleet_worker(fleet, None, worker_id="w2")
+    assert merge_campaign(fleet)["merged"]
+    assert _results_bytes(fleet) == control
+
+
+# ----------------------------------------------------------------------
+# skeleton-aware steering
+# ----------------------------------------------------------------------
+
+
+def test_rank_points_composition_rebalances():
+    points = [("basic", 3), ("tempo", 3), ("atlas", 3)]
+    # all tried equally, none starved, identical discovery rates —
+    # the legacy order is the canonical enumeration
+    progress = {
+        "basic/n3": {"tried": 5, "cov_recent": [[5, 2]]},
+        "tempo/n3": {"tried": 5, "cov_recent": [[5, 2]]},
+        "atlas/n3": {"tried": 5, "cov_recent": [[5, 2]]},
+    }
+    legacy = rank_points(points, progress, schedules=10)
+    assert legacy == ["basic/n3", "tempo/n3", "atlas/n3"]
+    assert rank_points(points, progress, schedules=10,
+                       composition=None) == legacy
+
+    # a mixed batch over-full of basic: under-represented protocols
+    # rank first among the unstarved
+    ranked = rank_points(points, progress, schedules=10,
+                         composition={"basic": 3, "tempo": 1})
+    assert ranked == ["atlas/n3", "tempo/n3", "basic/n3"]
+
+    # starvation still dominates composition
+    progress["basic/n3"] = {"tried": 0}
+    ranked = rank_points(points, progress, schedules=10,
+                         composition={"basic": 3, "tempo": 1})
+    assert ranked[0] == "basic/n3"
+
+    # determinism: pure function of its (journaled) inputs
+    assert ranked == rank_points(points, dict(progress), schedules=10,
+                                 composition={"basic": 3, "tempo": 1})
+
+
+# ----------------------------------------------------------------------
+# GL605 (slow: compiles and executes three runners)
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_gl605_selfcheck_fires():
+    from fantoch_tpu.lint.skeleton import (
+        check_mixed_batch,
+        run_skeleton_selfcheck,
+    )
+
+    assert check_mixed_batch() == []
+    findings, meta = run_skeleton_selfcheck("mixed")
+    assert findings and all(f.rule == "GL605" for f in findings)
